@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 ImageNet training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (BASELINE.md): the reference's best published ImageNet
+training throughput is Inception-BN bs=512 on 4x Titan X — 2,495 s/epoch
+over 1,281,167 images ≈ 513 img/s total ≈ 128 img/s per GPU
+(example/image-classification/README.md:255). vs_baseline = img/s on ONE
+v5e chip / 128 — i.e. per-chip vs the reference's best per-GPU number on
+its flagship config (the north-star in BASELINE.json: beat the reference's
+own samples/sec on TPU).
+
+The measured program is the framework's fused symbol train step
+(mxnet_tpu.parallel.symbol_trainer): ResNet-50 Symbol graph -> one XLA
+program (fwd+bwd+SGD), bf16 compute / f32 master weights, donated buffers.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S_PER_GPU = 513.0 / 4.0  # ref README.md:255, see docstring
+
+
+def main():
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+
+    import jax
+    import optax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet
+    from mxnet_tpu.parallel.symbol_trainer import make_symbol_train_step
+
+    sym = get_resnet(num_classes=1000, num_layers=50)
+    step, state = make_symbol_train_step(
+        sym,
+        input_shapes={"data": (batch_size, 3, image, image),
+                      "softmax_label": (batch_size,)},
+        optimizer=optax.sgd(0.05, momentum=0.9),
+        compute_dtype="bfloat16",
+    )
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.rand(batch_size, 3, image, image).astype(np.float32)
+        .astype(jax.numpy.bfloat16),
+        "softmax_label": rng.randint(0, 1000, batch_size).astype(np.float32),
+    }
+    key = jax.random.PRNGKey(0)
+
+    for i in range(warmup):
+        key, sub = jax.random.split(key)
+        state, outs = step(state, batch, sub)
+    jax.block_until_ready(state["params"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        key, sub = jax.random.split(key)
+        state, outs = step(state, batch, sub)
+    jax.block_until_ready(state["params"])
+    dt = time.perf_counter() - t0
+
+    img_s = batch_size * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S_PER_GPU, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,474 @@
+"""Executor: bound, compiled computation graph.
+
+TPU-native redesign of GraphExecutor (ref: src/symbol/graph_executor.cc
+1,164 LoC, include/mxnet/symbolic.h:283-391, python/mxnet/executor.py:359).
+
+Mapping of the reference bind pipeline (SURVEY §3.2) onto XLA:
+- InitGraph + MakeBackwardPass (static_graph.cc:395)  → jax.vjp
+- AssignContext / _CrossDeviceCopy (graph_executor.cc:391-490) → per-node
+  jax.device_put placement driven by ctx_group attrs + group2ctx
+- InitDataEntryMemory / GraphStorageAllocator (static planning) → XLA
+  buffer assignment inside jax.jit
+- InitCachedOps / InitOpSegs bulk execution (graph_executor.cc:842) → the
+  whole graph is ONE compiled XLA program (the ultimate bulk segment)
+- Monitor hook (graph_executor.cc:938) → eager per-node replay when a
+  monitor is installed (the reference likewise disables bulk exec then)
+
+Training-step economics: the reference runs forward then backward as two
+engine pushes over shared buffers. Here ``forward(is_train=True)`` runs a
+single fused fwd+bwd XLA program (outputs + gradients), caching gradients
+keyed on argument version counters; ``backward()`` then just writes them
+into ``grad_arrays`` honoring grad_req write/add/null — one compiled
+program per batch, matching the reference's cost model.
+
+grad_req semantics (write/add/null) follow OpReqType kWriteTo/kAddTo/kNullOp
+(ref: include/mxnet/operator.h:43-56).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray, zeros
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+def _as_req_list(grad_req, arg_names):
+    if isinstance(grad_req, str):
+        return [grad_req] * len(arg_names)
+    if isinstance(grad_req, (list, tuple)):
+        return list(grad_req)
+    if isinstance(grad_req, dict):
+        return [grad_req.get(n, "null") for n in arg_names]
+    raise MXNetError("invalid grad_req %r" % (grad_req,))
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        import jax
+
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = dict(group2ctx or {})
+        self._monitor_callback = None
+
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        # -- normalize args ---------------------------------------------------
+        if isinstance(args, dict):
+            missing = [n for n in self._arg_names if n not in args]
+            if missing:
+                raise MXNetError("bind: missing arguments %s" % missing)
+            self.arg_arrays = [args[n] for n in self._arg_names]
+        else:
+            if len(args) != len(self._arg_names):
+                raise MXNetError(
+                    "bind: expected %d args, got %d" % (len(self._arg_names), len(args))
+                )
+            self.arg_arrays = list(args)
+
+        if args_grad is None:
+            self.grad_arrays = [None] * len(self._arg_names)
+        elif isinstance(args_grad, dict):
+            self.grad_arrays = [args_grad.get(n) for n in self._arg_names]
+        else:
+            self.grad_arrays = list(args_grad)
+            while len(self.grad_arrays) < len(self._arg_names):
+                self.grad_arrays.append(None)
+
+        self._reqs = _as_req_list(grad_req, self._arg_names)
+        for i, (g, r) in enumerate(zip(self.grad_arrays, self._reqs)):
+            if g is None and r != "null":
+                self._reqs[i] = "null"
+
+        # -- aux states -------------------------------------------------------
+        if aux_states is None:
+            if self._aux_names:
+                # derive aux shapes from the bound argument shapes
+                shape_kwargs = {
+                    n: a.shape for n, a in zip(self._arg_names, self.arg_arrays)
+                }
+                _, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+                if aux_shapes is None or any(s is None for s in aux_shapes):
+                    raise MXNetError("bind: aux_states required (shapes underdetermined)")
+                self.aux_arrays = [zeros(s, self._ctx) for s in aux_shapes]
+            else:
+                self.aux_arrays = []
+        elif isinstance(aux_states, dict):
+            self.aux_arrays = [aux_states[n] for n in self._aux_names]
+        else:
+            self.aux_arrays = list(aux_states)
+
+        # -- plan -------------------------------------------------------------
+        self._nodes = symbol.nodes
+        self._nid = {id(n): i for i, n in enumerate(self._nodes)}
+        self._var_argidx = {}
+        ai = 0
+        for n in self._nodes:
+            if n.is_variable:
+                self._var_argidx[id(n)] = ai
+                ai += 1
+        self._node_aux = {}
+        pos = 0
+        for n in self._nodes:
+            if n.is_variable:
+                continue
+            na = len(n.op.list_auxiliary_states(n.params))
+            if na:
+                self._node_aux[id(n)] = (pos, pos + na)
+                pos += na
+        self._heads = [(self._nid[id(nd)], i) for nd, i in symbol._outputs]
+        self._head_no_grad = [
+            (not nd.is_variable) and nd.op.no_head_grad for nd, _ in symbol._outputs
+        ]
+        self._grad_idx = [i for i, r in enumerate(self._reqs) if r != "null"]
+
+        # node devices for model parallelism (ctx_group; SURVEY §2.7)
+        self._multi_device = bool(self._group2ctx)
+        self._node_device = {}
+        if self._multi_device:
+            for n in self._nodes:
+                grp = n.attrs.get("ctx_group")
+                c = self._group2ctx.get(grp, self._ctx) if grp else self._ctx
+                self._node_device[id(n)] = c.jax_device
+
+        # jitted entry points (skip jit under multi-device eager pipeline)
+        if self._multi_device:
+            self._fwd_infer = functools.partial(self._run, is_train=False)
+            self._fwd_train = functools.partial(self._run, is_train=True)
+            self._fwd_bwd = self._fwd_bwd_impl
+        else:
+            self._fwd_infer = jax.jit(functools.partial(self._run, is_train=False))
+            self._fwd_train = jax.jit(functools.partial(self._run, is_train=True))
+            self._fwd_bwd = jax.jit(self._fwd_bwd_impl)
+
+        self._outputs_nd = None
+        self._grad_cache = None  # (arg_versions, grads)
+
+    # -- the traced program ----------------------------------------------------
+    def _run(self, arg_vals, aux_vals, rng, is_train):
+        import jax
+
+        env = {}
+        new_aux = list(aux_vals)
+        for serial, n in enumerate(self._nodes):
+            if n.is_variable:
+                v = arg_vals[self._var_argidx[id(n)]]
+                if self._multi_device:
+                    v = jax.device_put(v, self._node_device[id(n)])
+                env[(id(n), 0)] = v
+                continue
+            ins = [env[(id(s), i)] for s, i in n.inputs]
+            if self._multi_device:
+                dev = self._node_device[id(n)]
+                ins = [jax.device_put(x, dev) for x in ins]
+            aux_slice = self._node_aux.get(id(n))
+            aux_in = new_aux[aux_slice[0]:aux_slice[1]] if aux_slice else []
+            node_rng = (
+                jax.random.fold_in(rng, serial)
+                if (n.op.need_rng and rng is not None)
+                else None
+            )
+            outs, n_aux = n.op.apply(n.params, ins, aux_in, is_train, node_rng)
+            for i, o in enumerate(outs):
+                env[(id(n), i)] = o
+            if aux_slice:
+                new_aux[aux_slice[0]:aux_slice[1]] = n_aux
+        outputs = [env[(id(self._nodes[i]), j)] for i, j in self._heads]
+        return outputs, new_aux
+
+    def _fwd_bwd_impl(self, arg_vals, aux_vals, rng, head_grads):
+        import jax
+        import jax.numpy as jnp
+
+        gidx = self._grad_idx
+
+        def f(ga):
+            vals = list(arg_vals)
+            for i, g in zip(gidx, ga):
+                vals[i] = g
+            return self._run(vals, aux_vals, rng, is_train=True)
+
+        ga0 = [arg_vals[i] for i in gidx]
+        (outs, new_aux), vjp_fn = jax.vjp(f, ga0)
+        zero_aux = [jnp.zeros_like(a) for a in new_aux]
+        (grads,) = vjp_fn((list(head_grads), zero_aux))
+        return outs, new_aux, grads
+
+    # -- helpers ---------------------------------------------------------------
+    def _arg_vals(self):
+        return [a._data for a in self.arg_arrays]
+
+    def _aux_vals(self):
+        return [a._data for a in self.aux_arrays]
+
+    def _default_head_grads(self):
+        import jax.numpy as jnp
+
+        hg = []
+        for (nidx, oidx), no_grad in zip(self._heads, self._head_no_grad):
+            # shapes come from last outputs; ones for loss ops, zeros otherwise
+            shape_src = self._outputs_nd[len(hg)] if self._outputs_nd else None
+            if shape_src is None:
+                raise MXNetError("backward before forward")
+            fill = 1.0 if no_grad else 0.0
+            hg.append(jnp.full(shape_src.shape, fill, dtype=shape_src.dtype))
+        return hg
+
+    def _versions(self):
+        return tuple(a.version for a in self.arg_arrays) + tuple(
+            a.version for a in self.aux_arrays
+        )
+
+    def _write_outputs(self, outs):
+        if self._outputs_nd is None:
+            self._outputs_nd = [NDArray(o, self._ctx) for o in outs]
+        else:
+            for nd, o in zip(self._outputs_nd, outs):
+                nd._set_data(o)
+
+    def _write_aux(self, new_aux):
+        for nd, v in zip(self.aux_arrays, new_aux):
+            nd._set_data(v)
+
+    def _monitor_replay(self, is_train):
+        """Eager per-node replay invoking the monitor callback per output
+        (ref: graph_executor.cc:938-955 + monitor install disabling bulk)."""
+        import jax
+
+        env = {}
+        aux_vals = self._aux_vals()
+        arg_vals = self._arg_vals()
+        rng = _random.next_key()
+        for serial, n in enumerate(self._nodes):
+            if n.is_variable:
+                env[(id(n), 0)] = arg_vals[self._var_argidx[id(n)]]
+                continue
+            ins = [env[(id(s), i)] for s, i in n.inputs]
+            aux_slice = self._node_aux.get(id(n))
+            aux_in = aux_vals[aux_slice[0]:aux_slice[1]] if aux_slice else []
+            node_rng = jax.random.fold_in(rng, serial) if n.op.need_rng else None
+            outs, _ = n.op.apply(n.params, ins, aux_in, is_train, node_rng)
+            onames = n.op.list_outputs(n.params)
+            for i, o in enumerate(outs):
+                env[(id(n), i)] = o
+                self._monitor_callback(
+                    "%s_%s" % (n.name, onames[i]), NDArray(o, self._ctx)
+                )
+
+    # -- public API ------------------------------------------------------------
+    @property
+    def outputs(self):
+        """ref: python/mxnet/executor.py outputs property."""
+        if self._outputs_nd is None:
+            self.forward(is_train=False)
+        return self._outputs_nd
+
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    def forward(self, is_train=False, **kwargs):
+        """ref: python/mxnet/executor.py:118 / GraphExecutor::Forward."""
+        if kwargs:
+            arg_dict = self.arg_dict
+            for k, v in kwargs.items():
+                if k not in arg_dict:
+                    raise MXNetError("forward: unknown argument %s" % k)
+                if isinstance(v, NDArray):
+                    v.copyto(arg_dict[k])
+                else:
+                    arg_dict[k][:] = v
+        if self._monitor_callback is not None:
+            self._monitor_replay(is_train)
+
+        rng = _random.next_key() if is_train else None
+        if is_train and self._grad_idx:
+            # fused fwd+bwd program; gradients cached for backward()
+            self._outputs_shape_probe()
+            hg = self._default_head_grads()
+            outs, new_aux, grads = self._fwd_bwd(
+                self._arg_vals(), self._aux_vals(), rng, hg
+            )
+            self._write_outputs(outs)
+            self._write_aux(new_aux)
+            self._grad_cache = (self._versions(), grads)
+        else:
+            outs, new_aux = (
+                self._fwd_train(self._arg_vals(), self._aux_vals(), rng)
+                if is_train
+                else self._fwd_infer(self._arg_vals(), self._aux_vals(), None)
+            )
+            self._write_outputs(outs)
+            if is_train:
+                self._write_aux(new_aux)
+            self._grad_cache = None
+        return self.outputs
+
+    def _outputs_shape_probe(self):
+        """Populate output shapes once (needed for default head grads)."""
+        if self._outputs_nd is None:
+            outs, _ = self._fwd_infer(self._arg_vals(), self._aux_vals(), None)
+            self._write_outputs(outs)
+
+    def backward(self, out_grads=None):
+        """ref: python/mxnet/executor.py:148 / GraphExecutor::Backward.
+        With no out_grads, heads must be loss ops (no_head_grad) — the
+        reference asserts the same (graph_executor.cc head_grad handling)."""
+        import jax.numpy as jnp
+
+        if not self._grad_idx:
+            return
+        if out_grads is None:
+            if not all(self._head_no_grad):
+                raise MXNetError(
+                    "backward() without out_grads requires loss-op heads; "
+                    "pass out_grads for outputs %s"
+                    % [n for n, ng in zip(self._output_names, self._head_no_grad) if not ng]
+                )
+            if self._grad_cache is not None and self._grad_cache[0] == self._versions():
+                grads = self._grad_cache[1]
+                self._apply_grads(grads)
+                return
+            hg = self._default_head_grads()
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            if isinstance(out_grads, dict):
+                out_grads = [out_grads[n] for n in self._output_names]
+            hg = [
+                (g._data if isinstance(g, NDArray) else jnp.asarray(g))
+                for g in out_grads
+            ]
+        rng = _random.next_key()
+        outs, new_aux, grads = self._fwd_bwd(
+            self._arg_vals(), self._aux_vals(), rng, hg
+        )
+        self._write_outputs(outs)
+        self._apply_grads(grads)
+
+    def _apply_grads(self, grads):
+        for slot, i in enumerate(self._grad_idx):
+            g = grads[slot]
+            tgt = self.grad_arrays[i]
+            req = self._reqs[i]
+            if req == "write":
+                tgt._set_data(g.astype(tgt._data.dtype))
+            elif req == "add":
+                tgt._set_data(tgt._data + g.astype(tgt._data.dtype))
+
+    def copy_params_from(self, arg_params, aux_params=None, allow_extra_params=False):
+        """ref: python/mxnet/executor.py:211."""
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("copy_params_from: %s not an argument" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    arr.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("copy_params_from: %s not an aux state" % name)
+
+    def set_monitor_callback(self, callback):
+        """ref: python/mxnet/executor.py:86 / MXExecutorSetMonitorCallback."""
+        self._monitor_callback = callback
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes sharing parameter arrays — the analog of
+        bucketing's shared-memory rebind (ref: graph_executor.h:50 shared_exec)."""
+        new_shapes = {}
+        arg_shapes, _, _ = self._symbol.infer_shape_partial(**kwargs)
+        arg_dict = self.arg_dict
+        new_args = {}
+        for name, s in zip(self._arg_names, arg_shapes):
+            cur = arg_dict[name]
+            if s is not None and tuple(s) != cur.shape:
+                new_args[name] = zeros(s, cur.context, cur.dtype)
+            else:
+                new_args[name] = cur
+        grads = {
+            n: (g if g is not None else None)
+            for n, g in zip(self._arg_names, self.grad_arrays)
+        }
+        new_grads = {}
+        for n, g in grads.items():
+            if g is None:
+                continue
+            tgt_shape = new_args[n].shape
+            new_grads[n] = g if g.shape == tgt_shape else zeros(tgt_shape, g.context, g.dtype)
+        return Executor(
+            self._symbol, self._ctx, new_args,
+            args_grad=new_grads or None,
+            grad_req={n: r for n, r in zip(self._arg_names, self._reqs)},
+            aux_states=self.aux_arrays, group2ctx=self._group2ctx,
+        )
+
+    def debug_str(self):
+        return self._symbol.debug_str()
+
+    # -- simple_bind -----------------------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                     group2ctx=None, shared_exec=None, **kwargs):
+        """ref: python/mxnet/symbol.py:635 simple_bind — allocate all
+        argument/grad/aux arrays from inferred shapes."""
+        import numpy as np
+
+        ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("simple_bind: cannot infer shapes from %s" % kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_types, _, aux_types = symbol.infer_type(
+            **{k: v for k, v in (type_dict or {}).items()}
+        )
+        # reuse shared_exec buffers when shapes match (bucketing memory share)
+        shared_args = shared_exec.arg_dict if shared_exec is not None else {}
+        args = {}
+        for name, shape, t in zip(arg_names, arg_shapes, arg_types):
+            if name in shared_args and shared_args[name].shape == tuple(shape):
+                args[name] = shared_args[name]
+            else:
+                args[name] = zeros(shape, ctx, dtype=t)
+        reqs = _as_req_list(grad_req, arg_names)
+        args_grad = {}
+        for name, shape, t, r in zip(arg_names, arg_shapes, arg_types, reqs):
+            if r != "null":
+                args_grad[name] = zeros(shape, ctx, dtype=t)
+        aux_states = []
+        for i, (name, shape, t) in enumerate(zip(aux_names, aux_shapes, aux_types)):
+            # default aux init: variance-like states to 1 (ref: initializer.py
+            # _init_one for moving_var), others 0
+            if "var" in name:
+                from .ndarray import ones as _ones
+
+                aux_states.append(_ones(shape, ctx, dtype=t))
+            else:
+                aux_states.append(zeros(shape, ctx, dtype=t))
+        return Executor(
+            symbol, ctx, args, args_grad=args_grad or None, grad_req=grad_req,
+            aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec,
+        )

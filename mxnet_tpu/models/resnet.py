@@ -1,0 +1,63 @@
+"""ResNet (v1) — baseline config 2, the bench.py flagship
+(ref: example/image-classification/symbol_resnet.py; arch per He et al.).
+Built bf16-friendly: convs accumulate f32 (ops/nn.py), BN in f32.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def _conv_bn(data, num_filter, kernel, stride, pad, name, act=True):
+    conv = sym.Convolution(
+        data=data, num_filter=num_filter, kernel=kernel, stride=stride, pad=pad,
+        no_bias=True, name=name + "_conv",
+    )
+    bn = sym.BatchNorm(data=conv, fix_gamma=False, eps=2e-5, momentum=0.9,
+                       name=name + "_bn")
+    if act:
+        return sym.Activation(data=bn, act_type="relu", name=name + "_relu")
+    return bn
+
+
+def _bottleneck(data, num_filter, stride, dim_match, name):
+    b1 = _conv_bn(data, num_filter // 4, (1, 1), (1, 1), (0, 0), name + "_branch2a")
+    b2 = _conv_bn(b1, num_filter // 4, (3, 3), stride, (1, 1), name + "_branch2b")
+    b3 = _conv_bn(b2, num_filter, (1, 1), (1, 1), (0, 0), name + "_branch2c", act=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv_bn(
+            data, num_filter, (1, 1), stride, (0, 0), name + "_branch1", act=False
+        )
+    fused = b3 + shortcut
+    return sym.Activation(data=fused, act_type="relu", name=name + "_relu")
+
+
+def get_resnet(num_classes=1000, num_layers=50):
+    """ResNet-50/101/152 v1 for 224x224 input."""
+    if num_layers == 50:
+        units = [3, 4, 6, 3]
+    elif num_layers == 101:
+        units = [3, 4, 23, 3]
+    elif num_layers == 152:
+        units = [3, 8, 36, 3]
+    else:
+        raise ValueError("unsupported num_layers %d" % num_layers)
+    filters = [256, 512, 1024, 2048]
+
+    data = sym.Variable("data")
+    body = _conv_bn(data, 64, (7, 7), (2, 2), (3, 3), "conv0")
+    body = sym.Pooling(
+        data=body, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max",
+        name="pool0",
+    )
+    for stage, (n, f) in enumerate(zip(units, filters)):
+        stride = (1, 1) if stage == 0 else (2, 2)
+        body = _bottleneck(body, f, stride, False, "stage%d_unit1" % (stage + 1))
+        for i in range(2, n + 1):
+            body = _bottleneck(body, f, (1, 1), True, "stage%d_unit%d" % (stage + 1, i))
+    pool = sym.Pooling(data=body, global_pool=True, kernel=(7, 7), pool_type="avg",
+                       name="pool1")
+    flat = sym.Flatten(data=pool)
+    fc = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(data=fc, name="softmax")

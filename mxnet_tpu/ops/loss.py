@@ -1,0 +1,265 @@
+"""Output/loss ops with loss-layer backward semantics.
+
+TPU-native redesign of the reference output layers (ref:
+src/operator/softmax_output-inl.h:386, regression_output-inl.h,
+svm_output-inl.h, make_loss-inl.h). These ops are special in the reference:
+their Backward *ignores the incoming out_grad* and writes the loss gradient
+directly (e.g. softmax - onehot(label)). We reproduce that with
+``jax.custom_vjp`` closures: the executor seeds their cotangent with ones
+and the custom bwd substitutes the loss gradient, so `Executor.backward()`
+with no head gradients behaves exactly like the reference
+(SURVEY §2.5, include/mxnet/operator.h DeclareBackwardDependency).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import Field, OpDef, register
+
+
+def _softmax_output_factory(params):
+    grad_scale = params["grad_scale"]
+    ignore_label = params["ignore_label"]
+    use_ignore = params["use_ignore"]
+    multi_output = params["multi_output"]
+    preserve_shape = params["preserve_shape"]
+    normalization = params["normalization"]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _forward(data)
+
+    def _forward(data):
+        if multi_output:
+            return jax.nn.softmax(data, axis=1)
+        if preserve_shape:
+            return jax.nn.softmax(data, axis=-1)
+        n = data.shape[0]
+        return jax.nn.softmax(data.reshape(n, -1), axis=-1).reshape(data.shape)
+
+    def fwd(data, label):
+        return f(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g  # loss-layer semantics: out_grad ignored (ref: softmax_output-inl.h Backward)
+        prob = _forward(data)
+        if multi_output:
+            c = data.shape[1]
+            lab = label.astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
+            # move class axis of onehot (last) to axis 1
+            onehot = jnp.moveaxis(onehot, -1, 1)
+            grad = prob - onehot
+            valid = jnp.not_equal(label, ignore_label)
+            if use_ignore:
+                grad = grad * valid.astype(data.dtype)[:, None]
+            denom = 1.0
+            if normalization == "batch":
+                denom = float(_np.prod(label.shape))
+            elif normalization == "valid":
+                denom = jnp.maximum(jnp.sum(valid.astype(data.dtype)), 1.0)
+            grad = grad * (grad_scale / denom)
+        else:
+            n = data.shape[0]
+            flat = data.reshape(n, -1)
+            c = flat.shape[1]
+            lab = label.reshape(n).astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
+            grad = jax.nn.softmax(flat, axis=-1) - onehot
+            valid = jnp.not_equal(label.reshape(n), ignore_label)
+            if use_ignore:
+                grad = grad * valid.astype(data.dtype)[:, None]
+            denom = 1.0
+            if normalization == "batch":
+                denom = float(n)
+            elif normalization == "valid":
+                denom = jnp.maximum(jnp.sum(valid.astype(data.dtype)), 1.0)
+            grad = (grad * (grad_scale / denom)).reshape(data.shape)
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _softmax_output_fwd(params, inputs, aux, is_train, rng):
+    f = _softmax_output_factory(params)
+    return [f(inputs[0], inputs[1])], []
+
+
+def _softmax_output_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("SoftmaxOutput: data shape unknown")
+    d = in_shapes[0]
+    if params["multi_output"]:
+        lshape = (d[0],) + d[2:]
+    else:
+        lshape = (d[0],)
+    return [d, lshape], [d], []
+
+
+_SOFTMAX_PARAMS = {
+    "grad_scale": Field("float", default=1.0),
+    "ignore_label": Field("float", default=-1.0),
+    "multi_output": Field("bool", default=False),
+    "use_ignore": Field("bool", default=False),
+    "preserve_shape": Field("bool", default=False),
+    "normalization": Field("str", default="null", enum=["null", "batch", "valid"]),
+    "out_grad": Field("bool", default=False),
+}
+
+register(
+    OpDef(
+        "SoftmaxOutput",
+        _softmax_output_fwd,
+        params=dict(_SOFTMAX_PARAMS),
+        arguments=("data", "label"),
+        infer_shape=_softmax_output_shape,
+        no_head_grad=True,
+    )
+)
+
+# deprecated alias (ref: src/operator/softmax_output.cc registers "Softmax" too)
+from .registry import REGISTRY as _R
+
+_R["Softmax"] = _R["SoftmaxOutput"]
+
+
+def _regression_factory(grad_fn, act_fn, grad_scale):
+    @jax.custom_vjp
+    def f(data, label):
+        return act_fn(data)
+
+    def fwd(data, label):
+        return f(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g
+        out = act_fn(data)
+        n = data.shape[0]
+        grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / 1.0)
+        return grad.astype(data.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _make_regression(name, act_fn, grad_fn):
+    """ref: src/operator/regression_output-inl.h — grad = f(out) - label
+    family, Backward ignores out_grad."""
+
+    def op_fwd(params, inputs, aux, is_train, rng):
+        f = _regression_factory(grad_fn, act_fn, params["grad_scale"])
+        return [f(inputs[0], inputs[1])], []
+
+    def ishape(params, in_shapes):
+        if in_shapes[0] is None:
+            raise MXNetError("%s: data shape unknown" % name)
+        return [in_shapes[0], in_shapes[0]], [in_shapes[0]], []
+
+    register(
+        OpDef(
+            name,
+            op_fwd,
+            params={"grad_scale": Field("float", default=1.0)},
+            arguments=("data", "label"),
+            infer_shape=ishape,
+            no_head_grad=True,
+        )
+    )
+
+
+_make_regression(
+    "LinearRegressionOutput", lambda x: x, lambda out, label: out - label
+)
+_make_regression(
+    "MAERegressionOutput", lambda x: x, lambda out, label: jnp.sign(out - label)
+)
+_make_regression(
+    "LogisticRegressionOutput", jax.nn.sigmoid, lambda out, label: out - label
+)
+
+
+# -- MakeLoss (ref: src/operator/make_loss-inl.h) ------------------------------
+def _make_loss_fwd(params, inputs, aux, is_train, rng):
+    grad_scale = params["grad_scale"]
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x  # residual only to carry shape+dtype for the cotangent
+
+    def bwd(res, g):
+        del g
+        return (jnp.full_like(res, grad_scale),)
+
+    f.defvjp(fwd, bwd)
+    return [f(inputs[0])], []
+
+
+register(
+    OpDef(
+        "MakeLoss",
+        _make_loss_fwd,
+        params={
+            "grad_scale": Field("float", default=1.0),
+            "valid_thresh": Field("float", default=0.0),
+            "normalization": Field("str", default="null", enum=["null", "batch", "valid"]),
+        },
+        no_head_grad=True,
+    )
+)
+
+
+# -- SVMOutput (ref: src/operator/svm_output-inl.h) ----------------------------
+def _svm_output_fwd(params, inputs, aux, is_train, rng):
+    margin = params["margin"]
+    reg = params["regularization_coefficient"]
+    use_linear = params["use_linear"]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g
+        n, c = data.shape[0], data.shape[1]
+        lab = label.reshape(n).astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
+        score_correct = jnp.sum(data * onehot, axis=1, keepdims=True)
+        if use_linear:  # L1-SVM hinge
+            viol = ((data - score_correct + margin) > 0).astype(data.dtype) * (1 - onehot)
+            grad = viol - onehot * jnp.sum(viol, axis=1, keepdims=True)
+        else:  # L2-SVM squared hinge
+            m = jnp.maximum(0.0, data - score_correct + margin) * (1 - onehot)
+            grad = 2.0 * m - onehot * jnp.sum(2.0 * m, axis=1, keepdims=True)
+        return (reg * grad).astype(data.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return [f(inputs[0], inputs[1])], []
+
+
+register(
+    OpDef(
+        "SVMOutput",
+        _svm_output_fwd,
+        params={
+            "margin": Field("float", default=1.0),
+            "regularization_coefficient": Field("float", default=1.0),
+            "use_linear": Field("bool", default=False),
+        },
+        arguments=("data", "label"),
+        infer_shape=lambda p, s: ([s[0], (s[0][0],)], [s[0]], []),
+        no_head_grad=True,
+    )
+)

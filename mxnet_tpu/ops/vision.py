@@ -1,0 +1,147 @@
+"""Vision ops: ROIPooling, SpatialTransformer.
+
+TPU-native redesign of src/operator/roi_pooling-inl.h and
+spatial_transformer-inl.h. The reference uses scatter-style CUDA kernels
+with argmax bookkeeping for backward; here both are expressed as masked
+reductions / gathers over static shapes so XLA can vectorise them on the
+VPU and jax.vjp derives the backward (scatter-add) automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import Field, OpDef, register
+
+
+# -- ROIPooling (ref: src/operator/roi_pooling-inl.h) --------------------------
+def _roi_pool_one(data, roi, pooled_h, pooled_w, spatial_scale):
+    # roi: [batch_idx, x1, y1, x2, y2]
+    H, W = data.shape[2], data.shape[3]
+    batch_idx = roi[0].astype(jnp.int32)
+    x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+    img = data[batch_idx]  # (C, H, W)
+    ys = jnp.arange(H)
+    xs = jnp.arange(W)
+    bins = []
+    for ph in range(pooled_h):
+        hstart = y1 + (ph * rh) // pooled_h
+        hend = y1 + ((ph + 1) * rh + pooled_h - 1) // pooled_h
+        row_mask = (ys >= hstart) & (ys < jnp.maximum(hend, hstart + 1))
+        row = []
+        for pw in range(pooled_w):
+            wstart = x1 + (pw * rw) // pooled_w
+            wend = x1 + ((pw + 1) * rw + pooled_w - 1) // pooled_w
+            col_mask = (xs >= wstart) & (xs < jnp.maximum(wend, wstart + 1))
+            mask = row_mask[:, None] & col_mask[None, :]
+            masked = jnp.where(mask[None, :, :], img, -jnp.inf)
+            v = jnp.max(masked, axis=(1, 2))
+            v = jnp.where(jnp.isfinite(v), v, 0.0)
+            row.append(v)
+        bins.append(jnp.stack(row, axis=-1))
+    return jnp.stack(bins, axis=-2)  # (C, ph, pw)
+
+
+def _roi_pooling_fwd(params, inputs, aux, is_train, rng):
+    data, rois = inputs
+    ph, pw = params["pooled_size"]
+    scale = params["spatial_scale"]
+    out = jax.vmap(lambda r: _roi_pool_one(data, r, ph, pw, scale))(rois)
+    return [out.astype(data.dtype)], []
+
+
+def _roi_pooling_shape(params, in_shapes):
+    if in_shapes[0] is None or in_shapes[1] is None:
+        raise MXNetError("ROIPooling: input shapes unknown")
+    ph, pw = params["pooled_size"]
+    nroi = in_shapes[1][0]
+    return list(in_shapes), [(nroi, in_shapes[0][1], ph, pw)], []
+
+
+register(
+    OpDef(
+        "ROIPooling",
+        _roi_pooling_fwd,
+        params={
+            "pooled_size": Field("shape", required=True),
+            "spatial_scale": Field("float", required=True),
+        },
+        arguments=("data", "rois"),
+        infer_shape=_roi_pooling_shape,
+    )
+)
+
+
+# -- SpatialTransformer (ref: src/operator/spatial_transformer-inl.h) ----------
+def _bilinear_sample(img, gx, gy):
+    """img (C,H,W); gx,gy (Ho,Wo) in pixel coords."""
+    H, W = img.shape[1], img.shape[2]
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0
+    wy1 = gy - y0
+    wx0, wy0 = 1 - wx1, 1 - wy1
+
+    def at(yy, xx):
+        valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1)
+        xc = jnp.clip(xx, 0, W - 1)
+        v = img[:, yc, xc]  # (C, Ho, Wo)
+        return jnp.where(valid[None], v, 0.0)
+
+    return (
+        at(y0, x0) * (wy0 * wx0)[None]
+        + at(y0, x1) * (wy0 * wx1)[None]
+        + at(y1, x0) * (wy1 * wx0)[None]
+        + at(y1, x1) * (wy1 * wx1)[None]
+    )
+
+
+def _spatial_transformer_fwd(params, inputs, aux, is_train, rng):
+    data, loc = inputs
+    Ho, Wo = params["target_shape"]
+    H, W = data.shape[2], data.shape[3]
+    theta = loc.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, Ho)
+    xs = jnp.linspace(-1.0, 1.0, Wo)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    grid = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(Ho * Wo)], axis=0)  # (3, HoWo)
+
+    def sample_one(img, th):
+        src = th @ grid  # (2, HoWo) normalized coords
+        sx = (src[0].reshape(Ho, Wo) + 1.0) * (W - 1) / 2.0
+        sy = (src[1].reshape(Ho, Wo) + 1.0) * (H - 1) / 2.0
+        return _bilinear_sample(img, sx, sy)
+
+    out = jax.vmap(sample_one)(data, theta.astype(jnp.float32))
+    return [out.astype(data.dtype)], []
+
+
+def _st_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("SpatialTransformer: data shape unknown")
+    Ho, Wo = params["target_shape"]
+    s = in_shapes[0]
+    return [s, (s[0], 6)], [(s[0], s[1], Ho, Wo)], []
+
+
+register(
+    OpDef(
+        "SpatialTransformer",
+        _spatial_transformer_fwd,
+        params={
+            "target_shape": Field("shape", required=True),
+            "transform_type": Field("str", default="affine", enum=["affine"]),
+            "sampler_type": Field("str", default="bilinear", enum=["bilinear"]),
+        },
+        arguments=("data", "loc"),
+        infer_shape=_st_shape,
+    )
+)

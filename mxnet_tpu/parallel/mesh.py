@@ -1,0 +1,50 @@
+"""Device mesh utilities.
+
+The mesh is the TPU-native analog of the reference's device lists
+(`ctx=[mx.gpu(i) ...]`) + comm topology (comm.h P2P rings): one
+`jax.sharding.Mesh` whose axes name the parallelism dimensions
+(data/model/seq/expert), with XLA inserting ICI/DCN collectives.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def local_devices(platform=None):
+    import jax
+
+    if platform:
+        try:
+            return jax.devices(platform)
+        except RuntimeError:
+            return []
+    return jax.devices()
+
+
+def create_mesh(shape, axis_names, devices=None):
+    """Create a Mesh of the given logical shape, e.g.
+    create_mesh((2, 4), ('data', 'model'))."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = 1
+    for s in shape:
+        n *= s
+    if len(devices) < n:
+        raise MXNetError(
+            "mesh shape %s needs %d devices, only %d available" % (shape, n, len(devices))
+        )
+    dev_array = np.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def default_mesh(axis_name="data", devices=None):
+    """1-D all-devices mesh — pure data parallelism."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    return create_mesh((len(devices),), (axis_name,), devices)

@@ -1,0 +1,186 @@
+"""RecordIO: binary record pack/read (ref: python/mxnet/recordio.py:1-275,
+dmlc-core recordio format used by src/io/iter_image_recordio.cc).
+
+Format-compatible with the reference so existing .rec datasets pack/unpack
+byte-identically: records framed as [kMagic u32][(cflag<<29)|len u32][data,
+4-byte aligned]; image records carry an IRHeader (flag, label, id, id2).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = [
+    "MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+    "pack_img", "unpack_img",
+]
+
+_kMagic = 0xCED7230A
+
+IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref: recordio.py:14)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def write(self, buf):
+        assert self.writable
+        data = buf if isinstance(buf, bytes) else bytes(buf)
+        self.handle.write(struct.pack("<II", _kMagic, len(data)))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _kMagic:
+            raise MXNetError("invalid record magic in %s" % self.uri)
+        length = lrec & ((1 << 29) - 1)
+        data = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random access via .idx sidecar (ref: recordio.py:87)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.is_open:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.keys.append(key)
+        self.idx[key] = pos
+
+
+def pack(header, s):
+    """Pack IRHeader + payload (ref: recordio.py:156)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id, header.id2)
+        return hdr + s
+    label = _np.asarray(header.label, dtype=_np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """ref: recordio.py:177."""
+    flag, label, idx, idx2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[: flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, idx, idx2)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode image + pack (ref: recordio.py:198); PIL replaces OpenCV."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("pack_img requires PIL") from e
+    arr = _np.asarray(img).astype(_np.uint8)
+    im = Image.fromarray(arr)
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+    im.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """ref: recordio.py:228."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("unpack_img requires PIL") from e
+    header, img_bytes = unpack(s)
+    img = Image.open(_io.BytesIO(img_bytes))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1:
+        img = img.convert("RGB")
+    return header, _np.asarray(img)

@@ -1,0 +1,20 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+This mirrors the reference's testing trick of using plural device ids in
+one process to simulate multi-worker setups (SURVEY §4.3) — here we force
+JAX onto CPU with 8 virtual devices so sharding/kvstore/model-parallel
+tests exercise real multi-device code paths without TPU hardware.
+Must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the TPU
+# tunnel), so a plain setdefault would leave tests running on the single
+# real chip. Tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,119 @@
+"""Executor semantics tests (modeled on reference test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b + a
+    x = np.array([2.0, 3.0], dtype="f")
+    y = np.array([4.0, 5.0], dtype="f")
+    args = {"a": mx.nd.array(x), "b": mx.nd.array(y)}
+    grads = {"a": mx.nd.zeros((2,)), "b": mx.nd.zeros((2,))}
+    exe = c.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert np.allclose(out, x * y + x)
+    exe.backward(out_grads=[mx.nd.ones((2,))])
+    assert np.allclose(exe.grad_dict["a"].asnumpy(), y + 1)
+    assert np.allclose(exe.grad_dict["b"].asnumpy(), x)
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    s = sym.square(a)
+    x = np.array([3.0], dtype="f")
+    args = {"a": mx.nd.array(x)}
+    grads = {"a": mx.nd.zeros((1,))}
+    exe = a.bind if False else s.bind(mx.cpu(), args, args_grad=grads, grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[mx.nd.ones((1,))])
+    exe.backward(out_grads=[mx.nd.ones((1,))])
+    assert np.allclose(exe.grad_dict["a"].asnumpy(), 12.0)  # 2*3 accumulated twice
+    exe2 = s.bind(mx.cpu(), args, grad_req="null")
+    exe2.forward(is_train=True)
+    exe2.backward(out_grads=[mx.nd.ones((1,))])  # no-op, must not raise
+
+
+def test_outputs_refresh_on_forward():
+    a = sym.Variable("a")
+    s = a * 2
+    args = {"a": mx.nd.array(np.array([1.0]))}
+    exe = s.bind(mx.cpu(), args, grad_req="null")
+    o = exe.forward()[0]
+    assert np.allclose(o.asnumpy(), 2)
+    args["a"][:] = 5
+    o2 = exe.forward()[0]
+    assert np.allclose(o2.asnumpy(), 10)
+    # the previously returned handle tracks the refreshed buffer
+    assert np.allclose(o.asnumpy(), 10)
+
+
+def test_forward_kwargs_update():
+    a = sym.Variable("a")
+    s = a + 1
+    exe = s.bind(mx.cpu(), {"a": mx.nd.zeros((2,))}, grad_req="null")
+    out = exe.forward(a=np.array([5.0, 6.0], dtype="f"))[0]
+    assert np.allclose(out.asnumpy(), [6, 7])
+
+
+def test_simple_bind_shapes_and_reqs():
+    net = mx.models.get_mlp()
+    exe = net.simple_bind(mx.cpu(), data=(4, 784), softmax_label=(4,))
+    assert exe.arg_dict["fc1_weight"].shape == (128, 784)
+    assert exe.grad_dict["fc1_weight"] is not None
+    exe_null = net.simple_bind(mx.cpu(), grad_req="null", data=(4, 784), softmax_label=(4,))
+    assert exe_null.grad_arrays[1] is None
+
+
+def test_copy_params_from():
+    net = mx.models.get_mlp()
+    exe = net.simple_bind(mx.cpu(), data=(2, 784), softmax_label=(2,))
+    params = {"fc1_weight": mx.nd.ones((128, 784))}
+    exe.copy_params_from(params, allow_extra_params=False)
+    assert np.allclose(exe.arg_dict["fc1_weight"].asnumpy(), 1)
+
+
+def test_monitor_callback():
+    seen = []
+    a = sym.Variable("a")
+    s = sym.exp(a, name="myexp")
+    exe = s.bind(mx.cpu(), {"a": mx.nd.ones((2,))}, grad_req="null")
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward()
+    assert "myexp_output" in seen
+
+
+def test_aux_state_mutation_only_in_train():
+    s = sym.BatchNorm(sym.Variable("data"), name="bn")
+    x = np.random.rand(4, 3, 2, 2).astype("f")
+    exe = s.simple_bind(mx.cpu(), data=x.shape)
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["bn_gamma"][:] = 1
+    mm0 = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=False)
+    assert np.allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), mm0)
+    exe.forward(is_train=True)
+    assert not np.allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), mm0)
+
+
+def test_backward_without_loss_head_raises():
+    a = sym.Variable("a")
+    s = sym.exp(a)
+    exe = s.bind(mx.cpu(), {"a": mx.nd.ones((2,))},
+                 args_grad={"a": mx.nd.zeros((2,))})
+    exe.forward(is_train=True)
+    with pytest.raises(mx.MXNetError):
+        exe.backward()
+
+
+def test_reshape_rebind():
+    net = mx.models.get_mlp()
+    exe = net.simple_bind(mx.cpu(), data=(4, 784), softmax_label=(4,))
+    exe2 = exe.reshape(data=(8, 784), softmax_label=(8,))
+    assert exe2.arg_dict["data"].shape == (8, 784)
+    # parameters shared, not reallocated
+    assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]

@@ -1,0 +1,115 @@
+"""IO tests (modeled on reference test_io.py + test_recordio.py)."""
+import numpy as np
+import os
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio as mrec
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype("f")
+    labels = np.arange(10).astype("f")
+    it = mio.NDArrayIter(data, labels, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it.reset()
+    b0 = next(it)
+    assert b0.data[0].shape == (3, 4)
+    assert np.allclose(b0.data[0].asnumpy(), data[:3])
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(40).reshape(10, 4).astype("f")
+    it = mio.NDArrayIter(data, np.zeros(10), batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle_deterministic():
+    np.random.seed(0)
+    data = np.arange(20).reshape(10, 2).astype("f")
+    it = mio.NDArrayIter(data, np.arange(10), batch_size=5, shuffle=True)
+    b = next(it)
+    # shuffled: first batch isn't simply the first 5 rows
+    assert b.data[0].shape == (5, 2)
+
+
+def test_mnist_iter_synthetic():
+    it = mio.MNISTIter(batch_size=32, num_synthetic=128, seed=3)
+    b = next(it)
+    assert b.data[0].shape == (32, 1, 28, 28)
+    assert b.label[0].shape == (32,)
+    flat = mio.MNISTIter(batch_size=32, num_synthetic=128, seed=3, flat=True)
+    b = next(flat)
+    assert b.data[0].shape == (32, 784)
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), "f")
+    base = mio.NDArrayIter(data, np.zeros(10), batch_size=5)
+    r = mio.ResizeIter(base, 5)
+    assert len(list(r)) == 5
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(10, 4).astype("f")
+    base = mio.NDArrayIter(data, np.zeros(10), batch_size=5)
+    pf = mio.PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 2
+    pf.reset()
+    assert len(list(pf)) == 2
+
+
+def test_recordio_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    w = mrec.MXRecordIO(fname, "w")
+    for i in range(5):
+        w.write(("record%d" % i).encode())
+    w.close()
+    r = mrec.MXRecordIO(fname, "r")
+    out = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        out.append(s.decode())
+    assert out == ["record%d" % i for i in range(5)]
+
+
+def test_indexed_recordio(tmp_path):
+    fname = str(tmp_path / "t.rec")
+    idxname = str(tmp_path / "t.idx")
+    w = mrec.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(5):
+        w.write_idx(i, ("rec%d" % i).encode())
+    w.close()
+    r = mrec.MXIndexedRecordIO(idxname, fname, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+
+
+def test_pack_unpack_header():
+    hdr = mrec.IRHeader(0, 3.0, 7, 0)
+    packed = mrec.pack(hdr, b"payload")
+    h2, payload = mrec.unpack(packed)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 7
+    # multi-label
+    hdr = mrec.IRHeader(0, np.array([1.0, 2.0, 3.0], "f"), 9, 0)
+    packed = mrec.pack(hdr, b"x")
+    h3, payload = mrec.unpack(packed)
+    assert np.allclose(h3.label, [1, 2, 3])
+    assert payload == b"x"
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "d.csv")
+    label_path = str(tmp_path / "l.csv")
+    np.savetxt(data_path, np.arange(20).reshape(10, 2), delimiter=",")
+    np.savetxt(label_path, np.arange(10), delimiter=",")
+    it = mio.CSVIter(data_csv=data_path, data_shape=(2,), label_csv=label_path,
+                     batch_size=5)
+    b = next(it)
+    assert b.data[0].shape == (5, 2)

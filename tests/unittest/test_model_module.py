@@ -1,0 +1,142 @@
+"""FeedForward + Module end-to-end tests (modeled on reference
+tests/python/train/test_mlp.py convergence + module tests)."""
+import numpy as np
+import os
+
+import mxnet_tpu as mx
+
+
+def _toy_data(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 20).astype("f")
+    Y = (X[:, 0] + 2 * X[:, 1] > 1.2).astype("f")  # easy binary task
+    return X, Y
+
+
+def _small_mlp(num_classes=2):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_feedforward_convergence():
+    mx.random.seed(7)
+    np.random.seed(7)
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    model = mx.FeedForward(
+        _small_mlp(), ctx=mx.cpu(), num_epoch=8, learning_rate=0.5, momentum=0.9,
+        initializer=mx.initializer.Xavier(),
+    )
+    model.fit(X=train)
+    acc = model.score(mx.io.NDArrayIter(X, Y, batch_size=32))
+    assert acc > 0.9, acc
+
+
+def test_feedforward_predict():
+    mx.random.seed(1)
+    X, Y = _toy_data(128)
+    train = mx.io.NDArrayIter(X, Y, batch_size=32)
+    model = mx.FeedForward(_small_mlp(), ctx=mx.cpu(), num_epoch=1, learning_rate=0.1)
+    model.fit(X=train)
+    preds = model.predict(mx.io.NDArrayIter(X, Y, batch_size=32))
+    assert preds.shape == (128, 2)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mx.random.seed(2)
+    X, Y = _toy_data(128)
+    train = mx.io.NDArrayIter(X, Y, batch_size=32)
+    model = mx.FeedForward(_small_mlp(), ctx=mx.cpu(), num_epoch=1, learning_rate=0.1)
+    model.fit(X=train)
+    prefix = str(tmp_path / "toy")
+    model.save(prefix)
+    loaded = mx.FeedForward.load(prefix, 1, ctx=mx.cpu())
+    p1 = model.predict(mx.io.NDArrayIter(X, Y, batch_size=32))
+    p2 = loaded.predict(mx.io.NDArrayIter(X, Y, batch_size=32))
+    assert np.allclose(p1, p2, atol=1e-5)
+
+
+def test_module_fit():
+    mx.random.seed(3)
+    np.random.seed(3)
+    X, Y = _toy_data()
+    train = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    mod = mx.module.Module(_small_mlp(), context=mx.cpu())
+    mod.fit(
+        train, num_epoch=8,
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+        initializer=mx.initializer.Xavier(),
+    )
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=32), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_save_load_params(tmp_path):
+    mod = mx.module.Module(_small_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 20))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    fname = str(tmp_path / "p.params")
+    mod.save_params(fname)
+    arg0, _ = mod.get_params()
+    mod2 = mx.module.Module(_small_mlp(), context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (8, 20))], label_shapes=[("softmax_label", (8,))])
+    mod2.init_params()
+    mod2.load_params(fname)
+    arg2, _ = mod2.get_params()
+    for k in arg0:
+        assert np.allclose(arg0[k].asnumpy(), arg2[k].asnumpy())
+
+
+def test_module_predict_outputs():
+    X, Y = _toy_data(64)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.module.Module(_small_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 2)
+
+
+def test_bucketing_module():
+    mx.random.seed(5)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc_shared")
+        out = mx.sym.FullyConnected(data=fc, num_hidden=2, name="out_shared")
+        return mx.sym.SoftmaxOutput(data=out, name="softmax"), ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    from mxnet_tpu.io import DataDesc, DataBatch
+
+    mod.bind(data_shapes=[DataDesc("data", (4, 10))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    batch = DataBatch(
+        data=[mx.nd.ones((4, 10))], label=[mx.nd.zeros((4,))], pad=0, index=None,
+        bucket_key=10,
+        provide_data=[DataDesc("data", (4, 10))],
+        provide_label=[DataDesc("softmax_label", (4,))],
+    )
+    mod.forward(batch)
+    mod.backward()
+    mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 2)
+
+
+def test_speedometer_and_metrics():
+    m = mx.metric.create("acc")
+    pred = mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2]]))
+    label = mx.nd.array(np.array([1, 0], "f"))
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+    m2 = mx.metric.create(["acc", "mse"])
+    m2.update([label], [pred])
+    names, vals = m2.get()
+    assert len(names) == 2

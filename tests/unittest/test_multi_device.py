@@ -1,0 +1,116 @@
+"""Multi-device tests on the virtual 8-CPU mesh (modeled on reference
+test_multi_device_exec.py, test_model_parallel.py, multi_lenet.py —
+SURVEY §4.3: plural device ids in one process simulate multi-worker)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _small_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_multi_context_data_parallel_fit():
+    mx.random.seed(11)
+    np.random.seed(11)
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, 20).astype("f")
+    Y = (X[:, 0] + 2 * X[:, 1] > 1.2).astype("f")
+    train = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True)
+    ctxs = [mx.cpu(i) for i in range(4)]
+    model = mx.FeedForward(
+        _small_mlp(), ctx=ctxs, num_epoch=8, learning_rate=0.5, momentum=0.9,
+        initializer=mx.initializer.Xavier(),
+    )
+    model.fit(X=train, kvstore="local")
+    acc = model.score(mx.io.NDArrayIter(X, Y, batch_size=64))
+    assert acc > 0.9, acc
+
+
+def test_multi_vs_single_device_same_result():
+    """Gradient-sync equivalence: 2-device DP with summed grads must match
+    single-device training on the same total batch (ref: multi_lenet.py)."""
+    np.random.seed(4)
+    rng = np.random.RandomState(1)
+    X = rng.rand(64, 10).astype("f")
+    Y = (X[:, 0] > 0.5).astype("f")
+
+    def run(ctxs):
+        mx.random.seed(99)
+        train = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=False)
+        model = mx.FeedForward(
+            _small_mlp(), ctx=ctxs, num_epoch=2, learning_rate=0.1,
+            initializer=mx.initializer.Uniform(0.1),
+        )
+        model.fit(X=train, kvstore="local")
+        return {k: v.asnumpy() for k, v in model.arg_params.items()}
+
+    p1 = run([mx.cpu(0)])
+    p2 = run([mx.cpu(0), mx.cpu(1)])
+    for k in p1:
+        assert np.allclose(p1[k], p2[k], atol=1e-4), k
+
+
+def test_group2ctx_model_parallel_exec():
+    """ctx_group placement across devices (ref: test_multi_device_exec.py)."""
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        act = mx.sym.Activation(data=fc1, act_type="relu")
+        fc2 = mx.sym.FullyConnected(data=act, num_hidden=4, name="fc2")
+    out = mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+    group2ctx = {"dev1": mx.cpu(1), "dev2": mx.cpu(2)}
+    x = np.random.rand(4, 6).astype("f")
+    exe = out.simple_bind(mx.cpu(0), group2ctx=group2ctx,
+                          data=(4, 6), softmax_label=(4,))
+    exe.arg_dict["data"][:] = x
+    for k, v in exe.arg_dict.items():
+        if k.endswith("weight"):
+            v[:] = 0.1
+    outs = exe.forward(is_train=True)
+    assert outs[0].shape == (4, 4)
+    exe.backward()
+    assert abs(exe.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+
+
+def test_model_parallel_lstm_gradients_match_single():
+    """MP LSTM grads match single-device (ref: test_model_parallel.py:54)."""
+    from mxnet_tpu.models.lstm import lstm_unroll, lstm_group2ctx
+
+    mx.random.seed(21)
+    np.random.seed(21)
+    net_mp = lstm_unroll(2, 4, 16, 8, 6, 10, group2ctx_layers=True)
+    net_sp = lstm_unroll(2, 4, 16, 8, 6, 10, group2ctx_layers=False)
+    shapes = {
+        "data": (2, 4),
+        "softmax_label": (2, 4),
+        "l0_init_c": (2, 8), "l0_init_h": (2, 8),
+        "l1_init_c": (2, 8), "l1_init_h": (2, 8),
+    }
+    ctxs = [mx.cpu(i) for i in range(4)]
+    g2c = lstm_group2ctx(2, ctxs)
+    exe_mp = net_mp.simple_bind(mx.cpu(0), group2ctx=g2c, **shapes)
+    exe_sp = net_sp.simple_bind(mx.cpu(0), **shapes)
+    rng = np.random.RandomState(5)
+    vals = {}
+    for k, v in exe_sp.arg_dict.items():
+        vals[k] = rng.uniform(-0.1, 0.1, v.shape).astype("f")
+    vals["data"] = rng.randint(0, 16, (2, 4)).astype("f")
+    vals["softmax_label"] = rng.randint(0, 10, (2, 4)).astype("f")
+    for exe in (exe_sp, exe_mp):
+        for k, v in exe.arg_dict.items():
+            v[:] = vals[k]
+        exe.forward(is_train=True)
+        exe.backward()
+    assert np.allclose(
+        exe_sp.outputs[0].asnumpy(), exe_mp.outputs[0].asnumpy(), atol=1e-5
+    )
+    for k in ("l0_i2h_weight", "embed_weight", "cls_weight"):
+        g1 = exe_sp.grad_dict[k].asnumpy()
+        g2 = exe_mp.grad_dict[k].asnumpy()
+        assert np.allclose(g1, g2, atol=1e-4), k

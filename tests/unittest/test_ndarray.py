@@ -1,0 +1,131 @@
+"""NDArray tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 3))
+    assert b.asnumpy().sum() == 6
+    c = mx.nd.full((2, 2), 3.5)
+    assert c.asnumpy().mean() == 3.5
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = mx.nd.arange(0, 10, 2)
+    assert list(e.asnumpy()) == [0, 2, 4, 6, 8]
+
+
+def test_arithmetic():
+    a = mx.nd.array(np.array([[1.0, 2], [3, 4]]))
+    b = mx.nd.array(np.array([[10.0, 20], [30, 40]]))
+    assert np.allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    assert np.allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    assert np.allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    assert np.allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    assert np.allclose((1 / a).asnumpy(), 1.0 / a.asnumpy())
+    assert np.allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert np.allclose((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace_versions():
+    a = mx.nd.ones((3,))
+    v0 = a.version
+    a += 1
+    assert a.version > v0
+    assert np.allclose(a.asnumpy(), 2)
+    a *= 3
+    assert np.allclose(a.asnumpy(), 6)
+
+
+def test_setitem_getitem():
+    a = mx.nd.zeros((4, 4))
+    a[1] = 1.0
+    assert a.asnumpy()[1].sum() == 4
+    a[2:4] = 2.0
+    assert a.asnumpy()[2:].sum() == 16
+    sl = a[1]
+    assert sl.shape == (4,)
+    a[:] = 7
+    assert (a.asnumpy() == 7).all()
+
+
+def test_copyto_and_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu(0))
+    b = mx.nd.zeros((2, 2), ctx=mx.cpu(1))
+    a.copyto(b)
+    assert b.context == mx.cpu(1)
+    assert (b.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu(1))
+    assert c.context == mx.cpu(1)
+    # same-context as_in_context returns self
+    assert a.as_in_context(mx.cpu(0)) is a
+
+
+def test_cross_context_op_faults():
+    a = mx.nd.ones((2,), ctx=mx.cpu(0))
+    b = mx.nd.ones((2,), ctx=mx.cpu(1))
+    with pytest.raises(mx.MXNetError):
+        _ = a + b
+
+
+def test_reshape_broadcast():
+    a = mx.nd.arange(0, 12).reshape((3, 4))
+    assert a.shape == (3, 4)
+    b = a.reshape((2, -1))
+    assert b.shape == (2, 6)
+    c = mx.nd.ones((1, 4)).broadcast_to((3, 4))
+    assert c.shape == (3, 4)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    d = {"w": mx.nd.array(np.random.rand(3, 4).astype("f")),
+         "b": mx.nd.array(np.random.rand(7).astype("f"))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert np.allclose(loaded["w"].asnumpy(), d["w"].asnumpy())
+    lst = [d["w"], d["b"]]
+    mx.nd.save(fname, lst)
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert np.allclose(loaded[1].asnumpy(), d["b"].asnumpy())
+
+
+def test_onehot_encode():
+    idx = mx.nd.array(np.array([0, 2, 1]))
+    out = mx.nd.zeros((3, 3))
+    mx.nd.onehot_encode(idx, out)
+    assert np.allclose(out.asnumpy(), np.eye(3)[[0, 2, 1]])
+
+
+def test_imperative_simple_ops():
+    a = mx.nd.array(np.array([1.0, 4.0, 9.0]))
+    assert np.allclose(mx.nd.sqrt(a).asnumpy(), [1, 2, 3])
+    assert np.allclose(mx.nd.square(a).asnumpy(), [1, 16, 81])
+    assert np.allclose(mx.nd.exp(mx.nd.zeros((2,))).asnumpy(), 1)
+    b = mx.nd.array(np.array([[1.0, 2], [3, 4]]))
+    assert np.allclose(mx.nd.sum(b).asnumpy(), [10])
+    assert np.allclose(mx.nd.dot(b, b).asnumpy(), b.asnumpy() @ b.asnumpy())
+    out = mx.nd.zeros((2, 2))
+    mx.nd.clip(b, a_min=1.5, a_max=3.5, out=out)
+    assert np.allclose(out.asnumpy(), np.clip(b.asnumpy(), 1.5, 3.5))
+
+
+def test_astype_dtype():
+    a = mx.nd.ones((2,), dtype=np.float32)
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    c = a.astype("float16")
+    assert c.dtype == np.float16
+
+
+def test_concatenate():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)

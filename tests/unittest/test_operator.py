@@ -1,0 +1,362 @@
+"""Operator tests: forward vs numpy/torch, backward vs finite differences
+(modeled on reference tests/python/unittest/test_operator.py, 1,629 LoC).
+torch (CPU) provides the independent reference for conv/pool/deconv."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_numeric_gradient, reldiff
+
+
+def _bind_fwd(s, arrays, is_train=False, **kw):
+    args = {k: mx.nd.array(v) for k, v in arrays.items()}
+    exe = s.bind(mx.cpu(), args, grad_req="null", **kw)
+    return [o.asnumpy() for o in exe.forward(is_train=is_train)]
+
+
+def test_elementwise_forward():
+    x = np.random.rand(3, 4).astype("f") + 0.5
+    a = sym.Variable("a")
+    for name, fn in [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+        ("square", np.square), ("abs", np.abs), ("sign", np.sign),
+        ("sin", np.sin), ("cos", np.cos), ("floor", np.floor),
+        ("ceil", np.ceil), ("round", np.round),
+    ]:
+        s = getattr(sym, name)(a)
+        out = _bind_fwd(s, {"a": x})[0]
+        assert np.allclose(out, fn(x), atol=1e-5), name
+
+
+def test_binary_broadcast():
+    a = np.random.rand(2, 3, 4).astype("f")
+    b = np.random.rand(2, 1, 4).astype("f")
+    s = sym.broadcast_mul(sym.Variable("a"), sym.Variable("b"))
+    out = _bind_fwd(s, {"a": a, "b": b})[0]
+    assert np.allclose(out, a * b)
+
+
+def test_reductions():
+    x = np.random.rand(2, 3, 4).astype("f")
+    out = _bind_fwd(sym.sum(sym.Variable("a"), axis=(1,)), {"a": x})[0]
+    assert np.allclose(out, x.sum(1), atol=1e-5)
+    out = _bind_fwd(sym.max(sym.Variable("a")), {"a": x})[0]
+    assert np.allclose(out, [x.max()])
+    out = _bind_fwd(sym.sum(sym.Variable("a"), axis=(1,), keepdims=True), {"a": x})[0]
+    assert out.shape == (2, 1, 4)
+
+
+def test_dot_batch_dot():
+    a = np.random.rand(3, 4).astype("f")
+    b = np.random.rand(4, 5).astype("f")
+    out = _bind_fwd(sym.dot(sym.Variable("a"), sym.Variable("b")), {"a": a, "b": b})[0]
+    assert np.allclose(out, a @ b, atol=1e-5)
+    a3 = np.random.rand(2, 3, 4).astype("f")
+    b3 = np.random.rand(2, 4, 5).astype("f")
+    out = _bind_fwd(sym.batch_dot(sym.Variable("a"), sym.Variable("b")),
+                    {"a": a3, "b": b3})[0]
+    assert np.allclose(out, np.einsum("bij,bjk->bik", a3, b3), atol=1e-5)
+
+
+def test_transpose_swapaxis_expanddims_flip():
+    x = np.random.rand(2, 3, 4).astype("f")
+    assert _bind_fwd(sym.transpose(sym.Variable("a")), {"a": x})[0].shape == (4, 3, 2)
+    out = _bind_fwd(sym.SwapAxis(sym.Variable("a"), dim1=0, dim2=2), {"a": x})[0]
+    assert np.allclose(out, x.swapaxes(0, 2))
+    out = _bind_fwd(sym.expand_dims(sym.Variable("a"), axis=1), {"a": x})[0]
+    assert out.shape == (2, 1, 3, 4)
+    out = _bind_fwd(sym.flip(sym.Variable("a"), axis=2), {"a": x})[0]
+    assert np.allclose(out, x[:, :, ::-1])
+
+
+def test_slice_axis_and_crop():
+    x = np.random.rand(4, 6).astype("f")
+    out = _bind_fwd(sym.slice_axis(sym.Variable("a"), axis=1, begin=1, end=4), {"a": x})[0]
+    assert np.allclose(out, x[:, 1:4])
+
+
+def test_activation_leakyrelu():
+    x = (np.random.rand(3, 4).astype("f") - 0.5) * 4
+    for act, fn in [
+        ("relu", lambda v: np.maximum(v, 0)),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("tanh", np.tanh),
+        ("softrelu", lambda v: np.log1p(np.exp(v))),
+    ]:
+        s = sym.Activation(sym.Variable("a"), act_type=act)
+        out = _bind_fwd(s, {"a": x})[0]
+        assert np.allclose(out, fn(x), atol=1e-5), act
+    s = sym.LeakyReLU(sym.Variable("a"), act_type="leaky", slope=0.1)
+    out = _bind_fwd(s, {"a": x})[0]
+    assert np.allclose(out, np.where(x > 0, x, 0.1 * x), atol=1e-6)
+    s = sym.LeakyReLU(sym.Variable("a"), act_type="elu", slope=0.3)
+    out = _bind_fwd(s, {"a": x})[0]
+    assert np.allclose(out, np.where(x > 0, x, 0.3 * (np.exp(x) - 1)), atol=1e-6)
+
+
+def test_fully_connected_vs_numpy():
+    x = np.random.rand(5, 8).astype("f")
+    w = np.random.rand(3, 8).astype("f")
+    b = np.random.rand(3).astype("f")
+    s = sym.FullyConnected(sym.Variable("data"), num_hidden=3, name="fc")
+    out = _bind_fwd(s, {"data": x, "fc_weight": w, "fc_bias": b})[0]
+    assert np.allclose(out, x @ w.T + b, atol=1e-5)
+
+
+def test_convolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    x = np.random.rand(2, 3, 10, 10).astype("f")
+    w = np.random.rand(4, 3, 3, 3).astype("f")
+    b = np.random.rand(4).astype("f")
+    s = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=4,
+                        stride=(2, 2), pad=(1, 1), name="conv")
+    out = _bind_fwd(s, {"data": x, "conv_weight": w, "conv_bias": b})[0]
+    ref = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                   stride=2, padding=1).numpy()
+    assert reldiff(out, ref) < 1e-5
+
+
+def test_convolution_dilate_group_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    x = np.random.rand(1, 4, 9, 9).astype("f")
+    w = np.random.rand(6, 2, 3, 3).astype("f")
+    s = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=6,
+                        dilate=(2, 2), num_group=2, no_bias=True, name="conv")
+    out = _bind_fwd(s, {"data": x, "conv_weight": w})[0]
+    ref = F.conv2d(torch.tensor(x), torch.tensor(w), None, dilation=2, groups=2).numpy()
+    assert reldiff(out, ref) < 1e-5
+
+
+def test_deconvolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    x = np.random.rand(2, 3, 5, 5).astype("f")
+    w = np.random.rand(3, 4, 3, 3).astype("f")  # (in, out, kh, kw)
+    s = sym.Deconvolution(sym.Variable("data"), kernel=(3, 3), num_filter=4,
+                          stride=(2, 2), pad=(1, 1), no_bias=True, name="deconv")
+    out = _bind_fwd(s, {"data": x, "deconv_weight": w})[0]
+    ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w), None,
+                             stride=2, padding=1).numpy()
+    assert reldiff(out, ref) < 1e-5
+
+
+def test_pooling_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    x = np.random.rand(2, 3, 8, 8).astype("f")
+    s = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    out = _bind_fwd(s, {"data": x})[0]
+    ref = F.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert np.allclose(out, ref)
+    s = sym.Pooling(sym.Variable("data"), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    out = _bind_fwd(s, {"data": x})[0]
+    ref = F.avg_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert np.allclose(out, ref, atol=1e-6)
+    s = sym.Pooling(sym.Variable("data"), kernel=(2, 2), global_pool=True, pool_type="avg")
+    out = _bind_fwd(s, {"data": x})[0]
+    assert np.allclose(out[:, :, 0, 0], x.mean((2, 3)), atol=1e-6)
+
+
+def test_batchnorm_train_stats():
+    x = np.random.rand(8, 3, 4, 4).astype("f") * 5
+    s = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, name="bn")
+    args = {"data": mx.nd.array(x),
+            "bn_gamma": mx.nd.ones((3,)),
+            "bn_beta": mx.nd.zeros((3,))}
+    aux = {"bn_moving_mean": mx.nd.zeros((3,)), "bn_moving_var": mx.nd.ones((3,))}
+    exe = s.bind(mx.cpu(), args, aux_states=aux, grad_req="null")
+    out = exe.forward(is_train=True)[0].asnumpy()
+    # normalized output: per-channel mean ~0, var ~1
+    assert np.allclose(out.mean((0, 2, 3)), 0, atol=1e-4)
+    assert np.allclose(out.var((0, 2, 3)), 1, atol=2e-2)
+    # moving stats updated: momentum 0.9
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    batch_mean = x.mean((0, 2, 3))
+    assert np.allclose(mm, 0.1 * batch_mean, rtol=1e-3)
+
+
+def test_softmax_output_grad():
+    x = np.random.rand(4, 5).astype("f")
+    y = np.array([0, 1, 2, 3], dtype="f")
+    s = sym.SoftmaxOutput(sym.Variable("data"), name="softmax")
+    args = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(y)}
+    grads = {"data": mx.nd.zeros((4, 5)), "softmax_label": mx.nd.zeros((4,))}
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    ex = np.exp(x - x.max(1, keepdims=True))
+    p = ex / ex.sum(1, keepdims=True)
+    assert np.allclose(out, p, atol=1e-5)
+    exe.backward()
+    expect = p.copy()
+    expect[np.arange(4), y.astype(int)] -= 1.0
+    assert np.allclose(exe.grad_dict["data"].asnumpy(), expect, atol=1e-5)
+
+
+def test_regression_outputs():
+    x = np.random.rand(4, 3).astype("f")
+    y = np.random.rand(4, 3).astype("f")
+    s = sym.LinearRegressionOutput(sym.Variable("data"), sym.Variable("label"), name="lr")
+    args = {"data": mx.nd.array(x), "label": mx.nd.array(y)}
+    grads = {"data": mx.nd.zeros(x.shape), "label": mx.nd.zeros(y.shape)}
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert np.allclose(out, x)
+    exe.backward()
+    assert np.allclose(exe.grad_dict["data"].asnumpy(), x - y, atol=1e-6)
+    s = sym.LogisticRegressionOutput(sym.Variable("data"), sym.Variable("label"), name="lr2")
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    sig = 1 / (1 + np.exp(-x))
+    assert np.allclose(out, sig, atol=1e-6)
+    exe.backward()
+    assert np.allclose(exe.grad_dict["data"].asnumpy(), sig - y, atol=1e-5)
+
+
+def test_block_grad():
+    a = sym.Variable("a")
+    s = sym.BlockGrad(sym.exp(a)) + sym.sqrt(a)
+    x = np.array([4.0], dtype="f")
+    args = {"a": mx.nd.array(x)}
+    grads = {"a": mx.nd.zeros((1,))}
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[mx.nd.ones((1,))])
+    # only sqrt contributes: d/dx sqrt(x) = 1/(2*sqrt(x)) = 0.25
+    assert np.allclose(exe.grad_dict["a"].asnumpy(), 0.25, atol=1e-6)
+
+
+def test_concat_elementwisesum():
+    a = np.random.rand(2, 3).astype("f")
+    b = np.random.rand(2, 4).astype("f")
+    s = sym.Concat(sym.Variable("a"), sym.Variable("b"), num_args=2, dim=1)
+    out = _bind_fwd(s, {"a": a, "b": b})[0]
+    assert np.allclose(out, np.concatenate([a, b], 1))
+    c = np.random.rand(2, 3).astype("f")
+    s = sym.ElementWiseSum(sym.Variable("a"), sym.Variable("c"), num_args=2)
+    out = _bind_fwd(s, {"a": a, "c": c})[0]
+    assert np.allclose(out, a + c)
+
+
+def test_embedding():
+    idx = np.array([[0, 2], [1, 3]], dtype="f")
+    w = np.random.rand(4, 5).astype("f")
+    s = sym.Embedding(sym.Variable("data"), input_dim=4, output_dim=5, name="emb")
+    out = _bind_fwd(s, {"data": idx, "emb_weight": w})[0]
+    assert out.shape == (2, 2, 5)
+    assert np.allclose(out[0, 1], w[2])
+
+
+def test_reshape_semantics():
+    x = np.arange(24).reshape(2, 3, 4).astype("f")
+    s = sym.Reshape(sym.Variable("a"), shape=(0, -1))
+    out = _bind_fwd(s, {"a": x})[0]
+    assert out.shape == (2, 12)
+    s = sym.Reshape(sym.Variable("a"), target_shape=(0, 12))
+    out = _bind_fwd(s, {"a": x})[0]
+    assert out.shape == (2, 12)
+
+
+def test_pad_upsampling():
+    x = np.random.rand(1, 2, 3, 3).astype("f")
+    s = sym.Pad(sym.Variable("a"), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                constant_value=7.0)
+    out = _bind_fwd(s, {"a": x})[0]
+    assert out.shape == (1, 2, 5, 5)
+    assert out[0, 0, 0, 0] == 7.0
+    s = sym.UpSampling(sym.Variable("a"), scale=2, sample_type="nearest", num_args=1)
+    out = _bind_fwd(s, {"a": x})[0]
+    assert out.shape == (1, 2, 6, 6)
+    assert np.allclose(out[0, 0, :2, :2], x[0, 0, 0, 0])
+
+
+def test_sequence_ops():
+    # time-major (T=3, N=2, D=2)
+    x = np.arange(12).reshape(3, 2, 2).astype("f")
+    lens = np.array([2, 3], dtype="f")
+    s = sym.SequenceLast(sym.Variable("d"), sym.Variable("l"), use_sequence_length=True)
+    out = _bind_fwd(s, {"d": x, "l": lens})[0]
+    assert np.allclose(out[0], x[1, 0])
+    assert np.allclose(out[1], x[2, 1])
+    s = sym.SequenceMask(sym.Variable("d"), sym.Variable("l"),
+                         use_sequence_length=True, value=-1.0)
+    out = _bind_fwd(s, {"d": x, "l": lens})[0]
+    assert (out[2, 0] == -1).all()
+    assert (out[2, 1] == x[2, 1]).all()
+    s = sym.SequenceReverse(sym.Variable("d"), sym.Variable("l"), use_sequence_length=True)
+    out = _bind_fwd(s, {"d": x, "l": lens})[0]
+    assert np.allclose(out[0, 0], x[1, 0])
+    assert np.allclose(out[1, 0], x[0, 0])
+    assert np.allclose(out[2, 0], x[2, 0])
+
+
+def test_rnn_lstm_shapes_and_grad_flow():
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    from mxnet_tpu.ops.sequence import rnn_param_size
+
+    psize = rnn_param_size("lstm", I, H, L, False)
+    s = sym.RNN(sym.Variable("data"), sym.Variable("params"), sym.Variable("state"),
+                sym.Variable("state_cell"), state_size=H, num_layers=L, mode="lstm",
+                state_outputs=True, name="rnn")
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(T, N, I))
+    assert out_shapes[0] == (T, N, H)
+    assert out_shapes[1] == (L, N, H)
+    args = {
+        "data": mx.nd.array(np.random.rand(T, N, I).astype("f")),
+        "params": mx.nd.array(np.random.rand(psize).astype("f") * 0.1),
+        "state": mx.nd.zeros((L, N, H)),
+        "state_cell": mx.nd.zeros((L, N, H)),
+    }
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    exe = s.bind(mx.cpu(), args, args_grad=grads)
+    outs = exe.forward(is_train=True)
+    assert outs[0].shape == (T, N, H)
+    exe.backward(out_grads=[mx.nd.ones(o.shape) for o in outs])
+    assert abs(exe.grad_dict["params"].asnumpy()).sum() > 0
+
+
+def test_numeric_gradient_simple():
+    a = sym.Variable("a")
+    s = sym.exp(a) * sym.sqrt(a)
+    check_numeric_gradient(s, {"a": np.random.rand(3, 4).astype("f") + 0.5})
+
+
+def test_numeric_gradient_fc():
+    data = sym.Variable("data")
+    s = sym.FullyConnected(data, num_hidden=4, name="fc")
+    check_numeric_gradient(
+        s, {"data": np.random.rand(3, 5).astype("f"),
+            "fc_weight": np.random.rand(4, 5).astype("f"),
+            "fc_bias": np.random.rand(4).astype("f")},
+        numeric_eps=1e-2, check_eps=3e-2,
+    )
+
+
+def test_dropout_train_eval():
+    x = np.ones((100, 100), dtype="f")
+    s = sym.Dropout(sym.Variable("a"), p=0.5)
+    args = {"a": mx.nd.array(x)}
+    exe = s.bind(mx.cpu(), args, grad_req="null")
+    out_eval = exe.forward(is_train=False)[0].asnumpy()
+    assert np.allclose(out_eval, x)
+    out_train = exe.forward(is_train=True)[0].asnumpy()
+    frac = (out_train == 0).mean()
+    assert 0.3 < frac < 0.7
+    kept = out_train[out_train != 0]
+    assert np.allclose(kept, 2.0)
+
+
+def test_roi_pooling():
+    x = np.arange(64, dtype="f").reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], dtype="f")
+    s = sym.ROIPooling(sym.Variable("d"), sym.Variable("r"),
+                       pooled_size=(2, 2), spatial_scale=1.0)
+    out = _bind_fwd(s, {"d": x, "r": rois})[0]
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 1, 1] == 63.0

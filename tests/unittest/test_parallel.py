@@ -1,0 +1,171 @@
+"""TPU parallelism tests: mesh train steps, tensor parallel, ring attention.
+These exercise the virtual 8-device CPU mesh (conftest) — the same code
+runs on a real TPU slice."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import create_mesh, make_train_step, ShardedTrainer
+from mxnet_tpu.parallel.ring_attention import make_ring_attention, ring_attention
+
+
+def _dense_attention(q, k, v, causal=True):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        mask = np.tril(np.ones((T, T), bool))
+        scores = np.where(mask, scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_mesh_creation():
+    import jax
+
+    mesh = create_mesh((2, 4), ("data", "model"))
+    assert mesh.shape == {"data": 2, "model": 4}
+    mesh1 = create_mesh((8,), ("data",))
+    assert mesh1.devices.size == 8
+
+
+def test_data_parallel_step_matches_single_device():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(4, 3).astype("f")
+    x = rng.rand(16, 4).astype("f")
+    y = rng.rand(16, 3).astype("f")
+
+    # single device
+    step1, init1 = make_train_step(loss_fn, optax.sgd(0.1), donate=False)
+    p1 = {"w": jnp.array(w0)}
+    s1 = init1(p1)
+    p1, s1, l1 = step1(p1, s1, {"x": x, "y": y}, jax.random.PRNGKey(0))
+
+    # 8-way data parallel
+    mesh = create_mesh((8,), ("data",))
+    step8, init8 = make_train_step(loss_fn, optax.sgd(0.1), mesh=mesh, donate=False)
+    p8 = {"w": jnp.array(w0)}
+    s8 = init8(p8)
+    p8, s8, l8 = step8(p8, s8, {"x": x, "y": y}, jax.random.PRNGKey(0))
+
+    assert np.allclose(float(l1), float(l8), atol=1e-6)
+    assert np.allclose(np.array(p1["w"]), np.array(p8["w"]), atol=1e-6)
+
+
+def test_sharded_trainer_loss_decreases():
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch, rng):
+        h = jnp.maximum(batch["x"] @ params["w1"], 0)
+        pred = h @ params["w2"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(1)
+    params = {"w1": rng.rand(6, 16).astype("f") * 0.3,
+              "w2": rng.rand(16, 1).astype("f") * 0.3}
+    mesh = create_mesh((4,), ("data",))
+    trainer = ShardedTrainer(loss_fn, params, optax.adam(1e-2), mesh=mesh)
+    x = rng.rand(32, 6).astype("f")
+    y = (x.sum(1, keepdims=True) > 3).astype("f")
+    losses = [float(trainer.step({"x": x, "y": y})) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_ring_attention_matches_dense():
+    import jax
+
+    mesh = create_mesh((4,), ("seq",))
+    B, H, T, D = 2, 2, 16, 8
+    rng = np.random.RandomState(3)
+    q = rng.randn(B, H, T, D).astype("f")
+    k = rng.randn(B, H, T, D).astype("f")
+    v = rng.randn(B, H, T, D).astype("f")
+    ring = make_ring_attention(mesh, seq_axis="seq", causal=True)
+    out = np.array(ring(q, k, v))
+    ref = _dense_attention(q, k, v, causal=True)
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_ring_attention_non_causal():
+    mesh = create_mesh((2,), ("seq",))
+    B, H, T, D = 1, 1, 8, 4
+    rng = np.random.RandomState(4)
+    q = rng.randn(B, H, T, D).astype("f")
+    k = rng.randn(B, H, T, D).astype("f")
+    v = rng.randn(B, H, T, D).astype("f")
+    ring = make_ring_attention(mesh, seq_axis="seq", causal=False)
+    out = np.array(ring(q, k, v))
+    ref = _dense_attention(q, k, v, causal=False)
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_transformer_tensor_parallel_forward():
+    """TP-sharded transformer forward == replicated forward."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, num_layers=2, d_model=32, num_heads=4, d_ff=64,
+        max_seq_len=32, dtype="float32",
+    )
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.random.RandomState(0).randint(0, 64, (2, 16)).astype("i")
+
+    logits_ref = np.array(tfm.forward(params, tokens, cfg))
+
+    mesh = create_mesh((2, 4), ("data", "model"))
+    specs = tfm.param_partition_specs(cfg)
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: hasattr(x, "shape"),
+    )
+    fwd = jax.jit(lambda p, t: tfm.forward(p, t, cfg))
+    logits_tp = np.array(fwd(sharded, tokens))
+    assert np.allclose(logits_ref, logits_tp, atol=1e-3)
+
+
+def test_transformer_train_step_dp_tp():
+    """2x4 dp×tp mesh training step runs and loss is finite."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, num_layers=1, d_model=16, num_heads=2, d_ff=32,
+        max_seq_len=16, dtype="float32",
+    )
+    mesh = create_mesh((2, 4), ("data", "model"))
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    specs = tfm.param_partition_specs(cfg)
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), params, param_shardings,
+        is_leaf=lambda x: hasattr(x, "shape"),
+    )
+    step, init = make_train_step(
+        tfm.loss_fn(cfg), optax.adam(1e-3), mesh=mesh,
+        batch_spec={"tokens": NamedSharding(mesh, P("data", None))},
+        donate=False,
+    )
+    opt_state = init(params)
+    tokens = np.random.RandomState(1).randint(0, 32, (8, 16)).astype("i")
+    params, opt_state, loss = step(params, opt_state, {"tokens": tokens},
+                                   jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))

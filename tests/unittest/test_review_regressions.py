@@ -1,0 +1,86 @@
+"""Regression tests for bugs found in the round-1 review pass."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_pooling_full_convention_shapes_match_runtime():
+    # ceil-formula output dims must match what the compiled program yields
+    x = np.random.rand(1, 2, 6, 6).astype("f")
+    s = sym.Pooling(sym.Variable("d"), kernel=(3, 3), stride=(2, 2),
+                    pool_type="max", pooling_convention="full")
+    _, out_shapes, _ = s.infer_shape(d=x.shape)
+    exe = s.bind(mx.cpu(), {"d": mx.nd.array(x)}, grad_req="null")
+    out = exe.forward()[0]
+    assert out.shape == out_shapes[0] == (1, 2, 3, 3)
+    # padding contributes -inf for max: corner value is a real max, not pad
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_bind_without_aux_states_allocates_from_arg_shapes():
+    s = sym.BatchNorm(sym.Variable("data"), name="bn")
+    args = {
+        "data": mx.nd.ones((2, 3, 4, 4)),
+        "bn_gamma": mx.nd.ones((3,)),
+        "bn_beta": mx.nd.zeros((3,)),
+    }
+    exe = s.bind(mx.cpu(), args, grad_req="null")
+    assert exe.aux_arrays[0].shape == (3,)
+    exe.forward()  # must run
+
+
+def test_makeloss_bf16_backward():
+    a = sym.Variable("a")
+    s = sym.MakeLoss(sym.sum(a * a))
+    import jax.numpy as jnp
+
+    x = mx.nd.NDArray(jnp.ones((3,), jnp.bfloat16))
+    g = mx.nd.NDArray(jnp.zeros((3,), jnp.bfloat16))
+    exe = s.bind(mx.cpu(), {"a": x}, args_grad={"a": g})
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.allclose(np.asarray(exe.grad_dict["a"].asnumpy(), np.float32), 2.0)
+
+
+def test_identity_attach_kl_sparse_reg_runs():
+    a = sym.Variable("a")
+    s = sym.MakeLoss(sym.sum(sym.IdentityAttachKLSparseReg(a)))
+    x = np.random.rand(4, 3).astype("f")
+    exe = s.bind(mx.cpu(), {"a": mx.nd.array(x)},
+                 args_grad={"a": mx.nd.zeros((4, 3))})
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["a"].asnumpy()
+    assert np.isfinite(g).all()
+    assert abs(g).sum() > 0
+
+
+def test_feedforward_numpy_input_small():
+    # numpy-X path: batch size must be an int (X.shape[0] // 2 path)
+    mx.random.seed(0)
+    X = np.random.rand(100, 10).astype("f")
+    Y = (X[:, 0] > 0.5).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data=data, num_hidden=2, name="fc"), name="softmax"
+    )
+    model = mx.FeedForward(net, ctx=mx.cpu(), num_epoch=1, learning_rate=0.1)
+    model.fit(X=X, y=Y)  # must not raise on float batch size
+
+
+def test_prefetching_iter_protocol():
+    from mxnet_tpu import io as mio
+
+    data = np.arange(40).reshape(10, 4).astype("f")
+    base = mio.NDArrayIter(data, np.zeros(10), batch_size=5)
+    pf = mio.PrefetchingIter(base, prefetch_depth=4)
+    # iter_next / getdata protocol must see every batch exactly once
+    seen = []
+    while pf.iter_next():
+        seen.append(pf.getdata()[0].asnumpy()[0, 0])
+    assert len(seen) == 2 and seen[0] != seen[1]
+    pf.reset()
+    assert pf._queue.maxsize == 4  # depth preserved across reset
+    assert len(list(pf)) == 2

@@ -1,0 +1,128 @@
+"""Symbol tests (modeled on reference tests/python/unittest/test_symbol.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def mlp2():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    out = sym.Activation(data=out, act_type="relu")
+    out = sym.FullyConnected(data=out, name="fc2", num_hidden=10)
+    return out
+
+
+def test_symbol_basic():
+    m = mlp2()
+    assert m.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"
+    ]
+    assert m.list_outputs() == ["fc2_output"]
+
+
+def test_compose():
+    data = sym.Variable("data")
+    net1 = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = sym.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    net2 = sym.FullyConnected(name="fc3", num_hidden=10)
+    net2 = sym.Activation(data=net2, act_type="relu")
+    net2 = sym.FullyConnected(data=net2, name="fc4", num_hidden=20)
+    composed = net2(fc3_data=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc3_weight" in args
+
+
+def test_symbol_internals():
+    m = mlp2()
+    internals = m.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_infer_shape():
+    m = mlp2()
+    arg_shapes, out_shapes, _ = m.infer_shape(data=(8, 100))
+    assert out_shapes == [(8, 10)]
+    d = dict(zip(m.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (10, 100)
+    assert d["fc2_weight"] == (10, 10)
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_infer_type():
+    m = mlp2()
+    arg_types, out_types, _ = m.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types == [np.float32]
+
+
+def test_json_roundtrip():
+    m = mlp2()
+    js = m.tojson()
+    m2 = sym.load_json(js)
+    assert m2.list_arguments() == m.list_arguments()
+    assert m2.list_outputs() == m.list_outputs()
+    # graphs must execute identically
+    e1 = m.simple_bind(mx.cpu(), data=(2, 5))
+    e2 = m2.simple_bind(mx.cpu(), data=(2, 5))
+    x = np.random.rand(2, 5).astype("f")
+    for e in (e1, e2):
+        e.arg_dict["data"][:] = x
+        for k, v in e.arg_dict.items():
+            if k != "data":
+                v[:] = 0.5
+    assert np.allclose(
+        e1.forward()[0].asnumpy(), e2.forward()[0].asnumpy()
+    )
+
+
+def test_symbol_arithmetic_sugar():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / 2 + 1
+    exe = c.bind(mx.cpu(), {"a": mx.nd.array(np.array([2.0])),
+                            "b": mx.nd.array(np.array([4.0]))})
+    out = exe.forward()[0].asnumpy()
+    assert np.allclose(out, (2 + 4) * 2 - 2 / 2 + 1)
+
+
+def test_grouped_symbol():
+    a = sym.Variable("a")
+    x = sym.exp(a)
+    y = sym.sqrt(a)
+    g = sym.Group([x, y])
+    assert len(g.list_outputs()) == 2
+    exe = g.bind(mx.cpu(), {"a": mx.nd.array(np.array([4.0]))})
+    outs = exe.forward()
+    assert np.allclose(outs[0].asnumpy(), np.exp(4))
+    assert np.allclose(outs[1].asnumpy(), 2)
+
+
+def test_attr_scope_and_variable_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        b = sym.exp(a)
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+    v = sym.Variable("w", lr_mult=2.0)
+    assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_multi_output_slice_channel():
+    data = sym.Variable("data")
+    s = sym.SliceChannel(data=data, num_outputs=3, axis=1, name="slice")
+    assert len(s.list_outputs()) == 3
+    exe = s.bind(mx.cpu(), {"data": mx.nd.array(np.arange(12).reshape(2, 6))})
+    outs = exe.forward()
+    assert outs[0].shape == (2, 2)
+    assert np.allclose(outs[1].asnumpy(), np.arange(12).reshape(2, 6)[:, 2:4])
