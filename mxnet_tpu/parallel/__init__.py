@@ -6,12 +6,12 @@ collectives over an ICI/DCN device mesh; ctx_group model parallelism
 becomes sharding annotations; and sequence/context parallelism (absent in
 the 2016 reference but first-class here) is provided by ring attention.
 """
-from .mesh import create_mesh, default_mesh, local_devices
+from .mesh import create_mesh, default_mesh, local_devices, set_default_devices
 from .trainer import ShardedTrainer, make_train_step, data_parallel_spec
 from .ring_attention import ring_attention
 
 __all__ = [
-    "create_mesh", "default_mesh", "local_devices",
+    "create_mesh", "default_mesh", "local_devices", "set_default_devices",
     "ShardedTrainer", "make_train_step", "data_parallel_spec",
     "ring_attention",
 ]

@@ -9,6 +9,20 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
+# Overridable device pool for mesh construction. The test harness (and any
+# embedder that wants meshes on something other than jax.devices(), e.g. the
+# virtual CPU devices from xla_force_host_platform_device_count) sets this
+# via set_default_devices(); production code paths keep the real device set
+# and fail loudly when a mesh doesn't fit.
+_default_devices = None
+
+
+def set_default_devices(devices):
+    """Set the device pool used when create_mesh/default_mesh get no
+    explicit devices. Pass None to restore jax.devices()."""
+    global _default_devices
+    _default_devices = list(devices) if devices is not None else None
+
 
 def local_devices(platform=None):
     import jax
@@ -21,15 +35,23 @@ def local_devices(platform=None):
     return jax.devices()
 
 
+def _resolve_devices(devices):
+    import jax
+
+    if devices is not None:
+        return list(devices)
+    if _default_devices is not None:
+        return list(_default_devices)
+    return jax.devices()
+
+
 def create_mesh(shape, axis_names, devices=None):
     """Create a Mesh of the given logical shape, e.g.
     create_mesh((2, 4), ('data', 'model'))."""
     import numpy as np
-    import jax
     from jax.sharding import Mesh
 
-    if devices is None:
-        devices = jax.devices()
+    devices = _resolve_devices(devices)
     n = 1
     for s in shape:
         n *= s
@@ -43,8 +65,5 @@ def create_mesh(shape, axis_names, devices=None):
 
 def default_mesh(axis_name="data", devices=None):
     """1-D all-devices mesh — pure data parallelism."""
-    import jax
-
-    if devices is None:
-        devices = jax.devices()
+    devices = _resolve_devices(devices)
     return create_mesh((len(devices),), (axis_name,), devices)

@@ -18,3 +18,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon site hook (PYTHONPATH=/root/.axon_site) force-loads the TPU
+# plugin even when JAX_PLATFORMS=cpu, which makes the TPU the default
+# backend: uncommitted arrays then compute on the real chip while
+# cpu(i)-committed arrays compute on host — mixed placement and mixed
+# numerics inside one test. Pin the default device to CPU so every
+# uncommitted op and jit lands on the virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+# Meshes built without explicit devices should use the virtual CPU mesh,
+# not the single real TPU chip.
+from mxnet_tpu.parallel import mesh as _mesh  # noqa: E402
+
+_mesh.set_default_devices(jax.devices("cpu"))
