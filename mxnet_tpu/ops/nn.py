@@ -9,8 +9,11 @@ and jax.vjp over the traced graph replaces every hand-written Backward
 (ref file:line citations per op below).
 
 bfloat16 note: these functions are dtype-polymorphic; the training APIs
-choose f32 or bf16. Convolutions accumulate in f32 via
-``preferred_element_type`` so bf16 training matches fp32 within tolerance.
+choose f32 or bf16, and op outputs follow the data operand's dtype.
+FullyConnected requests f32 accumulation via ``preferred_element_type``;
+convolutions run bf16-in/bf16-out (jax 0.9's conv transpose rejects a
+widened cotangent) and rely on XLA:TPU's f32 MXU accumulators — on
+non-TPU backends low-precision conv accumulation is backend-default.
 """
 from __future__ import annotations
 
@@ -155,6 +158,11 @@ register(
 # -- Convolution (ref: src/operator/convolution-inl.h:489) ---------------------
 def _conv_fwd(params, inputs, aux, is_train, rng):
     data, weight = inputs[0], inputs[1]
+    # operands must share a dtype (lax.conv requirement); the op's contract
+    # is that the output follows data's dtype (mixed-precision: bf16
+    # activations with f32 master weights compute in bf16 on the MXU)
+    if weight.dtype != data.dtype:
+        weight = weight.astype(data.dtype)
     nsp = data.ndim - 2
     stride = _pair(params["stride"] or (1,) * nsp, nsp)
     pad = _pair(params["pad"] or (0,) * nsp, nsp)
@@ -167,8 +175,11 @@ def _conv_fwd(params, inputs, aux, is_train, rng):
         rhs_dilation=dilate,
         dimension_numbers=_conv_dnums(nsp),
         feature_group_count=params["num_group"],
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+        # no preferred_element_type: jax 0.9 conv transpose can't mix an
+        # f32 cotangent with bf16 operands; XLA:TPU accumulates bf16 convs
+        # in the MXU's f32 accumulators regardless, so bf16-in/bf16-out is
+        # the fast AND safe mixed-precision shape
+    )
     if not params["no_bias"]:
         bias = inputs[2].astype(out.dtype).reshape((1, -1) + (1,) * nsp)
         out = out + bias
@@ -226,6 +237,8 @@ register(
 # -- Deconvolution (ref: src/operator/deconvolution-inl.h) ---------------------
 def _deconv_fwd(params, inputs, aux, is_train, rng):
     data, weight = inputs[0], inputs[1]
+    if weight.dtype != data.dtype:
+        weight = weight.astype(data.dtype)
     nsp = data.ndim - 2
     stride = _pair(params["stride"] or (1,) * nsp, nsp)
     pad = _pair(params["pad"] or (0,) * nsp, nsp)
@@ -242,8 +255,8 @@ def _deconv_fwd(params, inputs, aux, is_train, rng):
         lhs_dilation=stride,
         dimension_numbers=("NC" + "DHW"[-nsp:], "IO" + "DHW"[-nsp:], "NC" + "DHW"[-nsp:]),
         feature_group_count=params["num_group"],
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+        # see Convolution: no preferred_element_type for jax-0.9 AD compat
+    )
     if not params["no_bias"]:
         out = out + inputs[2].reshape((1, -1) + (1,) * nsp)
     return [out], []

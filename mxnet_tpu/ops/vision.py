@@ -145,3 +145,116 @@ register(
         infer_shape=_st_shape,
     )
 )
+
+
+# -- Correlation (ref: src/operator/correlation-inl.h, correlation.cc) ---------
+def _corr_geom(params, dshape):
+    """Shared geometry (ref: correlation-inl.h:176-206 InferShape)."""
+    import math
+
+    pad, ks = params["pad_size"], params["kernel_size"]
+    md, s1, s2 = params["max_displacement"], params["stride1"], params["stride2"]
+    ph, pw = dshape[2] + 2 * pad, dshape[3] + 2 * pad
+    kr = (ks - 1) // 2
+    border = md + kr
+    top_h = int(math.ceil(float(ph - 2 * border) / s1))
+    top_w = int(math.ceil(float(pw - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    if top_h < 1 or top_w < 1:
+        raise MXNetError(
+            "Correlation cannot be done with current settings. "
+            "Neighborhood and kernel don't fit in blob"
+        )
+    return ph, pw, kr, top_h, top_w, ngr, ngw
+
+
+def _correlation_fwd(params, inputs, aux, is_train, rng):
+    """FlowNet-style correlation. The reference's scalar 7-deep loop nest
+    (correlation.cc:22-63) becomes, per displacement, an elementwise
+    combine of two statically-shifted slices followed by ONE ones-kernel
+    conv that performs the window+channel sum on the MXU — ngw^2 small
+    convs total, all shapes static so XLA fuses and pipelines them."""
+    data1, data2 = inputs
+    pad, ks = params["pad_size"], params["kernel_size"]
+    md, s1, s2 = params["max_displacement"], params["stride1"], params["stride2"]
+    ph, pw, kr, top_h, top_w, ngr, ngw = _corr_geom(params, data1.shape)
+    N, C = data1.shape[0], data1.shape[1]
+    f32 = jnp.float32
+    p1 = jnp.pad(data1.astype(f32), ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2.astype(f32), ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    sumelems = float(ks * ks * C)
+    # window rows for out (i,j) start at y1 = i*s1 + md (ref correlation.cc:41-42)
+    span_h = (top_h - 1) * s1 + ks
+    span_w = (top_w - 1) * s1 + ks
+    a = jax.lax.slice(p1, (0, 0, md, md), (N, C, md + span_h, md + span_w))
+    ones_k = jnp.ones((1, C, ks, ks), f32)
+    chans = []
+    for tc in range(ngw * ngw):
+        s2o = (tc % ngw - ngr) * s2
+        s2p = (tc // ngw - ngr) * s2
+        b = jax.lax.slice(
+            p2, (0, 0, md + s2p, md + s2o),
+            (N, C, md + s2p + span_h, md + s2o + span_w),
+        )
+        prod = a * b if params["is_multiply"] else jnp.abs(a - b)
+        corr = jax.lax.conv_general_dilated(
+            prod, ones_k, window_strides=(s1, s1), padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        chans.append(corr[:, 0] / sumelems)
+    out = jnp.stack(chans, axis=1)
+    return [out.astype(data1.dtype)], []
+
+
+def _correlation_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("Correlation: data shape unknown")
+    d = in_shapes[0]
+    if len(d) != 4:
+        raise MXNetError("Correlation: data should be a 4D tensor")
+    _, _, _, top_h, top_w, _, ngw = _corr_geom(params, d)
+    return [d, d], [(d[0], ngw * ngw, top_h, top_w)], []
+
+
+register(
+    OpDef(
+        "Correlation",
+        _correlation_fwd,
+        params={
+            "kernel_size": Field("int", default=1),
+            "max_displacement": Field("int", default=1),
+            "stride1": Field("int", default=1),
+            "stride2": Field("int", default=1),
+            "pad_size": Field("int", default=0),
+            "is_multiply": Field("bool", default=True),
+        },
+        arguments=("data1", "data2"),
+        infer_shape=_correlation_shape,
+    )
+)
+
+
+# -- name aliases for reference parity ----------------------------------------
+# CuDNNBatchNorm (ref: src/operator/cudnn_batch_norm.cc) is the cuDNN fast
+# path of BatchNorm; on TPU there is one XLA-compiled implementation, so
+# the name aliases it. _CrossDeviceCopy (ref: src/operator/cross_device_copy.cc)
+# is a graph-visible identity whose placement the Executor handles
+# (per-node device_put under group2ctx — executor.py _run).
+from .registry import REGISTRY as _REG
+
+_REG["CuDNNBatchNorm"] = _REG["BatchNorm"]
+
+
+def _cross_device_copy_fwd(params, inputs, aux, is_train, rng):
+    return [inputs[0]], []
+
+
+register(
+    OpDef(
+        "_CrossDeviceCopy",
+        _cross_device_copy_fwd,
+        arguments=("data",),
+        imperative=False,
+    )
+)

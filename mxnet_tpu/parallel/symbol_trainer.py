@@ -8,8 +8,9 @@ buffers, so weights update in-place in HBM and every elementwise op fuses
 into the surrounding matmuls/convs.
 
 Mixed precision: master params stay f32; tensors with ndim>=2 are cast to
-``compute_dtype`` (bf16 on TPU → MXU) inside the step; convs/FC accumulate
-f32 via preferred_element_type (ops/nn.py).
+``compute_dtype`` (bf16 on TPU → MXU) inside the step; FC accumulates f32
+via preferred_element_type, convs ride XLA:TPU's f32 MXU accumulators
+(see ops/nn.py dtype note).
 
 Used by bench.py; Module users get the same semantics through the
 Executor's fused fwd+bwd path.

@@ -1,0 +1,88 @@
+"""Correlation / ROIPooling / SpatialTransformer coverage
+(ref: tests/python/unittest/test_operator.py patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _ref_corr(d1, d2, ks=1, md=1, s1=1, s2=1, pad=0, is_mult=True):
+    """Direct port of the reference loop nest (correlation.cc:22-63)."""
+    N, C, H, W = d1.shape
+    ph, pw = H + 2 * pad, W + 2 * pad
+    kr = (ks - 1) // 2
+    border = md + kr
+    th = int(np.ceil((ph - 2 * border) / s1))
+    tw = int(np.ceil((pw - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    t1 = np.zeros((N, ph, pw, C), d1.dtype)
+    t2 = np.zeros_like(t1)
+    t1[:, pad:pad + H, pad:pad + W, :] = d1.transpose(0, 2, 3, 1)
+    t2[:, pad:pad + H, pad:pad + W, :] = d2.transpose(0, 2, 3, 1)
+    out = np.zeros((N, ngw * ngw, th, tw), np.float32)
+    sumelems = ks * ks * C
+    for i in range(th):
+        for j in range(tw):
+            x1, y1 = j * s1 + md, i * s1 + md
+            for tc in range(ngw * ngw):
+                s2o = (tc % ngw - ngr) * s2
+                s2p = (tc // ngw - ngr) * s2
+                x2, y2 = x1 + s2o, y1 + s2p
+                for h in range(ks):
+                    for w in range(ks):
+                        a = t1[:, y1 + h, x1 + w, :]
+                        b = t2[:, y2 + h, x2 + w, :]
+                        d = (a * b) if is_mult else np.abs(a - b)
+                        out[:, tc, i, j] += d.sum(axis=1)
+                out[:, tc, i, j] /= sumelems
+    return out
+
+
+@pytest.mark.parametrize(
+    "ks,md,s1,s2,pad,mult",
+    [(1, 1, 1, 1, 0, True), (3, 2, 2, 1, 2, True),
+     (1, 2, 1, 2, 1, False), (3, 1, 1, 1, 1, False)],
+)
+def test_correlation_forward_matches_reference(ks, md, s1, s2, pad, mult):
+    rng = np.random.RandomState(0)
+    d1 = rng.randn(2, 3, 8, 8).astype("f")
+    d2 = rng.randn(2, 3, 8, 8).astype("f")
+    got = mx.nd.Correlation(
+        mx.nd.array(d1), mx.nd.array(d2), kernel_size=ks, max_displacement=md,
+        stride1=s1, stride2=s2, pad_size=pad, is_multiply=mult,
+    ).asnumpy()
+    want = _ref_corr(d1, d2, ks, md, s1, s2, pad, mult)
+    assert got.shape == want.shape
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_correlation_backward_numeric():
+    sym = mx.sym.Correlation(
+        data1=mx.sym.Variable("data1"), data2=mx.sym.Variable("data2"),
+        kernel_size=1, max_displacement=1,
+    )
+    rng = np.random.RandomState(1)
+    loc = {"data1": rng.randn(1, 2, 5, 5).astype("f"),
+           "data2": rng.randn(1, 2, 5, 5).astype("f")}
+    check_numeric_gradient(sym, loc, numeric_eps=1e-2, check_eps=0.05)
+
+
+def test_correlation_bad_geometry():
+    with pytest.raises(mx.base.MXNetError):
+        mx.sym.Correlation(
+            data1=mx.sym.Variable("a"), data2=mx.sym.Variable("b"),
+            max_displacement=10,
+        ).infer_shape(a=(1, 1, 4, 4), b=(1, 1, 4, 4))
+
+
+def test_cudnn_batchnorm_alias():
+    x = mx.sym.Variable("data")
+    bn = mx.sym.CuDNNBatchNorm(data=x, name="bn")
+    ex = bn.simple_bind(mx.cpu(0), data=(2, 3, 4, 4))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.randn(2, 3, 4, 4).astype("f")
+    out = ex.forward(is_train=True)[0].asnumpy()
+    m = out.mean(axis=(0, 2, 3))
+    assert np.allclose(m, 0, atol=1e-4)
