@@ -1,0 +1,82 @@
+"""Shared fit() used by the image-classification examples.
+
+Mirrors the reference's example/image-classification/train_model.py:6-89:
+create the kvstore from --kv-store, build FeedForward, wire checkpoint /
+speedometer callbacks, call .fit().
+"""
+import logging
+import os
+
+import mxnet_tpu as mx
+
+
+def _contexts(args):
+    if args.ctx == "cpu" or (args.ctx == "auto" and mx.context.num_devices("tpu") == 0):
+        dev = mx.cpu
+    else:
+        dev = mx.tpu
+    n = max(1, args.num_devices)
+    return [dev(i) for i in range(n)]
+
+
+def fit(args, network, data_loader, batch_end_callback=None):
+    # kvstore: 'local' | 'device' | 'dist_sync' | 'dist_async'
+    # (ref train_model.py:8  kv = mx.kvstore.create(args.kv_store))
+    kv = mx.kvstore.create(args.kv_store)
+
+    head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
+    logging.basicConfig(level=logging.DEBUG, format=head)
+    logging.info("start with arguments %s", args)
+
+    (train, val) = data_loader(args, kv)
+
+    devs = _contexts(args)
+
+    epoch_size = args.num_examples // args.batch_size
+    checkpoint = None
+    if args.model_prefix is not None:
+        dirname = os.path.dirname(args.model_prefix)
+        if dirname and not os.path.isdir(dirname):
+            os.makedirs(dirname, exist_ok=True)
+        checkpoint = mx.callback.do_checkpoint(args.model_prefix)
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.load_epoch is not None:
+        assert args.model_prefix is not None
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    lr_scheduler = None
+    if args.lr_factor is not None and args.lr_factor < 1:
+        lr_scheduler = mx.lr_scheduler.FactorScheduler(
+            step=max(int(epoch_size * args.lr_factor_epoch), 1),
+            factor=args.lr_factor)
+
+    model = mx.FeedForward(
+        ctx=devs,
+        symbol=network,
+        num_epoch=args.num_epochs,
+        begin_epoch=begin_epoch,
+        learning_rate=args.lr,
+        momentum=0.9,
+        wd=0.00001,
+        lr_scheduler=lr_scheduler,
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        arg_params=arg_params,
+        aux_params=aux_params,
+    )
+
+    batch_cbs = [mx.callback.Speedometer(args.batch_size, 50)]
+    if batch_end_callback is not None:
+        batch_cbs.insert(0, batch_end_callback)
+
+    model.fit(
+        X=train,
+        eval_data=val,
+        kvstore=kv,
+        batch_end_callback=batch_cbs,
+        epoch_end_callback=checkpoint,
+    )
+    return model

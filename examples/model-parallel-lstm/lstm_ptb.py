@@ -1,0 +1,113 @@
+"""Model-parallel LSTM — baseline config #4.
+
+Mirrors the reference example/model-parallel-lstm/lstm_ptb.py:79-90 +
+lstm.py setup_rnn_model/train_lstm: each LSTM layer (and embed/decode) is
+tagged with AttrScope(ctx_group=...) (mxnet_tpu/models/lstm.py
+group2ctx_layers=True), the symbol is bound with a group2ctx map placing
+groups on different devices, and a manual SGD loop drives it. On TPU the
+groups become placement constraints over the mesh; XLA overlaps the
+pipeline the way the reference's dependency engine did.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import lstm_unroll, lstm_group2ctx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', 'rnn'))
+from bucket_io import BucketSentenceIter  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument('--data-dir', type=str, default='ptb/')
+    p.add_argument('--seq-len', type=int, default=32)
+    p.add_argument('--num-hidden', type=int, default=200)
+    p.add_argument('--num-embed', type=int, default=128)
+    p.add_argument('--num-lstm-layer', type=int, default=4)
+    p.add_argument('--num-devices', type=int, default=4)
+    p.add_argument('--num-epochs', type=int, default=2)
+    p.add_argument('--batch-size', type=int, default=32)
+    p.add_argument('--lr', type=float, default=0.5)
+    p.add_argument('--ctx', type=str, default='auto', choices=['auto', 'cpu', 'tpu'])
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    logging.basicConfig(level=logging.INFO)
+    if args.ctx == 'cpu' or (args.ctx == 'auto' and mx.context.num_devices('tpu') == 0):
+        devs = [mx.cpu(i) for i in range(args.num_devices)]
+    else:
+        devs = [mx.tpu(i) for i in range(min(args.num_devices,
+                                             max(1, mx.context.num_devices('tpu'))))]
+
+    init_states = (
+        [('l%d_init_c' % l, (args.batch_size, args.num_hidden))
+         for l in range(args.num_lstm_layer)]
+        + [('l%d_init_h' % l, (args.batch_size, args.num_hidden))
+           for l in range(args.num_lstm_layer)])
+    train_path = os.path.join(args.data_dir, 'ptb.train.txt')
+    data_train = BucketSentenceIter(
+        train_path if os.path.exists(train_path) else None, None,
+        [args.seq_len], args.batch_size, init_states)
+
+    # ctx_group-tagged symbol (ref model-parallel-lstm/lstm.py:48-99)
+    sym = lstm_unroll(args.num_lstm_layer, args.seq_len, data_train.vocab_size,
+                      num_hidden=args.num_hidden, num_embed=args.num_embed,
+                      num_label=data_train.vocab_size, group2ctx_layers=True)
+    group2ctx = lstm_group2ctx(args.num_lstm_layer, devs)
+
+    # bind with group placement (ref lstm.py setup_rnn_model → simple_bind
+    # with group2ctx; lstm_ptb.py:79-90)
+    input_shapes = dict(
+        [('data', (args.batch_size, args.seq_len)),
+         ('softmax_label', (args.batch_size, args.seq_len))]
+        + [(n, s) for n, s in init_states])
+    exe = sym.simple_bind(ctx=devs[0], grad_req='add', group2ctx=group2ctx,
+                          **input_shapes)
+
+    initializer = mx.initializer.Xavier()
+    for name, arr in zip(sym.list_arguments(), exe.arg_arrays):
+        if name not in input_shapes or name.endswith(('init_c', 'init_h')):
+            if not name.endswith(('_c', '_h')) and name not in ('data', 'softmax_label'):
+                initializer(name, arr)
+
+    param_names = [n for n in sym.list_arguments()
+                   if n not in ('data', 'softmax_label')
+                   and not n.endswith(('init_c', 'init_h'))]
+    name2idx = {n: i for i, n in enumerate(sym.list_arguments())}
+    metric = mx.metric.Perplexity(ignore_label=0)
+
+    for epoch in range(args.num_epochs):
+        data_train.reset()
+        metric.reset()
+        tic = time.time()
+        nbatch = 0
+        for batch in data_train:
+            arg_dict = dict(zip(sym.list_arguments(), exe.arg_arrays))
+            arg_dict['data'][:] = batch.data[0]
+            arg_dict['softmax_label'][:] = batch.label[0]
+            for g in exe.grad_arrays:
+                if g is not None:
+                    g[:] = 0.0
+            exe.forward(is_train=True)
+            exe.backward()
+            for n in param_names:
+                i = name2idx[n]
+                w, g = exe.arg_arrays[i], exe.grad_arrays[i]
+                w[:] = w - (args.lr / args.batch_size) * g
+            metric.update([batch.label[0]], [exe.outputs[0]])
+            nbatch += 1
+        name, val = metric.get()
+        logging.info('Epoch[%d] %s=%f  (%.1f samples/s)', epoch, name, val,
+                     nbatch * args.batch_size / (time.time() - tic))
+
+
+if __name__ == '__main__':
+    main()

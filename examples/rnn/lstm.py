@@ -1,0 +1,42 @@
+"""Fixed-length unrolled LSTM LM — the reference's example/rnn/lstm.py
+cell and unroll, re-exported from the model zoo (mxnet_tpu/models/lstm.py
+is the canonical implementation; same math as ref lstm.py:17-41).
+
+Run directly for a quick synthetic-corpus training at one fixed length.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import LSTMState, LSTMParam, lstm_cell as lstm, lstm_unroll  # noqa: F401
+from bucket_io import BucketSentenceIter
+
+
+if __name__ == '__main__':
+    p = argparse.ArgumentParser()
+    p.add_argument('--seq-len', type=int, default=20)
+    p.add_argument('--num-hidden', type=int, default=100)
+    p.add_argument('--num-embed', type=int, default=64)
+    p.add_argument('--num-lstm-layer', type=int, default=1)
+    p.add_argument('--num-epochs', type=int, default=3)
+    p.add_argument('--batch-size', type=int, default=32)
+    args = p.parse_args()
+
+    init_states = (
+        [('l%d_init_c' % l, (args.batch_size, args.num_hidden))
+         for l in range(args.num_lstm_layer)]
+        + [('l%d_init_h' % l, (args.batch_size, args.num_hidden))
+           for l in range(args.num_lstm_layer)])
+    data_train = BucketSentenceIter(None, None, [args.seq_len], args.batch_size,
+                                    init_states)
+    sym = lstm_unroll(args.num_lstm_layer, args.seq_len, data_train.vocab_size,
+                      num_hidden=args.num_hidden, num_embed=args.num_embed,
+                      num_label=data_train.vocab_size)
+    import logging
+    logging.basicConfig(level=logging.DEBUG)
+    model = mx.FeedForward(sym, num_epoch=args.num_epochs, learning_rate=0.1,
+                           momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=data_train, eval_metric=mx.metric.Perplexity(ignore_label=0),
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))

@@ -1,0 +1,72 @@
+"""PTB LSTM LM with bucketing — baseline config #3.
+
+Mirrors the reference example/rnn/lstm_bucketing.py:48-62: sym_gen per
+bucket key + BucketSentenceIter, trained with FeedForward. Uses PTB text
+(ptb.train.txt) when present, else a synthetic Markov corpus.
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import lstm_unroll
+from bucket_io import BucketSentenceIter, default_build_vocab
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument('--data-dir', type=str, default='ptb/')
+    p.add_argument('--num-hidden', type=int, default=200)
+    p.add_argument('--num-embed', type=int, default=200)
+    p.add_argument('--num-lstm-layer', type=int, default=2)
+    p.add_argument('--num-epochs', type=int, default=5)
+    p.add_argument('--batch-size', type=int, default=32)
+    p.add_argument('--lr', type=float, default=0.1)
+    p.add_argument('--kv-store', type=str, default='local')
+    p.add_argument('--buckets', type=int, nargs='+', default=[10, 20, 30, 40, 60])
+    return p.parse_args()
+
+
+if __name__ == '__main__':
+    args = parse_args()
+    batch_size = args.batch_size
+    buckets = args.buckets
+
+    init_c = [('l%d_init_c' % l, (batch_size, args.num_hidden))
+              for l in range(args.num_lstm_layer)]
+    init_h = [('l%d_init_h' % l, (batch_size, args.num_hidden))
+              for l in range(args.num_lstm_layer)]
+    init_states = init_c + init_h
+
+    train_path = os.path.join(args.data_dir, 'ptb.train.txt')
+    if os.path.exists(train_path):
+        vocab = default_build_vocab(train_path)
+        data_train = BucketSentenceIter(train_path, vocab, buckets, batch_size,
+                                        init_states)
+    else:
+        data_train = BucketSentenceIter(None, None, buckets, batch_size,
+                                        init_states)
+    vocab_size = data_train.vocab_size
+
+    def sym_gen(seq_len):
+        # (ref lstm_bucketing.py:53-56)
+        return lstm_unroll(args.num_lstm_layer, seq_len, vocab_size,
+                           num_hidden=args.num_hidden, num_embed=args.num_embed,
+                           num_label=vocab_size)
+
+    model = mx.FeedForward(
+        ctx=mx.context.current_context(),
+        symbol=sym_gen,
+        num_epoch=args.num_epochs,
+        learning_rate=args.lr,
+        momentum=0.9,
+        wd=0.00001,
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34))
+
+    import logging
+    logging.basicConfig(level=logging.DEBUG)
+    model.fit(X=data_train,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              batch_end_callback=mx.callback.Speedometer(batch_size, 50),
+              kvstore=args.kv_store)

@@ -133,10 +133,32 @@ class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (ref: python/mxnet/io.py:475)."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
-                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label",
+                 num_parts=1, part_index=0):
         super().__init__()
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        if num_parts > 1:
+            # distributed sharding (ref: src/io/iter_mnist.cc part_index /
+            # kv.num_workers convention used by tests/nightly/dist_lenet.py).
+            # Every worker gets exactly n//num_parts samples so sharded
+            # iterators yield identical batch counts — unequal counts would
+            # deadlock collective-backed dist training at epoch end. When
+            # shuffling, a shared-seed permutation of the FULL set runs
+            # before the split so class-ordered inputs don't bias shards.
+            if not 0 <= part_index < num_parts:
+                raise ValueError(
+                    "part_index must be in [0, num_parts), got %d/%d"
+                    % (part_index, num_parts))
+            n = self.data[0][1].shape[0]
+            per = n // num_parts
+            if shuffle:
+                perm = _np.random.RandomState(0).permutation(n)
+                sel = perm[part_index * per:(part_index + 1) * per]
+            else:
+                sel = _np.arange(part_index * per, (part_index + 1) * per)
+            self.data = [(k, v[sel]) for k, v in self.data]
+            self.label = [(k, v[sel]) for k, v in self.label]
         self.num_data = self.data[0][1].shape[0]
         assert self.num_data >= batch_size, "batch_size needs to be smaller than data size."
         self.idx = _np.arange(self.num_data)
@@ -236,7 +258,8 @@ class MNISTIter(NDArrayIter):
 
     def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
                  batch_size=128, shuffle=True, flat=False, silent=False, seed=0,
-                 input_shape=None, allow_synthetic=True, num_synthetic=2048, **kwargs):
+                 input_shape=None, allow_synthetic=True, num_synthetic=2048,
+                 num_parts=1, part_index=0, **kwargs):
         if os.path.exists(image) and os.path.exists(label):
             images = _read_idx_images(image).astype(_np.float32) / 255.0
             labels = _read_idx_labels(label)
@@ -258,7 +281,7 @@ class MNISTIter(NDArrayIter):
             images = images.reshape(images.shape[0], 1, 28, 28)
         super().__init__(
             images, labels, batch_size=batch_size, shuffle=shuffle,
-            last_batch_handle="discard",
+            last_batch_handle="discard", num_parts=num_parts, part_index=part_index,
         )
 
 
@@ -444,7 +467,8 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_img=None, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
-                 round_batch=True, prefetch_depth=4, seed=0, **kwargs):
+                 round_batch=True, prefetch_depth=4, seed=0,
+                 num_parts=1, part_index=0, **kwargs):
         super().__init__()
         from . import recordio as _recordio
 
@@ -464,12 +488,25 @@ class ImageRecordIter(DataIter):
         elif mean_r or mean_g or mean_b:
             self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32).reshape(3, 1, 1)
         self._rng = _np.random.RandomState(seed)
+        # round-robin sharding during the scan: out-of-shard record bytes are
+        # dropped immediately so per-worker memory is O(dataset/num_parts);
+        # shards are then truncated to total//num_parts so every worker
+        # yields the same batch count (collective-backed dist training
+        # deadlocks on unequal counts)
+        if not 0 <= part_index < num_parts:
+            raise ValueError("part_index must be in [0, num_parts), got %d/%d"
+                             % (part_index, num_parts))
         self._records = []
+        i = 0
         while True:
             s = self.rec.read()
             if s is None:
                 break
-            self._records.append(s)
+            if i % num_parts == part_index:
+                self._records.append(s)
+            i += 1
+        if num_parts > 1:
+            self._records = self._records[: i // num_parts]
         self._order = _np.arange(len(self._records))
         self.cursor = -batch_size
 

@@ -6,12 +6,13 @@ sharded parallel trainer for mesh-scale training.
 """
 from .lenet import get_lenet
 from .mlp import get_mlp
-from .resnet import get_resnet
+from .resnet import get_resnet, get_resnet_small
 from .inception_bn import get_inception_bn_small
 from .lstm import lstm_unroll
 from . import transformer
 
 __all__ = [
-    "get_lenet", "get_mlp", "get_resnet", "get_inception_bn_small",
+    "get_lenet", "get_mlp", "get_resnet", "get_resnet_small",
+    "get_inception_bn_small",
     "lstm_unroll", "transformer",
 ]

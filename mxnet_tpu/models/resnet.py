@@ -1,6 +1,7 @@
 """ResNet (v1) — baseline config 2, the bench.py flagship
 (ref: example/image-classification/symbol_resnet.py; arch per He et al.).
-Built bf16-friendly: convs accumulate f32 (ops/nn.py), BN in f32.
+Built bf16-friendly: BN statistics in f32; conv accumulation follows the
+backend default (f32 on TPU MXU).
 """
 from __future__ import annotations
 
@@ -58,6 +59,38 @@ def get_resnet(num_classes=1000, num_layers=50):
             body = _bottleneck(body, f, (1, 1), True, "stage%d_unit%d" % (stage + 1, i))
     pool = sym.Pooling(data=body, global_pool=True, kernel=(7, 7), pool_type="avg",
                        name="pool1")
+    flat = sym.Flatten(data=pool)
+    fc = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(data=fc, name="softmax")
+
+
+def _basic_unit(data, num_filter, dim_match, name):
+    """Basic (two 3x3) residual unit for the CIFAR-size net
+    (ref: example/image-classification/symbol_resnet-28-small.py
+    residual_factory)."""
+    stride = (1, 1) if dim_match else (2, 2)
+    c1 = _conv_bn(data, num_filter, (3, 3), stride, (1, 1), name + "_a")
+    c2 = _conv_bn(c1, num_filter, (3, 3), (1, 1), (1, 1), name + "_b", act=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv_bn(data, num_filter, (1, 1), stride, (0, 0),
+                            name + "_sc", act=False)
+    return sym.Activation(data=c2 + shortcut, act_type="relu", name=name + "_relu")
+
+
+def get_resnet_small(num_classes=10, n=3):
+    """ResNet-(6n+2) for 28x28/32x32 inputs — CIFAR baseline config
+    (ref: symbol_resnet-28-small.py get_symbol; n=3 → 20 layers)."""
+    data = sym.Variable("data")
+    body = _conv_bn(data, 16, (3, 3), (1, 1), (1, 1), "conv0")
+    for stage, f in enumerate([16, 32, 64]):
+        for i in range(n):
+            dim_match = not (stage > 0 and i == 0)
+            body = _basic_unit(body, f, dim_match,
+                               "stage%d_unit%d" % (stage + 1, i + 1))
+    pool = sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
+                       pool_type="avg", name="pool1")
     flat = sym.Flatten(data=pool)
     fc = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
     return sym.SoftmaxOutput(data=fc, name="softmax")

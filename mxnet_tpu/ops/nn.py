@@ -258,7 +258,7 @@ def _deconv_fwd(params, inputs, aux, is_train, rng):
         # see Convolution: no preferred_element_type for jax-0.9 AD compat
     )
     if not params["no_bias"]:
-        out = out + inputs[2].reshape((1, -1) + (1,) * nsp)
+        out = out + inputs[2].astype(out.dtype).reshape((1, -1) + (1,) * nsp)
     return [out], []
 
 

@@ -153,6 +153,10 @@ def _corr_geom(params, dshape):
     import math
 
     pad, ks = params["pad_size"], params["kernel_size"]
+    if ks < 1 or ks % 2 == 0:
+        # even kernels would slice past the padded bounds (jax.lax.slice
+        # clamps silently) — the reference's loop nest assumes odd too
+        raise MXNetError("Correlation: kernel_size must be odd, got %d" % ks)
     md, s1, s2 = params["max_displacement"], params["stride1"], params["stride2"]
     ph, pw = dshape[2] + 2 * pad, dshape[3] + 2 * pad
     kr = (ks - 1) // 2
