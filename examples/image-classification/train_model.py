@@ -33,6 +33,9 @@ def fit(args, network, data_loader, batch_end_callback=None):
     devs = _contexts(args)
 
     epoch_size = args.num_examples // args.batch_size
+    if 'dist' in args.kv_store:
+        # each worker sees 1/num_workers of the data (ref train_model.py:60)
+        epoch_size //= kv.num_workers
     checkpoint = None
     if args.model_prefix is not None:
         dirname = os.path.dirname(args.model_prefix)

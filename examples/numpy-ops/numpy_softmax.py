@@ -1,0 +1,66 @@
+"""NumpyOp escape hatch demo — train an MLP whose softmax layer is a
+user-defined numpy operator.
+
+Mirrors the reference example/numpy-ops/numpy_softmax.py (NumpyOp runs
+host-side numpy inside the graph via io_callback — the TPU-native analog
+of _Native/NumpyOp, ref: src/operator/native_op-inl.h,
+python/mxnet/operator.py:124-222).
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super(NumpySoftmax, self).__init__(False)
+
+    def list_arguments(self):
+        return ['data', 'label']
+
+    def list_outputs(self):
+        return ['output']
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        y = out_data[0]
+        y[:] = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+        y /= y.sum(axis=1).reshape((x.shape[0], 1))
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1]
+        l = l.reshape((l.size,)).astype(int)
+        y = out_data[0]
+        dx = in_grad[0]
+        dx[:] = y
+        dx[np.arange(l.shape[0]), l] -= 1.0
+
+
+if __name__ == '__main__':
+    data = mx.symbol.Variable('data')
+    fc1 = mx.symbol.FullyConnected(data=data, name='fc1', num_hidden=128)
+    act1 = mx.symbol.Activation(data=fc1, name='relu1', act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act1, name='fc2', num_hidden=64)
+    act2 = mx.symbol.Activation(data=fc2, name='relu2', act_type="relu")
+    fc3 = mx.symbol.FullyConnected(data=act2, name='fc3', num_hidden=10)
+    mysoftmax = NumpySoftmax()
+    mlp = mysoftmax(data=fc3, name='softmax')
+
+    train = mx.io.MNISTIter(batch_size=100, flat=True)
+    val = mx.io.MNISTIter(batch_size=100, flat=True, shuffle=False, seed=7)
+
+    logging.basicConfig(level=logging.INFO)
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=mlp, num_epoch=5,
+        learning_rate=0.1, momentum=0.9, wd=0.00001,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val,
+              batch_end_callback=mx.callback.Speedometer(100, 50))

@@ -127,7 +127,8 @@ class Executor:
                 pos += na
         self._heads = [(self._nid[id(nd)], i) for nd, i in symbol._outputs]
         self._head_no_grad = [
-            (not nd.is_variable) and nd.op.no_head_grad for nd, _ in symbol._outputs
+            (not nd.is_variable) and nd.op.head_no_grad(nd.params)
+            for nd, _ in symbol._outputs
         ]
         self._grad_idx = [i for i, r in enumerate(self._reqs) if r != "null"]
 

@@ -123,7 +123,7 @@ def _custom_fwd(params, inputs, aux, is_train, rng):
     prop = _CUSTOM_REGISTRY[op_type](**(params.get("__kwargs__") or {}))
     n_out = len(prop.list_outputs())
     in_shapes = [tuple(x.shape) for x in inputs]
-    _, out_shapes, _ = prop.infer_shape(list(map(list, in_shapes)))
+    _, out_shapes, _ = _norm_infer_shape(prop.infer_shape(list(map(list, in_shapes))))
     in_dtypes = [x.dtype for x in inputs]
     _, out_dtypes, _ = prop.infer_type(in_dtypes)
     op = prop.create_operator(None, in_shapes, in_dtypes)
@@ -187,7 +187,18 @@ class _HostND:
         return self._arr[k]
 
     def __setitem__(self, k, v):
+        if hasattr(v, "asnumpy"):  # mx NDArray / another host view
+            v = v.asnumpy()
         self._arr[k] = _np.asarray(v)
+
+
+def _norm_infer_shape(ret):
+    """User infer_shape may return (in, out) — the 2016 API (ref:
+    python/mxnet/operator.py:73-90) — or (in, out, aux)."""
+    if len(ret) == 2:
+        ins, outs = ret
+        return ins, outs, []
+    return ret
 
 
 def _custom_infer_shape(params, in_shapes):
@@ -195,7 +206,7 @@ def _custom_infer_shape(params, in_shapes):
     prop = _CUSTOM_REGISTRY[op_type](**(params.get("__kwargs__") or {}))
     if any(s is None for s in in_shapes):
         raise MXNetError("Custom: all input shapes required")
-    ins, outs, auxs = prop.infer_shape([list(s) for s in in_shapes])
+    ins, outs, auxs = _norm_infer_shape(prop.infer_shape([list(s) for s in in_shapes]))
     return [tuple(s) for s in ins], [tuple(s) for s in outs], [tuple(s) for s in auxs]
 
 
@@ -227,6 +238,13 @@ _register_opdef(
         outputs=_custom_outputs,
         infer_shape=_custom_infer_shape,
         imperative=False,
+        # loss-head semantics follow the user Prop's need_top_grad
+        no_head_grad=lambda params: (
+            params.get("op_type") in _CUSTOM_REGISTRY
+            and not _CUSTOM_REGISTRY[params["op_type"]](
+                **(params.get("__kwargs__") or {})
+            ).need_top_grad_
+        ),
     )
 )
 
@@ -296,3 +314,18 @@ class NumpyOp:
 
 
 NDArrayOp = NumpyOp  # same user surface; arrays arrive as host views
+
+# reference NumpyOp instances are called directly to build the symbol
+# (example/numpy-ops/numpy_softmax.py: mysoftmax(data=fc3, name='softmax'))
+NumpyOp.__call__ = NumpyOp.get_symbol
+
+# `Custom` is registered above AFTER ops.install() ran in __init__, so
+# wire it into the symbol module here (mx.sym.Custom(op_type=...), ref:
+# python/mxnet/symbol.py auto-generated Custom)
+from . import symbol as _sym_mod  # noqa: E402
+
+if not hasattr(_sym_mod, "Custom"):
+    from .ops.registry import REGISTRY as _reg
+    from .symbol import _make_op_func as _mk
+
+    _sym_mod.Custom = _mk(_reg["Custom"], "Custom")

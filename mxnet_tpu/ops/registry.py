@@ -125,6 +125,13 @@ class OpDef:
         self.init_aux = init_aux  # fn(params, aux_shapes)->list of np arrays
         self.doc = doc
 
+    def head_no_grad(self, params=None):
+        """Whether this node, as a graph head, needs no out_grad (loss
+        semantics). May be params-dependent (Custom ops decide per
+        need_top_grad of the user Prop)."""
+        v = self.no_head_grad
+        return bool(v(params or {})) if callable(v) else bool(v)
+
     # -- params ---------------------------------------------------------------
     def parse_params(self, kwargs):
         unknown = set(kwargs) - set(self.param_fields)

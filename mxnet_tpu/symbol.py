@@ -491,6 +491,18 @@ def _make_op_func(op, func_name):
                 param_kwargs[k] = v
         if op.key_var_num_args and op.key_var_num_args not in param_kwargs and sym_args:
             param_kwargs[op.key_var_num_args] = len(sym_args)
+        if "__kwargs__" in op.param_fields:
+            # Custom-style ops forward arbitrary string kwargs to the
+            # user Prop constructor (ref: operator.py:533 register /
+            # c_api.h:1418 MXCustomOpRegister kwargs-as-strings)
+            extra = {}
+            for k in list(param_kwargs):
+                if k not in op.param_fields:
+                    extra[k] = param_kwargs.pop(k)
+            if extra:
+                kw = dict(param_kwargs.get("__kwargs__") or {})
+                kw.update({k: str(v) for k, v in extra.items()})
+                param_kwargs["__kwargs__"] = kw
         params = op.parse_params(param_kwargs)
         arg_names = op.list_arguments(params)
         name = NameManager.current.get(name, op.name.lower().lstrip("_"))

@@ -86,3 +86,142 @@ def test_cudnn_batchnorm_alias():
     out = ex.forward(is_train=True)[0].asnumpy()
     m = out.mean(axis=(0, 2, 3))
     assert np.allclose(m, 0, atol=1e-4)
+
+
+# -- SSD MultiBox ops (ref: example/ssd/operator/multibox_*.cc) ---------------
+def _ref_prior(h, w, sizes, ratios):
+    """Direct port of multibox_prior.cc:22-51."""
+    out = []
+    for r in range(h):
+        cy = (r + 0.5) / h
+        for c in range(w):
+            cx = (c + 0.5) / w
+            for s in sizes:
+                out.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+            for rat in ratios[1:]:
+                rt = np.sqrt(rat)
+                bw, bh = sizes[0] * rt / 2, sizes[0] / rt / 2
+                out.append([cx - bw, cy - bh, cx + bw, cy + bh])
+    return np.array(out, np.float32)[None]
+
+
+def test_multibox_prior_matches_reference():
+    d = mx.nd.zeros((2, 8, 3, 5))
+    sizes, ratios = (0.4, 0.2, 0.1), (1.0, 2.0, 0.5)
+    out = mx.nd.MultiBoxPrior(d, sizes=sizes, ratios=ratios).asnumpy()
+    ref = _ref_prior(3, 5, sizes, ratios)
+    assert out.shape == (1, 3 * 5 * 5, 4)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    clipped = mx.nd.MultiBoxPrior(d, sizes=(0.9,), ratios=(1.0, 3.0),
+                                  clip=True).asnumpy()
+    assert clipped.min() >= 0.0 and clipped.max() <= 1.0
+
+
+def test_multibox_prior_symbol_shape():
+    data = mx.sym.Variable("data")
+    p = mx.sym.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2, 0.5))
+    _, out, _ = p.infer_shape(data=(4, 16, 10, 10))
+    assert out[0] == (1, 10 * 10 * 4, 4)
+
+
+def test_multibox_target_basic_matching():
+    anchors = np.array([[[0, 0, .5, .5], [.5, .5, 1, 1],
+                         [0, .5, .5, 1], [.4, .4, .9, .9]]], 'f')
+    labels = np.array([[[0, .1, .1, .4, .4],
+                        [1, .55, .55, .95, .95],
+                        [-1, -1, -1, -1, -1]]], 'f')
+    cls_preds = np.random.RandomState(0).rand(1, 3, 4).astype('f')
+    lt, lm, ct = mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds),
+        overlap_threshold=0.5, negative_mining_ratio=-1)
+    ct = ct.asnumpy()[0]
+    lm = lm.asnumpy().reshape(4, 4)
+    lt = lt.asnumpy().reshape(4, 4)
+    # gt0 (class 0) -> cls target 1 on anchor 0; gt1 (class 1) -> 2
+    assert ct[0] == 1.0
+    assert 2.0 in (ct[1], ct[3])
+    # unmatched anchors are negatives (no mining): background 0
+    assert set(np.unique(ct)) <= {0.0, 1.0, 2.0}
+    # loc_mask set exactly on positives; loc target finite
+    pos = ct > 0
+    assert (lm[pos] == 1).all() and (lm[~pos] == 0).all()
+    # check one regression target against AssignLocTargets math
+    # (multibox_target.cc:12-36): anchor0 vs gt0, variances (.1,.1,.2,.2)
+    a = anchors[0, 0]
+    g = labels[0, 0, 1:]
+    aw, ah = a[2] - a[0], a[3] - a[1]
+    ax, ay = (a[0] + a[2]) / 2, (a[1] + a[3]) / 2
+    gw, gh = g[2] - g[0], g[3] - g[1]
+    gx, gy = (g[0] + g[2]) / 2, (g[1] + g[3]) / 2
+    ref = [(gx - ax) / aw / .1, (gy - ay) / ah / .1,
+           np.log(gw / aw) / .2, np.log(gh / ah) / .2]
+    np.testing.assert_allclose(lt[0], ref, rtol=1e-4)
+
+
+def test_multibox_target_no_gt_and_ignore():
+    anchors = np.array([[[0, 0, .5, .5], [.5, .5, 1, 1]]], 'f')
+    labels = -np.ones((1, 2, 5), 'f')  # all padding
+    cls_preds = np.zeros((1, 3, 2), 'f')
+    lt, lm, ct = mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds))
+    assert (ct.asnumpy() == -1.0).all()  # ignore_label everywhere
+    assert (lm.asnumpy() == 0).all() and (lt.asnumpy() == 0).all()
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(3)
+    anchors = _ref_prior(4, 4, (0.3,), (1.0,)).astype('f')  # (1,16,4)
+    labels = np.array([[[2, .1, .1, .45, .45]]], 'f')
+    cls_preds = rng.rand(1, 4, 16).astype('f')
+    lt, lm, ct = mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.array(cls_preds),
+        overlap_threshold=0.5, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    npos = (ct > 0).sum()
+    nneg = (ct == 0).sum()
+    nign = (ct == -1).sum()
+    assert npos >= 1
+    assert nneg <= 3 * npos  # mining cap (multibox_target.cc:164-167)
+    assert nign == 16 - npos - nneg
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0, 0, .5, .5], [.05, .05, .55, .55],
+                         [.5, .5, 1, 1]]], 'f')
+    # anchors 0,1 predict class 0 strongly (overlapping); anchor 2 class 1
+    cls_prob = np.array([[[0.1, 0.2, 0.1],
+                          [0.8, 0.7, 0.1],
+                          [0.1, 0.1, 0.8]]], 'f')
+    loc_pred = np.zeros((1, 12), 'f')
+    out = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        threshold=0.3, nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # NMS kills the weaker overlapping class-0 box
+    assert len(kept) == 2
+    assert set(kept[:, 0].tolist()) == {0.0, 1.0}
+    # rows sorted by confidence descending
+    assert kept[0, 1] >= kept[1, 1]
+    # zero offsets -> decoded boxes == anchors for the kept rows
+    best = kept[kept[:, 0] == 0.0][0]
+    np.testing.assert_allclose(best[2:], anchors[0, 0], atol=1e-5)
+
+
+def test_multibox_detection_loc_decode():
+    """Nonzero offsets decode per TransformLocations (multibox_detection.cc:26-52)."""
+    anchors = np.array([[[.2, .2, .6, .6]]], 'f')
+    cls_prob = np.array([[[0.1], [0.9]]], 'f')
+    loc = np.array([[.5, -.3, .2, .4]], 'f').reshape(1, 4)
+    out = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc), mx.nd.array(anchors),
+        threshold=0.3, nms_threshold=-1, clip=False).asnumpy()[0][0]
+    vx, vy, vw, vh = .1, .1, .2, .2
+    aw = ah = .4
+    ax = ay = .4
+    ox = .5 * vx * aw + ax
+    oy = -.3 * vy * ah + ay
+    ow = np.exp(.2 * vw) * aw / 2
+    oh = np.exp(.4 * vh) * ah / 2
+    np.testing.assert_allclose(out[2:], [ox - ow, oy - oh, ox + ow, oy + oh],
+                               rtol=1e-5)
