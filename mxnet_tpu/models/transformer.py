@@ -103,17 +103,11 @@ def _layer_norm(x, p, eps=1e-5):
 
 
 def _attention(q, k, v, causal=True):
-    import jax.numpy as jnp
+    # Pallas flash kernel on TPU; flash_attention falls back to the plain
+    # XLA path internally when disabled or untileable.
+    from ..ops.pallas_kernels import flash_attention
 
-    scale = 1.0 / _np.sqrt(q.shape[-1])
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        tq, tk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool))
-        scores = jnp.where(mask, scores, -1e30)
-    p = jnp.exp(scores - scores.max(-1, keepdims=True))
-    p = p / p.sum(-1, keepdims=True)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+    return flash_attention(q, k, v, causal=causal)
 
 
 def forward(params, tokens, cfg: TransformerConfig, mesh=None):

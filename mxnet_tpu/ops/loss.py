@@ -38,7 +38,9 @@ def _softmax_output_factory(params):
         if preserve_shape:
             return jax.nn.softmax(data, axis=-1)
         n = data.shape[0]
-        return jax.nn.softmax(data.reshape(n, -1), axis=-1).reshape(data.shape)
+        from .pallas_kernels import fused_softmax
+
+        return fused_softmax(data.reshape(n, -1)).reshape(data.shape)
 
     def fwd(data, label):
         return f(data, label), (data, label)
@@ -46,8 +48,8 @@ def _softmax_output_factory(params):
     def bwd(res, g):
         data, label = res
         del g  # loss-layer semantics: out_grad ignored (ref: softmax_output-inl.h Backward)
-        prob = _forward(data)
         if multi_output:
+            prob = _forward(data)
             c = data.shape[1]
             lab = label.astype(jnp.int32)
             onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
