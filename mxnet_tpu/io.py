@@ -468,7 +468,7 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_img=None, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
                  round_batch=True, prefetch_depth=4, seed=0,
-                 num_parts=1, part_index=0, **kwargs):
+                 num_parts=1, part_index=0, preprocess_threads=4, **kwargs):
         super().__init__()
         from . import recordio as _recordio
 
@@ -509,6 +509,19 @@ class ImageRecordIter(DataIter):
             self._records = self._records[: i // num_parts]
         self._order = _np.arange(len(self._records))
         self.cursor = -batch_size
+        # parallel JPEG decode, the OMP-worker role of the reference's
+        # ImageRecordIOParser (ref: src/io/iter_image_recordio.cc:150,
+        # `preprocess_threads` param); PIL releases the GIL while decoding
+        self._pool = None
+        if preprocess_threads and preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     @property
     def provide_data(self):
@@ -528,7 +541,9 @@ class ImageRecordIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor + self.batch_size <= len(self._records)
 
-    def _decode(self, s):
+    def _decode(self, s, aug):
+        """aug = (crop_rx, crop_ry, mirror_r) uniform floats drawn on the
+        iterator thread, so thread-pool decode stays deterministic."""
         from . import recordio as _recordio
 
         header, img_bytes = _recordio.unpack(s)
@@ -541,14 +556,15 @@ class ImageRecordIter(DataIter):
         img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
         c, h, w = self.data_shape
         iw, ih = img.size
+        rx, ry, rm = aug
         if self.rand_crop and (iw > w and ih > h):
-            x0 = self._rng.randint(0, iw - w + 1)
-            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = int(rx * (iw - w + 1))
+            y0 = int(ry * (ih - h + 1))
             img = img.crop((x0, y0, x0 + w, y0 + h))
         else:
             img = img.resize((w, h))
         arr = _np.asarray(img, _np.float32).transpose(2, 0, 1)  # CHW, RGB
-        if self.rand_mirror and self._rng.rand() < 0.5:
+        if self.rand_mirror and rm < 0.5:
             arr = arr[:, :, ::-1]
         if self.mean is not None:
             arr = arr - self.mean
@@ -559,12 +575,15 @@ class ImageRecordIter(DataIter):
     def next(self):
         if not self.iter_next():
             raise StopIteration
-        datas, labels = [], []
-        for i in range(self.batch_size):
-            s = self._records[self._order[self.cursor + i]]
-            d, l = self._decode(s)
-            datas.append(d)
-            labels.append(l)
+        recs = [self._records[self._order[self.cursor + i]]
+                for i in range(self.batch_size)]
+        augs = [tuple(self._rng.rand(3)) for _ in recs]
+        if self._pool is not None:
+            results = list(self._pool.map(self._decode, recs, augs))
+        else:
+            results = [self._decode(s, a) for s, a in zip(recs, augs)]
+        datas = [d for d, _ in results]
+        labels = [l for _, l in results]
         data = array(_np.stack(datas))
         label = array(_np.asarray(labels, _np.float32).reshape(
             (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)

@@ -198,4 +198,29 @@ def create(name="local"):
     )
     if name not in known:
         raise MXNetError("unknown KVStore type %s (known: %s)" % (name, known))
+    if name.startswith("dist"):
+        _maybe_init_distributed()
     return KVStore(name)
+
+
+def _maybe_init_distributed():
+    """Rendezvous through jax.distributed using the env exported by
+    tools/launch.py — the role the dmlc tracker's DMLC_PS_ROOT_URI env
+    played for ps-lite (ref: include/mxnet/kvstore.h:158-164). No-op when
+    single-process or already initialized."""
+    import os
+
+    nprocs = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+    if nprocs <= 1:
+        return
+    import jax
+
+    # NB: must not touch jax.process_count()/devices() here — that would
+    # initialize the local backend and make distributed init impossible.
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9876"),
+        num_processes=nprocs,
+        process_id=int(os.environ.get("MXNET_PROC_ID", "0")),
+    )

@@ -21,6 +21,7 @@ __all__ = [
 ]
 
 _kMagic = 0xCED7230A
+_kLenMask = (1 << 29) - 1
 
 IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
 _IR_FORMAT = "IfQQ"
@@ -28,28 +29,62 @@ _IR_SIZE = struct.calcsize(_IR_FORMAT)
 
 
 class MXRecordIO:
-    """Sequential record reader/writer (ref: recordio.py:14)."""
+    """Sequential record reader/writer (ref: recordio.py:14).
+
+    When the native C++ runtime is built (src/recordio.cc via
+    mxnet_tpu._native), reads go through a background prefetch thread —
+    the dmlc::ThreadedIter role (ref: src/io/iter_prefetcher.h:72) — and
+    writes through buffered C stdio; otherwise a pure-Python file path
+    with identical on-disk framing is used.
+    """
+
+    #: records read ahead by the native producer thread (dmlc ThreadedIter
+    #: used a 16-deep queue, ref: iter_prefetcher.h:75)
+    PREFETCH_DEPTH = 16
+    _USE_NATIVE = True
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._nlib = None
+        self._nh = None
         self.open()
 
     def open(self):
+        from . import _native
+
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        lib = _native.recordio_lib() if self._USE_NATIVE else None
+        if lib is not None:
+            uri = self.uri.encode()
+            h = (lib.rio_writer_open(uri) if self.writable
+                 else lib.rio_reader_open(uri, self.PREFETCH_DEPTH))
+            if h:
+                self._nlib, self._nh = lib, h
+                self.is_open = True
+                return
+            if not self.writable and not os.path.isfile(self.uri):
+                raise IOError("cannot open %s" % self.uri)
+        self.handle = open(self.uri, "wb" if self.writable else "rb")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._nh is not None:
+                if self.writable:
+                    self._nlib.rio_writer_close(self._nh)
+                else:
+                    self._nlib.rio_reader_close(self._nh)
+                self._nh = None
+            if self.handle is not None:
+                self.handle.close()
+                self.handle = None
             self.is_open = False
 
     def __del__(self):
@@ -59,15 +94,36 @@ class MXRecordIO:
             pass
 
     def reset(self):
+        if self._nh is not None and not self.writable:
+            self._nlib.rio_reader_reset(self._nh)
+            return
         self.close()
         self.open()
 
     def tell(self):
+        if self._nh is not None:
+            if self.writable:
+                return self._nlib.rio_writer_tell(self._nh)
+            return self._nlib.rio_reader_tell(self._nh)
         return self.handle.tell()
+
+    def _seek(self, pos):
+        assert not self.writable
+        if self._nh is not None:
+            self._nlib.rio_reader_seek(self._nh, pos)
+        else:
+            self.handle.seek(pos)
 
     def write(self, buf):
         assert self.writable
         data = buf if isinstance(buf, bytes) else bytes(buf)
+        if len(data) > _kLenMask:
+            raise MXNetError("record too large: %d > %d bytes (29-bit length framing)"
+                             % (len(data), _kLenMask))
+        if self._nh is not None:
+            if self._nlib.rio_writer_write(self._nh, data, len(data)) < 0:
+                raise MXNetError("write failed on %s" % self.uri)
+            return
         self.handle.write(struct.pack("<II", _kMagic, len(data)))
         self.handle.write(data)
         pad = (4 - len(data) % 4) % 4
@@ -76,6 +132,18 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._nh is not None:
+            import ctypes
+
+            data = ctypes.POINTER(ctypes.c_char)()
+            length = ctypes.c_uint64()
+            status = self._nlib.rio_reader_next(
+                self._nh, ctypes.byref(data), ctypes.byref(length))
+            if status == 0:
+                return None
+            if status < 0:
+                raise MXNetError("invalid record magic in %s" % self.uri)
+            return ctypes.string_at(data, length.value)
         head = self.handle.read(8)
         if len(head) < 8:
             return None
@@ -91,7 +159,15 @@ class MXRecordIO:
 
 
 class MXIndexedRecordIO(MXRecordIO):
-    """Keyed random access via .idx sidecar (ref: recordio.py:87)."""
+    """Keyed random access via .idx sidecar (ref: recordio.py:87).
+
+    Random access seeks would defeat (and keep restarting) the native
+    sequential prefetch thread, so reads stay on the plain file path;
+    writes are sequential and could go native, but share the flag for
+    symmetry of the .idx offsets with the data actually on disk.
+    """
+
+    _USE_NATIVE = False
 
     def __init__(self, idx_path, uri, flag, key_type=int):
         self.idx_path = idx_path
@@ -117,7 +193,7 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         assert not self.writable
         pos = self.idx[idx]
-        self.handle.seek(pos)
+        self._seek(pos)
 
     def read_idx(self, idx):
         self.seek(idx)

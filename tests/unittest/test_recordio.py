@@ -1,0 +1,135 @@
+"""RecordIO tests (ref: tests/python/unittest/test_recordio.py) plus
+native-vs-Python path interop for the C++ codec in src/recordio.cc."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def _native_available():
+    from mxnet_tpu import _native
+
+    return _native.recordio_lib() is not None
+
+
+def test_roundtrip_basic(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [bytes([i]) * (i * 7 % 31 + 1) for i in range(50)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.reset()
+    assert r.read() == payloads[0]
+    r.close()
+
+
+def test_native_lib_builds():
+    assert _native_available(), "native recordio failed to build"
+
+
+def test_native_python_interop(tmp_path, monkeypatch):
+    """Records written by the native writer parse with the Python reader
+    and vice versa — same on-disk framing."""
+    if not _native_available():
+        pytest.skip("no native lib")
+    payloads = [os.urandom(n) for n in (1, 2, 3, 4, 5, 100, 1000)]
+
+    native = str(tmp_path / "native.rec")
+    w = recordio.MXRecordIO(native, "w")
+    assert w._nh is not None  # really the native path
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    monkeypatch.setenv("MXNET_NATIVE", "0")
+    pyrec = str(tmp_path / "py.rec")
+    w = recordio.MXRecordIO(pyrec, "w")
+    assert w._nh is None
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+    with open(native, "rb") as a, open(pyrec, "rb") as b:
+        assert a.read() == b.read()  # byte-identical files
+
+    r = recordio.MXRecordIO(native, "r")  # python reader on native file
+    for p in payloads:
+        assert r.read() == p
+    r.close()
+    monkeypatch.delenv("MXNET_NATIVE")
+    r = recordio.MXRecordIO(pyrec, "r")  # native reader on python file
+    assert r._nh is not None
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_native_reader_tell_tracks_records(tmp_path):
+    if not _native_available():
+        pytest.skip("no native lib")
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    offsets = []
+    for i in range(10):
+        offsets.append(w.tell())
+        w.write(b"x" * (i + 1))
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    assert r.tell() == 0
+    r.read()
+    assert r.tell() == offsets[1]
+    r.read()
+    assert r.tell() == offsets[2]
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(20):
+        w.write_idx(i, b"rec%03d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.read_idx(13) == b"rec013"
+    assert r.read_idx(2) == b"rec002"
+    assert r.keys == list(range(20))
+    r.close()
+
+
+def test_corrupt_magic_raises(tmp_path):
+    path = str(tmp_path / "bad.rec")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 64)
+    r = recordio.MXRecordIO(path, "r")
+    with pytest.raises(mx.MXNetError):
+        r.read()
+    r.close()
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises((IOError, OSError)):
+        recordio.MXRecordIO(str(tmp_path / "nope.rec"), "r")
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.5, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, data = recordio.unpack(s)
+    assert data == b"payload"
+    assert h2.label == 3.5 and h2.id == 7
+    # vector label
+    lab = np.array([1.0, 2.0, 3.0], np.float32)
+    s = recordio.pack(recordio.IRHeader(3, lab, 1, 0), b"x")
+    h3, data = recordio.unpack(s)
+    np.testing.assert_array_equal(h3.label, lab)
+    assert data == b"x"

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Measure gradient-synchronization bandwidth across devices.
+
+TPU-native port of the reference comm benchmark (ref:
+tools/bandwidth/measure.py, whose README reports GB/s per GPU for kvstore
+reduce on ResNet grads). Here the sync primitive is an ICI/DCN all-reduce
+(`psum` under shard_map over a Mesh), which is what kvstore('device')
+lowers to (SURVEY §5.8), so the measured number is the framework's real
+gradient path.
+
+Run on CPU for a smoke test:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python tools/bandwidth/measure.py --size-mb 64
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size-mb", type=float, default=256,
+                   help="gradient bytes per device (f32)")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    elems = int(args.size_mb * 1e6 / 4)
+    x = jnp.zeros((n, elems), jnp.float32)
+
+    @jax.jit
+    def allreduce(x):
+        def f(x):
+            return jax.lax.psum(x, "dp")
+
+        return shard_map(f, mesh=mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(x)
+
+    for _ in range(args.warmup):
+        allreduce(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = allreduce(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / args.iters
+    # ring all-reduce moves 2*(n-1)/n of the buffer per device
+    gbps = args.size_mb / 1e3 * 2 * (n - 1) / n / dt
+    print("devices=%d size=%.0fMB time=%.4fs algbw=%.2f GB/s/device"
+          % (n, args.size_mb, dt, gbps))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Kill stray distributed training processes on the hosts of a job.
+
+Port of the reference cleanup tool (ref: tools/kill-mxnet.py). Greps for
+processes whose command line matches the given program and SIGTERMs them,
+locally or over ssh for every host in a hostfile.
+"""
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("pattern", help="pgrep -f pattern identifying the job")
+    p.add_argument("--hostfile", "-H", help="one host per line; local if absent")
+    args = p.parse_args()
+    kill = "pkill -f %s" % shlex.quote(args.pattern)
+    if not args.hostfile:
+        return subprocess.call(["pkill", "-f", args.pattern])
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    code = 0
+    for h in hosts:
+        code |= subprocess.call(
+            ["ssh", "-o", "StrictHostKeyChecking=no", h, kill])
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Multi-host job launcher for distributed training.
+
+TPU-native port of the reference launcher (ref: tools/launch.py:46-50,
+which delegates to the dmlc-core tracker over ssh/mpi/sge/yarn). On TPU
+pods there is no parameter-server topology to boot — every host runs the
+SAME program and rendezvouses through `jax.distributed.initialize`
+(SURVEY §5.8) — so the launcher's job collapses to: start N copies with
+the coordinator address and process ids set, locally or over ssh.
+
+Modes:
+  local  N copies on this machine (testing; pairs with JAX_PLATFORMS=cpu
+         and xla_force_host_platform_device_count for virtual devices)
+  ssh    one copy per host listed in --hostfile
+
+Env exported to workers (consumed by mxnet_tpu.kvstore / jax.distributed):
+  MXNET_COORDINATOR  coordinator ip:port
+  MXNET_NUM_PROCS    world size
+  MXNET_PROC_ID      process id
+The reference's DMLC_ROLE/DMLC_PS_ROOT_URI scheme (ref:
+include/mxnet/kvstore.h:173-214) has no server/scheduler roles here:
+all processes are workers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def launch_local(args, cmd):
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXNET_COORDINATOR": args.coordinator,
+            "MXNET_NUM_PROCS": str(args.num_workers),
+            "MXNET_PROC_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    code = 0
+    try:
+        for p in procs:
+            code = p.wait() or code
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        code = 1
+    return code
+
+
+def launch_ssh(args, cmd):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if len(hosts) < args.num_workers:
+        print("hostfile has %d hosts < %d workers" % (len(hosts), args.num_workers),
+              file=sys.stderr)
+        return 1
+    procs = []
+    for rank in range(args.num_workers):
+        envs = " ".join([
+            "MXNET_COORDINATOR=%s" % args.coordinator,
+            "MXNET_NUM_PROCS=%d" % args.num_workers,
+            "MXNET_PROC_ID=%d" % rank,
+        ])
+        remote = "cd %s && %s %s" % (
+            shlex.quote(args.workdir) if args.workdir else "~", envs,
+            " ".join(shlex.quote(c) for c in cmd))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank], remote]))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    p.add_argument("--hostfile", "-H", help="one host per line (ssh mode)")
+    p.add_argument("--coordinator", default="127.0.0.1:9876",
+                   help="jax.distributed coordinator ip:port")
+    p.add_argument("--workdir", help="remote working dir (ssh mode)")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    cmd = [c for c in args.command if c != "--"]
+    if not cmd:
+        p.error("no command given")
+    if args.launcher == "ssh":
+        return launch_ssh(args, cmd)
+    return launch_local(args, cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch table (ref: tools/parse_log.py).
+
+Reads a log produced by FeedForward/Module.fit with Speedometer installed
+and emits markdown: epoch | train-accuracy | valid-accuracy | speed.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(path):
+    with open(path) as f:
+        lines = f.read().split("\n")
+    res = [
+        re.compile(r"Epoch\[(\d+)\] Train-([a-zA-Z0-9-]+)=([.\d]+)"),
+        re.compile(r"Epoch\[(\d+)\] Validation-([a-zA-Z0-9-]+)=([.\d]+)"),
+        re.compile(r"Epoch\[(\d+)\].*Speed: ([.\d]+) samples/sec"),
+    ]
+    data = {}
+    for line in lines:
+        for i, r in enumerate(res):
+            m = r.search(line)
+            if m is None:
+                continue
+            epoch = int(m.group(1))
+            if epoch not in data:
+                data[epoch] = [0.0, 0.0, 0.0, 0]
+            if i == 2:
+                data[epoch][2] += float(m.group(2))
+                data[epoch][3] += 1
+            else:
+                data[epoch][i] = float(m.group(3))
+    return data
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    args = p.parse_args()
+    data = parse(args.logfile)
+    print("| epoch | train-accuracy | valid-accuracy | speed |")
+    print("| --- | --- | --- | --- |")
+    for e in sorted(data):
+        tr, va, sp, n = data[e]
+        print("| %d | %f | %f | %.2f |" % (e, tr, va, sp / max(n, 1)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
