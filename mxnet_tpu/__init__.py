@@ -45,5 +45,8 @@ from . import visualization  # noqa: E402
 from . import visualization as viz  # noqa: E402
 from . import test_utils  # noqa: E402
 from . import operator  # noqa: E402
+from . import rtc  # noqa: E402
+from . import torch as torch_plugin  # noqa: E402
+from .torch import th  # noqa: E402
 from . import parallel  # noqa: E402
 from . import models  # noqa: E402

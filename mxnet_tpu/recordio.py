@@ -22,6 +22,7 @@ __all__ = [
 
 _kMagic = 0xCED7230A
 _kLenMask = (1 << 29) - 1
+_MAGIC_BYTES = struct.pack("<I", _kMagic)
 
 IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
 _IR_FORMAT = "IfQQ"
@@ -124,11 +125,26 @@ class MXRecordIO:
             if self._nlib.rio_writer_write(self._nh, data, len(data)) < 0:
                 raise MXNetError("write failed on %s" % self.uri)
             return
-        self.handle.write(struct.pack("<II", _kMagic, len(data)))
-        self.handle.write(data)
-        pad = (4 - len(data) % 4) % 4
-        if pad:
-            self.handle.write(b"\x00" * pad)
+        # dmlc multipart protocol: payloads containing the magic bytes are
+        # split at each occurrence (magic removed, cflag 1/2/3 in the top 3
+        # bits); the reader re-inserts the magic when joining parts
+        # (ref: dmlc-core RecordIOWriter::WriteRecord)
+        parts = data.split(_MAGIC_BYTES)
+        for i, part in enumerate(parts):
+            if len(parts) == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == len(parts) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.handle.write(
+                struct.pack("<II", _kMagic, (cflag << 29) | len(part)))
+            self.handle.write(part)
+            pad = (4 - len(part) % 4) % 4
+            if pad:
+                self.handle.write(b"\x00" * pad)
 
     def read(self):
         assert not self.writable
@@ -144,18 +160,30 @@ class MXRecordIO:
             if status < 0:
                 raise MXNetError("invalid record magic in %s" % self.uri)
             return ctypes.string_at(data, length.value)
-        head = self.handle.read(8)
-        if len(head) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", head)
-        if magic != _kMagic:
-            raise MXNetError("invalid record magic in %s" % self.uri)
-        length = lrec & ((1 << 29) - 1)
-        data = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return data
+        out = None  # accumulates multipart records (cflag 1..3)
+        while True:
+            head = self.handle.read(8)
+            if len(head) < 8:
+                if out is not None:
+                    raise MXNetError("truncated multipart record in %s" % self.uri)
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _kMagic:
+                raise MXNetError("invalid record magic in %s" % self.uri)
+            length = lrec & _kLenMask
+            cflag = lrec >> 29
+            data = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            if cflag == 0:
+                return data
+            if cflag == 1:
+                out = data
+            else:  # 2 = middle, 3 = end: re-insert the split-out magic
+                out = (out or b"") + _MAGIC_BYTES + data
+                if cflag == 3:
+                    return out
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -40,22 +40,47 @@ class Writer {
   bool ok() const { return fp_ != nullptr; }
 
   // Returns the offset the record was written at (for .idx sidecars).
+  // Payloads containing the magic bytes follow the dmlc multipart protocol:
+  // split at each occurrence, magic removed, cflag 1/2/3 in the top 3 bits
+  // (ref: dmlc-core RecordIOWriter::WriteRecord).
   int64_t Write(const char* data, uint64_t len) {
     if (!fp_) return -1;
     if (len > kLenMask) return -1;  // framing carries 29 length bits
     int64_t pos = static_cast<int64_t>(std::ftell(fp_));
-    uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
-    if (std::fwrite(header, sizeof(header), 1, fp_) != 1) return -1;
-    if (len && std::fwrite(data, 1, len, fp_) != len) return -1;
-    uint64_t pad = (4 - len % 4) % 4;
-    if (pad) {
-      const char zeros[4] = {0, 0, 0, 0};
-      if (std::fwrite(zeros, 1, pad, fp_) != pad) return -1;
+    const char* magic = reinterpret_cast<const char*>(&kMagic);
+    uint64_t begin = 0;
+    uint32_t nsplit = 0;
+    for (uint64_t i = 0; i + 4 <= len; ++i) {
+      if (std::memcmp(data + i, magic, 4) == 0) {
+        uint32_t cflag = (nsplit == 0) ? 1u : 2u;
+        if (!WritePart(cflag, data + begin, i - begin)) return -1;
+        begin = i + 4;
+        i += 3;
+        ++nsplit;
+      }
     }
+    uint32_t cflag = (nsplit == 0) ? 0u : 3u;
+    if (!WritePart(cflag, data + begin, len - begin)) return -1;
     return pos;
   }
 
   int64_t Tell() { return fp_ ? static_cast<int64_t>(std::ftell(fp_)) : -1; }
+
+ private:
+  bool WritePart(uint32_t cflag, const char* data, uint64_t len) {
+    uint32_t header[2] = {kMagic,
+                          (cflag << 29) | static_cast<uint32_t>(len & kLenMask)};
+    if (std::fwrite(header, sizeof(header), 1, fp_) != 1) return false;
+    if (len && std::fwrite(data, 1, len, fp_) != len) return false;
+    uint64_t pad = (4 - len % 4) % 4;
+    if (pad) {
+      const char zeros[4] = {0, 0, 0, 0};
+      if (std::fwrite(zeros, 1, pad, fp_) != pad) return false;
+    }
+    return true;
+  }
+
+ public:
 
   void Close() {
     if (fp_) {
@@ -138,25 +163,48 @@ class Reader {
     }
     if (offset) std::fseek(fp, static_cast<long>(offset), SEEK_SET);
     uint64_t pos = offset;
+    const char* magic_bytes = reinterpret_cast<const char*>(&kMagic);
     for (;;) {
-      uint32_t header[2];
-      if (std::fread(header, sizeof(header), 1, fp) != 1) break;  // EOF
-      if (header[0] != kMagic) {
-        std::lock_guard<std::mutex> lk(mu_);
-        ok_ = false;
-        break;
-      }
-      uint64_t len = header[1] & kLenMask;
-      uint64_t pad = (4 - len % 4) % 4;
+      // assemble one logical record, re-joining multipart chunks with the
+      // magic re-inserted (ref: dmlc-core RecordIOReader::NextRecord)
       Record rec;
-      rec.data.resize(len);
-      if (len && std::fread(&rec.data[0], 1, len, fp) != len) {
+      bool in_multipart = false;
+      bool fail = false, eof = false;
+      for (;;) {
+        uint32_t header[2];
+        if (std::fread(header, sizeof(header), 1, fp) != 1) {  // EOF
+          eof = true;
+          fail = in_multipart;  // truncated multipart record
+          break;
+        }
+        if (header[0] != kMagic) {
+          fail = true;
+          break;
+        }
+        uint64_t len = header[1] & kLenMask;
+        uint32_t cflag = header[1] >> 29;
+        uint64_t pad = (4 - len % 4) % 4;
+        size_t prev = rec.data.size();
+        if (cflag == 2 || cflag == 3) {
+          rec.data.append(magic_bytes, 4);
+          prev = rec.data.size();
+        }
+        rec.data.resize(prev + len);
+        if (len && std::fread(&rec.data[prev], 1, len, fp) != len) {
+          fail = true;
+          break;
+        }
+        if (pad) std::fseek(fp, static_cast<long>(pad), SEEK_CUR);
+        pos += 8 + len + pad;
+        if (cflag == 0 || cflag == 3) break;
+        in_multipart = true;
+      }
+      if (fail) {
         std::lock_guard<std::mutex> lk(mu_);
         ok_ = false;
         break;
       }
-      if (pad) std::fseek(fp, static_cast<long>(pad), SEEK_CUR);
-      pos += 8 + len + pad;
+      if (eof) break;
       rec.end_offset = pos;
       std::unique_lock<std::mutex> lk(mu_);
       not_full_.wait(lk, [&] {

@@ -133,3 +133,51 @@ def test_pack_unpack_header():
     h3, data = recordio.unpack(s)
     np.testing.assert_array_equal(h3.label, lab)
     assert data == b"x"
+
+
+def test_multipart_magic_payload(tmp_path, monkeypatch):
+    """Payloads containing the magic bytes use the dmlc multipart protocol
+    (cflag 1/2/3 split) and must roundtrip byte-identically — the format
+    guarantee that reference-written .rec files (e.g. JPEGs containing the
+    magic) parse correctly (ref: dmlc-core RecordIOWriter::WriteRecord)."""
+    import struct
+
+    magic = struct.pack("<I", 0xCED7230A)
+    payloads = [
+        magic,                            # exactly the magic
+        magic * 3,                        # consecutive magics
+        b"head" + magic + b"tail",        # embedded once
+        b"a" * 7 + magic + b"b" * 5 + magic + b"c",  # twice, odd lengths
+        magic + b"x",                     # at start
+        b"x" + magic,                     # at end
+        b"plain record",                  # control
+    ]
+    natives = [False, True] if _native_available() else [False]
+    files = {}
+    for use_native in natives:
+        if use_native:
+            monkeypatch.delenv("MXNET_NATIVE", raising=False)
+        else:
+            monkeypatch.setenv("MXNET_NATIVE", "0")
+        path = str(tmp_path / ("m%d.rec" % use_native))
+        w = recordio.MXRecordIO(path, "w")
+        assert (w._nh is not None) == use_native
+        for pay in payloads:
+            w.write(pay)
+        w.close()
+        files[use_native] = path
+    if len(files) == 2:  # both writers emit byte-identical framing
+        with open(files[False], "rb") as a, open(files[True], "rb") as b:
+            assert a.read() == b.read()
+    for read_native in natives:
+        if read_native:
+            monkeypatch.delenv("MXNET_NATIVE", raising=False)
+        else:
+            monkeypatch.setenv("MXNET_NATIVE", "0")
+        for path in files.values():
+            r = recordio.MXRecordIO(path, "r")
+            assert (r._nh is not None) == read_native
+            for pay in payloads:
+                assert r.read() == pay, (read_native, path, pay)
+            assert r.read() is None
+            r.close()

@@ -1,0 +1,138 @@
+"""Torch plugin tests: TorchModule / TorchCriterion ops + mx.th functions.
+
+Model: the reference ships plugin/torch with no dedicated python test; we
+test the bridge numerically the way test_operator.py tests native ops —
+forward vs direct torch execution, backward vs analytic/FD gradients.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+torch = pytest.importorskip("torch")
+
+
+def test_th_unary_and_binary():
+    x = mx.nd.array(np.random.rand(3, 4).astype("f") + 0.5)
+    y = mx.th.exp(x)
+    assert np.allclose(y.asnumpy(), np.exp(x.asnumpy()), atol=1e-6)
+
+    # fn(res, inputs...) mutate-first convention (ref: python/mxnet/torch.py)
+    res = mx.nd.zeros((3, 4))
+    out = mx.th.sqrt(res, x)
+    assert out is res
+    assert np.allclose(res.asnumpy(), np.sqrt(x.asnumpy()), atol=1e-6)
+
+    b = mx.nd.array(np.random.rand(3, 4).astype("f") + 0.5)
+    z = mx.th.cmul(x, b)
+    assert np.allclose(z.asnumpy(), x.asnumpy() * b.asnumpy(), atol=1e-6)
+    mm = mx.th.mm(x, mx.nd.array(np.random.rand(4, 2).astype("f")))
+    assert mm.shape == (3, 2)
+
+
+def test_torch_module_linear_forward_backward():
+    data = sym.Variable("data")
+    s = sym.TorchModule(
+        data,
+        module_string="torch.nn.Linear(4, 3)",
+        num_data=1,
+        num_params=2,
+        num_outputs=1,
+    )
+    names = s.list_arguments()
+    assert names[0] == "data"
+    assert names[1].endswith("torch_weight") and names[2].endswith("torch_bias")
+
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(5, 4))
+    assert out_shapes[0] == (5, 3)
+    assert arg_shapes[1] == (3, 4) and arg_shapes[2] == (3,)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(5, 4).astype("f")
+    w = rng.rand(3, 4).astype("f")
+    b = rng.rand(3).astype("f")
+    args = {
+        "data": mx.nd.array(x),
+        names[1]: mx.nd.array(w),
+        names[2]: mx.nd.array(b),
+    }
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    exe = s.bind(mx.cpu(), args, args_grad=grads, grad_req="write")
+    (out,) = exe.forward(is_train=True)
+    assert np.allclose(out.asnumpy(), x @ w.T + b, atol=1e-5)
+
+    og = rng.rand(5, 3).astype("f")
+    exe.backward([mx.nd.array(og)])
+    assert np.allclose(grads["data"].asnumpy(), og @ w, atol=1e-5)
+    assert np.allclose(grads[names[1]].asnumpy(), og.T @ x, atol=1e-5)
+    assert np.allclose(grads[names[2]].asnumpy(), og.sum(0), atol=1e-5)
+
+
+def test_torch_module_sequential():
+    s = sym.TorchModule(
+        sym.Variable("data"),
+        module_string=(
+            "torch.nn.Sequential(torch.nn.Linear(6, 8), torch.nn.Tanh(), "
+            "torch.nn.Linear(8, 2))"
+        ),
+        num_data=1,
+        num_params=4,
+        num_outputs=1,
+    )
+    names = s.list_arguments()
+    assert names[0] == "data" and len(names) == 5
+    _, out_shapes, _ = s.infer_shape(data=(3, 6))
+    assert out_shapes[0] == (3, 2)
+
+    rng = np.random.RandomState(1)
+    args = {"data": mx.nd.array(rng.rand(3, 6).astype("f"))}
+    shapes, _, _ = s.infer_shape(data=(3, 6))
+    for n, sh in zip(names[1:], shapes[1:]):
+        args[n] = mx.nd.array(rng.normal(0, 0.3, sh).astype("f"))
+    exe = s.bind(mx.cpu(), args, grad_req="null")
+    (out,) = exe.forward(is_train=False)
+
+    # independent torch execution with the same weights
+    mod = torch.nn.Sequential(
+        torch.nn.Linear(6, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2)
+    )
+    with torch.no_grad():
+        for p, n in zip(mod.parameters(), names[1:]):
+            p.copy_(torch.from_numpy(args[n].asnumpy()))
+        expect = mod(torch.from_numpy(args["data"].asnumpy())).numpy()
+    assert np.allclose(out.asnumpy(), expect, atol=1e-5)
+
+
+def test_torch_criterion_mse():
+    d = sym.Variable("data")
+    l = sym.Variable("label")
+    s = sym.TorchCriterion(d, l, module_string="torch.nn.MSELoss()")
+    rng = np.random.RandomState(2)
+    x = rng.rand(4, 3).astype("f")
+    y = rng.rand(4, 3).astype("f")
+    args = {"data": mx.nd.array(x), "label": mx.nd.array(y)}
+    grads = {"data": mx.nd.zeros(x.shape), "label": mx.nd.zeros(y.shape)}
+    exe = s.bind(mx.cpu(), args, args_grad=grads,
+                 grad_req={"data": "write", "label": "null"})
+    (out,) = exe.forward(is_train=True)
+    expect = ((x - y) ** 2).mean()
+    assert np.allclose(out.asnumpy(), expect, atol=1e-5)
+
+    exe.backward()  # loss head: no out_grad needed
+    assert np.allclose(grads["data"].asnumpy(), 2 * (x - y) / x.size, atol=1e-5)
+
+
+def test_torch_module_lua_string_alias():
+    # reference compatibility: lua_string param name accepted
+    s = sym.TorchModule(
+        sym.Variable("data"),
+        lua_string="torch.nn.ReLU()",
+        num_data=1,
+        num_params=0,
+        num_outputs=1,
+    )
+    x = np.random.randn(2, 5).astype("f")
+    exe = s.bind(mx.cpu(), {"data": mx.nd.array(x)}, grad_req="null")
+    (out,) = exe.forward()
+    assert np.allclose(out.asnumpy(), np.maximum(x, 0), atol=1e-6)

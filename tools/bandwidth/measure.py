@@ -45,7 +45,15 @@ def main():
     n = len(devices)
     mesh = Mesh(np.asarray(devices), ("dp",))
     elems = int(args.size_mb * 1e6 / 4)
-    x = jnp.zeros((n, elems), jnp.float32)
+    # commit the buffer sharded over the mesh up front: otherwise device 0
+    # holds the full n*size array and every timed iteration includes the
+    # re-shard, corrupting the reported bandwidth
+    from jax.sharding import NamedSharding
+
+    x = jax.device_put(
+        jnp.zeros((n, elems), jnp.float32),
+        NamedSharding(mesh, P("dp", None)),
+    )
 
     @jax.jit
     def allreduce(x):

@@ -3,14 +3,34 @@
 
 Port of the reference cleanup tool (ref: tools/kill-mxnet.py). Greps for
 processes whose command line matches the given program and SIGTERMs them,
-locally or over ssh for every host in a hostfile.
+locally or over ssh for every host in a hostfile. The matcher excludes the
+tool's own process tree (pgrep -f matches this script's command line too —
+the reference kill-mxnet.py filtered itself the same way).
 """
 from __future__ import annotations
 
 import argparse
+import os
 import shlex
+import signal
 import subprocess
 import sys
+
+
+def _kill_local(pattern):
+    """pgrep then filter self/parent before SIGTERM (pkill -f would match
+    this process's own command line, which carries the pattern)."""
+    out = subprocess.run(
+        ["pgrep", "-f", pattern], capture_output=True, text=True
+    ).stdout
+    me = {os.getpid(), os.getppid()}
+    pids = [int(x) for x in out.split() if x.strip() and int(x) not in me]
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    return 0 if pids else 1
 
 
 def main():
@@ -18,9 +38,16 @@ def main():
     p.add_argument("pattern", help="pgrep -f pattern identifying the job")
     p.add_argument("--hostfile", "-H", help="one host per line; local if absent")
     args = p.parse_args()
-    kill = "pkill -f %s" % shlex.quote(args.pattern)
     if not args.hostfile:
-        return subprocess.call(["pkill", "-f", args.pattern])
+        return _kill_local(args.pattern)
+    # remote: exclude the remote shell itself ($$ and its parent sshd) so the
+    # carrier of the pattern is not killed and the exit code reflects targets
+    quoted = shlex.quote(args.pattern)
+    kill = (
+        "for pid in $(pgrep -f %s); do "
+        "[ \"$pid\" != \"$$\" ] && [ \"$pid\" != \"$PPID\" ] "
+        "&& kill -TERM \"$pid\" 2>/dev/null; done; true" % quoted
+    )
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
     code = 0

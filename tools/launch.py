@@ -87,7 +87,11 @@ def main():
     p.add_argument("--workdir", help="remote working dir (ssh mode)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
-    cmd = [c for c in args.command if c != "--"]
+    # drop only the single leading '--' separating launcher args from the
+    # command; later '--' tokens belong to the child program
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
     if not cmd:
         p.error("no command given")
     if args.launcher == "ssh":

@@ -2,7 +2,8 @@
 """Parse training logs into a per-epoch table (ref: tools/parse_log.py).
 
 Reads a log produced by FeedForward/Module.fit with Speedometer installed
-and emits markdown: epoch | train-accuracy | valid-accuracy | speed.
+and emits markdown: one column per Train-*/Validation-* metric name found
+in the log, plus mean samples/sec.
 """
 from __future__ import annotations
 
@@ -12,40 +13,50 @@ import sys
 
 
 def parse(path):
+    """Return (sorted epoch list, sorted metric-column names,
+    {epoch: {column: value}}, {epoch: mean speed})."""
     with open(path) as f:
         lines = f.read().split("\n")
-    res = [
-        re.compile(r"Epoch\[(\d+)\] Train-([a-zA-Z0-9-]+)=([.\d]+)"),
-        re.compile(r"Epoch\[(\d+)\] Validation-([a-zA-Z0-9-]+)=([.\d]+)"),
-        re.compile(r"Epoch\[(\d+)\].*Speed: ([.\d]+) samples/sec"),
-    ]
-    data = {}
+    metric_re = re.compile(
+        r"Epoch\[(\d+)\] (Train|Validation)-([a-zA-Z0-9_-]+)=([.\d]+)"
+    )
+    speed_re = re.compile(r"Epoch\[(\d+)\].*Speed: ([.\d]+) samples/sec")
+    metrics = {}
+    speeds = {}
+    columns = set()
     for line in lines:
-        for i, r in enumerate(res):
-            m = r.search(line)
-            if m is None:
-                continue
+        m = metric_re.search(line)
+        if m is not None:
             epoch = int(m.group(1))
-            if epoch not in data:
-                data[epoch] = [0.0, 0.0, 0.0, 0]
-            if i == 2:
-                data[epoch][2] += float(m.group(2))
-                data[epoch][3] += 1
-            else:
-                data[epoch][i] = float(m.group(3))
-    return data
+            col = "%s-%s" % (m.group(2).lower().replace("validation", "valid"),
+                             m.group(3))
+            columns.add(col)
+            metrics.setdefault(epoch, {})[col] = float(m.group(4))
+            continue
+        m = speed_re.search(line)
+        if m is not None:
+            epoch = int(m.group(1))
+            tot, cnt = speeds.get(epoch, (0.0, 0))
+            speeds[epoch] = (tot + float(m.group(2)), cnt + 1)
+    epochs = sorted(set(metrics) | set(speeds))
+    return epochs, sorted(columns), metrics, speeds
 
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("logfile")
     args = p.parse_args()
-    data = parse(args.logfile)
-    print("| epoch | train-accuracy | valid-accuracy | speed |")
-    print("| --- | --- | --- | --- |")
-    for e in sorted(data):
-        tr, va, sp, n = data[e]
-        print("| %d | %f | %f | %.2f |" % (e, tr, va, sp / max(n, 1)))
+    epochs, columns, metrics, speeds = parse(args.logfile)
+    print("| epoch | %s speed |" % "".join("%s | " % c for c in columns))
+    print("| --- |%s --- |" % (" --- |" * len(columns)))
+    for e in epochs:
+        row = ["%d" % e]
+        for c in columns:
+            v = metrics.get(e, {}).get(c)
+            row.append("%f" % v if v is not None else "-")
+        tot, cnt = speeds.get(e, (0.0, 0))
+        row.append("%.2f" % (tot / cnt) if cnt else "-")
+        print("| %s |" % " | ".join(row))
 
 
 if __name__ == "__main__":
