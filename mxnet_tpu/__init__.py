@@ -24,7 +24,7 @@ from . import ops as _ops
 
 _ops.install(ndarray_module=ndarray, symbol_module=symbol)
 
-from .ndarray import NDArray, load, save, zeros, ones, array, empty, full, arange, concatenate, waitall  # noqa: E402
+from .ndarray import NDArray, load, save, load_frombuffer, zeros, ones, array, empty, full, arange, concatenate, waitall  # noqa: E402
 from .executor import Executor  # noqa: E402
 from . import initializer  # noqa: E402
 from .initializer import init  # noqa: E402
@@ -46,6 +46,10 @@ from . import visualization as viz  # noqa: E402
 from . import test_utils  # noqa: E402
 from . import operator  # noqa: E402
 from . import rtc  # noqa: E402
+from . import predictor  # noqa: E402
+from . import profiler  # noqa: E402
+from . import caffe_plugin  # noqa: E402
+from .predictor import Predictor  # noqa: E402
 from . import torch as torch_plugin  # noqa: E402
 from .torch import th  # noqa: E402
 from . import parallel  # noqa: E402

@@ -560,18 +560,28 @@ def save(fname, data):
 
 def load(fname, ctx=None):
     """Load list or dict of NDArray (ref: python/mxnet/ndarray.py:876)."""
+    with open(fname, "rb") as f:
+        return load_frombuffer(f.read(), ctx)
+
+
+def load_frombuffer(buf, ctx=None):
+    """Load list or dict of NDArray from raw .params bytes — the predict
+    API entry point that receives the file contents instead of a path
+    (ref: c_predict_api.h MXPredCreate param_bytes)."""
+    import io
+
     if ctx is None:
         ctx = cpu()
-    with open(fname, "rb") as f:
-        magic, _, count = struct.unpack("<QQQ", f.read(24))
-        if magic != _ND_MAGIC:
-            raise MXNetError("invalid NDArray file %s" % fname)
-        num_names = struct.unpack("<Q", f.read(8))[0]
-        names = []
-        for _ in range(num_names):
-            ln = struct.unpack("<Q", f.read(8))[0]
-            names.append(f.read(ln).decode("utf-8"))
-        arrays = [_read_tensor(f, ctx) for _ in range(count)]
+    f = io.BytesIO(buf)
+    magic, _, count = struct.unpack("<QQQ", f.read(24))
+    if magic != _ND_MAGIC:
+        raise MXNetError("invalid NDArray buffer")
+    num_names = struct.unpack("<Q", f.read(8))[0]
+    names = []
+    for _ in range(num_names):
+        ln = struct.unpack("<Q", f.read(8))[0]
+        names.append(f.read(ln).decode("utf-8"))
+    arrays = [_read_tensor(f, ctx) for _ in range(count)]
     if names:
         return dict(zip(names, arrays))
     return arrays

@@ -265,3 +265,149 @@ register(
         no_head_grad=True,
     )
 )
+
+
+# ---------------------------------------------------------------------------
+# WarpCTC (ref: plugin/warpctc/warpctc-inl.h)
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels):
+    """Batched CTC negative log-likelihood in log space.
+
+    TPU-native replacement for Baidu warp-ctc's compute_ctc_loss
+    (ref: plugin/warpctc/warpctc-inl.h:183-194): the standard
+    alpha-recursion over the blank-extended label sequence, as one
+    ``lax.scan`` over time so XLA compiles a single fused loop — and,
+    because it is pure jnp/lax, the activation gradient comes from jax
+    autodiff instead of warp-ctc's hand-written kernel.
+
+    log_probs: (T, B, A) log-softmax activations, blank index 0.
+    labels: (B, L) int labels, 0 = padding (reference removeBlank strips
+    zeros anywhere in the row, warpctc-inl.h:101-110 — we left-pack).
+    Returns (B,) positive costs.
+    """
+    from jax import lax
+
+    T, B, A = log_probs.shape
+    L = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+
+    # left-pack nonzero labels per row (stable): reference strips blanks
+    # wherever they appear, not only trailing padding
+    nonblank = labels != 0
+    order = jnp.argsort(~nonblank, axis=1, stable=True)
+    packed = jnp.take_along_axis(labels, order, axis=1)
+    label_len = nonblank.sum(axis=1)
+
+    # blank-extended sequence z = [0, l1, 0, l2, ..., lL, 0], S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.zeros((B, S), jnp.int32).at[:, 1::2].set(packed)
+    s_len = 2 * label_len + 1
+
+    neg_inf = jnp.array(-1e30, log_probs.dtype)
+    pos = jnp.arange(S)
+    # transition s-2 -> s allowed for label states whose label differs from
+    # the one two back (repeated labels must pass through the blank)
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    allow_skip = (ext != 0) & (ext != ext_m2)
+    in_seq = pos[None, :] < s_len[:, None]
+
+    def emit(logp_t):
+        return jnp.take_along_axis(logp_t, ext, axis=1)  # (B, S)
+
+    alpha0 = jnp.where(pos[None, :] < 2, emit(log_probs[0]), neg_inf)
+    alpha0 = jnp.where(in_seq, alpha0, neg_inf)
+    # a label_len of 0 leaves only the blank state
+    alpha0 = jnp.where((pos[None, :] == 1) & (label_len[:, None] == 0),
+                       neg_inf, alpha0)
+
+    def step(alpha, logp_t):
+        shift1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :S]
+        shift2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg_inf)[:, :S]
+        a = jnp.logaddexp(alpha, shift1)
+        a = jnp.where(allow_skip, jnp.logaddexp(a, shift2), a)
+        a = a + emit(logp_t)
+        a = jnp.where(in_seq, a, neg_inf)
+        return a, None
+
+    alpha, _ = lax.scan(step, alpha0, log_probs[1:])
+    last = jnp.take_along_axis(alpha, (s_len - 1)[:, None], axis=1)[:, 0]
+    prev = jnp.take_along_axis(
+        alpha, jnp.maximum(s_len - 2, 0)[:, None], axis=1)[:, 0]
+    prev = jnp.where(s_len > 1, prev, neg_inf)
+    return -jnp.logaddexp(last, prev)
+
+
+def _warpctc_fwd(params, inputs, aux, is_train, rng):
+    input_length = int(params["input_length"])
+    label_length = int(params["label_length"])
+    if input_length <= 0 or label_length <= 0:
+        raise MXNetError("WarpCTC requires input_length and label_length > 0")
+    data, label = inputs[0], inputs[1]
+    if data.ndim != 2:
+        raise MXNetError("WarpCTC input data shape should be 2: (t*n, p)")
+    T = input_length
+    if data.shape[0] % T != 0:
+        raise MXNetError(
+            "WarpCTC: data rows %d not divisible by input_length %d"
+            % (data.shape[0], T))
+    B = data.shape[0] // T
+    A = data.shape[1]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.softmax(data, axis=-1)
+
+    def fwd(data, label):
+        return f(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g  # loss head: grads written directly (warpctc-inl.h Backward)
+
+        def total_cost(d):
+            logp = jax.nn.log_softmax(
+                d.astype(jnp.float32).reshape(T, B, A), axis=-1)
+            lab = label.reshape(B, label_length)
+            return jnp.sum(ctc_loss(logp, lab))
+
+        gd = jax.grad(total_cost)(data).astype(data.dtype)
+        return gd, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return [f(data, label)], []
+
+
+def _warpctc_infer_shape(params, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        raise MXNetError("WarpCTC: data shape required")
+    T = int(params["input_length"])
+    if T <= 0 or int(params["label_length"]) <= 0:
+        raise MXNetError("WarpCTC requires input_length and label_length > 0")
+    if d[0] % T != 0:
+        raise MXNetError(
+            "WarpCTC: data rows %d not divisible by input_length %d"
+            % (d[0], T))
+    B = d[0] // T
+    label = in_shapes[1] if in_shapes[1] is not None else (
+        B * int(params["label_length"]),)
+    return [tuple(d), tuple(label)], [tuple(d)], []
+
+
+register(
+    OpDef(
+        "WarpCTC",
+        _warpctc_fwd,
+        params={
+            "label_length": Field("int", default=0),
+            "input_length": Field("int", default=0),
+        },
+        arguments=("data", "label"),
+        infer_shape=_warpctc_infer_shape,
+        no_head_grad=True,
+        doc="CTC loss layer (ref: plugin/warpctc/warpctc-inl.h); "
+            "forward = softmax over the alphabet, backward = CTC gradient "
+            "wrt activations, blank index 0.",
+    )
+)

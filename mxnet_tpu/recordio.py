@@ -173,6 +173,8 @@ class MXRecordIO:
             length = lrec & _kLenMask
             cflag = lrec >> 29
             data = self.handle.read(length)
+            if len(data) < length:
+                raise MXNetError("truncated record payload in %s" % self.uri)
             pad = (4 - length % 4) % 4
             if pad:
                 self.handle.read(pad)

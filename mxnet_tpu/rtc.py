@@ -153,6 +153,12 @@ class Rtc:
         BlockSpec constructor tuples for explicit VMEM tiling.
         """
         del block_dims  # no TPU analog; see module docstring
+        if len(inputs) != len(self._in_shapes):
+            raise ValueError("kernel takes %d inputs, got %d"
+                             % (len(self._in_shapes), len(inputs)))
+        if len(outputs) != len(self._out_shapes):
+            raise ValueError("kernel produces %d outputs, got %d arrays"
+                             % (len(self._out_shapes), len(outputs)))
         for arr, shape in zip(inputs, self._in_shapes):
             if tuple(arr.shape) != shape:
                 raise ValueError(

@@ -36,6 +36,7 @@ BatchNorm op for train-time moving stats.
 from __future__ import annotations
 
 import sys
+import threading
 
 import numpy as _np
 
@@ -45,6 +46,10 @@ from .ops.registry import Field, OpDef, register as _register_opdef
 __all__ = ["import_torch", "module_creator"]
 
 _module_cache = {}
+# two ops built from the same module_string share the cached module object;
+# pure_callback gives no ordering guarantee, so param-load + forward must be
+# atomic with respect to other instances' callbacks
+_torch_lock = threading.Lock()
 
 
 def import_torch():
@@ -130,25 +135,28 @@ def _torch_module_fwd(params, inputs, aux, is_train, rng):
     def run(host_args, with_grad, out_grads=None):
         datas = [torch.from_numpy(_np.array(a, _np.float32)) for a in
                  host_args[:num_data]]
-        pvals = host_args[num_data:]
-        _load_params(mod, pvals)
-        tensors = datas + _param_tensors(mod)
-        if with_grad:
-            for t in tensors:
-                t.requires_grad_(True)
-        outs = mod(*datas)
-        if not isinstance(outs, (tuple, list)):
-            outs = (outs,)
-        if not with_grad:
-            return tuple(o.detach().numpy() for o in outs)
-        ogs = [torch.from_numpy(_np.array(g, _np.float32)) for g in out_grads]
-        grads = torch.autograd.grad(
-            outs, tensors, grad_outputs=ogs, allow_unused=True
-        )
-        return tuple(
-            _np.zeros(t.shape, _np.float32) if g is None else g.detach().numpy()
-            for g, t in zip(grads, tensors)
-        )
+        with _torch_lock:
+            pvals = host_args[num_data:]
+            _load_params(mod, pvals)
+            tensors = datas + _param_tensors(mod)
+            if with_grad:
+                for t in tensors:
+                    t.requires_grad_(True)
+            outs = mod(*datas)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            if not with_grad:
+                return tuple(o.detach().numpy() for o in outs)
+            ogs = [torch.from_numpy(_np.array(g, _np.float32))
+                   for g in out_grads]
+            grads = torch.autograd.grad(
+                outs, tensors, grad_outputs=ogs, allow_unused=True
+            )
+            return tuple(
+                _np.zeros(t.shape, _np.float32) if g is None
+                else g.detach().numpy()
+                for g, t in zip(grads, tensors)
+            )
 
     def host_forward(*host_args):
         return run(host_args, with_grad=False)
@@ -179,7 +187,7 @@ def _torch_out_shapes(mstr, data_shapes, num_outputs):
     (torch_module-inl.h:341-376)."""
     torch = import_torch()
     mod = module_creator(mstr)
-    with torch.no_grad():
+    with _torch_lock, torch.no_grad():
         outs = mod(*[torch.zeros(*s) for s in data_shapes])
     if not isinstance(outs, (tuple, list)):
         outs = (outs,)
@@ -261,7 +269,7 @@ def _torch_criterion_fwd(params, inputs, aux, is_train, rng):
     grad_spec = jax.ShapeDtypeStruct(tuple(data.shape), _np.dtype(_np.float32))
 
     def host_forward(d, l):
-        with torch.no_grad():
+        with _torch_lock, torch.no_grad():
             loss = crit(
                 torch.from_numpy(_np.array(d, _np.float32)),
                 torch.from_numpy(_np.array(l, _np.float32)),
@@ -273,8 +281,9 @@ def _torch_criterion_fwd(params, inputs, aux, is_train, rng):
     def host_backward(d, l):
         dt = torch.from_numpy(_np.array(d, _np.float32)).requires_grad_(True)
         lt = torch.from_numpy(_np.array(l, _np.float32))
-        loss = crit(dt, lt)
-        (g,) = torch.autograd.grad(loss, (dt,))
+        with _torch_lock:
+            loss = crit(dt, lt)
+            (g,) = torch.autograd.grad(loss, (dt,))
         return g.detach().numpy() * grad_scale
 
     @jax.custom_vjp

@@ -1,0 +1,100 @@
+"""Predictor / compiled-export tests.
+
+Model: tests/python/predict/mxnet_predict_example.py in the reference
+(load checkpoint → set_input → forward → get_output) plus the
+amalgamation deployment story, here as jax.export artifacts.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _make_checkpoint(tmp_path, seed=0):
+    net = mx.models.get_mlp(num_classes=10)
+    rng = np.random.RandomState(seed)
+    arg_names = net.list_arguments()
+    arg_shapes, _, _ = net.infer_shape(data=(4, 784), softmax_label=(4,))
+    arg_params = {
+        n: mx.nd.array(rng.normal(0, 0.1, s).astype("f"))
+        for n, s in zip(arg_names, arg_shapes)
+        if n not in ("data", "softmax_label")
+    }
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 1, net, arg_params, {})
+    return net, arg_params, prefix
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    net, arg_params, prefix = _make_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 1, ctx=mx.cpu(), input_shapes={"data": (4, 784)})
+    x = np.random.RandomState(1).rand(4, 784).astype("f")
+
+    # c_predict_api call sequence: set_input -> forward -> get_output
+    pred.set_input("data", x)
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.shape == pred.get_output_shape(0) == (4, 10)
+    assert np.allclose(out.sum(1), 1.0, atol=1e-5)  # softmax rows
+
+    # must match a direct executor run with the same weights
+    args = {"data": mx.nd.array(x), "softmax_label": mx.nd.zeros((4,))}
+    args.update(arg_params)
+    exe = net.bind(mx.cpu(), args, grad_req="null")
+    (expect,) = exe.forward(is_train=False)
+    assert np.allclose(out, expect.asnumpy(), atol=1e-5)
+
+
+def test_predictor_reshape_and_errors(tmp_path):
+    _, _, prefix = _make_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 1, ctx=mx.cpu(), input_shapes={"data": (4, 784)})
+    with pytest.raises(mx.MXNetError):
+        pred.get_output(0)  # forward not called yet
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("data", np.zeros((3, 784), "f"))  # wrong shape
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("bogus", np.zeros((4, 784), "f"))
+
+    pred.reshape({"data": (2, 784)})  # MXPredReshape
+    x = np.random.rand(2, 784).astype("f")
+    pred.forward(data=x)
+    assert pred.get_output(0).shape == (2, 10)
+
+
+def test_predictor_partial_out(tmp_path):
+    net, arg_params, prefix = _make_checkpoint(tmp_path)
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    hidden = [n for n in names if n.endswith("_output") and "fc" in n][0]
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 1, ctx=mx.cpu(), input_shapes={"data": (4, 784)},
+        output_names=[hidden])
+    x = np.random.rand(4, 784).astype("f")
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    assert out.ndim == 2 and out.shape[0] == 4
+
+
+def test_compiled_export_roundtrip(tmp_path):
+    net, arg_params, prefix = _make_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 1, ctx=mx.cpu(), input_shapes={"data": (4, 784)})
+    blob = pred.export_compiled()
+    assert isinstance(blob, bytes) and blob[:4] == b"MXTC"
+
+    x = np.random.RandomState(3).rand(4, 784).astype("f")
+    pred.forward(data=x)
+    expect = pred.get_output(0)
+
+    # load in a fresh object: no symbol graph, no op registry involved
+    runner = mx.predictor.load_compiled(blob)
+    assert runner.input_names == ["data"]
+    runner.forward(data=x)
+    got = runner.get_output(0)
+    assert np.allclose(got, expect, atol=1e-5)
+
+    with pytest.raises(mx.MXNetError):
+        mx.predictor.load_compiled(b"JUNKDATA")

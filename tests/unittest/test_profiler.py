@@ -1,0 +1,36 @@
+"""Profiler smoke tests: trace capture writes xplane files; annotations
+and state machine behave."""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_trace_capture(tmp_path):
+    out = str(tmp_path / "traces")
+    mx.profiler.profiler_set_config(filename=out)
+    assert mx.profiler.state() == "stop"
+    mx.profiler.profiler_set_state("run")
+    assert mx.profiler.state() == "run"
+
+    with mx.profiler.scope("tiny-matmul"):
+        a = mx.nd.array(np.random.rand(64, 64).astype("f"))
+        (a @ a if hasattr(a, "__matmul__") else a).wait_to_read()
+
+    @mx.profiler.annotate("square")
+    def f(x):
+        return x * x
+
+    f(a).wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    assert mx.profiler.state() == "stop"
+    files = glob.glob(os.path.join(out, "**", "*.xplane.pb"), recursive=True)
+    assert files, "no xplane trace written under %s" % out
+
+    # idempotent stop, invalid state rejected
+    mx.profiler.profiler_set_state("stop")
+    with pytest.raises(ValueError):
+        mx.profiler.profiler_set_state("bogus")

@@ -1,0 +1,105 @@
+"""WarpCTC tests: the pure-JAX CTC recursion vs torch.nn.CTCLoss, and the
+op-level loss-head contract (forward = softmax, backward = CTC grads).
+
+Model: the reference warpctc plugin has no python unit test; torch (CPU)
+provides the independent numerical reference, like conv/pool tests do.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+torch = pytest.importorskip("torch")
+
+
+def _torch_ctc(logits_tba, labels_bl, reduction="none"):
+    T, B, A = logits_tba.shape
+    lp = torch.nn.functional.log_softmax(
+        torch.from_numpy(logits_tba).double(), dim=-1)
+    label_lens = [int((labels_bl[b] != 0).sum()) for b in range(B)]
+    targets = torch.tensor(
+        [v for b in range(B) for v in labels_bl[b] if v != 0], dtype=torch.long)
+    return torch.nn.functional.ctc_loss(
+        lp, targets, torch.tensor([T] * B), torch.tensor(label_lens),
+        blank=0, reduction=reduction, zero_infinity=False)
+
+
+def test_ctc_loss_matches_torch():
+    from mxnet_tpu.ops.loss import ctc_loss
+    import jax
+
+    rng = np.random.RandomState(0)
+    T, B, A, L = 12, 4, 6, 5
+    logits = rng.randn(T, B, A).astype("f")
+    labels = np.zeros((B, L), np.int32)
+    labels[0, :3] = [1, 2, 1]      # repeated label (needs blank transition)
+    labels[1, :5] = [5, 4, 3, 2, 1]
+    labels[2, :1] = [3]
+    labels[3, :4] = [2, 0, 2, 4]   # zero padding mid-row (reference strips)
+    logp = jax.nn.log_softmax(np.asarray(logits), axis=-1)
+    ours = np.asarray(ctc_loss(logp, labels))
+
+    # torch target for row 3 is the packed [2, 2, 4]
+    expect = _torch_ctc(logits, labels).numpy()
+    assert np.allclose(ours, expect, atol=1e-4), (ours, expect)
+
+
+def test_ctc_loss_empty_label():
+    from mxnet_tpu.ops.loss import ctc_loss
+    import jax
+
+    rng = np.random.RandomState(1)
+    T, B, A = 7, 2, 5
+    logits = rng.randn(T, B, A).astype("f")
+    labels = np.zeros((B, 3), np.int32)
+    labels[1, 0] = 2
+    logp = jax.nn.log_softmax(np.asarray(logits), axis=-1)
+    ours = np.asarray(ctc_loss(logp, labels))
+    # empty label: cost = -sum_t logp(blank)
+    assert np.allclose(ours[0], -logp[:, 0, 0].sum(), atol=1e-4)
+    expect = _torch_ctc(logits, labels).numpy()
+    assert np.allclose(ours, expect, atol=1e-4)
+
+
+def test_warpctc_op_forward_backward():
+    T, B, A, L = 10, 3, 8, 4
+    rng = np.random.RandomState(2)
+    x = rng.randn(T * B, A).astype("f")
+    labels = np.zeros((B, L), np.float32)
+    labels[0, :2] = [1, 3]
+    labels[1, :4] = [2, 2, 5, 7]
+    labels[2, :1] = [6]
+
+    s = sym.WarpCTC(
+        sym.Variable("data"), sym.Variable("label"),
+        input_length=T, label_length=L,
+    )
+    args = {"data": mx.nd.array(x), "label": mx.nd.array(labels.reshape(-1))}
+    grads = {"data": mx.nd.zeros(x.shape), "label": mx.nd.zeros((B * L,))}
+    exe = s.bind(mx.cpu(), args, args_grad=grads,
+                 grad_req={"data": "write", "label": "null"})
+    (out,) = exe.forward(is_train=True)
+    # forward contract: softmax over the alphabet (warpctc-inl.h Forward)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert np.allclose(out.asnumpy(), e / e.sum(-1, keepdims=True), atol=1e-5)
+
+    exe.backward()  # loss head: no out_grad
+    got = grads["data"].asnumpy()
+
+    lt = torch.from_numpy(x.reshape(T, B, A)).double().requires_grad_(True)
+    lp = torch.nn.functional.log_softmax(lt, dim=-1)
+    label_lens = [2, 4, 1]
+    targets = torch.tensor([1, 3, 2, 2, 5, 7, 6], dtype=torch.long)
+    loss = torch.nn.functional.ctc_loss(
+        lp, targets, torch.tensor([T] * B), torch.tensor(label_lens),
+        blank=0, reduction="sum")
+    loss.backward()
+    expect = lt.grad.numpy().reshape(T * B, A)
+    assert np.allclose(got, expect, atol=1e-4), np.abs(got - expect).max()
+
+
+def test_warpctc_param_validation():
+    s = sym.WarpCTC(sym.Variable("data"), sym.Variable("label"))
+    with pytest.raises(Exception):
+        s.infer_shape(data=(20, 5))

@@ -44,9 +44,10 @@ def main():
     # carrier of the pattern is not killed and the exit code reflects targets
     quoted = shlex.quote(args.pattern)
     kill = (
-        "for pid in $(pgrep -f %s); do "
-        "[ \"$pid\" != \"$$\" ] && [ \"$pid\" != \"$PPID\" ] "
-        "&& kill -TERM \"$pid\" 2>/dev/null; done; true" % quoted
+        "found=1; for pid in $(pgrep -f %s); do "
+        "if [ \"$pid\" != \"$$\" ] && [ \"$pid\" != \"$PPID\" ]; then "
+        "kill -TERM \"$pid\" 2>/dev/null && found=0; fi; done; "
+        "exit $found" % quoted
     )
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
