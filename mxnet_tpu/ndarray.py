@@ -500,11 +500,14 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mea
 
 def waitall():
     """Block until all async work is done (ref: MXNDArrayWaitAll,
-    c_api.h:332). JAX effects are per-array; this is a fence via
-    jax.block_until_ready of live arrays — practically a no-op needed only
-    for timing, so we expose jax's own barrier."""
+    c_api.h:332). Two fences: drain the host-task dependency engine
+    (mxnet_tpu.engine), then a device barrier via jax.block_until_ready."""
     import jax
 
+    from . import engine as _engine
+
+    if _engine.Engine._instance is not None:
+        _engine.Engine._instance.wait_for_all()
     (jax.device_put(0.0) + 0).block_until_ready()
 
 
