@@ -1,0 +1,114 @@
+/*
+ * Flat C API of the TPU-native framework (parity target:
+ * include/mxnet/c_api.h in the reference — SURVEY §2.10).
+ *
+ * Architecture: the reference's C API sits above a C++ core; here the
+ * core is the Python/JAX layer, so this ABI embeds CPython (linked
+ * against libpython3) and marshals into mxnet_tpu._c_api_impl. Language
+ * bindings (R/Scala/MATLAB/C++ deployments) link this library exactly as
+ * they link the reference's libmxnet.so.
+ *
+ * Conventions (same as reference):
+ *  - every function returns 0 on success, nonzero on failure;
+ *  - MXGetLastError() returns the failure message for the calling thread;
+ *  - handles are opaque pointers owned by the library; free with the
+ *    matching *Free call;
+ *  - output string/array pointers are valid until the next call on the
+ *    same thread.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *AtomicSymbolHandle;
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+/* ref: c_api.h:144 MXGetLastError */
+const char *MXGetLastError();
+/* ref: c_api.h MXGetVersion */
+int MXGetVersion(int *out);
+/* ref: c_api.h MXNotifyShutdown */
+int MXNotifyShutdown();
+/* ref: c_api.h MXRandomSeed */
+int MXRandomSeed(int seed);
+
+/* ---- NDArray ---- */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+/* dev_type: 1=cpu, 2=gpu(alias tpu), 3=cpu_pinned, 6=tpu */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* ---- imperative function registry ---- */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+/* Generic invoke by name (ref: MXFuncInvoke c_api.h:447); kwargs as
+ * key/value strings, outputs appended to out_handles (caller provides
+ * capacity >= *num_outputs; actual count written back). */
+int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
+                       mx_uint num_inputs, mx_uint num_params,
+                       const char **keys, const char **vals,
+                       mx_uint *num_outputs, NDArrayHandle *out_handles);
+
+/* ---- Symbol ---- */
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle handle, const char *fname);
+int MXSymbolFree(SymbolHandle handle);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* Atomic symbol creation + composition (ref: c_api.h:600-668). */
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               AtomicSymbolHandle *out);
+int MXSymbolCompose(AtomicSymbolHandle handle, const char *name,
+                    mx_uint num_args, const char **keys,
+                    SymbolHandle *args, SymbolHandle *out);
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array);
+/* CSR-style shape args, as in the reference (c_api.h:714):
+ * arg_ind_ptr has num_args+1 entries delimiting arg_shape_data. */
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_API_H_ */

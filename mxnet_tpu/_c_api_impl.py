@@ -1,0 +1,267 @@
+"""Python side of the flat C API (ref: src/c_api/c_api.cc, SURVEY §2.10).
+
+The reference exposes ~110 flat C functions over its C++ core; every
+language binding (Python/R/Scala/MATLAB/amalgamation) sits on that ABI.
+In this framework the core is the Python/JAX layer, so the C ABI
+(src/c_api.cc) embeds CPython and marshals into the plain functions here.
+Each function takes/returns only simple types (ints, strings, bytes,
+tuples, handles-as-objects) so the C side stays a dumb marshaller.
+
+Device-type codes follow the reference (include/mxnet/base.h:85-118):
+1 = cpu, 2 = gpu (alias of tpu here), 3 = cpu_pinned, 6 = tpu.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+_DEV = {}
+
+
+def _ctx(dev_type, dev_id):
+    from . import context
+
+    if not _DEV:
+        _DEV.update({1: context.cpu, 2: context.tpu, 3: context.cpu_pinned,
+                     6: context.tpu})
+    return _DEV[int(dev_type)](int(dev_id))
+
+
+def _dev_code(ctx):
+    return {"cpu": 1, "tpu": 6, "gpu": 6, "cpu_pinned": 3}[ctx.device_type], ctx.device_id
+
+
+# -- NDArray ------------------------------------------------------------------
+
+def ndarray_create(shape, dev_type, dev_id):
+    from . import ndarray as nd
+
+    return nd.empty(tuple(int(s) for s in shape), ctx=_ctx(dev_type, dev_id))
+
+
+def ndarray_create_none():
+    from . import ndarray as nd
+
+    return nd.empty((0,))
+
+
+def ndarray_sync_copy_from(arr, data):
+    """data: bytes of float32, length must equal arr.size*4."""
+    src = _np.frombuffer(data, dtype=_np.float32).reshape(arr.shape)
+    arr[:] = src.astype(arr.dtype, copy=False)
+    return 0
+
+
+def ndarray_sync_copy_to(arr):
+    return _np.ascontiguousarray(arr.asnumpy().astype(_np.float32)).tobytes()
+
+
+def ndarray_shape(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def ndarray_dtype_code(arr):
+    from .base import _DTYPE_NP_TO_MX
+
+    return int(_DTYPE_NP_TO_MX[_np.dtype(arr.dtype)])
+
+
+def ndarray_context(arr):
+    return _dev_code(arr.context)
+
+
+def ndarray_slice(arr, start, stop):
+    return arr[int(start):int(stop)]
+
+def ndarray_at(arr, idx):
+    return arr[int(idx)]
+
+
+def ndarray_save(fname, handles, keys):
+    from . import ndarray as nd
+
+    if keys:
+        nd.save(fname, dict(zip(keys, handles)))
+    else:
+        nd.save(fname, list(handles))
+    return 0
+
+
+def ndarray_load(fname):
+    """Returns (list_of_arrays, list_of_names) — names empty for a list."""
+    from . import ndarray as nd
+
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [data[k] for k in names], names
+    return list(data), []
+
+
+def ndarray_wait_to_read(arr):
+    arr.wait_to_read()
+    return 0
+
+
+def wait_all():
+    from . import ndarray as nd
+
+    nd.waitall()
+    return 0
+
+
+def random_seed(seed):
+    from . import random
+
+    random.seed(int(seed))
+    return 0
+
+
+# -- imperative function registry --------------------------------------------
+
+def list_all_op_names():
+    from . import ndarray as nd
+
+    return sorted(
+        n for n in dir(nd)
+        if not n.startswith("_") and callable(getattr(nd, n)))
+
+
+def _parse_literal(s):
+    """Best-effort string→value for kwargs crossing the C ABI, mirroring
+    the reference's dmlc::Parameter string protocol (registry Field.convert
+    handles op params; this covers plain jnp-wrapper functions)."""
+    import ast
+
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def func_invoke(name, inputs, keys, vals):
+    """Generic imperative invoke (ref: MXFuncInvoke, c_api.h:447).
+    kwargs arrive as strings, as in the reference C API."""
+    from . import ndarray as nd
+
+    fn = getattr(nd, name, None)
+    if fn is None or name.startswith("_"):
+        raise ValueError("unknown NDArray function: %s" % name)
+    kwargs = {k: _parse_literal(v) for k, v in zip(keys, vals)}
+    out = fn(*inputs, **kwargs)
+    return out if isinstance(out, (list, tuple)) else [out]
+
+
+# -- Symbol -------------------------------------------------------------------
+
+def symbol_create_from_json(json_str):
+    from . import symbol
+
+    return symbol.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_create_variable(name):
+    from . import symbol
+
+    return symbol.Variable(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    """Create an un-composed op symbol; compose() wires its inputs
+    (ref: MXSymbolCreateAtomicSymbol + MXSymbolCompose, c_api.h:600-668)."""
+    from . import symbol
+
+    op = getattr(symbol, op_name, None)
+    if op is None:
+        raise ValueError("unknown operator: %s" % op_name)
+    # registry ops convert string params themselves (Field.convert — the
+    # dmlc::Parameter protocol), so kwargs stay as strings here
+    return ("_atomic", op, dict(zip(keys, vals)))
+
+
+def symbol_compose(atom, name, keys, args):
+    if not (isinstance(atom, tuple) and atom and atom[0] == "_atomic"):
+        raise ValueError("handle is not an atomic symbol")
+    _, op, base_kwargs = atom
+    kwargs = dict(base_kwargs)  # the atomic handle may be composed repeatedly
+    if name:
+        kwargs.setdefault("name", name)
+    if keys:
+        kwargs.update(dict(zip(keys, args)))
+        return op(**kwargs)
+    return op(*args, **kwargs)
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_infer_shape(sym, keys, shapes):
+    """shapes: list of int tuples aligned with keys. Returns
+    (arg_shapes, out_shapes, aux_shapes) or None on incomplete info."""
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    arg, out, aux = sym.infer_shape(**kwargs)
+    if arg is None:
+        return None
+    return ([tuple(map(int, s)) for s in arg],
+            [tuple(map(int, s)) for s in out],
+            [tuple(map(int, s)) for s in aux])
+
+
+# -- Predict API (ref: include/mxnet/c_predict_api.h) -------------------------
+
+def pred_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
+                input_shapes):
+    from .predictor import Predictor
+
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    return Predictor(symbol_json, param_bytes, ctx=_ctx(dev_type, dev_id),
+                     input_shapes=shapes)
+
+
+def pred_set_input(pred, key, data):
+    if key not in pred._args:
+        raise ValueError("unknown input %r" % key)
+    shape = pred._args[key].shape
+    arr = _np.frombuffer(data, dtype=_np.float32).reshape(shape)
+    pred.set_input(key, arr)
+    return 0
+
+
+def pred_forward(pred):
+    pred.forward()
+    return 0
+
+
+def pred_get_output_shape(pred, index):
+    return tuple(int(s) for s in pred.get_output_shape(int(index)))
+
+
+def pred_get_output(pred, index):
+    out = pred.get_output(int(index))
+    return _np.ascontiguousarray(
+        _np.asarray(out, dtype=_np.float32)).tobytes()
+
+
+def pred_reshape(pred, input_keys, input_shapes):
+    """Returns a NEW predictor at the new shapes; the original handle
+    stays valid at its old shapes (ref: MXPredReshape contract)."""
+    import copy
+
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    newp = copy.copy(pred)
+    newp.reshape(shapes)
+    return newp

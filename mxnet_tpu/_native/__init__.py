@@ -25,6 +25,20 @@ def native_disabled():
     return os.environ.get("MXNET_NATIVE", "").strip().lower() in ("0", "false", "off")
 
 
+def _extra_flags(name):
+    """Per-component compile/link flags. c_api embeds CPython
+    (src/c_api.cc) and needs the interpreter headers + libpython."""
+    if name == "c_api":
+        import sysconfig
+
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+        ver = "python%d.%d" % tuple(__import__("sys").version_info[:2])
+        return ["-I" + inc, "-L" + libdir, "-l" + ver,
+                "-Wl,-rpath," + libdir]
+    return []
+
+
 def _build(name):
     src = os.path.join(_SRC_DIR, name + ".cc")
     out = os.path.join(_PKG_DIR, "lib%s.so" % name)
@@ -39,7 +53,7 @@ def _build(name):
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
         src, "-o", tmp,
-    ]
+    ] + _extra_flags(name)
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)

@@ -226,10 +226,12 @@ class Engine:
         return self._lib.EnginePendingCount(self._handle)
 
     def _raise_pending(self):
-        if self._errors:
-            err = self._errors.pop(0)
+        with self._live_lock:
+            if not self._errors:
+                return
+            err = self._errors[0]
             self._errors.clear()
-            raise err
+        raise err
 
 
 def get():

@@ -1,0 +1,688 @@
+// Flat C ABI over the embedded Python/JAX core (ref: src/c_api/c_api.cc,
+// src/c_api/c_predict_api.cc — SURVEY §2.10). See include/c_api.h for the
+// architecture note. Every entry point:
+//   1. ensures the interpreter is alive and takes the GIL,
+//   2. calls a plain function in mxnet_tpu._c_api_impl,
+//   3. marshals results into thread-local buffers,
+//   4. converts Python exceptions into -1 + MXGetLastError().
+// Handles are strong PyObject* references.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *AtomicSymbolHandle;
+typedef void *PredictorHandle;
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+namespace {
+
+thread_local std::string tl_last_error;
+
+// Per-thread marshalling buffers; valid until the next call on the thread
+// (the reference uses the same thread-local ownership discipline via
+// MXAPIThreadLocalEntry, src/c_api/c_api.cc).
+struct TLBuffers {
+  std::vector<mx_uint> shape;
+  std::vector<std::string> strings;
+  std::vector<const char *> cstrs;
+  std::vector<void *> handles;
+  std::string json;
+  std::vector<std::vector<mx_uint>> shape_rows[3];
+  std::vector<mx_uint> shape_ndim[3];
+  std::vector<const mx_uint *> shape_ptrs[3];
+  std::vector<mx_uint> out_shape;
+};
+thread_local TLBuffers tl_buf;
+
+void EnsureInterpreter() {
+  // first calls may race from multiple foreign threads (JVM/C++ hosts)
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();  // drop the GIL so GILGuard below is uniform
+    }
+  });
+}
+
+struct GILGuard {
+  PyGILState_STATE st;
+  GILGuard() {
+    EnsureInterpreter();
+    st = PyGILState_Ensure();
+  }
+  ~GILGuard() { PyGILState_Release(st); }
+};
+
+// Record the active Python exception into tl_last_error and clear it.
+int HandleException() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tl_last_error = "unknown error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) tl_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+PyObject *Impl() {
+  static PyObject *mod = nullptr;  // borrowed forever, created under GIL
+  if (mod == nullptr) mod = PyImport_ImportModule("mxnet_tpu._c_api_impl");
+  return mod;
+}
+
+// Call impl.<fn>(*args). STEALS the args reference (callers build the
+// tuple inline and must not touch it afterwards); returns new ref or null.
+PyObject *CallImpl(const char *fn, PyObject *args) {
+  PyObject *r = nullptr;
+  PyObject *mod = Impl();
+  if (mod != nullptr) {
+    PyObject *f = PyObject_GetAttrString(mod, fn);
+    if (f != nullptr) {
+      r = PyObject_CallObject(f, args);
+      Py_DECREF(f);
+    }
+  }
+  Py_XDECREF(args);
+  return r;
+}
+
+PyObject *UIntTuple(const mx_uint *data, mx_uint n) {
+  PyObject *t = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(data[i]));
+  return t;
+}
+
+PyObject *StrList(const char **strs, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs[i]));
+  return l;
+}
+
+PyObject *HandleList(void **handles, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *o = static_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+// CSR shape args → list of int tuples (ref MXSymbolInferShape marshalling)
+PyObject *CSRShapes(mx_uint num, const mx_uint *indptr, const mx_uint *data) {
+  PyObject *l = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyList_SET_ITEM(l, i, UIntTuple(data + lo, hi - lo));
+  }
+  return l;
+}
+
+// Fill tl_buf.strings/cstrs from a Python list of str.
+int MarshalStrList(PyObject *list, mx_uint *out_size, const char ***out) {
+  tl_buf.strings.clear();
+  tl_buf.cstrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(list, i));
+    if (c == nullptr) return -1;
+    tl_buf.strings.emplace_back(c);
+  }
+  for (auto &s : tl_buf.strings) tl_buf.cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out = tl_buf.cstrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return tl_last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  *out = 10000;  // 1.0.0 of the TPU-native framework
+  return 0;
+}
+
+int MXNotifyShutdown() { return 0; }
+
+int MXRandomSeed(int seed) {
+  GILGuard g;
+  PyObject *r = CallImpl("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- NDArray ---- */
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *r = CallImpl("ndarray_create_none", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int /*delay_alloc*/, NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, UIntTuple(shape, ndim));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_id));
+  PyObject *r = CallImpl("ndarray_create", args);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  GILGuard g;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), static_cast<Py_ssize_t>(size * 4));
+  PyObject *args = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(args, 0, h);
+  PyTuple_SET_ITEM(args, 1, bytes);
+  PyObject *r = CallImpl("ndarray_sync_copy_from", args);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_sync_copy_to", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  if (static_cast<size_t>(len) != size * 4) {
+    Py_DECREF(r);
+    tl_last_error = "MXNDArraySyncCopyToCPU: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_wait_to_read", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  GILGuard g;
+  PyObject *r = CallImpl("wait_all", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_shape", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_ssize_t n = PyTuple_Size(r);
+  tl_buf.shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_buf.shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i))));
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = tl_buf.shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_dtype_code", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_context", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_slice", Py_BuildValue("(OII)", h, start, stop));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_at", Py_BuildValue("(OI)", h, idx));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(fname));
+  PyTuple_SET_ITEM(t, 1, HandleList(args, num_args));
+  if (keys != nullptr) {
+    PyTuple_SET_ITEM(t, 2, StrList(keys, num_args));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(t, 2, Py_None);
+  }
+  PyObject *r = CallImpl("ndarray_save", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  GILGuard g;
+  PyObject *r = CallImpl("ndarray_load", Py_BuildValue("(s)", fname));
+  if (r == nullptr) return HandleException();
+  PyObject *arrs = PyTuple_GET_ITEM(r, 0);
+  PyObject *names = PyTuple_GET_ITEM(r, 1);
+  tl_buf.handles.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);  // caller owns; frees via MXNDArrayFree
+    tl_buf.handles.push_back(o);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = tl_buf.handles.data();
+  int rc = MarshalStrList(names, out_name_size, out_names);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+/* ---- function registry ---- */
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  GILGuard g;
+  PyObject *r = CallImpl("list_all_op_names", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  int rc = MarshalStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
+                       mx_uint num_inputs, mx_uint num_params,
+                       const char **keys, const char **vals,
+                       mx_uint *num_outputs, NDArrayHandle *out_handles) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(4);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(name));
+  PyTuple_SET_ITEM(t, 1, HandleList(inputs, num_inputs));
+  PyTuple_SET_ITEM(t, 2, StrList(keys, num_params));
+  PyTuple_SET_ITEM(t, 3, StrList(vals, num_params));
+  PyObject *r = CallImpl("func_invoke", t);
+  if (r == nullptr) return HandleException();
+  Py_ssize_t n = PyList_Size(r);
+  if (static_cast<mx_uint>(n) > *num_outputs) {
+    Py_DECREF(r);
+    tl_last_error = "MXFuncInvokeByName: output capacity too small";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    out_handles[i] = o;
+  }
+  *num_outputs = static_cast<mx_uint>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Symbol ---- */
+
+static int SymCallStr(const char *fn, const char *arg, SymbolHandle *out) {
+  GILGuard g;
+  PyObject *r = CallImpl(fn, Py_BuildValue("(s)", arg));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  return SymCallStr("symbol_create_from_json", json, out);
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  return SymCallStr("symbol_create_variable", name, out);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("symbol_to_json", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  const char *c = PyUnicode_AsUTF8(r);
+  if (c == nullptr) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  tl_buf.json = c;
+  Py_DECREF(r);
+  *out_json = tl_buf.json.c_str();
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  GILGuard g;
+  PyObject *io = PyImport_ImportModule("mxnet_tpu.symbol");
+  if (io == nullptr) return HandleException();
+  PyObject *r = PyObject_CallMethod(io, "load", "(s)", fname);
+  Py_DECREF(io);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle handle, const char *fname) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = PyObject_CallMethod(h, "save", "(s)", fname);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               AtomicSymbolHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(op_name));
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_param));
+  PyTuple_SET_ITEM(t, 2, StrList(vals, num_param));
+  PyObject *r = CallImpl("symbol_create_atomic", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolCompose(AtomicSymbolHandle handle, const char *name,
+                    mx_uint num_args, const char **keys, SymbolHandle *args,
+                    SymbolHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(4);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, PyUnicode_FromString(name == nullptr ? "" : name));
+  if (keys != nullptr) {
+    PyTuple_SET_ITEM(t, 2, StrList(keys, num_args));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(t, 2, Py_None);
+  }
+  PyTuple_SET_ITEM(t, 3, HandleList(args, num_args));
+  PyObject *r = CallImpl("symbol_compose", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+static int SymListCall(const char *fn, SymbolHandle handle, mx_uint *out_size,
+                       const char ***out_array) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  int rc = MarshalStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array) {
+  return SymListCall("symbol_list_arguments", handle, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array) {
+  return SymListCall("symbol_list_outputs", handle, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array) {
+  return SymListCall("symbol_list_aux", handle, out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_args));
+  PyTuple_SET_ITEM(t, 2, CSRShapes(num_args, arg_ind_ptr, arg_shape_data));
+  PyObject *r = CallImpl("symbol_infer_shape", t);
+  if (r == nullptr) return HandleException();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *complete = 0;
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    return 0;
+  }
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims[3] = {in_shape_ndim, out_shape_ndim, aux_shape_ndim};
+  const mx_uint ***datas[3] = {in_shape_data, out_shape_data, aux_shape_data};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject *lst = PyTuple_GET_ITEM(r, grp);
+    Py_ssize_t n = PyList_Size(lst);
+    auto &rows = tl_buf.shape_rows[grp];
+    auto &nd = tl_buf.shape_ndim[grp];
+    auto &ptrs = tl_buf.shape_ptrs[grp];
+    rows.clear();
+    nd.clear();
+    ptrs.clear();
+    rows.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *shp = PyList_GET_ITEM(lst, i);
+      Py_ssize_t d = PyTuple_Size(shp);
+      for (Py_ssize_t k = 0; k < d; ++k)
+        rows[i].push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, k))));
+      nd.push_back(static_cast<mx_uint>(d));
+    }
+    for (auto &row : rows) ptrs.push_back(row.data());
+    *sizes[grp] = static_cast<mx_uint>(n);
+    *ndims[grp] = nd.data();
+    *datas[grp] = ptrs.data();
+  }
+  Py_DECREF(r);
+  *complete = 1;
+  return 0;
+}
+
+/* ---- Predict API ---- */
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(6);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(symbol_json_str));
+  PyTuple_SET_ITEM(t, 1, PyBytes_FromStringAndSize(
+                             static_cast<const char *>(param_bytes),
+                             param_size));
+  PyTuple_SET_ITEM(t, 2, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(t, 3, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(t, 4, StrList(input_keys, num_input_nodes));
+  PyTuple_SET_ITEM(
+      t, 5, CSRShapes(num_input_nodes, input_shape_indptr, input_shape_data));
+  PyObject *r = CallImpl("pred_create", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("pred_get_output_shape",
+                         Py_BuildValue("(OI)", h, index));
+  if (r == nullptr) return HandleException();
+  Py_ssize_t n = PyTuple_Size(r);
+  tl_buf.out_shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_buf.out_shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i))));
+  Py_DECREF(r);
+  *shape_ndim = static_cast<mx_uint>(n);
+  *shape_data = tl_buf.out_shape.data();
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, PyUnicode_FromString(key));
+  PyTuple_SET_ITEM(t, 2, PyBytes_FromStringAndSize(
+                             reinterpret_cast<const char *>(data), size * 4));
+  PyObject *r = CallImpl("pred_set_input", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("pred_forward", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("pred_get_output", Py_BuildValue("(OI)", h, index));
+  if (r == nullptr) return HandleException();
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  if (static_cast<size_t>(len) != static_cast<size_t>(size) * 4) {
+    Py_DECREF(r);
+    tl_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(input_keys, num_input_nodes));
+  PyTuple_SET_ITEM(
+      t, 2, CSRShapes(num_input_nodes, input_shape_indptr, input_shape_data));
+  PyObject *r = CallImpl("pred_reshape", t);
+  if (r == nullptr) return HandleException();
+  *out = r;  // a NEW predictor; the input handle keeps its old shapes
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+}  // extern "C"

@@ -196,12 +196,16 @@ struct Engine {
   void OnComplete(CompletionToken* tok) {
     Opr* o = tok->opr;
     std::vector<Opr*> runnable;
+    std::vector<Var*> dead;
     for (Var* v : o->const_vars) {
       std::lock_guard<std::mutex> lk(v->m);
       v->pending_reads -= 1;
       Grant(v, &runnable);
+      if (v->to_delete && v->queue.empty() && v->pending_reads == 0 &&
+          !v->write_granted) {
+        dead.push_back(v);
+      }
     }
-    std::vector<Var*> dead;
     for (Var* v : o->mutable_vars) {
       std::lock_guard<std::mutex> lk(v->m);
       v->write_granted = false;

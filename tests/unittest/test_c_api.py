@@ -1,0 +1,254 @@
+"""Drive the flat C API through ctypes, as an external binding would.
+
+Parity target: the reference's C API surface (include/mxnet/c_api.h,
+include/mxnet/c_predict_api.h) exercised the way
+tests/python/predict/mxnet_predict_example.py and the MATLAB binding use
+it. The library embeds CPython; loading it inside this Python process
+shares the interpreter (Py_IsInitialized short-circuits init), which is
+exactly the in-process path the reference's own Python binding takes.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _native
+
+c_uint_p = ctypes.POINTER(ctypes.c_uint)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = _native.load("c_api")
+    if lib is None:
+        pytest.skip("c_api native build unavailable")
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def check(lib, rc):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def test_version_and_seed(lib):
+    v = ctypes.c_int()
+    check(lib, lib.MXGetVersion(ctypes.byref(v)))
+    assert v.value >= 10000
+    check(lib, lib.MXRandomSeed(0))
+
+
+def test_ndarray_roundtrip(lib):
+    shape = (ctypes.c_uint * 2)(3, 4)
+    h = ctypes.c_void_p()
+    check(lib, lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)))
+    data = np.arange(12, dtype=np.float32)
+    check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), 12))
+    check(lib, lib.MXNDArrayWaitToRead(h))
+    # shape readback
+    ndim = ctypes.c_uint()
+    pdata = c_uint_p()
+    check(lib, lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                     ctypes.byref(pdata)))
+    assert [pdata[i] for i in range(ndim.value)] == [3, 4]
+    # copy back
+    out = np.zeros(12, dtype=np.float32)
+    check(lib, lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), 12))
+    np.testing.assert_array_equal(out, data)
+    # context
+    dt, di = ctypes.c_int(), ctypes.c_int()
+    check(lib, lib.MXNDArrayGetContext(h, ctypes.byref(dt), ctypes.byref(di)))
+    assert dt.value == 1 and di.value == 0
+    check(lib, lib.MXNDArrayFree(h))
+
+
+def test_func_invoke_and_op_list(lib):
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    check(lib, lib.MXListAllOpNames(ctypes.byref(n),
+                                    ctypes.byref(arr)))
+    names = [arr[i].decode() for i in range(n.value)]
+    assert "dot" in names and "sqrt" in names
+    # c = dot(a, b) through the generic invoke
+    def make(shape, val):
+        s = (ctypes.c_uint * len(shape))(*shape)
+        h = ctypes.c_void_p()
+        check(lib, lib.MXNDArrayCreate(s, len(shape), 1, 0, 0,
+                                       ctypes.byref(h)))
+        d = np.full(shape, val, dtype=np.float32)
+        check(lib, lib.MXNDArraySyncCopyFromCPU(
+            h, d.ctypes.data_as(ctypes.c_void_p), d.size))
+        return h
+
+    a, b = make((2, 3), 2.0), make((3, 4), 3.0)
+    nout = ctypes.c_uint(1)
+    out = (ctypes.c_void_p * 1)()
+    ins = (ctypes.c_void_p * 2)(a, b)
+    check(lib, lib.MXFuncInvokeByName(
+        b"dot", ins, 2, 0, None, None, ctypes.byref(nout), out))
+    assert nout.value == 1
+    res = np.zeros(8, dtype=np.float32)
+    check(lib, lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(out[0]), res.ctypes.data_as(ctypes.c_void_p), 8))
+    np.testing.assert_allclose(res, 18.0)
+    for h in (a, b, ctypes.c_void_p(out[0])):
+        lib.MXNDArrayFree(h)
+
+
+def test_error_reporting(lib):
+    h = ctypes.c_void_p()
+    nout = ctypes.c_uint(1)
+    out = (ctypes.c_void_p * 1)()
+    rc = lib.MXFuncInvokeByName(
+        b"definitely_not_an_op", None, 0, 0, None, None,
+        ctypes.byref(nout), out)
+    assert rc != 0
+    assert b"definitely_not_an_op" in lib.MXGetLastError()
+
+
+def test_symbol_json_and_lists(lib):
+    sym = mx.models.get_lenet()
+    js = sym.tojson().encode()
+    h = ctypes.c_void_p()
+    check(lib, lib.MXSymbolCreateFromJSON(js, ctypes.byref(h)))
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    check(lib, lib.MXSymbolListArguments(h, ctypes.byref(n),
+                                         ctypes.byref(arr)))
+    args = [arr[i].decode() for i in range(n.value)]
+    assert args == sym.list_arguments()
+    out_json = ctypes.c_char_p()
+    check(lib, lib.MXSymbolSaveToJSON(h, ctypes.byref(out_json)))
+    assert mx.symbol.load_json(out_json.value.decode()).list_arguments() == args
+    check(lib, lib.MXSymbolFree(h))
+
+
+def test_symbol_compose_and_infer_shape(lib):
+    # data -> FullyConnected(num_hidden=8), built entirely through the C ABI
+    data = ctypes.c_void_p()
+    check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    atom = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"8")
+    check(lib, lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, keys, vals, ctypes.byref(atom)))
+    fc = ctypes.c_void_p()
+    args = (ctypes.c_void_p * 1)(data)
+    check(lib, lib.MXSymbolCompose(atom, b"fc1", 1, None, args,
+                                   ctypes.byref(fc)))
+    # infer shape with CSR args
+    akeys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    sdata = (ctypes.c_uint * 2)(5, 10)
+    in_sz = ctypes.c_uint()
+    out_sz = ctypes.c_uint()
+    aux_sz = ctypes.c_uint()
+    in_nd = c_uint_p()
+    out_nd = c_uint_p()
+    aux_nd = c_uint_p()
+    in_d = ctypes.POINTER(c_uint_p)()
+    out_d = ctypes.POINTER(c_uint_p)()
+    aux_d = ctypes.POINTER(c_uint_p)()
+    complete = ctypes.c_int()
+    check(lib, lib.MXSymbolInferShape(
+        fc, 1, akeys, indptr, sdata,
+        ctypes.byref(in_sz), ctypes.byref(in_nd), ctypes.byref(in_d),
+        ctypes.byref(out_sz), ctypes.byref(out_nd), ctypes.byref(out_d),
+        ctypes.byref(aux_sz), ctypes.byref(aux_nd), ctypes.byref(aux_d),
+        ctypes.byref(complete)))
+    assert complete.value == 1
+    assert out_sz.value == 1
+    out_shape = [out_d[0][i] for i in range(out_nd[0])]
+    assert out_shape == [5, 8]
+    for h in (data, atom, fc):
+        lib.MXSymbolFree(h)
+
+
+def test_predict_api_end_to_end(lib, tmp_path):
+    # train nothing: save random params for lenet, predict through C ABI
+    sym = mx.models.get_lenet()
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(2, 1, 28, 28), softmax_label=(2,))
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, s in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params["arg:" + name] = mx.nd.array(
+            rng.normal(0, 0.1, s).astype(np.float32))
+    for name, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        params["aux:" + name] = mx.nd.array(np.zeros(s, np.float32))
+    pfile = str(tmp_path / "p.params")
+    mx.nd.save(pfile, params)
+    param_bytes = open(pfile, "rb").read()
+
+    h = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 4)
+    sdata = (ctypes.c_uint * 4)(2, 1, 28, 28)
+    check(lib, lib.MXPredCreate(
+        sym.tojson().encode(), param_bytes, len(param_bytes), 1, 0,
+        1, keys, indptr, sdata, ctypes.byref(h)))
+    x = rng.rand(2, 1, 28, 28).astype(np.float32)
+    check(lib, lib.MXPredSetInput(
+        h, b"data", x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        x.size))
+    check(lib, lib.MXPredForward(h))
+    sd = c_uint_p()
+    snd = ctypes.c_uint()
+    check(lib, lib.MXPredGetOutputShape(h, 0, ctypes.byref(sd),
+                                        ctypes.byref(snd)))
+    oshape = [sd[i] for i in range(snd.value)]
+    assert oshape == [2, 10]
+    out = np.zeros(20, dtype=np.float32)
+    check(lib, lib.MXPredGetOutput(
+        h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 20))
+    out = out.reshape(2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)  # softmax
+
+    # MXPredReshape returns an independent predictor; original keeps bs=2
+    h2 = ctypes.c_void_p()
+    indptr2 = (ctypes.c_uint * 2)(0, 4)
+    sdata2 = (ctypes.c_uint * 4)(1, 1, 28, 28)
+    check(lib, lib.MXPredReshape(1, keys, indptr2, sdata2, h,
+                                 ctypes.byref(h2)))
+    sd2 = c_uint_p()
+    snd2 = ctypes.c_uint()
+    check(lib, lib.MXPredGetOutputShape(h2, 0, ctypes.byref(sd2),
+                                        ctypes.byref(snd2)))
+    assert [sd2[i] for i in range(snd2.value)] == [1, 10]
+    check(lib, lib.MXPredGetOutputShape(h, 0, ctypes.byref(sd2),
+                                        ctypes.byref(snd2)))
+    assert [sd2[i] for i in range(snd2.value)] == [2, 10]
+    check(lib, lib.MXPredFree(h2))
+    check(lib, lib.MXPredFree(h))
+
+
+def test_atomic_symbol_reused(lib):
+    """One atomic handle composed twice yields two distinct symbols
+    (the reference C API permits handle reuse)."""
+    atom = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"4")
+    check(lib, lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 1, keys, vals, ctypes.byref(atom)))
+    outs = []
+    for nm in (b"fca", b"fcb"):
+        d = ctypes.c_void_p()
+        check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(d)))
+        fc = ctypes.c_void_p()
+        args = (ctypes.c_void_p * 1)(d)
+        check(lib, lib.MXSymbolCompose(atom, nm, 1, None, args,
+                                       ctypes.byref(fc)))
+        n = ctypes.c_uint()
+        arr = ctypes.POINTER(ctypes.c_char_p)()
+        check(lib, lib.MXSymbolListOutputs(fc, ctypes.byref(n),
+                                           ctypes.byref(arr)))
+        outs.append([arr[i].decode() for i in range(n.value)])
+        lib.MXSymbolFree(d)
+        lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(atom)
+    assert outs[0] == ["fca_output"] and outs[1] == ["fcb_output"]
