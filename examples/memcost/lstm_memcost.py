@@ -1,0 +1,97 @@
+"""Gradient-checkpoint ("mirror") memory-cost demo.
+
+TPU-native counterpart of example/memcost/inception_memcost.py and
+example/image-classification/train_cifar10_mirroring.py in the reference:
+nodes tagged with ``force_mirroring`` (or everything, via
+MXNET_BACKWARD_DO_MIRROR=1) are rematerialized in the backward pass
+instead of having their activations stored — the executor groups
+consecutive mirrored nodes into jax.checkpoint segments, chunked by
+MXNET_BACKWARD_MIRROR_STEP (default: sqrt(N) schedule)
+(ref: static_graph.cc:404-422).
+
+Run:  PYTHONPATH=. python examples/memcost/lstm_memcost.py
+Reports the bytes of residuals JAX saves for the backward pass of a
+deeply unrolled LSTM — the reference's motivating workload (§5.7) —
+with and without mirroring.
+"""
+import argparse
+import contextlib
+import io
+import re
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import lstm_unroll
+
+_DT_BYTES = {"f32": 4, "f16": 2, "bf16": 2, "f64": 8, "i32": 4, "u32": 4}
+
+
+def build(seq_len, mirror):
+    scope = mx.AttrScope(force_mirroring="True") if mirror else None
+    if scope:
+        scope.__enter__()
+    net = lstm_unroll(
+        num_lstm_layer=2, seq_len=seq_len, input_size=128,
+        num_hidden=256, num_embed=128, num_label=128)
+    if scope:
+        scope.__exit__(None, None, None)
+    return net
+
+
+def residual_bytes(net, seq_len, batch=32):
+    """Total bytes of activations saved for backward (what mirroring cuts)."""
+    from jax.ad_checkpoint import print_saved_residuals
+
+    shapes = {"data": (batch, seq_len), "softmax_label": (batch, seq_len)}
+    for layer in range(2):
+        shapes["l%d_init_c" % layer] = (batch, 256)
+        shapes["l%d_init_h" % layer] = (batch, 256)
+    exe = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+    rng = np.random.RandomState(0)
+    for k, a in exe.arg_dict.items():
+        if k not in shapes:
+            a[:] = rng.normal(0, 0.05, a.shape)
+
+    gidx = exe._grad_idx
+    arg_vals = exe._arg_vals()
+    aux_vals = exe._aux_vals()
+
+    def loss_fn(ga):
+        vals = list(arg_vals)
+        for i, g in zip(gidx, ga):
+            vals[i] = g
+        outs, _ = exe._run(vals, aux_vals, None, is_train=True)
+        return sum(o.sum() for o in outs)
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        print_saved_residuals(loss_fn, [arg_vals[i] for i in gidx])
+    total = 0
+    for line in buf.getvalue().splitlines():
+        m = re.match(r"\s*(\w+)\[([\d,]*)\]", line)
+        if m and "from the argument" not in line:
+            dt, dims = m.group(1), m.group(2)
+            n = int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
+            total += n * _DT_BYTES.get(dt, 4)
+    nseg = sum(1 for it in exe._plan if it[0] == "seg")
+    return total, nseg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=64)
+    args = ap.parse_args()
+
+    base = None
+    for mirror in (False, True):
+        net = build(args.seq_len, mirror)
+        total, nseg = residual_bytes(net, args.seq_len)
+        if base is None:
+            base = total
+        print("mirror=%-5s remat_segments=%-3d saved_residual_MB=%.1f (%.0f%%)"
+              % (mirror, nseg, total / 1e6, 100.0 * total / base))
+
+
+if __name__ == "__main__":
+    main()

@@ -119,11 +119,11 @@ def random_seed(seed):
 # -- imperative function registry --------------------------------------------
 
 def list_all_op_names():
-    from . import ndarray as nd
+    """Registered operators only — the set a binding generator should wrap
+    (ref: MXListFunctions lists the op registry, not module helpers)."""
+    from .ops.registry import REGISTRY
 
-    return sorted(
-        n for n in dir(nd)
-        if not n.startswith("_") and callable(getattr(nd, n)))
+    return sorted(n for n, op in REGISTRY.items() if op.imperative)
 
 
 def _parse_literal(s):
@@ -142,13 +142,15 @@ def func_invoke(name, inputs, keys, vals):
     """Generic imperative invoke (ref: MXFuncInvoke, c_api.h:447).
     kwargs arrive as strings, as in the reference C API."""
     from . import ndarray as nd
+    from .ops.registry import REGISTRY
 
-    fn = getattr(nd, name, None)
-    if fn is None or name.startswith("_"):
+    op = REGISTRY.get(name)
+    if op is None or not op.imperative:
         raise ValueError("unknown NDArray function: %s" % name)
+    fn = getattr(nd, name)
     kwargs = {k: _parse_literal(v) for k, v in zip(keys, vals)}
     out = fn(*inputs, **kwargs)
-    return out if isinstance(out, (list, tuple)) else [out]
+    return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
 # -- Symbol -------------------------------------------------------------------

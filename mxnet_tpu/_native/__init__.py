@@ -33,8 +33,10 @@ def _extra_flags(name):
 
         inc = sysconfig.get_paths()["include"]
         libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
-        ver = "python%d.%d" % tuple(__import__("sys").version_info[:2])
-        return ["-I" + inc, "-L" + libdir, "-l" + ver,
+        # LDVERSION carries ABI suffixes (e.g. 3.13t, 3.12d)
+        ldver = (sysconfig.get_config_var("LDVERSION")
+                 or "%d.%d" % tuple(__import__("sys").version_info[:2]))
+        return ["-I" + inc, "-L" + libdir, "-lpython" + ldver,
                 "-Wl,-rpath," + libdir]
     return []
 

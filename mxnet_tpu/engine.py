@@ -110,13 +110,15 @@ class Engine:
                 try:
                     fn(on_complete)
                 except BaseException as e:  # surface on next wait()
-                    self._errors.append(e)
+                    with self._live_lock:
+                        self._errors.append(e)
                     on_complete()
             else:
                 try:
                     fn()
                 except BaseException as e:
-                    self._errors.append(e)
+                    with self._live_lock:
+                        self._errors.append(e)
 
         self._trampoline = _ENGINE_FN(_trampoline) if lib is not None else None
 

@@ -141,6 +141,12 @@ class Executor:
                 c = self._group2ctx.get(grp, self._ctx) if grp else self._ctx
                 self._node_device[id(n)] = c.jax_device
 
+        # gradient-checkpoint (memonger "mirror") planning: maximal runs of
+        # consecutive mirrored nodes are rematerialized in backward via
+        # jax.checkpoint (ref: static_graph.cc:404-422 force_mirroring attr,
+        # MXNET_BACKWARD_DO_MIRROR env; demo example/memcost/)
+        self._plan = self._build_mirror_plan()
+
         # jitted entry points (skip jit under multi-device eager pipeline)
         if self._multi_device:
             self._fwd_infer = functools.partial(self._run, is_train=False)
@@ -154,35 +160,168 @@ class Executor:
         self._outputs_nd = None
         self._grad_cache = None  # (arg_versions, grads)
 
+    # -- mirror (gradient checkpointing) planning ------------------------------
+    def _build_mirror_plan(self):
+        """Group consecutive mirrored nodes into remat segments.
+
+        Returns a list of plan items: ``("node", serial)`` or
+        ``("seg", serials, ext_keys, out_keys)`` where keys are
+        ``(node_id, out_idx)`` env entries. Mirroring comes from the
+        ``force_mirroring`` node attr, with MXNET_BACKWARD_DO_MIRROR as the
+        global default (ref: static_graph.cc:404-422)."""
+        import math
+
+        from .base import env_bool, env_int
+
+        mirror_all = env_bool("MXNET_BACKWARD_DO_MIRROR", False)
+        # segment length: remat in chunks so backward peak holds one
+        # chunk's activations, not the whole graph's (ref mirror_step,
+        # static_graph.cc:404-422). 0 = sqrt(run length), the classic
+        # O(sqrt(N)) memory schedule.
+        mirror_step = env_int("MXNET_BACKWARD_MIRROR_STEP", 0)
+
+        def mirrored(n):
+            if n.is_variable:
+                return False
+            a = n.attrs.get("force_mirroring")
+            if a is not None:
+                return str(a).lower() in ("true", "1")
+            return mirror_all
+
+        # multi-device eager pipeline doesn't jit; keep per-node plan
+        if self._multi_device or not any(mirrored(n) for n in self._nodes):
+            return [("node", i) for i in range(len(self._nodes))]
+
+        head_keys = {(id(self._nodes[i]), j) for i, j in self._heads}
+        consumers = {}  # key -> set of consumer serials
+        for serial, n in enumerate(self._nodes):
+            if n.is_variable:
+                continue
+            for s, i in n.inputs:
+                consumers.setdefault((id(s), i), set()).add(serial)
+
+        plan, run = [], []
+
+        def emit(chunk):
+            seg_set = set(chunk)
+            produced = []
+            for s in chunk:
+                n = self._nodes[s]
+                for i in range(len(n.op.list_outputs(n.params))):
+                    produced.append((id(n), i))
+            produced_set = set(produced)
+            ext, seen = [], set()
+            for s in chunk:
+                for src, i in self._nodes[s].inputs:
+                    k = (id(src), i)
+                    if k not in produced_set and k not in seen:
+                        seen.add(k)
+                        ext.append(k)
+            outs = [
+                k for k in produced
+                if k in head_keys or (consumers.get(k, set()) - seg_set)
+            ]
+            plan.append(("seg", tuple(chunk), tuple(ext), tuple(outs)))
+
+        def flush():
+            if not run:
+                return
+            step = mirror_step or max(1, int(math.sqrt(len(run))))
+            for lo in range(0, len(run), step):
+                emit(run[lo:lo + step])
+            run.clear()
+
+        for serial, n in enumerate(self._nodes):
+            if mirrored(n):
+                run.append(serial)
+            elif n.is_variable:
+                # variables are plain env loads — emit them ahead of the
+                # open segment instead of splitting it (weight variables
+                # interleave with ops in topo order; splitting would
+                # reduce every segment to a single op)
+                plan.append(("node", serial))
+            else:
+                flush()
+                plan.append(("node", serial))
+        flush()
+        return plan
+
+    def _apply_node(self, serial, env, aux_store, node_rng, is_train):
+        """Evaluate one node into env/aux_store. aux_store is indexed by
+        global aux position (list in the main loop, dict inside remat
+        segments). node_rng is the already-folded per-node key or None."""
+        import jax
+
+        n = self._nodes[serial]
+        ins = [env[(id(s), i)] for s, i in n.inputs]
+        if self._multi_device:
+            dev = self._node_device[id(n)]
+            ins = [jax.device_put(x, dev) for x in ins]
+        sl = self._node_aux.get(id(n))
+        aux_in = [aux_store[j] for j in range(sl[0], sl[1])] if sl else []
+        outs, n_aux = n.op.apply(n.params, ins, aux_in, is_train, node_rng)
+        for i, o in enumerate(outs):
+            env[(id(n), i)] = o
+        if sl:
+            for j, v in zip(range(sl[0], sl[1]), n_aux):
+                aux_store[j] = v
+
     # -- the traced program ----------------------------------------------------
     def _run(self, arg_vals, aux_vals, rng, is_train):
         import jax
 
         env = {}
         new_aux = list(aux_vals)
-        for serial, n in enumerate(self._nodes):
-            if n.is_variable:
-                v = arg_vals[self._var_argidx[id(n)]]
-                if self._multi_device:
-                    v = jax.device_put(v, self._node_device[id(n)])
-                env[(id(n), 0)] = v
+        for item in self._plan:
+            if item[0] == "node":
+                serial = item[1]
+                n = self._nodes[serial]
+                if n.is_variable:
+                    v = arg_vals[self._var_argidx[id(n)]]
+                    if self._multi_device:
+                        v = jax.device_put(v, self._node_device[id(n)])
+                    env[(id(n), 0)] = v
+                    continue
+                node_rng = (
+                    jax.random.fold_in(rng, serial)
+                    if (n.op.need_rng and rng is not None)
+                    else None
+                )
+                self._apply_node(serial, env, new_aux, node_rng, is_train)
                 continue
-            ins = [env[(id(s), i)] for s, i in n.inputs]
-            if self._multi_device:
-                dev = self._node_device[id(n)]
-                ins = [jax.device_put(x, dev) for x in ins]
-            aux_slice = self._node_aux.get(id(n))
-            aux_in = new_aux[aux_slice[0]:aux_slice[1]] if aux_slice else []
-            node_rng = (
-                jax.random.fold_in(rng, serial)
-                if (n.op.need_rng and rng is not None)
-                else None
-            )
-            outs, n_aux = n.op.apply(n.params, ins, aux_in, is_train, node_rng)
-            for i, o in enumerate(outs):
-                env[(id(n), i)] = o
-            if aux_slice:
-                new_aux[aux_slice[0]:aux_slice[1]] = n_aux
+
+            # remat segment: recompute these nodes' activations in backward
+            _, serials, ext_keys, out_keys = item
+            # gather the segment's aux window (contiguous per node)
+            aux_slices = [
+                self._node_aux[id(self._nodes[s])]
+                for s in serials if id(self._nodes[s]) in self._node_aux
+            ]
+            aux_ids = [j for lo, hi in aux_slices for j in range(lo, hi)]
+            rng_serials = [
+                s for s in serials
+                if self._nodes[s].op.need_rng and rng is not None
+            ]
+            rngs = [jax.random.fold_in(rng, s) for s in rng_serials]
+
+            def seg_fn(ext_vals, aux_in, rngs_in, _serials=serials,
+                       _ext_keys=ext_keys, _out_keys=out_keys,
+                       _aux_ids=aux_ids, _rng_serials=rng_serials):
+                local = dict(zip(_ext_keys, ext_vals))
+                laux = dict(zip(_aux_ids, aux_in))
+                rmap = dict(zip(_rng_serials, rngs_in))
+                for s in _serials:
+                    self._apply_node(s, local, laux, rmap.get(s), is_train)
+                return ([local[k] for k in _out_keys],
+                        [laux[j] for j in _aux_ids])
+
+            fn = jax.checkpoint(seg_fn) if is_train else seg_fn
+            ext_vals = [env[k] for k in ext_keys]
+            aux_in = [new_aux[j] for j in aux_ids]
+            outs, aux_out = fn(ext_vals, aux_in, rngs)
+            env.update(zip(out_keys, outs))
+            for j, v in zip(aux_ids, aux_out):
+                new_aux[j] = v
         outputs = [env[(id(self._nodes[i]), j)] for i, j in self._heads]
         return outputs, new_aux
 

@@ -117,3 +117,73 @@ def test_reshape_rebind():
     assert exe2.arg_dict["data"].shape == (8, 784)
     # parameters shared, not reallocated
     assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+
+
+def _mlp_grads(mirror_attr=False, mirror_env=False, monkeypatch=None):
+    """fwd+bwd grads of a small MLP, optionally with mirrored hidden layers
+    (ref: static_graph.cc:404-422 force_mirroring / MXNET_BACKWARD_DO_MIRROR)."""
+    if mirror_env:
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    data = sym.Variable("data")
+    scope = mx.AttrScope(force_mirroring="True") if mirror_attr else None
+    if scope:
+        scope.__enter__()
+    h = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    h = sym.Activation(data=h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(data=h, num_hidden=8, name="fc2")
+    h = sym.Activation(data=h, act_type="tanh", name="tanh1")
+    if scope:
+        scope.__exit__(None, None, None)
+    loss = sym.LinearRegressionOutput(
+        data=sym.FullyConnected(data=h, num_hidden=1, name="fc3"),
+        label=sym.Variable("lro_label"), name="lro")
+    rng = np.random.RandomState(3)
+    args = {n: mx.nd.array(rng.normal(0, 0.1, s).astype("f"))
+            for n, s in zip(loss.list_arguments(),
+                            loss.infer_shape(data=(4, 10), lro_label=(4, 1))[0])}
+    grads = {n: mx.nd.zeros(a.shape) for n, a in args.items()}
+    exe = loss.bind(mx.cpu(), args, args_grad=grads)
+    exe.forward(is_train=True)
+    exe.backward()
+    return {n: g.asnumpy() for n, g in grads.items()}, exe
+
+
+def test_mirror_attr_grads_match():
+    base, exe0 = _mlp_grads()
+    assert all(kind == "node" for kind, *_ in exe0._plan)
+    mirrored, exe1 = _mlp_grads(mirror_attr=True)
+    assert any(kind == "seg" for kind, *_ in exe1._plan)
+    for n in base:
+        np.testing.assert_allclose(mirrored[n], base[n], rtol=1e-5,
+                                   err_msg=n)
+
+
+def test_mirror_env_grads_match(monkeypatch):
+    base, _ = _mlp_grads()
+    mirrored, exe1 = _mlp_grads(mirror_env=True, monkeypatch=monkeypatch)
+    assert any(kind == "seg" for kind, *_ in exe1._plan)
+    for n in base:
+        np.testing.assert_allclose(mirrored[n], base[n], rtol=1e-5,
+                                   err_msg=n)
+
+
+def test_mirror_with_aux_and_dropout(monkeypatch):
+    """Mirrored segments must thread BatchNorm aux state and per-node rng."""
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    h = sym.BatchNorm(data=h, name="bn1")
+    h = sym.Dropout(data=h, p=0.5, name="dp1")
+    loss = sym.LinearRegressionOutput(
+        data=h, label=sym.Variable("lro_label"), name="lro")
+    exe = loss.simple_bind(mx.cpu(), data=(4, 6), lro_label=(4, 8),
+                           grad_req="write")
+    assert any(kind == "seg" for kind, *_ in exe._plan)
+    mm0 = exe.aux_dict["bn1_moving_mean"].asnumpy().copy()
+    rng = np.random.RandomState(0)
+    exe.arg_dict["data"][:] = rng.rand(4, 6)
+    exe.arg_dict["fc1_weight"][:] = rng.normal(0, 0.5, (8, 6))
+    exe.forward(is_train=True)
+    exe.backward()
+    # aux state still mutates through the remat segment
+    assert not np.allclose(exe.aux_dict["bn1_moving_mean"].asnumpy(), mm0)
