@@ -28,15 +28,12 @@ _DT_BYTES = {"f32": 4, "f16": 2, "bf16": 2, "f64": 8, "i32": 4, "u32": 4}
 
 
 def build(seq_len, mirror):
-    scope = mx.AttrScope(force_mirroring="True") if mirror else None
-    if scope:
-        scope.__enter__()
-    net = lstm_unroll(
-        num_lstm_layer=2, seq_len=seq_len, input_size=128,
-        num_hidden=256, num_embed=128, num_label=128)
-    if scope:
-        scope.__exit__(None, None, None)
-    return net
+    scope = (mx.AttrScope(force_mirroring="True") if mirror
+             else contextlib.nullcontext())
+    with scope:
+        return lstm_unroll(
+            num_lstm_layer=2, seq_len=seq_len, input_size=128,
+            num_hidden=256, num_embed=128, num_label=128)
 
 
 def residual_bytes(net, seq_len, batch=32):
@@ -89,6 +86,10 @@ def main():
         total, nseg = residual_bytes(net, args.seq_len)
         if base is None:
             base = total
+        if base == 0:
+            raise SystemExit(
+                "no residuals parsed — jax print_saved_residuals output "
+                "format changed; update the regex in residual_bytes()")
         print("mirror=%-5s remat_segments=%-3d saved_residual_MB=%.1f (%.0f%%)"
               % (mirror, nseg, total / 1e6, 100.0 * total / base))
 

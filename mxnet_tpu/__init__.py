@@ -13,6 +13,8 @@ __version__ = "0.1.0"
 from .base import MXNetError, MXTPUError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
 from . import engine
+from . import storage
+from . import resource
 from . import ndarray
 from . import ndarray as nd
 from . import random

@@ -37,11 +37,17 @@ def next_key():
 
 
 def seed(seed_state):
-    """Seed all random generators (ref: python/mxnet/random.py:77)."""
+    """Seed all random generators (ref: python/mxnet/random.py:77).
+    Also reseeds every live per-device random resource, matching
+    MXRandomSeed → ResourceManager::SeedRandom (src/resource.cc)."""
     import jax
 
     _state["seed"] = int(seed_state)
     _state["key"] = jax.random.PRNGKey(int(seed_state))
+    from .resource import ResourceManager
+
+    if ResourceManager._instance is not None:
+        ResourceManager._instance.seed(int(seed_state))
 
 
 def uniform(low=0.0, high=1.0, shape=None, ctx=None, out=None):

@@ -124,16 +124,15 @@ def _mlp_grads(mirror_attr=False, mirror_env=False, monkeypatch=None):
     (ref: static_graph.cc:404-422 force_mirroring / MXNET_BACKWARD_DO_MIRROR)."""
     if mirror_env:
         monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    import contextlib
     data = sym.Variable("data")
-    scope = mx.AttrScope(force_mirroring="True") if mirror_attr else None
-    if scope:
-        scope.__enter__()
-    h = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
-    h = sym.Activation(data=h, act_type="relu", name="relu1")
-    h = sym.FullyConnected(data=h, num_hidden=8, name="fc2")
-    h = sym.Activation(data=h, act_type="tanh", name="tanh1")
-    if scope:
-        scope.__exit__(None, None, None)
+    scope = (mx.AttrScope(force_mirroring="True") if mirror_attr
+             else contextlib.nullcontext())
+    with scope:
+        h = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+        h = sym.Activation(data=h, act_type="relu", name="relu1")
+        h = sym.FullyConnected(data=h, num_hidden=8, name="fc2")
+        h = sym.Activation(data=h, act_type="tanh", name="tanh1")
     loss = sym.LinearRegressionOutput(
         data=sym.FullyConnected(data=h, num_hidden=1, name="fc3"),
         label=sym.Variable("lro_label"), name="lro")

@@ -1,0 +1,85 @@
+"""Storage + ResourceManager tests (ref: tests/cpp/storage_test.cc smoke
+coverage plus the resource semantics of src/resource.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resource import ResourceManager
+from mxnet_tpu.storage import Storage
+
+
+def test_alloc_free_pool_reuse():
+    st = Storage.get()
+    base_used = st.used_bytes(mx.cpu(0))
+    h = st.alloc(1000, mx.cpu(0))
+    assert h.dptr.size >= 1000
+    assert st.used_bytes(mx.cpu(0)) > base_used
+    buf_id = id(h.dptr)
+    st.free(h)
+    assert st.used_bytes(mx.cpu(0)) == base_used
+    assert st.pooled_bytes(mx.cpu(0)) >= 1000
+    # same-size alloc reuses the pooled buffer (exact-size free list,
+    # ref pooled_storage_manager.h)
+    h2 = st.alloc(1000, mx.cpu(0))
+    assert id(h2.dptr) == buf_id
+    st.direct_free(h2)
+    with pytest.raises(MXNetError):
+        _ = h2.dptr  # use-after-free guarded
+
+
+def test_release_pool():
+    st = Storage.get()
+    h = st.alloc(4096, mx.cpu(0))
+    st.free(h)
+    assert st.pooled_bytes(mx.cpu(0)) > 0
+    st.release_pool(mx.cpu(0))
+    assert st.pooled_bytes(mx.cpu(0)) == 0
+
+
+def test_random_resource_reproducible():
+    rm = ResourceManager.get()
+    r = rm.request(mx.cpu(0), "random")
+    mx.random.seed(42)
+    a = np.asarray(r.uniform((4,)))
+    mx.random.seed(42)  # global reseed must reset the resource stream
+    b = np.asarray(r.uniform((4,)))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(r.uniform((4,)))
+    assert not np.array_equal(b, c)
+
+
+def test_random_resource_per_device_streams():
+    rm = ResourceManager.get()
+    r0 = rm.request(mx.cpu(0), "random")
+    r1 = rm.request(mx.cpu(1), "random")
+    assert r0 is not r1
+    mx.random.seed(7)
+    a = np.asarray(r0.normal((8,)))
+    b = np.asarray(r1.normal((8,)))
+    assert not np.array_equal(a, b)  # distinct per-device streams
+
+
+def test_temp_space_rotation_and_growth():
+    rm = ResourceManager.get()
+    t = rm.request(mx.cpu(0), "temp_space")
+    w1 = t.get_space((16,), "f4")
+    assert w1.shape == (16,) and w1.dtype == np.float32
+    w1[:] = 3.0  # writable scratch
+    # rotating copies: consecutive requests hand out different buffers
+    w2 = t.get_space((16,), "f4")
+    assert w2.ctypes.data != w1.ctypes.data
+    big = t.get_space((100000,), "f4")  # grows transparently
+    assert big.size == 100000
+
+
+def test_request_same_resource_is_cached():
+    rm = ResourceManager.get()
+    assert rm.request(mx.cpu(0), "random") is rm.request(mx.cpu(0), "random")
+    assert (rm.request(mx.cpu(0), "temp_space")
+            is rm.request(mx.cpu(0), "temp_space"))
+
+
+def test_unknown_request_raises():
+    with pytest.raises(MXNetError):
+        ResourceManager.get().request(mx.cpu(0), "workspace")
