@@ -88,8 +88,12 @@ class TempSpaceResource:
         self._lock = threading.Lock()
 
     def get_space(self, shape, dtype="float32"):
-        """A numpy scratch view; contents are undefined between calls —
-        the reference's temp-space contract."""
+        """A writable numpy scratch view; contents are undefined between
+        calls — the reference's temp-space contract. Always host memory:
+        custom-op kernels (the consumers of temp space here) run on the
+        host via callbacks, and jax device buffers are immutable."""
+        from .context import cpu
+
         dt = _np.dtype(dtype)
         nbytes = int(_np.prod(shape)) * dt.itemsize
         with self._lock:
@@ -99,7 +103,7 @@ class TempSpaceResource:
             if h is None or h.size < nbytes:
                 if h is not None:
                     Storage.get().free(h)
-                h = Storage.get().alloc(nbytes, self._ctx)
+                h = Storage.get().alloc(nbytes, cpu(self._ctx.device_id))
                 self._handles[i] = h
         return h.dptr[:nbytes].view(dt).reshape(shape)
 
@@ -111,9 +115,12 @@ class ResourceManager:
     _lock = threading.Lock()
 
     def __init__(self):
+        from . import random as _random
+
         self._random = {}
         self._temp = {}
-        self._seed = 0
+        # honor a global mx.random.seed() issued before the manager existed
+        self._seed = _random._state["seed"]
         self._mu = threading.Lock()
 
     @classmethod

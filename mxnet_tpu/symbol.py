@@ -314,6 +314,15 @@ class Symbol:
                 for an, s in zip(n.op.list_auxiliary_states(n.params), auxs):
                     aux_shapes_map["%s_%s" % (n.name, an)] = tuple(s)
 
+        # user-provided shapes must agree with the fixed point — silent
+        # override hides real bugs (ref: InferShape CHECK on provided args)
+        for name, s in known.items():
+            inferred = arg_shapes_map.get(name)
+            if inferred is not None and tuple(inferred) != tuple(s):
+                raise MXNetError(
+                    "infer_shape: shape mismatch for %s: provided %s but "
+                    "inferred %s" % (name, tuple(s), tuple(inferred)))
+
         arg_shapes = [arg_shapes_map.get(nm) for nm in arg_names]
         out_shapes = [shapes.get((id(nd), i)) for nd, i in self._outputs]
         aux_shapes = [aux_shapes_map.get(nm) for nm in self.list_auxiliary_states()]

@@ -1,0 +1,61 @@
+"""End-to-end convergence: LeNet conv net (modeled on reference
+tests/python/train/test_conv.py) plus multi-device data parallelism and
+bf16 (the reference's test_dtype.py role, fp16→bf16 on TPU)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _iters(batch_size=64):
+    train = mx.io.MNISTIter(batch_size=batch_size, num_synthetic=1500,
+                            seed=20)
+    val = mx.io.MNISTIter(batch_size=batch_size, num_synthetic=500,
+                          seed=21, shuffle=False)
+    return train, val
+
+
+def test_lenet_convergence():
+    mx.random.seed(0)
+    train, val = _iters()
+    model = mx.FeedForward(
+        mx.models.get_lenet(), ctx=mx.cpu(0), num_epoch=3,
+        learning_rate=0.1, momentum=0.9,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val)
+    assert model.score(val) > 0.9
+
+
+def test_lenet_multi_device_dp():
+    """Data parallelism over plural cpu ids with kvstore='device'
+    (SURVEY §4.3 — plural Contexts simulate the multi-worker setup)."""
+    mx.random.seed(0)
+    train, val = _iters()
+    model = mx.FeedForward(
+        mx.models.get_lenet(), ctx=[mx.cpu(i) for i in range(4)],
+        num_epoch=3, learning_rate=0.1, momentum=0.9,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val, kvstore="device")
+    assert model.score(val) > 0.9
+
+
+def test_lenet_bf16():
+    """The reference's fp16 cifar test (test_dtype.py) maps to bf16 on
+    TPU: cast data path to bfloat16, train, assert accuracy."""
+    mx.random.seed(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Cast(data=data, dtype="bfloat16")
+    net = mx.sym.Convolution(data=net, kernel=(5, 5), num_filter=8,
+                             name="conv1")
+    net = mx.sym.Activation(data=net, act_type="tanh")
+    net = mx.sym.Pooling(data=net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Flatten(data=net)
+    net = mx.sym.FullyConnected(data=net, num_hidden=10, name="fc")
+    net = mx.sym.Cast(data=net, dtype="float32")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    train, val = _iters()
+    model = mx.FeedForward(
+        net, ctx=mx.cpu(0), num_epoch=3, learning_rate=0.1, momentum=0.9,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val)
+    assert model.score(val) > 0.85

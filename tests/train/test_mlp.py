@@ -1,0 +1,64 @@
+"""End-to-end convergence: MLP on synthetic MNIST (modeled on reference
+tests/python/train/test_mlp.py — trains a real model and asserts a final
+accuracy threshold)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_mlp_convergence():
+    mx.random.seed(0)
+    np.random.seed(0)
+    train = mx.io.MNISTIter(batch_size=100, num_synthetic=2000, seed=10)
+    val = mx.io.MNISTIter(batch_size=100, num_synthetic=1000, seed=11,
+                          shuffle=False)
+    model = mx.FeedForward(
+        mx.models.get_mlp(), ctx=mx.cpu(0), num_epoch=4,
+        learning_rate=0.1, momentum=0.9, wd=1e-5,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val)
+    acc = model.score(val)
+    assert acc > 0.9, "mlp accuracy %.3f below threshold" % acc
+
+
+def test_mlp_adam_convergence():
+    """Optimizer coverage in a real loop (ref test_mlp uses sgd; adam is
+    the other production optimizer)."""
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(batch_size=100, num_synthetic=2000, seed=10)
+    val = mx.io.MNISTIter(batch_size=100, num_synthetic=1000, seed=11,
+                          shuffle=False)
+    model = mx.FeedForward(
+        mx.models.get_mlp(), ctx=mx.cpu(0), num_epoch=3,
+        optimizer="adam", learning_rate=2e-3,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val)
+    acc = model.score(val)
+    assert acc > 0.9, "adam mlp accuracy %.3f below threshold" % acc
+
+
+def test_checkpoint_resume_continues_training():
+    """save_checkpoint/load_checkpoint mid-training (ref: the reference's
+    resume story — FeedForward(begin_epoch=...), model.py:311-341)."""
+    import tempfile, os
+
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(batch_size=100, num_synthetic=1000, seed=10)
+    val = mx.io.MNISTIter(batch_size=100, num_synthetic=500, seed=11,
+                          shuffle=False)
+    model = mx.FeedForward(
+        mx.models.get_mlp(), ctx=mx.cpu(0), num_epoch=2,
+        learning_rate=0.1, momentum=0.9,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train)
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "mlp")
+        model.save(prefix, epoch=2)
+        resumed = mx.FeedForward.load(
+            prefix, 2, ctx=mx.cpu(0), num_epoch=4,
+            learning_rate=0.05, momentum=0.9)
+        a0 = resumed.score(val)
+        resumed.fit(X=train)
+        a1 = resumed.score(val)
+    assert a1 >= a0 - 0.02  # training continued from the checkpoint
+    assert a1 > 0.9

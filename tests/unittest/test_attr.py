@@ -1,0 +1,84 @@
+"""Symbol attribute tests (modeled on reference tests/python/unittest/
+test_attr.py): AttrScope nesting/override, attr survival through JSON,
+attr_dict, and the __lr_mult__/__wd_mult__ optimizer conventions."""
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_attr_basic():
+    data = sym.Variable("data", attr={"mood": "angry"})
+    op = sym.Convolution(
+        data=data, name="conv", kernel=(1, 1), num_filter=1,
+        attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope_nesting_and_override():
+    with mx.AttrScope(group="4", data="great"):
+        data = sym.Variable("data", attr={"dtype": "data", "group": "1"})
+        gdata = sym.Variable("data2")
+    assert gdata.attr("group") == "4"          # from scope
+    assert data.attr("group") == "1"           # explicit beats scope
+    assert data.attr("dtype") == "data"
+
+    with mx.AttrScope(x="outer"):
+        with mx.AttrScope(y="inner"):
+            v = sym.Variable("v")
+        w = sym.Variable("w")
+    assert v.attr("x") == "outer" and v.attr("y") == "inner"
+    assert w.attr("x") == "outer" and w.attr("y") is None
+
+
+def test_attr_json_roundtrip():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    fc2 = sym.FullyConnected(data=fc1, name="fc2", num_hidden=4)
+    js = fc2.tojson()
+    back = sym.load_json(js)
+    assert back.attr_dict()["fc1"]["ctx_group"] == "stage1"
+    assert back.attr_dict()["data"]["ctx_group"] == "stage1"
+    assert "ctx_group" not in back.attr_dict().get("fc2", {})
+
+
+def test_list_attr_recursive():
+    with mx.AttrScope(group="g"):
+        data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc", num_hidden=2)
+    shallow = net.list_attr(recursive=False)
+    deep = net.attr_dict()
+    assert "group" not in shallow
+    assert deep["data"]["group"] == "g"
+
+
+def test_lr_wd_mult_reach_optimizer():
+    """__lr_mult__/__wd_mult__ attrs scale per-arg updates
+    (ref: python/mxnet/optimizer.py set_lr_mult path)."""
+    import numpy as np
+
+    w_fast = sym.Variable("w_fast", lr_mult=2.0)
+    w_slow = sym.Variable("w_slow", lr_mult=0.0)
+    x = sym.Variable("x")
+    out = sym.LinearRegressionOutput(
+        data=(x * w_fast) + (x * w_slow),
+        label=sym.Variable("label"), name="lro")
+    mod = mx.module.Module(out, data_names=("x",), label_names=("label",),
+                           context=mx.cpu())
+    import mxnet_tpu.io as mio
+
+    it = mio.NDArrayIter(
+        data={"x": np.ones((8, 1), "f")},
+        label={"label": np.zeros((8, 1), "f")}, batch_size=4)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.One())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    w_fast_v = mod.get_params()[0]["w_fast"].asnumpy()
+    w_slow_v = mod.get_params()[0]["w_slow"].asnumpy()
+    assert np.allclose(w_slow_v, 1.0)       # lr_mult=0 freezes
+    assert not np.allclose(w_fast_v, 1.0)   # lr_mult=2 moves

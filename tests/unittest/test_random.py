@@ -1,0 +1,77 @@
+"""Random sampling tests (modeled on reference tests/python/unittest/
+test_random.py): seed determinism, distribution moments, and rng flowing
+through compiled graphs (Dropout)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_seed_determinism_uniform_normal():
+    mx.random.seed(128)
+    u1 = mx.random.uniform(0, 1, shape=(100,)).asnumpy()
+    n1 = mx.random.normal(0, 1, shape=(100,)).asnumpy()
+    mx.random.seed(128)
+    u2 = mx.random.uniform(0, 1, shape=(100,)).asnumpy()
+    n2 = mx.random.normal(0, 1, shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(u1, u2)
+    np.testing.assert_array_equal(n1, n2)
+    mx.random.seed(129)
+    u3 = mx.random.uniform(0, 1, shape=(100,)).asnumpy()
+    assert not np.array_equal(u1, u3)
+
+
+def test_uniform_moments_and_range():
+    """ref test_random.py check_with_device: mean/std within tolerance."""
+    mx.random.seed(0)
+    a, b = -10.0, 10.0
+    x = mx.random.uniform(a, b, shape=(50, 50)).asnumpy()
+    assert x.min() >= a and x.max() < b
+    assert abs(x.mean() - (a + b) / 2) < 0.5
+    assert abs(x.std() - (b - a) / np.sqrt(12)) < 0.5
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    mu, sigma = 10.0, 2.0
+    x = mx.random.normal(mu, sigma, shape=(50, 50)).asnumpy()
+    assert abs(x.mean() - mu) < 0.2
+    assert abs(x.std() - sigma) < 0.2
+
+
+def test_randint_bounds():
+    mx.random.seed(0)
+    x = mx.random.randint(3, 17, shape=(1000,)).asnumpy()
+    assert x.min() >= 3 and x.max() < 17
+    assert set(np.unique(x)).issubset(set(range(3, 17)))
+
+
+def test_nd_imperative_sampling_ops():
+    """_random_uniform/_random_gaussian NDArray functions
+    (ref: ndarray.cc:764-781) via the out= form."""
+    out = mx.nd.zeros((32, 32))
+    mx.random.seed(1)
+    mx.random.uniform(0, 1, out=out)
+    v1 = out.asnumpy().copy()
+    assert v1.std() > 0
+    mx.random.seed(1)
+    mx.random.uniform(0, 1, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), v1)
+
+
+def test_dropout_uses_seeded_stream():
+    """Executor rng threading: same seed → same dropout mask."""
+    data = sym.Variable("data")
+    d = sym.Dropout(data=data, p=0.5, name="dp")
+    exe = d.simple_bind(mx.cpu(), data=(64, 64), grad_req="null")
+    exe.arg_dict["data"][:] = np.ones((64, 64), "f")
+    mx.random.seed(77)
+    o1 = exe.forward(is_train=True)[0].asnumpy()
+    mx.random.seed(77)
+    o2 = exe.forward(is_train=True)[0].asnumpy()
+    o3 = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_array_equal(o1, o2)
+    assert not np.array_equal(o2, o3)
+    # mask statistics: roughly half zeroed, survivors scaled by 1/keep
+    assert abs((o1 == 0).mean() - 0.5) < 0.1
+    np.testing.assert_allclose(o1[o1 != 0], 2.0, rtol=1e-5)
