@@ -15,6 +15,8 @@ from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_de
 from . import engine
 from . import storage
 from . import resource
+from . import opencv as cv
+from . import sframe_plugin
 from . import ndarray
 from . import ndarray as nd
 from . import random

@@ -51,8 +51,7 @@ def test_backward_weight_inference():
     (ref: InferShape fixed-point over nodes, static_graph.h:262-283)."""
     data = sym.Variable("data")
     fc = sym.FullyConnected(data=data, name="fc", num_hidden=5)
-    arg_shapes, out_shapes, _ = fc.infer_shape(
-        data=(8, 0) if False else (8, 12))
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(8, 12))
     assert dict(zip(fc.list_arguments(), arg_shapes))["fc_weight"] == (5, 12)
 
 
@@ -83,8 +82,7 @@ def test_broadcast_ops_shape():
 
 def test_reshape_flatten_shapes():
     a = sym.Variable("a")
-    r = sym.Reshape(a, target_shape=(2, 6)) if False else sym.Reshape(
-        a, shape=(2, 6), name="rs")
+    r = sym.Reshape(a, shape=(2, 6), name="rs")
     _, out_shapes, _ = r.infer_shape(a=(3, 4))
     assert out_shapes == [(2, 6)]
     f = sym.Flatten(sym.Variable("b"), name="fl")

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Amalgamation: pack a trained checkpoint into one deployable artifact.
+
+TPU-native redesign of amalgamation/ (ref: amalgamation/amalgamation.py,
+mxnet_predict0.cc, jni/predictor.cc — SURVEY §2.20). The reference
+concatenates the whole C++ library into a single .cc so a predictor can
+be compiled standalone for Android/iOS/JS. Here the single-file artifact
+is not source but a *compiled program*: symbol graph + weights traced
+through the Executor, exported as portable StableHLO with weights baked
+in. The result runs with only jax installed (no mxnet_tpu, no op
+registry) on cpu or tpu — or from C++ via the PJRT C API.
+
+Pack:
+    python tools/amalgamate.py pack prefix epoch out.mxtc \\
+        --input data=1,1,28,28
+
+Run (anywhere, jax only):
+    python tools/amalgamate.py run out.mxtc --input data=@image.npy
+or programmatically:
+    from mxnet_tpu.predictor import load_compiled
+    model = load_compiled(open("out.mxtc", "rb").read())
+    out = model.forward(data=np_array)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_inputs(specs):
+    shapes = {}
+    for spec in specs:
+        name, _, dims = spec.partition("=")
+        if not dims:
+            raise SystemExit("bad --input %r; expected name=d0,d1,..." % spec)
+        shapes[name] = tuple(int(d) for d in dims.split(","))
+    return shapes
+
+
+def cmd_pack(args):
+    from mxnet_tpu.predictor import Predictor
+
+    shapes = parse_inputs(args.input)
+    pred = Predictor.from_checkpoint(
+        args.prefix, args.epoch, input_shapes=shapes)
+    blob = pred.export_compiled()
+    with open(args.out, "wb") as f:
+        f.write(blob)
+    print("packed %s-%04d.params -> %s (%d bytes, inputs %s)"
+          % (args.prefix, args.epoch, args.out, len(blob),
+             dict(shapes)))
+
+
+def load_artifact(blob):
+    """Standalone loader: envelope parse + jax.export.deserialize. Kept
+    free of any mxnet_tpu import so the deployment box needs jax only —
+    copy this function into your serving code if you don't ship the repo
+    (same format as mxnet_tpu.predictor.load_compiled)."""
+    import json
+
+    from jax import export as jexport
+
+    if blob[:4] != b"MXTC":
+        raise SystemExit("not a compiled-model artifact")
+    hlen = int.from_bytes(blob[4:8], "little")
+    header = json.loads(blob[8:8 + hlen].decode())
+    exported = jexport.deserialize(blob[8 + hlen:])
+    return header["inputs"], exported
+
+
+def cmd_run(args):
+    # deliberately avoids the framework: the artifact must be
+    # self-sufficient with jax alone
+    input_names, exported = load_artifact(open(args.artifact, "rb").read())
+    feeds = {}
+    for spec in args.input:
+        name, _, val = spec.partition("=")
+        if val.startswith("@"):
+            feeds[name] = np.load(val[1:])
+        else:
+            raise SystemExit("run inputs must be name=@file.npy")
+    missing = [n for n in input_names if n not in feeds]
+    if missing:
+        raise SystemExit("missing inputs: %s" % missing)
+    outs = exported.call(*[feeds[n] for n in input_names])
+    for i, o in enumerate(outs if isinstance(outs, (list, tuple)) else [outs]):
+        print("output[%d] shape=%s argmax=%s" % (i, o.shape,
+                                                 np.argmax(np.asarray(o), -1)))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("pack", help="checkpoint -> single-file artifact")
+    p.add_argument("prefix")
+    p.add_argument("epoch", type=int)
+    p.add_argument("out")
+    p.add_argument("--input", action="append", required=True,
+                   help="name=d0,d1,... (repeatable)")
+    p.set_defaults(fn=cmd_pack)
+    r = sub.add_parser("run", help="run an artifact (jax-only runtime)")
+    r.add_argument("artifact")
+    r.add_argument("--input", action="append", required=True,
+                   help="name=@file.npy (repeatable)")
+    r.set_defaults(fn=cmd_run)
+    args = ap.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
