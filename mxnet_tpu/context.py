@@ -67,15 +67,22 @@ class Context:
     # -- JAX device resolution -------------------------------------------------
     @property
     def jax_device(self):
-        """The concrete jax.Device this context denotes."""
+        """The concrete jax.Device this context denotes. Device ids index
+        *this process's* devices: under multi-process jax.distributed,
+        jax.devices() is the global list and other processes' devices are
+        not addressable — a Context always means local hardware (the
+        reference's device ids are per-node too)."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            devs = [d for d in jax.local_devices() if d.platform == "cpu"]
+            if not devs:
+                devs = jax.devices("cpu")
         else:  # tpu / gpu -> accelerator backend if present, else cpu fallback
             devs = _accelerator_devices()
             if not devs:
-                devs = jax.devices("cpu")
+                devs = [d for d in jax.local_devices()
+                        if d.platform == "cpu"] or jax.devices("cpu")
         if self.device_id >= len(devs):
             raise MXNetError(
                 "%s: device_id %d out of range (%d %s device(s) visible)"
@@ -94,10 +101,13 @@ class Context:
 
 
 def _accelerator_devices():
+    """Local accelerator devices: under multi-process jax.distributed,
+    jax.devices() is global and other processes' chips are not
+    addressable — Context device ids index this process's hardware."""
     import jax
 
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]
@@ -139,5 +149,6 @@ def num_devices(device_type="tpu"):
     import jax
 
     if device_type in ("cpu", "cpu_pinned"):
-        return len(jax.devices("cpu"))
+        return len([d for d in jax.local_devices() if d.platform == "cpu"]
+                   or jax.devices("cpu"))
     return len(_accelerator_devices())

@@ -49,8 +49,11 @@ def imdecode(buf, flag=IMREAD_COLOR, to_rgb=True):
             "opencv.imdecode requires PIL in this build (the data "
             "pipeline's native decoder is mxnet_tpu.io.ImageRecordIter)"
         ) from e
-    img = Image.open(_io.BytesIO(bytes(buf)))
-    img = img.convert("L" if flag == IMREAD_GRAYSCALE else "RGB")
+    try:
+        img = Image.open(_io.BytesIO(bytes(buf)))
+        img = img.convert("L" if flag == IMREAD_GRAYSCALE else "RGB")
+    except Exception as e:
+        raise MXNetError("imdecode: cannot decode image buffer: %s" % e) from e
     arr = _np.asarray(img, dtype=_np.uint8)
     if arr.ndim == 2:
         arr = arr[:, :, None]
@@ -79,7 +82,8 @@ def resize(src, size, interp=1):
     if _np.issubdtype(_np.dtype(orig_dtype), _np.integer):
         info = _np.iinfo(_np.dtype(orig_dtype))
         out = jnp.clip(jnp.round(out), info.min, info.max)
-    return NDArray(out.astype(orig_dtype))
+    return NDArray(out.astype(orig_dtype),
+                   src.context if isinstance(src, NDArray) else None)
 
 
 def copyMakeBorder(src, top, bot, left, right, border_type=BORDER_CONSTANT,
@@ -102,4 +106,4 @@ def copyMakeBorder(src, top, bot, left, right, border_type=BORDER_CONSTANT,
     else:
         raise MXNetError("copyMakeBorder: unknown border_type %r"
                          % (border_type,))
-    return NDArray(out)
+    return NDArray(out, src.context if isinstance(src, NDArray) else None)

@@ -1,0 +1,62 @@
+"""Distributed sync-kvstore arithmetic check, run as one worker of a
+multi-process job (modeled on the reference's
+tests/nightly/dist_sync_kvstore.py:30-40).
+
+Launch:
+    python tools/launch.py -n 3 --mode local -- \\
+        python tests/nightly/dist_sync_kvstore.py
+
+Each of ``nworker`` workers pushes ``ones * (rank+1)`` for ``nrepeat``
+rounds through a 'dist_sync' kvstore whose server-side optimizer is the
+Test optimizer (weight += rescale_grad * grad). The reference's exact
+acceptance arithmetic: the pulled value must equal
+
+    (nworker+1) * nworker / 2 * rate * nrepeat + 1
+
+including on a big (1200, 1200) array — the shape the reference uses to
+force the >BIGARRAY server-sharded path (kvstore_dist.h:260-300); here
+the global reduce is shape-agnostic, the check is numerical identity.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+shape = (3, 3)
+big_shape = (1200, 1200)
+keys = ["3", "99"]
+rate = 2.0
+nrepeat = 4
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    kv.init(keys[0], mx.nd.ones(shape))
+    kv.init(keys[1], mx.nd.ones(big_shape))
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=rate))
+    kv.barrier()
+
+    for _ in range(nrepeat):
+        kv.push(keys[0], mx.nd.ones(shape) * (rank + 1))
+        kv.push(keys[1], mx.nd.ones(big_shape) * (rank + 1))
+
+    kv.barrier()
+    expect = (nworker + 1) * nworker / 2 * rate * nrepeat + 1
+    for key, shp in zip(keys, (shape, big_shape)):
+        out = mx.nd.zeros(shp)
+        kv.pull(key, out=out)
+        err = np.abs(out.asnumpy() - expect).max()
+        assert err < 1e-4, (
+            "rank %d key %s: expect %s, max err %s" % (rank, key, expect, err))
+    print("rank %d/%d: dist_sync arithmetic OK (value=%s)"
+          % (rank, nworker, expect))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Multi-process dist_sync kvstore test: launches the nightly arithmetic
+check (tests/nightly/dist_sync_kvstore.py) through tools/launch.py with 3
+real processes rendezvousing over jax.distributed — the reference's
+`tools/launch.py -n 3 ... dist_sync_kvstore.py` acceptance run
+(SURVEY §4.6)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_dist_sync_kvstore_3_workers():
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        # each worker gets exactly one cpu device
+        "XLA_FLAGS": "",
+        "MXNET_COORDINATOR": "127.0.0.1:29418",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", "--launcher", "local", "--coordinator",
+         "127.0.0.1:29418", sys.executable,
+         os.path.join(REPO, "tests", "nightly", "dist_sync_kvstore.py")],
+        capture_output=True, text=True, env=env, timeout=280)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in range(3):
+        assert ("rank %d/3: dist_sync arithmetic OK" % rank) in r.stdout, \
+            r.stdout + r.stderr
