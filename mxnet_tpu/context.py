@@ -75,14 +75,9 @@ class Context:
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = [d for d in jax.local_devices() if d.platform == "cpu"]
-            if not devs:
-                devs = jax.devices("cpu")
+            devs = _local_cpu_devices()
         else:  # tpu / gpu -> accelerator backend if present, else cpu fallback
-            devs = _accelerator_devices()
-            if not devs:
-                devs = [d for d in jax.local_devices()
-                        if d.platform == "cpu"] or jax.devices("cpu")
+            devs = _accelerator_devices() or _local_cpu_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "%s: device_id %d out of range (%d %s device(s) visible)"
@@ -111,6 +106,18 @@ def _accelerator_devices():
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]
+
+
+def _local_cpu_devices():
+    """This process's cpu devices. jax.local_devices() only enumerates
+    the default backend (tpu on accelerator hosts), so ask the cpu
+    backend explicitly."""
+    import jax
+
+    try:
+        return jax.local_devices(backend="cpu")
+    except RuntimeError:
+        return jax.devices("cpu")
 
 
 def cpu(device_id=0):
@@ -149,6 +156,5 @@ def num_devices(device_type="tpu"):
     import jax
 
     if device_type in ("cpu", "cpu_pinned"):
-        return len([d for d in jax.local_devices() if d.platform == "cpu"]
-                   or jax.devices("cpu"))
+        return len(_local_cpu_devices())
     return len(_accelerator_devices())

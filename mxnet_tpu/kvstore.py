@@ -142,7 +142,6 @@ class KVStore:
         if jax.process_count() <= 1:
             return merged
         import numpy as _np
-        from jax.experimental import multihost_utils
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         if not hasattr(self, "_proc_mesh"):
@@ -152,16 +151,20 @@ class KVStore:
                 by_proc.setdefault(d.process_index, d)
             devs = [by_proc[p] for p in sorted(by_proc)]
             self._proc_mesh = Mesh(_np.array(devs), ("p",))
+            self._proc_sharding = NamedSharding(self._proc_mesh, P("p"))
+            self._local_mesh_dev = by_proc[jax.process_index()]
             self._reduce_fn = jax.jit(
                 lambda x: x.sum(axis=0),
                 out_shardings=NamedSharding(self._proc_mesh, P()))
-        local = _np.asarray(merged._data)[None, ...]
-        garr = multihost_utils.host_local_array_to_global_array(
-            local, self._proc_mesh, P("p"))
+        # zero host round trips: place the local contribution on this
+        # process's mesh device, assemble the global array shard-wise,
+        # reduce on device, wrap the replicated local shard directly
+        local = jax.device_put(merged._data[None, ...], self._local_mesh_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (jax.process_count(),) + tuple(merged._data.shape),
+            self._proc_sharding, [local])
         summed = self._reduce_fn(garr)
-        host = multihost_utils.global_array_to_host_local_array(
-            summed, self._proc_mesh, P())
-        return NDArray(_np.asarray(host), merged.context)
+        return NDArray(summed.addressable_data(0), merged.context)
 
     # -- optimizer/updater -----------------------------------------------------
     def set_optimizer(self, optimizer):

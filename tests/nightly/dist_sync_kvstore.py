@@ -3,7 +3,7 @@ multi-process job (modeled on the reference's
 tests/nightly/dist_sync_kvstore.py:30-40).
 
 Launch:
-    python tools/launch.py -n 3 --mode local -- \\
+    python tools/launch.py -n 3 --launcher local \\
         python tests/nightly/dist_sync_kvstore.py
 
 Each of ``nworker`` workers pushes ``ones * (rank+1)`` for ``nrepeat``

@@ -31,3 +31,27 @@ def test_dist_sync_kvstore_3_workers():
     for rank in range(3):
         assert ("rank %d/3: dist_sync arithmetic OK" % rank) in r.stdout, \
             r.stdout + r.stderr
+
+
+def test_dist_lenet_2_workers():
+    """Distributed training e2e (ref: tests/nightly/dist_lenet.py):
+    2 workers, rank-sharded data, sync kvstore; both must converge to
+    identical weights."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",
+        "MXNET_COORDINATOR": "127.0.0.1:29421",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--coordinator",
+         "127.0.0.1:29421", sys.executable,
+         os.path.join(REPO, "tests", "nightly", "dist_lenet.py")],
+        capture_output=True, text=True, env=env, timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in range(2):
+        assert ("rank %d/2: dist lenet OK" % rank) in r.stdout, \
+            r.stdout + r.stderr
