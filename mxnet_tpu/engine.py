@@ -19,7 +19,9 @@ NaiveEngine. Worker count: MXNET_CPU_WORKER_NTHREADS.
 """
 from __future__ import annotations
 
+import atexit
 import ctypes
+import logging
 import os
 import threading
 
@@ -82,6 +84,9 @@ class Engine:
         if num_workers is None:
             num_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "0"))
         self.engine_type = engine_type
+        # MXNET_ENGINE_INFO: log each push (ref: threaded_engine.h:253)
+        self._verbose = os.environ.get("MXNET_ENGINE_INFO", "").strip() \
+            not in ("", "0", "false")
         threaded = 0 if engine_type == "NaiveEngine" else 1
         self._lib = _engine_lib()
         self._handle = None
@@ -180,6 +185,11 @@ class Engine:
 
     def _push(self, fn, const_vars, mutable_vars, priority, is_async):
         self._check_dup(const_vars, mutable_vars)
+        if self._verbose:
+            logging.info(
+                "engine: push %s const=%d mutable=%d priority=%d async=%s",
+                getattr(fn, "__name__", "fn"), len(const_vars),
+                len(mutable_vars), priority, is_async)
         for v in list(const_vars) + list(mutable_vars):
             if self._handle is not None and not v._ptr:
                 raise MXNetError("engine variable used after delete_variable")
@@ -234,6 +244,21 @@ class Engine:
             err = self._errors[0]
             self._errors.clear()
         raise err
+
+
+@atexit.register
+def _drain_at_exit():
+    """Fence pending host tasks (async checkpoints etc.) at interpreter
+    exit; a swallowed worker-thread error must not vanish silently."""
+    e = Engine._instance
+    if e is None or e._handle is None:
+        return
+    try:
+        e._lib.EngineWaitForAll(e._handle)
+    except Exception:
+        return
+    for err in e._errors:
+        logging.error("engine: pending task failed: %r", err)
 
 
 def get():

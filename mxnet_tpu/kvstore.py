@@ -164,7 +164,11 @@ class KVStore:
             (jax.process_count(),) + tuple(merged._data.shape),
             self._proc_sharding, [local])
         summed = self._reduce_fn(garr)
-        return NDArray(summed.addressable_data(0), merged.context)
+        # bring the replicated shard back to the pushing context's device
+        # (device-to-device; the mesh device may differ from e.g. cpu(0))
+        out = jax.device_put(summed.addressable_data(0),
+                             merged.context.jax_device)
+        return NDArray(out, merged.context)
 
     # -- optimizer/updater -----------------------------------------------------
     def set_optimizer(self, optimizer):

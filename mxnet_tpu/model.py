@@ -179,6 +179,13 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
                 logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
             eval_data.reset()
 
+    # fence host tasks (async epoch checkpoints): a failed write must
+    # surface here, at the training call site, not be swallowed
+    from . import engine as _engine
+
+    if _engine.Engine._instance is not None:
+        _engine.Engine._instance.wait_for_all()
+
 
 def _multiple_callbacks(callbacks, *args, **kwargs):
     if isinstance(callbacks, list):
@@ -189,19 +196,50 @@ def _multiple_callbacks(callbacks, *args, **kwargs):
         callbacks(*args, **kwargs)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """ref: python/mxnet/model.py:311."""
+_ckpt_vars = {}  # prefix -> engine write-var serializing its checkpoints
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    sync=False):
+    """ref: python/mxnet/model.py:311.
+
+    Async by default: the file write is pushed to the dependency engine
+    with a per-prefix write variable (successive checkpoints of one
+    prefix serialize; different prefixes overlap) so the training loop
+    keeps stepping while the params hit disk — the TPU-era async
+    checkpoint pattern, fenced by ``nd.waitall()``. ``sync=True`` (or a
+    NaiveEngine / non-native build) writes inline."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    # snapshot device buffers now: later mutations must not leak into
+    # the checkpoint being written
+    save_dict = {("arg:%s" % k): v.asnumpy() for k, v in arg_params.items()}
+    save_dict.update(
+        {("aux:%s" % k): v.asnumpy() for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd_save(param_name, save_dict)
-    logging.info('Saved checkpoint to "%s"', param_name)
+
+    def _write():
+        nd_save(param_name, save_dict)
+        logging.info('Saved checkpoint to "%s"', param_name)
+
+    from . import engine as _engine
+
+    eng = _engine.Engine.get()
+    if sync or not eng.is_native:
+        _write()
+        return
+    if prefix not in _ckpt_vars:
+        _ckpt_vars[prefix] = eng.new_variable()
+    eng.push(_write, mutable_vars=[_ckpt_vars[prefix]])
 
 
 def load_checkpoint(prefix, epoch):
-    """ref: python/mxnet/model.py:341."""
+    """ref: python/mxnet/model.py:341. Fences any in-flight async
+    checkpoint of this prefix before reading."""
+    if prefix in _ckpt_vars:
+        from . import engine as _engine
+
+        _engine.Engine.get().wait_for_var(_ckpt_vars[prefix])
     symbol = sym_load("%s-symbol.json" % prefix)
     save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}
@@ -520,7 +558,10 @@ class FeedForward(BASE_ESTIMATOR):
         if epoch is None:
             epoch = self.num_epoch
         assert epoch is not None
-        save_checkpoint(prefix, epoch, self.symbol, self.arg_params, self.aux_params)
+        # explicit save → durable on return (async path is the epoch-end
+        # do_checkpoint callback)
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
+                        self.aux_params, sync=True)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

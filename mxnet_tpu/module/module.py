@@ -81,7 +81,7 @@ class Module(BaseModule):
 
         self._sync_params_from_devices()
         _save_ckpt(prefix, epoch, self.symbol, *self.get_params()[:1],
-                   self.get_params()[1])
+                   self.get_params()[1], sync=True)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)

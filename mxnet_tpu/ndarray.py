@@ -520,7 +520,8 @@ _ND_MAGIC = 0x112  # same magic family as the reference's NDARRAY_MAGIC
 
 
 def _write_tensor(f, arr):
-    npa = arr.asnumpy()
+    # accepts NDArray or a host numpy snapshot (async checkpoint path)
+    npa = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
     code = _DTYPE_NP_TO_MX[_np.dtype(npa.dtype)]
     f.write(struct.pack("<I", npa.ndim))
     for d in npa.shape:

@@ -140,3 +140,28 @@ def test_speedometer_and_metrics():
     m2.update([label], [pred])
     names, vals = m2.get()
     assert len(names) == 2
+
+
+def test_async_checkpoint_fenced_by_load_and_waitall(tmp_path):
+    """do_checkpoint-style saves run through the dependency engine; a
+    later load_checkpoint (or nd.waitall) must observe the completed
+    file (async checkpointing with write-var serialization)."""
+    import os
+
+    from mxnet_tpu.model import load_checkpoint, save_checkpoint
+
+    net = mx.models.get_mlp()
+    shapes, _, _ = net.infer_shape(data=(2, 784), softmax_label=(2,))
+    rng = np.random.RandomState(0)
+    args = {n: mx.nd.array(rng.rand(*s).astype("f"))
+            for n, s in zip(net.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "async")
+    for epoch in (1, 2, 3):  # successive saves serialize on one var
+        save_checkpoint(prefix, epoch, net, args, {})
+    sym2, args2, _ = load_checkpoint(prefix, 3)  # fences pending writes
+    assert os.path.exists(prefix + "-0003.params")
+    np.testing.assert_array_equal(
+        args2["fc1_weight"].asnumpy(), args["fc1_weight"].asnumpy())
+    mx.nd.waitall()
+    assert mx.engine.get().pending_count() == 0
