@@ -1,0 +1,116 @@
+"""accnn low-rank factorization tests (ref: tools/accnn/ — full-rank
+decomposition must reproduce the original network's outputs; reduced rank
+must shrink parameters)."""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+
+import accnn  # noqa: E402
+
+
+def _lenet_with_params(seed=0):
+    net = mx.models.get_lenet()
+    shapes, _, _ = net.infer_shape(data=(2, 1, 28, 28), softmax_label=(2,))
+    rng = np.random.RandomState(seed)
+    args = {}
+    for n, s in zip(net.list_arguments(), shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        args[n] = mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+    return net, args
+
+
+def _forward(sym, args, x):
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=x.shape,
+                          softmax_label=(x.shape[0],))
+    for k, v in args.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    exe.arg_dict["data"][:] = x
+    return exe.forward(is_train=False)[0].asnumpy()
+
+
+def test_full_rank_conv_decompose_is_exact():
+    net, args = _lenet_with_params()
+    x = np.random.RandomState(1).rand(2, 1, 28, 28).astype(np.float32)
+    base = _forward(net, dict(args), x)
+    # conv1: kernel 5x5, 8 filters, 1 channel -> full rank = min(C*ky, N*kx)
+    new_sym, new_args = accnn.accelerate(
+        net, dict(args), layers=["conv1"], rank=10**9)
+    assert "conv1_v_weight" in new_args and "conv1_weight" not in new_args
+    out = _forward(new_sym, new_args, x)
+    np.testing.assert_allclose(out, base, rtol=1e-4, atol=1e-5)
+
+
+def test_full_rank_fc_decompose_is_exact():
+    net, args = _lenet_with_params()
+    x = np.random.RandomState(1).rand(2, 1, 28, 28).astype(np.float32)
+    base = _forward(net, dict(args), x)
+    new_sym, new_args = accnn.accelerate(
+        net, dict(args), layers=["fc1"], rank=10**9)
+    assert "fc1_red_weight" in new_args
+    out = _forward(new_sym, new_args, x)
+    np.testing.assert_allclose(out, base, rtol=1e-4, atol=1e-5)
+
+
+def test_whole_net_ratio_shrinks_params():
+    net, args = _lenet_with_params()
+    orig = sum(int(np.prod(a.shape)) for a in args.values())
+    new_sym, new_args = accnn.accelerate(net, dict(args), ratio=3.0)
+    new = sum(int(np.prod(a.shape)) for a in new_args.values())
+    assert new < orig, (new, orig)
+    # network still runs and keeps output shape
+    x = np.random.RandomState(1).rand(2, 1, 28, 28).astype(np.float32)
+    out = _forward(new_sym, new_args, x)
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_low_rank_reconstruction_error_decreases_with_rank():
+    """SVD truncation: the factorized kernel V*H reconstructs the
+    original with Frobenius error decreasing in rank, →0 at full rank."""
+    net, args = _lenet_with_params()
+    W = args["conv2_weight"].asnumpy()  # (N, C, ky, kx)
+    errs = []
+    # conv2 weight (50, 20, 5, 5): full rank = min(C*ky, N*kx) = 100
+    for r in (5, 40, 10**9):
+        _, new_args = accnn.accelerate(
+            net, dict(args), layers=["conv2"], rank=r)
+        V = new_args["conv2_v_weight"].asnumpy()  # (R, C, ky, 1)
+        H = new_args["conv2_h_weight"].asnumpy()  # (N, R, 1, kx)
+        W_approx = np.einsum("rcyq,nrqx->ncyx", V, H)
+        errs.append(float(np.linalg.norm(W_approx - W)))
+    assert errs[2] < errs[1] < errs[0], errs
+    assert errs[2] < 1e-4 * np.linalg.norm(W)
+
+
+def test_no_bias_conv_decompose():
+    """Conv(no_bias=True) (conv+BN style) decomposes without a bias param
+    and stays numerically exact at full rank."""
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                           no_bias=True, name="cnb")
+    net = mx.sym.Flatten(c, name="fl")
+    rng = np.random.RandomState(2)
+    args = {"cnb_weight": mx.nd.array(
+        rng.normal(0, 0.3, (4, 3, 3, 3)).astype(np.float32))}
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    exe = net.bind(mx.cpu(), dict(args, data=mx.nd.array(x)), grad_req="null")
+    base = exe.forward()[0].asnumpy()
+    new_sym, new_args = accnn.accelerate(net, dict(args), rank=10**9)
+    assert "cnb_v_weight" in new_args
+    exe2 = new_sym.bind(mx.cpu(), dict(new_args, data=mx.nd.array(x)),
+                        grad_req="null")
+    np.testing.assert_allclose(exe2.forward()[0].asnumpy(), base,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dilated_conv_rejected():
+    node = {"op": "Convolution", "name": "d",
+            "param": {"kernel": "(3, 3)", "dilate": "(2, 2)"}}
+    assert not accnn.eligible(node, {"d_weight": None})

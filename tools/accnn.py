@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""accnn: accelerate a trained CNN by low-rank factorization.
+
+TPU-native rebuild of tools/accnn/ (ref: acc_conv.py conv_vh_decomposition,
+acc_fc.py fc_decomposition, accnn.py whole-net driver, rank_selection.py).
+A KxK Convolution becomes a (K,1) "vertical" conv with R filters followed
+by a (1,K) "horizontal" conv (SVD of the unfolded kernel); a
+FullyConnected becomes two FCs through rank R (truncated SVD). On TPU the
+factorized layers are narrower matmuls on the MXU — same accuracy/speed
+trade the reference tool targets.
+
+Usage:
+  # whole network, target ~2x FLOP reduction in eligible layers
+  python tools/accnn.py -m prefix --epoch 1 --save-model new-prefix --ratio 2
+
+  # single layer with an explicit rank
+  python tools/accnn.py -m prefix --epoch 1 --save-model new-prefix \\
+      --layer conv1 --rank 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pair(s):
+    import ast
+
+    v = ast.literal_eval(s) if isinstance(s, str) else s  # "(3, 3)" or "3"
+    if isinstance(v, int):
+        v = (v, v)
+    return tuple(int(x) for x in v)
+
+
+def _graph_replace(graph, name, build):
+    """Replace node `name` (and its private weight/bias vars) with the
+    node list produced by ``build(data_ref, base_index)``; reindex all
+    later references (the utils.replace_conv_layer role)."""
+    nodes = graph["nodes"]
+    idx = next(i for i, n in enumerate(nodes) if n["name"] == name)
+    old = nodes[idx]
+    data_ref = old["inputs"][0]
+    drop = {idx}
+    for ref in old["inputs"][1:]:  # private weight/bias variable nodes
+        if nodes[ref[0]]["op"] == "null":
+            drop.add(ref[0])
+
+    keep = [i for i in range(len(nodes)) if i not in drop]
+    remap = {}
+    new_nodes = []
+    out_ref = None
+    for i in keep:
+        if i > idx and out_ref is None:
+            # splice replacement nodes where the old node stood
+            built, out_local = build(
+                [remap[data_ref[0]], data_ref[1]], len(new_nodes))
+            new_nodes.extend(built)
+            out_ref = [len(new_nodes) - len(built) + out_local, 0]
+        remap[i] = len(new_nodes)
+        n = dict(nodes[i])
+        n["inputs"] = [
+            (out_ref if ref[0] == idx else [remap[ref[0]], ref[1]])
+            for ref in n["inputs"]
+        ]
+        new_nodes.append(n)
+    if out_ref is None:  # replaced node was last
+        built, out_local = build(
+            [remap[data_ref[0]], data_ref[1]], len(new_nodes))
+        new_nodes.extend(built)
+        out_ref = [len(new_nodes) - len(built) + out_local, 0]
+
+    graph["nodes"] = new_nodes
+    graph["arg_nodes"] = [
+        i for i, n in enumerate(new_nodes) if n["op"] == "null"]
+    graph["heads"] = [
+        (out_ref if h[0] == idx else [remap[h[0]], h[1]])
+        for h in graph["heads"]
+    ]
+    return graph
+
+
+def _var(name):
+    return {"op": "null", "name": name, "param": {}, "inputs": [], "attr": {}}
+
+
+def conv_vh_decompose(graph, arg_params, layer, rank):
+    """SVD split of one Convolution (ref: acc_conv.py:7-39)."""
+    W = np.asarray(arg_params[layer + "_weight"].asnumpy())
+    n_f, c, ky, kx = W.shape
+    node = next(n for n in graph["nodes"] if n["name"] == layer)
+    no_bias = str(node["param"].get("no_bias", "False")) == "True"
+    b = (np.zeros((n_f,), np.float32) if no_bias
+         else np.asarray(arg_params[layer + "_bias"].asnumpy()))
+    pad = _pair(node["param"].get("pad", "(0, 0)"))
+    stride = _pair(node["param"].get("stride", "(1, 1)"))
+    attr = dict(node.get("attr", {}))
+
+    M = W.transpose((1, 2, 0, 3)).reshape((c * ky, n_f * kx))
+    U, D, Q = np.linalg.svd(M, full_matrices=False)
+    rank = min(rank, len(D))
+    sq = np.sqrt(D[:rank])
+    V = (U[:, :rank] * sq).T.reshape(rank, c, ky, 1)
+    H = (Q.T[:, :rank] * sq).reshape(n_f, kx, 1, rank).transpose((0, 3, 2, 1))
+
+    def build(data_ref, base):
+        return [
+            _var(layer + "_v_weight"),
+            _var(layer + "_v_bias"),
+            {"op": "Convolution", "name": layer + "_v",
+             "param": {"kernel": str((ky, 1)), "pad": str((pad[0], 0)),
+                       "stride": str((stride[0], 1)),
+                       "num_filter": str(rank)},
+             "inputs": [data_ref, [base, 0], [base + 1, 0]],
+             "attr": dict(attr)},
+            _var(layer + "_h_weight"),
+            _var(layer + "_h_bias"),
+            {"op": "Convolution", "name": layer + "_h",
+             "param": {"kernel": str((1, kx)), "pad": str((0, pad[1])),
+                       "stride": str((1, stride[1])),
+                       "num_filter": str(n_f)},
+             "inputs": [[base + 2, 0], [base + 3, 0], [base + 4, 0]],
+             "attr": dict(attr)},
+        ], 5
+
+    _graph_replace(graph, layer, build)
+    del arg_params[layer + "_weight"]
+    if not no_bias:
+        del arg_params[layer + "_bias"]
+    import mxnet_tpu as mx
+
+    arg_params[layer + "_v_weight"] = mx.nd.array(V.astype(np.float32))
+    arg_params[layer + "_v_bias"] = mx.nd.zeros((rank,))
+    arg_params[layer + "_h_weight"] = mx.nd.array(H.astype(np.float32))
+    arg_params[layer + "_h_bias"] = mx.nd.array(b)
+    return graph
+
+
+def fc_decompose(graph, arg_params, layer, rank):
+    """Truncated-SVD split of one FullyConnected (ref: acc_fc.py:8-28)."""
+    W = np.asarray(arg_params[layer + "_weight"].asnumpy())
+    b = np.asarray(arg_params[layer + "_bias"].asnumpy())
+    n_h = W.shape[0]
+    Wm = W.reshape(n_h, -1)
+    U, D, V = np.linalg.svd(Wm, full_matrices=False)
+    rank = min(rank, len(D))
+    P = U[:, :rank]                      # (N, R)
+    Q = (np.diag(D[:rank]) @ V[:rank])   # (R, M)
+
+    node = next(n for n in graph["nodes"] if n["name"] == layer)
+    attr = dict(node.get("attr", {}))
+
+    def build(data_ref, base):
+        return [
+            _var(layer + "_red_weight"),
+            {"op": "FullyConnected", "name": layer + "_red",
+             "param": {"num_hidden": str(rank), "no_bias": "True"},
+             "inputs": [data_ref, [base, 0]], "attr": dict(attr)},
+            _var(layer + "_rec_weight"),
+            _var(layer + "_rec_bias"),
+            {"op": "FullyConnected", "name": layer + "_rec",
+             "param": {"num_hidden": str(n_h), "no_bias": "False"},
+             "inputs": [[base + 1, 0], [base + 2, 0], [base + 3, 0]],
+             "attr": dict(attr)},
+        ], 4
+
+    _graph_replace(graph, layer, build)
+    del arg_params[layer + "_weight"], arg_params[layer + "_bias"]
+    import mxnet_tpu as mx
+
+    arg_params[layer + "_red_weight"] = mx.nd.array(Q.astype(np.float32))
+    arg_params[layer + "_rec_weight"] = mx.nd.array(P.astype(np.float32))
+    arg_params[layer + "_rec_bias"] = mx.nd.array(b)
+    return graph
+
+
+def select_rank(node, arg_params, ratio):
+    """Per-layer rank for a target FLOP reduction (the rank_selection.py
+    role, greedy per-layer instead of global DP)."""
+    name = node["name"]
+    W = arg_params[name + "_weight"]
+    if node["op"] == "Convolution":
+        n_f, c, ky, kx = W.shape
+        full = n_f * c * ky * kx
+        per_rank = c * ky + n_f * kx
+    else:
+        n_h, m = W.shape[0], int(np.prod(W.shape[1:]))
+        full = n_h * m
+        per_rank = n_h + m
+    return max(1, int(full / (ratio * per_rank)))
+
+
+def eligible(node, arg_params):
+    if node["op"] == "Convolution":
+        if node["param"].get("num_group", "1") not in ("1", 1):
+            return False
+        if _pair(node["param"].get("dilate", "(1, 1)")) != (1, 1):
+            return False  # the (k,1)/(1,k) split does not model dilation
+        k = _pair(node["param"]["kernel"])
+        return k[0] > 1 and k[1] > 1 and (node["name"] + "_weight") in arg_params
+    if node["op"] == "FullyConnected":
+        return (node["param"].get("no_bias", "False") in ("False", False)
+                and (node["name"] + "_weight") in arg_params)
+    return False
+
+
+def accelerate(symbol, arg_params, ratio=2.0, layers=None, rank=None):
+    """Whole-network driver (ref: accnn.py). Returns (new_symbol,
+    new_arg_params); arg_params dict is modified in place."""
+    import mxnet_tpu as mx
+
+    graph = json.loads(symbol.tojson())
+    targets = []
+    for node in graph["nodes"]:
+        if layers is not None and node["name"] not in layers:
+            continue
+        if eligible(node, arg_params):
+            targets.append(dict(node))
+    for node in targets:
+        r = rank if rank is not None else select_rank(node, arg_params, ratio)
+        if node["op"] == "Convolution":
+            conv_vh_decompose(graph, arg_params, node["name"], r)
+        else:
+            fc_decompose(graph, arg_params, node["name"], r)
+    return mx.symbol.load_json(json.dumps(graph)), arg_params
+
+
+def main():
+    import mxnet_tpu as mx
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-m", "--model", required=True, help="checkpoint prefix")
+    ap.add_argument("--epoch", type=int, default=1)
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--ratio", type=float, default=2.0)
+    ap.add_argument("--layer", help="only this layer")
+    ap.add_argument("--rank", type=int, help="explicit rank (with --layer)")
+    args = ap.parse_args()
+
+    from mxnet_tpu.model import load_checkpoint, save_checkpoint
+
+    symbol, arg_params, aux_params = load_checkpoint(args.model, args.epoch)
+    new_sym, new_args = accelerate(
+        symbol, arg_params, ratio=args.ratio,
+        layers=[args.layer] if args.layer else None, rank=args.rank)
+    save_checkpoint(args.save_model, args.epoch, new_sym, new_args,
+                    aux_params, sync=True)
+    print("saved accelerated model to %s-symbol.json / %s-%04d.params"
+          % (args.save_model, args.save_model, args.epoch))
+
+
+if __name__ == "__main__":
+    main()
