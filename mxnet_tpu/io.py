@@ -281,7 +281,10 @@ class MNISTIter(NDArrayIter):
             images = images.reshape(images.shape[0], 1, 28, 28)
         super().__init__(
             images, labels, batch_size=batch_size, shuffle=shuffle,
-            last_batch_handle="discard", num_parts=num_parts, part_index=part_index,
+            last_batch_handle="discard", num_parts=num_parts,
+            part_index=part_index,
+            data_name=kwargs.pop("data_name", "data"),
+            label_name=kwargs.pop("label_name", "softmax_label"),
         )
 
 

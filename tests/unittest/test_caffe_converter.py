@@ -1,0 +1,126 @@
+"""caffe prototxt → Symbol converter tests (ref:
+tools/caffe_converter/convert_symbol.py — here with a self-contained
+text-format parser, validated on a classic LeNet deploy prototxt)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+
+import caffe_converter  # noqa: E402
+
+LENET_PROTOTXT = """
+name: "LeNet"
+input: "data"
+input_dim: 1
+input_dim: 1
+input_dim: 28
+input_dim: 28
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "conv2"
+  type: "Convolution"
+  bottom: "pool1"
+  top: "conv2"
+  convolution_param { num_output: 50 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool2"
+  type: "Pooling"
+  bottom: "conv2"
+  top: "pool2"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool2"
+  top: "ip1"
+  inner_product_param { num_output: 500 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer {
+  name: "ip2"
+  type: "InnerProduct"
+  bottom: "ip1"
+  top: "ip2"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+
+def test_parse_prototxt_structure():
+    net = caffe_converter.parse_prototxt(LENET_PROTOTXT)
+    assert net["name"] == "LeNet"
+    assert net["input"] == "data"
+    assert net["input_dim"] == [1, 1, 28, 28]
+    assert len(net["layer"]) == 8
+    assert net["layer"][0]["convolution_param"]["num_output"] == 20
+
+
+def test_convert_lenet_symbol():
+    sym, input_name, input_dim = caffe_converter.convert_symbol(
+        LENET_PROTOTXT)
+    assert input_name == "data"
+    assert input_dim == (1, 1, 28, 28)
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "ip2_bias" in args
+    arg_shapes, out_shapes, _ = sym.infer_shape(
+        data=(2, 1, 28, 28), prob_label=(2,))
+    assert out_shapes == [(2, 10)]
+    d = dict(zip(args, arg_shapes))
+    assert d["conv1_weight"] == (20, 1, 5, 5)
+    assert d["ip1_weight"] == (500, 800)  # 50*4*4 after two pools
+
+
+def test_converted_net_runs():
+    sym, _, _ = caffe_converter.convert_symbol(LENET_PROTOTXT)
+    exe = sym.simple_bind(mx.cpu(), data=(2, 1, 28, 28), prob_label=(2,),
+                          grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, a in exe.arg_dict.items():
+        if k != "prob_label":
+            a[:] = rng.normal(0, 0.1, a.shape)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_relu_in_place_top():
+    """Caffe in-place layers (top == bottom) must chain correctly: the
+    ReLU output replaces ip1 for downstream consumers."""
+    sym, _, _ = caffe_converter.convert_symbol(LENET_PROTOTXT)
+    import json
+
+    ops = [n["op"] for n in json.loads(sym.tojson())["nodes"]]
+    assert "Activation" in ops
+
+
+def test_unsupported_layer_raises():
+    bad = 'layer { name: "x" type: "SPP" bottom: "data" top: "x" }'
+    with pytest.raises(NotImplementedError):
+        caffe_converter.convert_symbol('input: "data"\n' + bad)
+
+
+def test_convert_model_gated():
+    with pytest.raises(MXNetError):
+        caffe_converter.convert_model("a.prototxt", "b.caffemodel", "out")

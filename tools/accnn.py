@@ -109,14 +109,15 @@ def conv_vh_decompose(graph, arg_params, layer, rank):
     H = (Q.T[:, :rank] * sq).reshape(n_f, kx, 1, rank).transpose((0, 3, 2, 1))
 
     def build(data_ref, base):
+        # vertical conv carries no bias: the horizontal conv's bias (the
+        # original layer's) is the only affine term needed
         return [
             _var(layer + "_v_weight"),
-            _var(layer + "_v_bias"),
             {"op": "Convolution", "name": layer + "_v",
              "param": {"kernel": str((ky, 1)), "pad": str((pad[0], 0)),
                        "stride": str((stride[0], 1)),
-                       "num_filter": str(rank)},
-             "inputs": [data_ref, [base, 0], [base + 1, 0]],
+                       "num_filter": str(rank), "no_bias": "True"},
+             "inputs": [data_ref, [base, 0]],
              "attr": dict(attr)},
             _var(layer + "_h_weight"),
             _var(layer + "_h_bias"),
@@ -124,9 +125,9 @@ def conv_vh_decompose(graph, arg_params, layer, rank):
              "param": {"kernel": str((1, kx)), "pad": str((0, pad[1])),
                        "stride": str((1, stride[1])),
                        "num_filter": str(n_f)},
-             "inputs": [[base + 2, 0], [base + 3, 0], [base + 4, 0]],
+             "inputs": [[base + 1, 0], [base + 2, 0], [base + 3, 0]],
              "attr": dict(attr)},
-        ], 5
+        ], 4
 
     _graph_replace(graph, layer, build)
     del arg_params[layer + "_weight"]
@@ -135,7 +136,6 @@ def conv_vh_decompose(graph, arg_params, layer, rank):
     import mxnet_tpu as mx
 
     arg_params[layer + "_v_weight"] = mx.nd.array(V.astype(np.float32))
-    arg_params[layer + "_v_bias"] = mx.nd.zeros((rank,))
     arg_params[layer + "_h_weight"] = mx.nd.array(H.astype(np.float32))
     arg_params[layer + "_h_bias"] = mx.nd.array(b)
     return graph
@@ -221,6 +221,12 @@ def accelerate(symbol, arg_params, ratio=2.0, layers=None, rank=None):
             continue
         if eligible(node, arg_params):
             targets.append(dict(node))
+    if not targets:
+        raise ValueError(
+            "no eligible layers matched %s — nothing to accelerate "
+            "(eligible: non-grouped non-dilated KxK Convolution or "
+            "FullyConnected with bias)"
+            % ("(any)" if layers is None else layers))
     for node in targets:
         r = rank if rank is not None else select_rank(node, arg_params, ratio)
         if node["op"] == "Convolution":
@@ -241,6 +247,9 @@ def main():
     ap.add_argument("--layer", help="only this layer")
     ap.add_argument("--rank", type=int, help="explicit rank (with --layer)")
     args = ap.parse_args()
+    if args.rank is not None and not args.layer:
+        ap.error("--rank requires --layer; use --ratio for whole-network "
+                 "rank selection")
 
     from mxnet_tpu.model import load_checkpoint, save_checkpoint
 

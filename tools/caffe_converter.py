@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Convert a Caffe network definition (.prototxt) into a Symbol.
+
+TPU-native rebuild of tools/caffe_converter/convert_symbol.py. The
+reference parses prototxt through caffe's generated protobuf classes
+(with a bundled caffe_pb2 fallback); here a small self-contained
+text-format parser reads the prototxt directly — no caffe, no protobuf
+schema. Weight conversion (.caffemodel, binary protobuf) still needs
+pycaffe, as in the reference's convert_model.py, and is gated like the
+caffe plugin.
+
+Supported layers: Input/Data, Convolution, Pooling (MAX/AVE),
+InnerProduct, ReLU, TanH, Sigmoid, Dropout, LRN, Concat, Eltwise(SUM),
+Flatten, Softmax / SoftmaxWithLoss, Accuracy (skipped).
+
+Usage:
+    python tools/caffe_converter.py deploy.prototxt out-prefix
+    # writes out-prefix-symbol.json
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- minimal protobuf text-format parser --------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)
+""", re.VERBOSE)
+
+
+def _tokenize(text):
+    text = re.sub(r"#[^\n]*", "", text)  # comments
+    pos = 0
+    while pos < len(text):
+        if text[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError("prototxt parse error at %r" % text[pos:pos + 30])
+        pos = m.end()
+        yield m
+
+
+def _parse_block(tokens):
+    """Parse `key: value` / `key { ... }` pairs until '}' or EOF into a
+    dict; repeated keys accumulate into lists."""
+    out = {}
+
+    def add(key, val):
+        if key in out:
+            if not isinstance(out[key], list):
+                out[key] = [out[key]]
+            out[key].append(val)
+        else:
+            out[key] = val
+
+    for m in tokens:
+        if m.group("brace") == "}":
+            return out
+        key = m.group("name")
+        if key is None:
+            raise ValueError("expected field name, got %r" % m.group(0))
+        nxt = next(tokens)
+        if nxt.group("brace") == "{":
+            add(key, _parse_block(tokens))
+        elif nxt.group("string") is not None:
+            add(key, nxt.group("string")[1:-1])
+        elif nxt.group("number") is not None:
+            n = nxt.group("number")
+            add(key, float(n) if ("." in n or "e" in n.lower()) else int(n))
+        elif nxt.group("name") is not None:  # enum / bool literal
+            v = nxt.group("name")
+            add(key, {"true": True, "false": False}.get(v, v))
+        else:
+            raise ValueError("unexpected token %r after %s" % (nxt.group(0), key))
+    return out
+
+
+def parse_prototxt(text):
+    return _parse_block(_tokenize(text))
+
+
+# -- layer mapping ------------------------------------------------------------
+
+def _aslist(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _first(v, default):
+    lst = _aslist(v)
+    return lst[0] if lst else default
+
+
+def _hw(p, field, default=None, required=False):
+    """Resolve caffe's square (`kernel_size`) or per-axis
+    (`kernel_h`/`kernel_w`) spatial params to an (h, w) tuple."""
+    square = "%s_size" % field if field == "kernel" else field
+    if p.get(square) is not None:
+        k = int(_first(p[square], default))
+        return (k, k)
+    h, w = p.get(field + "_h"), p.get(field + "_w")
+    if h is not None or w is not None:
+        if h is None or w is None:
+            raise ValueError("%s_h/%s_w must be given together" % (field, field))
+        return (int(h), int(w))
+    if required:
+        raise ValueError("missing %s in %r" % (square, sorted(p)))
+    return (int(default), int(default))
+
+
+def convert_symbol(prototxt_text):
+    """Returns (symbol, input_name, input_dim or None)
+    (ref: convert_symbol.py proto2symbol)."""
+    import mxnet_tpu as mx
+
+    net = parse_prototxt(prototxt_text)
+    layers = _aslist(net.get("layer")) or _aslist(net.get("layers"))
+    outputs = {}  # caffe top name -> symbol
+    input_name, input_dim = None, None
+
+    if "input" in net:
+        input_name = _first(net["input"], "data")
+        dims = net.get("input_dim")
+        if dims is None and "input_shape" in net:
+            dims = _first(net["input_shape"], {}).get("dim")
+        input_dim = tuple(_aslist(dims)) if dims else None
+        outputs[input_name] = mx.sym.Variable(input_name)
+
+    for layer in layers:
+        ltype = str(layer.get("type", ""))
+        name = str(layer.get("name", ltype)).replace("/", "_")
+        bottoms = [outputs[b] for b in _aslist(layer.get("bottom"))
+                   if b in outputs]
+        tops = _aslist(layer.get("top")) or [name]
+        data = bottoms[0] if bottoms else None
+
+        if ltype in ("Input", "Data", "MemoryData", "HDF5Data"):
+            input_name = tops[0]
+            shape = layer.get("input_param", {}).get("shape")
+            if shape:
+                input_dim = tuple(_aslist(_first(_aslist(shape), {}).get("dim")))
+            sym = mx.sym.Variable(input_name)
+        elif ltype == "Convolution":
+            p = layer.get("convolution_param", {})
+            kernel = _hw(p, "kernel", required=True)
+            sym = mx.sym.Convolution(
+                data=data, name=name, num_filter=int(p["num_output"]),
+                kernel=kernel,
+                stride=_hw(p, "stride", default=1),
+                pad=_hw(p, "pad", default=0),
+                no_bias=not p.get("bias_term", True),
+                num_group=int(p.get("group", 1)))
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            global_pool = bool(p.get("global_pooling", False))
+            sym = mx.sym.Pooling(
+                data=data, name=name,
+                pool_type={"MAX": "max", "AVE": "avg", 0: "max",
+                           1: "avg"}.get(p.get("pool", "MAX"), "max"),
+                kernel=(_hw(p, "kernel", default=1)
+                        if not global_pool else (1, 1)),
+                stride=_hw(p, "stride", default=1),
+                pad=_hw(p, "pad", default=0),
+                # caffe sizes pooled maps with ceil(): 'full' convention
+                pooling_convention="full",
+                global_pool=global_pool)
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            sym = mx.sym.FullyConnected(
+                data=mx.sym.Flatten(data), name=name,
+                num_hidden=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "ReLU":
+            sym = mx.sym.Activation(data=data, act_type="relu", name=name)
+        elif ltype == "TanH":
+            sym = mx.sym.Activation(data=data, act_type="tanh", name=name)
+        elif ltype == "Sigmoid":
+            sym = mx.sym.Activation(data=data, act_type="sigmoid", name=name)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            sym = mx.sym.Dropout(data=data, name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            sym = mx.sym.LRN(
+                data=data, name=name,
+                alpha=float(p.get("alpha", 1e-4)),
+                beta=float(p.get("beta", 0.75)),
+                knorm=float(p.get("k", 1.0)),
+                nsize=int(p.get("local_size", 5)))
+        elif ltype == "Concat":
+            sym = mx.sym.Concat(*bottoms, num_args=len(bottoms), name=name)
+        elif ltype == "Eltwise":
+            op = str(layer.get("eltwise_param", {}).get("operation", "SUM"))
+            sym = bottoms[0]
+            for b in bottoms[1:]:
+                if op in ("SUM", "1"):
+                    sym = sym + b
+                elif op in ("PROD", "0"):
+                    sym = sym * b
+                elif op in ("MAX", "2"):
+                    sym = mx.sym.maximum(sym, b)
+                else:
+                    raise NotImplementedError(
+                        "Eltwise operation %r not supported" % op)
+        elif ltype == "Flatten":
+            sym = mx.sym.Flatten(data=data, name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            sym = mx.sym.SoftmaxOutput(data=data, name=name)
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r (%s) not supported" % (ltype, name))
+        for t in tops:
+            outputs[t] = sym
+
+    return sym, input_name, input_dim
+
+
+def convert_model(prototxt_path, caffemodel_path, output_prefix):
+    """Convert weights too (ref: convert_model.py). Reading .caffemodel
+    needs pycaffe — gated the same way the caffe plugin is. Writes
+    <output_prefix>-symbol.json and <output_prefix>-0001.params; returns
+    (symbol, arg_params)."""
+    try:
+        import caffe
+    except ImportError as e:
+        from mxnet_tpu.base import MXNetError
+
+        raise MXNetError(
+            "convert_model requires pycaffe to read .caffemodel (not in "
+            "this build). convert_symbol works without it.") from e
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    sym, _, _ = convert_symbol(open(prototxt_path).read())
+    net = caffe.Net(prototxt_path, caffemodel_path, caffe.TEST)
+    arg_params = {}
+    for lname, blobs in net.params.items():
+        name = lname.replace("/", "_")
+        wkey, bkey = name + "_weight", name + "_bias"
+        if wkey in sym.list_arguments():
+            # caffe conv weights are (N, C, kh, kw) and IP weights
+            # (out, in) — both match this framework's layout directly
+            arg_params[wkey] = mx.nd.array(
+                np.asarray(blobs[0].data, np.float32))
+            if len(blobs) > 1 and bkey in sym.list_arguments():
+                arg_params[bkey] = mx.nd.array(
+                    np.asarray(blobs[1].data, np.float32))
+    sym.save(output_prefix + "-symbol.json")
+    mx.nd.save(output_prefix + "-0001.params",
+               {"arg:" + k: v for k, v in arg_params.items()})
+    return sym, arg_params
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prototxt")
+    ap.add_argument("output_prefix")
+    args = ap.parse_args()
+    sym, input_name, input_dim = convert_symbol(open(args.prototxt).read())
+    sym.save(args.output_prefix + "-symbol.json")
+    print("wrote %s-symbol.json (input %s %s)"
+          % (args.output_prefix, input_name, input_dim))
+
+
+if __name__ == "__main__":
+    main()
