@@ -33,6 +33,7 @@ from .context import Context, cpu, current_context
 __all__ = [
     "NDArray", "zeros", "ones", "full", "empty", "array", "arange",
     "concatenate", "load", "save", "waitall", "onehot_encode", "imdecode",
+    "maximum", "minimum",
 ]
 
 
@@ -589,3 +590,32 @@ def load_frombuffer(buf, ctx=None):
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+def maximum(lhs, rhs):
+    """Elementwise max of arrays/scalars (ref: python/mxnet/ndarray.py:799
+    dispatching to _maximum/_maximum_scalar)."""
+    from . import ndarray as _nd
+
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        # NB: plain max() would hit the attached 'max' reduction op —
+        # registry functions shadow builtins at module scope
+        return lhs if lhs > rhs else rhs
+    if isinstance(rhs, numeric_types):
+        return _nd._maximum_scalar(lhs, scalar=float(rhs))
+    if isinstance(lhs, numeric_types):
+        return _nd._maximum_scalar(rhs, scalar=float(lhs))
+    return _nd._maximum(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise min (ref: python/mxnet/ndarray.py:825)."""
+    from . import ndarray as _nd
+
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        return lhs if lhs < rhs else rhs  # see maximum(): 'min' is shadowed
+    if isinstance(rhs, numeric_types):
+        return _nd._minimum_scalar(lhs, scalar=float(rhs))
+    if isinstance(lhs, numeric_types):
+        return _nd._minimum_scalar(rhs, scalar=float(lhs))
+    return _nd._minimum(lhs, rhs)

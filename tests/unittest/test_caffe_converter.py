@@ -122,5 +122,45 @@ def test_unsupported_layer_raises():
 
 
 def test_convert_model_gated():
+    try:
+        import caffe  # noqa: F401
+
+        pytest.skip("pycaffe installed; gate inactive")
+    except ImportError:
+        pass
     with pytest.raises(MXNetError):
         caffe_converter.convert_model("a.prototxt", "b.caffemodel", "out")
+
+
+def test_unknown_bottom_named_in_error():
+    bad = ('input: "data"\n'
+           'layer { name: "c" type: "Convolution" bottom: "typo" top: "c" '
+           'convolution_param { num_output: 2 kernel_size: 3 } }')
+    with pytest.raises(ValueError, match="typo"):
+        caffe_converter.convert_symbol(bad)
+
+
+def test_eltwise_coeff_subtraction():
+    proto = ('input: "data"\n'
+             'layer { name: "s" type: "Eltwise" bottom: "data" '
+             'bottom: "data" top: "s" '
+             'eltwise_param { operation: SUM coeff: 1.0 coeff: -1.0 } }')
+    sym, _, _ = caffe_converter.convert_symbol(proto)
+    exe = sym.bind(mx.cpu(), {"data": mx.nd.ones((2, 3))}, grad_req="null")
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), 0.0)
+
+
+def test_stochastic_pool_rejected():
+    proto = ('input: "data"\n'
+             'layer { name: "p" type: "Pooling" bottom: "data" top: "p" '
+             'pooling_param { pool: STOCHASTIC kernel_size: 2 } }')
+    with pytest.raises(NotImplementedError):
+        caffe_converter.convert_symbol(proto)
+
+
+def test_input_only_prototxt():
+    sym, name, dim = caffe_converter.convert_symbol(
+        'input: "data"\ninput_dim: 1\ninput_dim: 3\n'
+        'input_dim: 8\ninput_dim: 8\n')
+    assert name == "data" and dim == (1, 3, 8, 8)
+    assert sym.list_arguments() == ["data"]

@@ -129,3 +129,15 @@ def test_concatenate():
     b = mx.nd.zeros((2, 3))
     c = mx.nd.concatenate([a, b], axis=0)
     assert c.shape == (4, 3)
+
+
+def test_maximum_minimum_dispatch():
+    """ref: python/mxnet/ndarray.py:799/825 — array/array, array/scalar,
+    scalar/array, scalar/scalar forms."""
+    a = mx.nd.array(np.array([1.0, 5.0, 3.0], "f"))
+    b = mx.nd.array(np.array([4.0, 2.0, 3.0], "f"))
+    assert np.allclose(mx.nd.maximum(a, b).asnumpy(), [4, 5, 3])
+    assert np.allclose(mx.nd.maximum(a, 2.0).asnumpy(), [2, 5, 3])
+    assert np.allclose(mx.nd.minimum(3.0, b).asnumpy(), [3, 2, 3])
+    assert np.allclose(mx.nd.minimum(a, b).asnumpy(), [1, 2, 3])
+    assert mx.nd.maximum(1, 2) == 2 and mx.nd.minimum(1, 2) == 1

@@ -138,11 +138,19 @@ def convert_symbol(prototxt_text):
         input_dim = tuple(_aslist(dims)) if dims else None
         outputs[input_name] = mx.sym.Variable(input_name)
 
+    sym = outputs.get(input_name)
     for layer in layers:
         ltype = str(layer.get("type", ""))
         name = str(layer.get("name", ltype)).replace("/", "_")
-        bottoms = [outputs[b] for b in _aslist(layer.get("bottom"))
-                   if b in outputs]
+        bottom_names = _aslist(layer.get("bottom"))
+        if ltype not in ("Input", "Data", "MemoryData", "HDF5Data",
+                         "Accuracy", "Silence"):
+            missing = [b for b in bottom_names if b not in outputs]
+            if missing:
+                raise ValueError(
+                    "layer %r: unknown bottom blob(s) %s — not produced by "
+                    "any earlier layer or input" % (name, missing))
+        bottoms = [outputs[b] for b in bottom_names if b in outputs]
         tops = _aslist(layer.get("top")) or [name]
         data = bottoms[0] if bottoms else None
 
@@ -160,15 +168,20 @@ def convert_symbol(prototxt_text):
                 kernel=kernel,
                 stride=_hw(p, "stride", default=1),
                 pad=_hw(p, "pad", default=0),
+                dilate=(int(_first(p.get("dilation"), 1)),) * 2,
                 no_bias=not p.get("bias_term", True),
                 num_group=int(p.get("group", 1)))
         elif ltype == "Pooling":
             p = layer.get("pooling_param", {})
             global_pool = bool(p.get("global_pooling", False))
+            pool_modes = {"MAX": "max", "AVE": "avg", 0: "max", 1: "avg"}
+            mode = p.get("pool", "MAX")
+            if mode not in pool_modes:
+                raise NotImplementedError(
+                    "Pooling mode %r (%s) not supported" % (mode, name))
             sym = mx.sym.Pooling(
                 data=data, name=name,
-                pool_type={"MAX": "max", "AVE": "avg", 0: "max",
-                           1: "avg"}.get(p.get("pool", "MAX"), "max"),
+                pool_type=pool_modes[mode],
                 kernel=(_hw(p, "kernel", default=1)
                         if not global_pool else (1, 1)),
                 stride=_hw(p, "stride", default=1),
@@ -203,9 +216,22 @@ def convert_symbol(prototxt_text):
         elif ltype == "Concat":
             sym = mx.sym.Concat(*bottoms, num_args=len(bottoms), name=name)
         elif ltype == "Eltwise":
-            op = str(layer.get("eltwise_param", {}).get("operation", "SUM"))
-            sym = bottoms[0]
-            for b in bottoms[1:]:
+            ep = layer.get("eltwise_param", {})
+            op = str(ep.get("operation", "SUM"))
+            coeffs = [float(c) for c in _aslist(ep.get("coeff"))]
+            if coeffs and op in ("SUM", "1"):
+                if len(coeffs) != len(bottoms):
+                    raise ValueError(
+                        "Eltwise %s: %d coeffs for %d bottoms"
+                        % (name, len(coeffs), len(bottoms)))
+                terms = [b * c for b, c in zip(bottoms, coeffs)]
+            else:
+                if coeffs:
+                    raise NotImplementedError(
+                        "Eltwise coeff only defined for SUM")
+                terms = bottoms
+            sym = terms[0]
+            for b in terms[1:]:
                 if op in ("SUM", "1"):
                     sym = sym + b
                 elif op in ("PROD", "0"):
@@ -227,6 +253,8 @@ def convert_symbol(prototxt_text):
         for t in tops:
             outputs[t] = sym
 
+    if sym is None:
+        raise ValueError("prototxt contains no layers and no input")
     return sym, input_name, input_dim
 
 
@@ -250,15 +278,16 @@ def convert_model(prototxt_path, caffemodel_path, output_prefix):
     sym, _, _ = convert_symbol(open(prototxt_path).read())
     net = caffe.Net(prototxt_path, caffemodel_path, caffe.TEST)
     arg_params = {}
+    args = set(sym.list_arguments())
     for lname, blobs in net.params.items():
         name = lname.replace("/", "_")
         wkey, bkey = name + "_weight", name + "_bias"
-        if wkey in sym.list_arguments():
+        if wkey in args:
             # caffe conv weights are (N, C, kh, kw) and IP weights
             # (out, in) — both match this framework's layout directly
             arg_params[wkey] = mx.nd.array(
                 np.asarray(blobs[0].data, np.float32))
-            if len(blobs) > 1 and bkey in sym.list_arguments():
+            if len(blobs) > 1 and bkey in args:
                 arg_params[bkey] = mx.nd.array(
                     np.asarray(blobs[1].data, np.float32))
     sym.save(output_prefix + "-symbol.json")
