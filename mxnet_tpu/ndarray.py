@@ -594,28 +594,25 @@ def load_frombuffer(buf, ctx=None):
 
 def maximum(lhs, rhs):
     """Elementwise max of arrays/scalars (ref: python/mxnet/ndarray.py:799
-    dispatching to _maximum/_maximum_scalar)."""
-    from . import ndarray as _nd
-
+    dispatching to _maximum/_maximum_scalar). The _maximum* ops are
+    attached to this module's globals by ops.install at import."""
     if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
         # NB: plain max() would hit the attached 'max' reduction op —
         # registry functions shadow builtins at module scope
         return lhs if lhs > rhs else rhs
     if isinstance(rhs, numeric_types):
-        return _nd._maximum_scalar(lhs, scalar=float(rhs))
+        return _maximum_scalar(lhs, scalar=float(rhs))  # noqa: F821
     if isinstance(lhs, numeric_types):
-        return _nd._maximum_scalar(rhs, scalar=float(lhs))
-    return _nd._maximum(lhs, rhs)
+        return _maximum_scalar(rhs, scalar=float(lhs))  # noqa: F821
+    return _maximum(lhs, rhs)  # noqa: F821
 
 
 def minimum(lhs, rhs):
     """Elementwise min (ref: python/mxnet/ndarray.py:825)."""
-    from . import ndarray as _nd
-
     if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
         return lhs if lhs < rhs else rhs  # see maximum(): 'min' is shadowed
     if isinstance(rhs, numeric_types):
-        return _nd._minimum_scalar(lhs, scalar=float(rhs))
+        return _minimum_scalar(lhs, scalar=float(rhs))  # noqa: F821
     if isinstance(lhs, numeric_types):
-        return _nd._minimum_scalar(rhs, scalar=float(lhs))
-    return _nd._minimum(lhs, rhs)
+        return _minimum_scalar(rhs, scalar=float(lhs))  # noqa: F821
+    return _minimum(lhs, rhs)  # noqa: F821

@@ -103,6 +103,18 @@ def _first(v, default):
     return lst[0] if lst else default
 
 
+def _dilate(p, name):
+    """dilation is a repeated field: one value applies to both axes,
+    two distinct values are anisotropic (unsupported)."""
+    vals = [int(v) for v in _aslist(p.get("dilation"))]
+    if not vals:
+        return (1, 1)
+    if len(set(vals)) > 1:
+        raise NotImplementedError(
+            "anisotropic dilation %s (%s) not supported" % (vals, name))
+    return (vals[0], vals[0])
+
+
 def _hw(p, field, default=None, required=False):
     """Resolve caffe's square (`kernel_size`) or per-axis
     (`kernel_h`/`kernel_w`) spatial params to an (h, w) tuple."""
@@ -168,7 +180,7 @@ def convert_symbol(prototxt_text):
                 kernel=kernel,
                 stride=_hw(p, "stride", default=1),
                 pad=_hw(p, "pad", default=0),
-                dilate=(int(_first(p.get("dilation"), 1)),) * 2,
+                dilate=_dilate(p, name),
                 no_bias=not p.get("bias_term", True),
                 num_group=int(p.get("group", 1)))
         elif ltype == "Pooling":
