@@ -9,9 +9,10 @@ the 2016 reference but first-class here) is provided by ring attention.
 from .mesh import create_mesh, default_mesh, local_devices, set_default_devices
 from .trainer import ShardedTrainer, make_train_step, data_parallel_spec
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention, make_ulysses_attention
 
 __all__ = [
     "create_mesh", "default_mesh", "local_devices", "set_default_devices",
     "ShardedTrainer", "make_train_step", "data_parallel_spec",
-    "ring_attention",
+    "ring_attention", "ulysses_attention", "make_ulysses_attention",
 ]

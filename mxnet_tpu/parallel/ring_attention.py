@@ -31,6 +31,20 @@ def _block_attn(q, k, v, mask, scale):
     return o, l, m
 
 
+def _merge_block(o_acc, l_acc, m_acc, o, l, m):
+    """Merge one block's (o, l, m) into running accumulators with
+    log-sum-exp rescaling (the flash-attention combine step). Shared by
+    ring_attention and ulysses."""
+    import jax.numpy as jnp
+
+    new_m = jnp.maximum(m_acc, m)
+    alpha = jnp.exp(m_acc - new_m)
+    beta = jnp.exp(m - new_m)
+    return (o_acc * alpha[..., None] + o * beta[..., None],
+            l_acc * alpha + l * beta,
+            new_m)
+
+
 def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     """Attention with K/V ring-rotated across `axis_name`.
 
@@ -70,14 +84,9 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
         else:
             mask = jnp.ones((tq, tk), bool)
         o, l, m = _block_attn(q, k_cur, v_cur, mask, scale)
-        o = o.astype(acc_dtype)
-        l = l.astype(acc_dtype)
-        m = m.astype(acc_dtype)
-        new_m = jnp.maximum(m_acc, m)
-        alpha = jnp.exp(m_acc - new_m)
-        beta = jnp.exp(m - new_m)
-        o_acc2 = o_acc * alpha[..., None] + o * beta[..., None]
-        l_acc2 = l_acc * alpha + l * beta
+        o_acc2, l_acc2, new_m = _merge_block(
+            o_acc, l_acc, m_acc,
+            o.astype(acc_dtype), l.astype(acc_dtype), m.astype(acc_dtype))
         perm = [(i, (i + 1) % ring) for i in range(ring)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)

@@ -169,3 +169,47 @@ def test_transformer_train_step_dp_tp():
     params, opt_state, loss = step(params, opt_state, {"tokens": tokens},
                                    jax.random.PRNGKey(0))
     assert np.isfinite(float(loss))
+
+
+def test_ulysses_attention_matches_dense():
+    """All-to-all sequence parallelism == dense attention (the Ulysses
+    counterpart of the ring test; heads divisible by axis size)."""
+    from mxnet_tpu.parallel import make_ulysses_attention
+
+    mesh = create_mesh((4,), ("seq",))
+    B, H, T, D = 2, 4, 16, 8
+    rng = np.random.RandomState(5)
+    q = rng.randn(B, H, T, D).astype("f")
+    k = rng.randn(B, H, T, D).astype("f")
+    v = rng.randn(B, H, T, D).astype("f")
+    uly = make_ulysses_attention(mesh, seq_axis="seq", causal=True)
+    out = np.array(uly(q, k, v))
+    ref = _dense_attention(q, k, v, causal=True)
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_ulysses_matches_ring():
+    """Both context-parallel schemes compute the same attention."""
+    from mxnet_tpu.parallel import make_ulysses_attention
+    from mxnet_tpu.parallel.ring_attention import make_ring_attention
+
+    mesh = create_mesh((2,), ("seq",))
+    B, H, T, D = 1, 2, 12, 4
+    rng = np.random.RandomState(6)
+    q = rng.randn(B, H, T, D).astype("f")
+    k = rng.randn(B, H, T, D).astype("f")
+    v = rng.randn(B, H, T, D).astype("f")
+    uly = make_ulysses_attention(mesh, seq_axis="seq", causal=False)
+    ring = make_ring_attention(mesh, seq_axis="seq", causal=False)
+    np.testing.assert_allclose(np.array(uly(q, k, v)),
+                               np.array(ring(q, k, v)), atol=1e-4)
+
+
+def test_ulysses_head_divisibility_error():
+    from mxnet_tpu.parallel import make_ulysses_attention
+
+    mesh = create_mesh((4,), ("seq",))
+    uly = make_ulysses_attention(mesh, seq_axis="seq")
+    q = np.zeros((1, 2, 8, 4), "f")  # 2 heads, 4-way axis
+    with pytest.raises(Exception, match="divide"):
+        uly(q, q, q)
