@@ -10,9 +10,13 @@ from .mesh import create_mesh, default_mesh, local_devices, set_default_devices
 from .trainer import ShardedTrainer, make_train_step, data_parallel_spec
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention, make_ulysses_attention
+from .moe import init_moe_params, moe_ffn, shard_moe_params
+from .pipeline import make_pipeline, pipeline_apply
 
 __all__ = [
     "create_mesh", "default_mesh", "local_devices", "set_default_devices",
     "ShardedTrainer", "make_train_step", "data_parallel_spec",
     "ring_attention", "ulysses_attention", "make_ulysses_attention",
+    "init_moe_params", "moe_ffn", "shard_moe_params",
+    "make_pipeline", "pipeline_apply",
 ]

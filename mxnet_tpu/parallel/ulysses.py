@@ -61,22 +61,29 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     t_global = ql.shape[2]
     chunk = t_local
     acc = jnp.float32
-    o_acc = jnp.zeros(ql.shape[:3] + (vl.shape[-1],), acc)
-    l_acc = jnp.zeros(ql.shape[:3], acc)
-    m_acc = jnp.full(ql.shape[:3], -1e30, acc)
     iq = jnp.arange(t_global)[:, None]
-    for c in range(t_global // chunk):
-        kc = lax.slice_in_dim(kl, c * chunk, (c + 1) * chunk, axis=2)
-        vc = lax.slice_in_dim(vl, c * chunk, (c + 1) * chunk, axis=2)
+
+    def body(c, carry):
+        o_acc, l_acc, m_acc = carry
+        kc = lax.dynamic_slice_in_dim(kl, c * chunk, chunk, axis=2)
+        vc = lax.dynamic_slice_in_dim(vl, c * chunk, chunk, axis=2)
         if causal:
-            ik = jnp.arange(c * chunk, (c + 1) * chunk)[None, :]
+            ik = c * chunk + jnp.arange(chunk)[None, :]
             mask = ik <= iq
         else:
             mask = jnp.ones((t_global, chunk), bool)
         o, l, m = _block_attn(ql, kc, vc, mask, scale)
-        o_acc, l_acc, m_acc = _merge_block(
-            o_acc, l_acc, m_acc,
-            o.astype(acc), l.astype(acc), m.astype(acc))
+        return _merge_block(o_acc, l_acc, m_acc,
+                            o.astype(acc), l.astype(acc), m.astype(acc))
+
+    init = (jnp.zeros(ql.shape[:3] + (vl.shape[-1],), acc),
+            jnp.zeros(ql.shape[:3], acc),
+            jnp.full(ql.shape[:3], -1e30, acc))
+    if hasattr(lax, "pvary"):
+        # block results are device-varying (post-all_to_all operands);
+        # mark the initial carry to match (same as ring's accumulators)
+        init = lax.pvary(init, (axis_name,))
+    o_acc, l_acc, m_acc = lax.fori_loop(0, t_global // chunk, body, init)
     out = o_acc / jnp.maximum(l_acc, 1e-30)[..., None]
     return heads_to_seq(out.astype(q.dtype))
 
