@@ -41,6 +41,11 @@ from . import io  # noqa: E402
 from . import recordio  # noqa: E402
 from . import kvstore  # noqa: E402
 from .kvstore import create as kvstore_create  # noqa: E402
+from . import kvstore_server as _kvstore_server  # noqa: E402
+
+# legacy DMLC_ROLE=server launches must fail loudly at import, as the
+# reference boots its server loop from package init (kvstore_server.py:58)
+_kvstore_server._init_kvstore_server_module()
 from . import monitor  # noqa: E402
 from .monitor import Monitor  # noqa: E402
 from . import model  # noqa: E402

@@ -1,6 +1,7 @@
 """KVStore semantics tests (modeled on reference test_kvstore.py:125 —
 "push ones from N fake devices, expect N")."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 
@@ -108,3 +109,30 @@ def test_get_type_and_rank():
     assert kv.type == "local"
     assert kv.rank == 0
     assert kv.num_workers == 1
+
+
+def test_kvstore_server_facade():
+    """ref: python/mxnet/kvstore_server.py — command protocol works
+    in-process; a legacy DMLC_ROLE=server launch fails loudly."""
+    import pickle
+
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    kv = mx.kvstore.create("local")
+    server = KVStoreServer(kv)
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    server._controller(0, pickle.dumps(opt))
+    assert kv._updater is not None
+    server.run()  # no server loop; must return immediately
+    with pytest.raises(mx.MXNetError):
+        server._controller(42, b"")
+
+
+def test_kvstore_server_role_rejected(monkeypatch):
+    from mxnet_tpu.kvstore_server import _init_kvstore_server_module
+
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    with pytest.raises(mx.MXNetError, match="worker"):
+        _init_kvstore_server_module()
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    _init_kvstore_server_module()  # no-op

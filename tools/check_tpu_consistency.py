@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""TPU vs CPU numeric consistency sweep.
+
+The TPU-era instance of the reference's GPU↔CPU consistency suite
+(ref: tests/python/gpu/test_operator_gpu.py via check_consistency,
+python/mxnet/test_utils.py:615 — SURVEY §4.4 calls it the template for
+TPU-vs-CPU parity). Binds the same symbols under cpu(0) and tpu(0) and
+asserts outputs and gradients agree within per-dtype tolerance.
+
+Run on a machine with a TPU attached:  python tools/check_tpu_consistency.py
+Exits nonzero on any mismatch; prints one line per case.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+from mxnet_tpu.test_utils import check_consistency  # noqa: E402
+
+
+def cases():
+    data = sym.Variable("data")
+    yield ("Convolution", sym.Convolution(
+        data=data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="op"),
+        {"data": (2, 3, 16, 16)})
+    yield ("FullyConnected", sym.FullyConnected(
+        data=data, num_hidden=16, name="op"), {"data": (4, 32)})
+    yield ("Pooling", sym.Pooling(
+        data=data, kernel=(2, 2), stride=(2, 2), pool_type="max", name="op"),
+        {"data": (2, 3, 8, 8)})
+    yield ("BatchNorm", sym.BatchNorm(data=data, name="op"),
+           {"data": (4, 3, 8, 8)})
+    yield ("SoftmaxActivation", sym.SoftmaxActivation(data=data, name="op"),
+           {"data": (4, 10)})
+    yield ("Deconvolution", sym.Deconvolution(
+        data=data, kernel=(4, 4), stride=(2, 2), pad=(1, 1), num_filter=4,
+        name="op"), {"data": (2, 3, 8, 8)})
+    yield ("act-chain", sym.Activation(sym.exp(data * 0.1), act_type="tanh"), {"data": (8, 8)})
+
+
+def main():
+    if mx.num_devices("tpu") == 0:
+        print("no TPU visible; nothing to check")
+        return 0
+    ctx_list = [{"ctx": mx.cpu(0)}, {"ctx": mx.tpu(0)}]
+    failures = 0
+    for name, s, shapes in cases():
+        try:
+            check_consistency(
+                s, [dict(c, **shapes) for c in ctx_list], grad_req="write")
+            print("%-20s OK" % name)
+        except Exception as e:  # report all, fail at end
+            failures += 1
+            print("%-20s FAIL: %s" % (name, e))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
