@@ -199,6 +199,15 @@ def _multiple_callbacks(callbacks, *args, **kwargs):
 _ckpt_vars = {}  # prefix -> engine write-var serializing its checkpoints
 
 
+def fence_checkpoint(prefix):
+    """Block until all queued async checkpoint writes of `prefix` have
+    landed (no-op when none are pending or the engine is non-native)."""
+    if prefix in _ckpt_vars:
+        from . import engine as _engine
+
+        _engine.Engine.get().wait_for_var(_ckpt_vars[prefix])
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     sync=False):
     """ref: python/mxnet/model.py:311.
@@ -236,10 +245,7 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
 def load_checkpoint(prefix, epoch):
     """ref: python/mxnet/model.py:341. Fences any in-flight async
     checkpoint of this prefix before reading."""
-    if prefix in _ckpt_vars:
-        from . import engine as _engine
-
-        _engine.Engine.get().wait_for_var(_ckpt_vars[prefix])
+    fence_checkpoint(prefix)
     symbol = sym_load("%s-symbol.json" % prefix)
     save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}

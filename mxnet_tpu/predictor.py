@@ -121,12 +121,9 @@ class Predictor:
                         output_names=None):
         """Build from ``prefix-symbol.json`` + ``prefix-%04d.params``
         (the files written by save_checkpoint, ref: model.py:311)."""
-        from .model import _ckpt_vars
+        from .model import fence_checkpoint
 
-        if prefix in _ckpt_vars:  # fence in-flight async checkpoint writes
-            from . import engine as _engine
-
-            _engine.Engine.get().wait_for_var(_ckpt_vars[prefix])
+        fence_checkpoint(prefix)  # in-flight async checkpoint writes
         with open("%s-symbol.json" % prefix) as f:
             sym_json = f.read()
         with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
