@@ -1,0 +1,206 @@
+"""Broad finite-difference gradient sweep across the operator library.
+
+Widens tests/unittest/test_operator.py toward the reference's
+test_operator.py coverage (1,629 LoC of per-op forward-vs-numpy and
+backward-vs-finite-difference checks, SURVEY §4.2): every op family gets
+its backward checked against numeric differentiation through the shared
+harness (mxnet_tpu.test_utils.check_numeric_gradient, the in-package
+assertion library the reference ships the same way)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(7)
+
+
+def _loc(shape, low=-1.0, high=1.0):
+    return {"data": RNG.uniform(low, high, shape).astype(np.float32)}
+
+
+# -- elementwise unary ---------------------------------------------------------
+# (name, input range) — ranges dodge non-differentiable/unstable points
+UNARY = [
+    ("exp", (-1, 1)), ("log", (0.3, 2.0)), ("sqrt", (0.3, 2.0)),
+    ("rsqrt", (0.3, 2.0)), ("square", (-1, 1)), ("abs", (0.2, 1.0)),
+    ("cos", (-1, 1)), ("sin", (-1, 1)),
+]
+# tanh/sigmoid/relu are Activation act_types in the reference, not
+# standalone simple ops — covered via test_activation_grads below
+
+
+@pytest.mark.parametrize("name,rng", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_grad(name, rng):
+    data = sym.Variable("data")
+    s = getattr(sym, name)(data)
+    check_numeric_gradient(s, _loc((3, 4), *rng))
+
+
+# -- binary / broadcast --------------------------------------------------------
+def test_binary_arithmetic_grads():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    loc = {"a": RNG.uniform(0.5, 1.5, (3, 4)).astype("f"),
+           "b": RNG.uniform(0.5, 1.5, (3, 4)).astype("f")}
+    for expr in (a + b, a - b, a * b, a / b, a ** b):
+        check_numeric_gradient(expr, dict(loc))
+
+
+def test_broadcast_binary_grads():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    loc = {"a": RNG.uniform(0.5, 1.5, (3, 4)).astype("f"),
+           "b": RNG.uniform(0.5, 1.5, (1, 4)).astype("f")}
+    for op in ("broadcast_plus", "broadcast_minus", "broadcast_mul",
+               "broadcast_div", "broadcast_power"):
+        check_numeric_gradient(getattr(sym, op)(a, b), dict(loc))
+
+
+def test_scalar_variant_grads():
+    data = sym.Variable("data")
+    loc = _loc((3, 4), 0.5, 1.5)
+    for expr in (data + 2.0, 2.0 - data, data * 3.0, 6.0 / data,
+                 data ** 2.0):
+        check_numeric_gradient(expr, dict(loc))
+
+
+def test_maximum_minimum_grads():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    # keep operands well separated so the max/min choice is stable
+    av = RNG.uniform(0.0, 0.4, (3, 4)).astype("f")
+    bv = RNG.uniform(0.6, 1.0, (3, 4)).astype("f")
+    check_numeric_gradient(sym.maximum(a, b), {"a": av, "b": bv})
+    check_numeric_gradient(sym.minimum(a, b), {"a": av, "b": bv})
+
+
+# -- reductions ----------------------------------------------------------------
+def test_reduction_grads():
+    data = sym.Variable("data")
+    loc = _loc((3, 4, 5))
+    check_numeric_gradient(sym.sum(data), dict(loc))
+    check_numeric_gradient(sym.sum_axis(data, axis=1), dict(loc))
+    # max/min: perturb-stable input (distinct values)
+    v = np.arange(60, dtype=np.float32).reshape(3, 4, 5) / 10.0
+    check_numeric_gradient(sym.max_axis(data, axis=2), {"data": v})
+    check_numeric_gradient(sym.min_axis(data, axis=0), {"data": v})
+
+
+# -- matrix ops ----------------------------------------------------------------
+def test_dot_grads():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    check_numeric_gradient(
+        sym.dot(a, b),
+        {"a": RNG.randn(3, 4).astype("f"), "b": RNG.randn(4, 2).astype("f")})
+
+
+def test_batch_dot_grads():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    check_numeric_gradient(
+        sym.batch_dot(a, b),
+        {"a": RNG.randn(2, 3, 4).astype("f"),
+         "b": RNG.randn(2, 4, 2).astype("f")})
+
+
+def test_transpose_swapaxis_expand_flip_grads():
+    data = sym.Variable("data")
+    loc = _loc((2, 3, 4))
+    check_numeric_gradient(sym.transpose(data, axes=(2, 0, 1)), dict(loc))
+    check_numeric_gradient(sym.SwapAxis(data, dim1=0, dim2=2), dict(loc))
+    check_numeric_gradient(sym.expand_dims(data, axis=1), dict(loc))
+    check_numeric_gradient(sym.flip(data, axis=1), dict(loc))
+
+
+def test_slice_reshape_grads():
+    data = sym.Variable("data")
+    loc = _loc((4, 6))
+    check_numeric_gradient(
+        sym.slice_axis(data, axis=1, begin=1, end=4), dict(loc))
+    check_numeric_gradient(sym.Reshape(data, shape=(2, 12)), dict(loc))
+    check_numeric_gradient(sym.Flatten(sym.Variable("data")),
+                           _loc((2, 3, 4)))
+
+
+# -- losses / specials ---------------------------------------------------------
+def test_smooth_l1_grad():
+    data = sym.Variable("data")
+    # dodge the |x|=1/sigma^2 kink
+    v = np.concatenate([RNG.uniform(-0.4, 0.4, 6),
+                        RNG.uniform(1.6, 2.4, 6)]).astype("f").reshape(3, 4)
+    check_numeric_gradient(sym.smooth_l1(data, scalar=1.0), {"data": v})
+
+
+def _full_loc(s, data_shape, **label_shapes):
+    shapes, _, _ = s.infer_shape(data=data_shape, **label_shapes)
+    return {n: RNG.uniform(-0.5, 0.5, shp).astype("f")
+            for n, shp in zip(s.list_arguments(), shapes)}
+
+
+def test_activation_grads():
+    data = sym.Variable("data")
+    for act in ("tanh", "sigmoid", "softrelu"):
+        check_numeric_gradient(
+            sym.Activation(data=data, act_type=act), _loc((3, 4), 0.2, 1.0))
+
+
+def test_nn_layer_grads():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=5, name="fc")
+    check_numeric_gradient(fc, _full_loc(fc, (3, 4)))
+    cv = sym.Convolution(data=data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                         name="cv")
+    check_numeric_gradient(cv, _full_loc(cv, (2, 3, 5, 5)))
+    dc = sym.Deconvolution(data=data, kernel=(2, 2), stride=(2, 2),
+                           num_filter=2, name="dc")
+    check_numeric_gradient(dc, _full_loc(dc, (2, 3, 4, 4)))
+
+
+def test_norm_layer_grads():
+    data = sym.Variable("data")
+    check_numeric_gradient(
+        sym.L2Normalization(data=data, name="l2"), _loc((3, 6), 0.5, 1.5))
+    check_numeric_gradient(
+        sym.InstanceNorm(data=data, gamma=sym.Variable("gamma"),
+                         beta=sym.Variable("beta"), name="in"),
+        {"data": RNG.uniform(0.5, 1.5, (2, 3, 5)).astype("f"),
+         "gamma": RNG.uniform(0.5, 1.5, (3,)).astype("f"),
+         "beta": RNG.uniform(-0.5, 0.5, (3,)).astype("f")})
+
+
+def test_leaky_relu_variants_grad():
+    data = sym.Variable("data")
+    loc = _loc((3, 4), 0.2, 1.0)  # positive side: smooth everywhere
+    for act in ("leaky", "elu"):
+        check_numeric_gradient(
+            sym.LeakyReLU(data=data, act_type=act, slope=0.3), dict(loc))
+
+
+def test_embedding_grad():
+    data = sym.Variable("data")
+    weight = sym.Variable("weight")
+    e = sym.Embedding(data=data, weight=weight, input_dim=6, output_dim=3,
+                      name="emb")
+    idx = np.array([[0, 2], [4, 5]], dtype=np.float32)
+    check_numeric_gradient(
+        e, {"data": idx, "weight": RNG.randn(6, 3).astype("f")},
+        grad_nodes=["weight"])
+
+
+def test_pad_upsampling_grads():
+    data = sym.Variable("data")
+    check_numeric_gradient(
+        sym.Pad(data, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+        _loc((1, 2, 3, 3)))
+    check_numeric_gradient(
+        sym.UpSampling(data, scale=2, sample_type="nearest", num_args=1),
+        _loc((1, 2, 3, 3)))
+
+
+def test_softmax_cross_entropy_grad():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    s = sym.softmax_cross_entropy(data, label)
+    check_numeric_gradient(
+        s,
+        {"data": RNG.randn(4, 5).astype("f"),
+         "label": np.array([0, 2, 4, 1], dtype=np.float32)},
+        grad_nodes=["data"])
