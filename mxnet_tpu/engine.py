@@ -128,8 +128,20 @@ class Engine:
         self._trampoline = _ENGINE_FN(_trampoline) if lib is not None else None
 
     def close(self):
-        """Drain pending work and free the native engine + worker pool."""
-        h, self._handle = self._handle, None
+        """Drain pending work and free the native engine + worker pool.
+
+        Contract: close() must only run once all threads that push to or
+        wait on this engine have quiesced (it is invoked from __del__ and
+        interpreter exit). The locked swap makes the handle hand-off
+        atomic — a thread that starts a push AFTER the swap falls back to
+        inline execution — but a native call already in flight when
+        EngineDestroy runs is undefined, same as the reference engine's
+        shutdown (threaded_engine destructor joins its workers without
+        fencing producers). Holding _live_lock across EngineDestroy is
+        not an option: the worker-thread trampoline takes _live_lock, so
+        destroy's drain would deadlock."""
+        with self._live_lock:
+            h, self._handle = self._handle, None
         if h is not None and self._lib is not None:
             self._lib.EngineDestroy(h)
 
@@ -151,16 +163,25 @@ class Engine:
     def is_native(self):
         return self._handle is not None
 
+    def _handle_snapshot(self):
+        """Read the handle once under the lock; callers use the snapshot
+        for the whole native call so a concurrent close() can never turn
+        a passed None-check into a NULL dereference."""
+        with self._live_lock:
+            return self._handle
+
     # -- variables -------------------------------------------------------------
     def new_variable(self):
-        if self._handle is None:
+        h = self._handle_snapshot()
+        if h is None:
             return VarHandle(None, self)
-        return VarHandle(self._lib.EngineNewVariable(self._handle), self)
+        return VarHandle(self._lib.EngineNewVariable(h), self)
 
     def delete_variable(self, var):
         """Deferred deletion after all pending ops (ref: engine.h:148-160)."""
-        if self._handle is not None and var._ptr:
-            self._lib.EngineDeleteVariable(self._handle, var._ptr)
+        h = self._handle_snapshot()
+        if h is not None and var._ptr:
+            self._lib.EngineDeleteVariable(h, var._ptr)
             var._ptr = None
 
     # -- push ------------------------------------------------------------------
@@ -190,10 +211,12 @@ class Engine:
                 "engine: push %s const=%d mutable=%d priority=%d async=%s",
                 getattr(fn, "__name__", "fn"), len(const_vars),
                 len(mutable_vars), priority, is_async)
+        with self._live_lock:
+            handle = self._handle
         for v in list(const_vars) + list(mutable_vars):
-            if self._handle is not None and not v._ptr:
+            if handle is not None and not v._ptr:
                 raise MXNetError("engine variable used after delete_variable")
-        if self._handle is None:  # NaiveEngine fallback: run inline
+        if handle is None:  # NaiveEngine fallback: run inline
             if is_async:
                 done = threading.Event()
                 fn(done.set)
@@ -211,38 +234,47 @@ class Engine:
         m_arr = (ctypes.c_void_p * max(n_m, 1))(
             *[v._ptr for v in mutable_vars])
         rc = self._lib.EnginePush(
-            self._handle, self._trampoline, ctypes.c_void_p(key),
+            handle, self._trampoline, ctypes.c_void_p(key),
             c_arr, n_c, m_arr, n_m, priority, 0 if is_async else 1)
         if rc != 0:
             with self._live_lock:
                 self._live.pop(key, None)
             raise MXNetError(
-                self._lib.EngineLastError(self._handle).decode())
+                self._lib.EngineLastError(handle).decode())
 
     # -- sync ------------------------------------------------------------------
     def wait_for_var(self, var):
         """ref: engine.h:166 WaitForVar."""
-        if self._handle is not None and var._ptr:
-            self._lib.EngineWaitForVar(self._handle, var._ptr)
+        h = self._handle_snapshot()
+        if h is not None and var._ptr:
+            self._lib.EngineWaitForVar(h, var._ptr)
         self._raise_pending()
 
     def wait_for_all(self):
         """ref: engine.h:170 WaitForAll."""
-        if self._handle is not None:
-            self._lib.EngineWaitForAll(self._handle)
+        h = self._handle_snapshot()
+        if h is not None:
+            self._lib.EngineWaitForAll(h)
         self._raise_pending()
 
     def pending_count(self):
-        if self._handle is None:
+        h = self._handle_snapshot()
+        if h is None:
             return 0
-        return self._lib.EnginePendingCount(self._handle)
+        return self._lib.EnginePendingCount(h)
 
     def _raise_pending(self):
         with self._live_lock:
             if not self._errors:
                 return
             err = self._errors[0]
+            dropped = self._errors[1:]
             self._errors.clear()
+        # Raise the first failure; the rest must not vanish silently
+        # (two async checkpoint writes can both fail in one wait).
+        for extra in dropped:
+            logging.error("engine: additional deferred task error "
+                          "(raised error takes precedence): %r", extra)
         raise err
 
 

@@ -8,6 +8,7 @@ metric host-side. Checkpoints are `prefix-symbol.json` +
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections import namedtuple
 
@@ -197,15 +198,18 @@ def _multiple_callbacks(callbacks, *args, **kwargs):
 
 
 _ckpt_vars = {}  # prefix -> engine write-var serializing its checkpoints
+_ckpt_vars_lock = threading.Lock()  # guards check-then-insert on _ckpt_vars
 
 
 def fence_checkpoint(prefix):
     """Block until all queued async checkpoint writes of `prefix` have
     landed (no-op when none are pending or the engine is non-native)."""
-    if prefix in _ckpt_vars:
+    with _ckpt_vars_lock:
+        var = _ckpt_vars.get(prefix)
+    if var is not None:
         from . import engine as _engine
 
-        _engine.Engine.get().wait_for_var(_ckpt_vars[prefix])
+        _engine.Engine.get().wait_for_var(var)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
@@ -237,9 +241,11 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     if sync or not eng.is_native:
         _write()
         return
-    if prefix not in _ckpt_vars:
-        _ckpt_vars[prefix] = eng.new_variable()
-    eng.push(_write, mutable_vars=[_ckpt_vars[prefix]])
+    with _ckpt_vars_lock:
+        var = _ckpt_vars.get(prefix)
+        if var is None:
+            var = _ckpt_vars[prefix] = eng.new_variable()
+    eng.push(_write, mutable_vars=[var])
 
 
 def load_checkpoint(prefix, epoch):

@@ -24,6 +24,21 @@ def set_default_devices(devices):
     _default_devices = list(devices) if devices is not None else None
 
 
+def mark_varying(x, axis_name):
+    """Mark a pytree of arrays device-varying along ``axis_name`` inside a
+    shard_map body (loop-carry typing discipline for ppermute/all_to_all
+    results). Prefers ``lax.pcast(..., to='varying')``; falls back to the
+    deprecated ``lax.pvary`` on older jax; no-op when neither exists."""
+    from jax import lax
+
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
+
+
 def local_devices(platform=None):
     import jax
 

@@ -43,8 +43,9 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     # the carry becomes device-varying along the pipe axis after the
     # first ppermute; mark the initials so the loop carry types match
     # (same discipline as ring_attention's accumulators)
-    if hasattr(lax, "pvary"):
-        state, outs = lax.pvary((state, outs), (axis_name,))
+    from .mesh import mark_varying
+
+    state, outs = mark_varying((state, outs), axis_name)
 
     def tick(t, carry):
         state, outs = carry

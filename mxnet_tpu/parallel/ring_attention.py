@@ -70,8 +70,9 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     m_acc = jnp.full(q.shape[:3], -1e30, acc_dtype)
     # mark accumulators as device-varying along the ring axis so the scan
     # carry type matches under shard_map's varying-axis checking
-    if hasattr(lax, "pvary"):
-        o_acc, l_acc, m_acc = lax.pvary((o_acc, l_acc, m_acc), (axis_name,))
+    from .mesh import mark_varying
+
+    o_acc, l_acc, m_acc = mark_varying((o_acc, l_acc, m_acc), axis_name)
 
     def body(step, carry):
         o_acc, l_acc, m_acc, k_cur, v_cur = carry

@@ -79,10 +79,11 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     init = (jnp.zeros(ql.shape[:3] + (vl.shape[-1],), acc),
             jnp.zeros(ql.shape[:3], acc),
             jnp.full(ql.shape[:3], -1e30, acc))
-    if hasattr(lax, "pvary"):
-        # block results are device-varying (post-all_to_all operands);
-        # mark the initial carry to match (same as ring's accumulators)
-        init = lax.pvary(init, (axis_name,))
+    from .mesh import mark_varying
+
+    # block results are device-varying (post-all_to_all operands);
+    # mark the initial carry to match (same as ring's accumulators)
+    init = mark_varying(init, axis_name)
     o_acc, l_acc, m_acc = lax.fori_loop(0, t_global // chunk, body, init)
     out = o_acc / jnp.maximum(l_acc, 1e-30)[..., None]
     return heads_to_seq(out.astype(q.dtype))
