@@ -395,7 +395,10 @@ def _bn_fwd(params, inputs, aux, is_train, rng):
         mean = jax.lax.stop_gradient(moving_mean).astype(jnp.float32)
         var = jax.lax.stop_gradient(moving_var).astype(jnp.float32)
         new_aux = [moving_mean, moving_var]
-    out = (x32 - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + eps)
+    # multiply by rsqrt (not divide by sqrt): XLA:TPU keeps the division
+    # out of the fused elementwise loop this way
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    out = (x32 - mean.reshape(bshape)) * inv
     out = out * gamma.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
     return [out.astype(data.dtype)], new_aux
 
