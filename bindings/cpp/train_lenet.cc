@@ -1,0 +1,223 @@
+// Train LeNet on (synthetic) MNIST purely through the flat C ABI —
+// a standalone C++ "binding" program, the proof that non-Python code can
+// drive the framework the way the reference's R/Scala/MATLAB bindings
+// drive libmxnet.so (ref: include/mxnet/c_api.h usage in
+// R-package/src/executor.cc, scala-package JNI).
+//
+// Build:  g++ -O2 -std=c++17 train_lenet.cc -o train_lenet \
+//             -L<repo>/mxnet_tpu/_native -lc_api \
+//             -Wl,-rpath,<repo>/mxnet_tpu/_native
+// Run:    PYTHONPATH=<repo> ./train_lenet
+// Exits 0 when final train accuracy > 0.9.
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "../../include/c_api.h"
+
+#define CHECK_RC(call)                                                  \
+  do {                                                                  \
+    if ((call) != 0) {                                                  \
+      std::fprintf(stderr, "FAILED %s: %s\n", #call, MXGetLastError()); \
+      std::exit(1);                                                     \
+    }                                                                   \
+  } while (0)
+
+namespace {
+
+SymbolHandle Atomic(const char *op, std::vector<const char *> keys,
+                    std::vector<const char *> vals) {
+  AtomicSymbolHandle atom = nullptr;
+  CHECK_RC(MXSymbolCreateAtomicSymbol(op, keys.size(), keys.data(),
+                                      vals.data(), &atom));
+  return atom;
+}
+
+SymbolHandle Compose1(AtomicSymbolHandle atom, const char *name,
+                      SymbolHandle data) {
+  const char *keys[] = {"data"};
+  SymbolHandle args[] = {data};
+  SymbolHandle out = nullptr;
+  CHECK_RC(MXSymbolCompose(atom, name, 1, keys, args, &out));
+  return out;
+}
+
+NDArrayHandle MakeND(const std::vector<mx_uint> &shape,
+                     const std::vector<float> &init) {
+  NDArrayHandle h = nullptr;
+  CHECK_RC(MXNDArrayCreate(shape.data(), shape.size(), 1, 0, 0, &h));
+  CHECK_RC(MXNDArraySyncCopyFromCPU(h, init.data(), init.size()));
+  return h;
+}
+
+std::vector<float> ReadND(NDArrayHandle h, size_t n) {
+  std::vector<float> out(n);
+  CHECK_RC(MXNDArraySyncCopyToCPU(h, out.data(), n));
+  return out;
+}
+
+}  // namespace
+
+int main() {
+  // ---- build LeNet symbol through compose calls ----
+  SymbolHandle data = nullptr, label = nullptr;
+  CHECK_RC(MXSymbolCreateVariable("data", &data));
+  CHECK_RC(MXSymbolCreateVariable("softmax_label", &label));
+  SymbolHandle c1 = Compose1(
+      Atomic("Convolution", {"kernel", "num_filter"}, {"(5, 5)", "8"}),
+      "conv1", data);
+  SymbolHandle a1 =
+      Compose1(Atomic("Activation", {"act_type"}, {"tanh"}), "act1", c1);
+  SymbolHandle p1 = Compose1(
+      Atomic("Pooling", {"pool_type", "kernel", "stride"},
+             {"max", "(2, 2)", "(2, 2)"}),
+      "pool1", a1);
+  SymbolHandle c2 = Compose1(
+      Atomic("Convolution", {"kernel", "num_filter"}, {"(5, 5)", "16"}),
+      "conv2", p1);
+  SymbolHandle a2 =
+      Compose1(Atomic("Activation", {"act_type"}, {"tanh"}), "act2", c2);
+  SymbolHandle p2 = Compose1(
+      Atomic("Pooling", {"pool_type", "kernel", "stride"},
+             {"max", "(2, 2)", "(2, 2)"}),
+      "pool2", a2);
+  SymbolHandle fl = Compose1(Atomic("Flatten", {}, {}), "flat", p2);
+  SymbolHandle f1 = Compose1(
+      Atomic("FullyConnected", {"num_hidden"}, {"64"}), "fc1", fl);
+  SymbolHandle a3 =
+      Compose1(Atomic("Activation", {"act_type"}, {"tanh"}), "act3", f1);
+  SymbolHandle f2 = Compose1(
+      Atomic("FullyConnected", {"num_hidden"}, {"10"}), "fc2", a3);
+  const char *sm_keys[] = {"data", "label"};
+  SymbolHandle sm_args[] = {f2, label};
+  SymbolHandle net = nullptr;
+  CHECK_RC(MXSymbolCompose(Atomic("SoftmaxOutput", {}, {}), "softmax", 2,
+                           sm_keys, sm_args, &net));
+
+  // ---- shapes ----
+  const mx_uint bs = 64;
+  mx_uint n_args = 0;
+  const char **arg_names = nullptr;
+  CHECK_RC(MXSymbolListArguments(net, &n_args, &arg_names));
+  std::vector<std::string> names(arg_names, arg_names + n_args);
+
+  const char *skeys[] = {"data", "softmax_label"};
+  mx_uint indptr[] = {0, 4, 5};
+  mx_uint sdata[] = {bs, 1, 28, 28, bs};
+  mx_uint in_n = 0, out_n = 0, aux_n = 0;
+  const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+  const mx_uint **in_sh = nullptr, **out_sh = nullptr, **aux_sh = nullptr;
+  int complete = 0;
+  CHECK_RC(MXSymbolInferShape(net, 2, skeys, indptr, sdata, &in_n, &in_nd,
+                              &in_sh, &out_n, &out_nd, &out_sh, &aux_n,
+                              &aux_nd, &aux_sh, &complete));
+  if (!complete) {
+    std::fprintf(stderr, "shape inference incomplete\n");
+    return 1;
+  }
+  std::vector<std::vector<mx_uint>> shapes(in_n);
+  for (mx_uint i = 0; i < in_n; ++i)
+    shapes[i].assign(in_sh[i], in_sh[i] + in_nd[i]);
+
+  // ---- parameter init (uniform Xavier-ish) ----
+  std::mt19937 rng(0);
+  std::vector<NDArrayHandle> args(in_n), grads(in_n, nullptr);
+  std::vector<mx_uint> reqs(in_n, 0);
+  std::vector<size_t> sizes(in_n);
+  int data_idx = -1, label_idx = -1;
+  for (mx_uint i = 0; i < in_n; ++i) {
+    size_t total = 1;
+    for (mx_uint d : shapes[i]) total *= d;
+    sizes[i] = total;
+    if (names[i] == "data" || names[i] == "softmax_label") {
+      if (names[i] == "data") data_idx = i;
+      else label_idx = i;
+      args[i] = MakeND(shapes[i], std::vector<float>(total, 0.f));
+      continue;
+    }
+    size_t fan_in = shapes[i].size() > 1 ? total / shapes[i][0] : total;
+    float scale = std::sqrt(3.0f / static_cast<float>(fan_in));
+    std::uniform_real_distribution<float> dist(-scale, scale);
+    std::vector<float> w(total, 0.f);
+    bool is_bias = names[i].size() > 4 &&
+                   names[i].compare(names[i].size() - 4, 4, "bias") == 0;
+    if (!is_bias)
+      for (auto &v : w) v = dist(rng);
+    args[i] = MakeND(shapes[i], w);
+    grads[i] = MakeND(shapes[i], std::vector<float>(total, 0.f));
+    reqs[i] = 1;  // kWriteTo
+  }
+
+  ExecutorHandle exe = nullptr;
+  CHECK_RC(MXExecutorBind(net, 1, 0, in_n, args.data(), grads.data(),
+                          reqs.data(), 0, nullptr, &exe));
+
+  // ---- data iterator (hermetic synthetic MNIST) ----
+  const char *ikeys[] = {"batch_size", "num_synthetic", "seed"};
+  const char *ivals[] = {"64", "512", "1"};
+  DataIterHandle it = nullptr;
+  CHECK_RC(MXDataIterCreateIter("MNISTIter", 3, ikeys, ivals, &it));
+
+  // ---- optimizer (grads sum over batch -> rescale 1/bs) ----
+  const char *okeys[] = {"momentum", "rescale_grad"};
+  const char *ovals[] = {"0.9", "0.015625"};
+  OptimizerHandle opt = nullptr;
+  CHECK_RC(MXOptimizerCreateOptimizer("sgd", 2, okeys, ovals, &opt));
+
+  float acc = 0.f;
+  for (int epoch = 0; epoch < 6; ++epoch) {
+    CHECK_RC(MXDataIterBeforeFirst(it));
+    int more = 0, correct = 0, total = 0;
+    for (;;) {
+      CHECK_RC(MXDataIterNext(it, &more));
+      if (!more) break;
+      NDArrayHandle d = nullptr, l = nullptr;
+      CHECK_RC(MXDataIterGetData(it, &d));
+      CHECK_RC(MXDataIterGetLabel(it, &l));
+      std::vector<float> dat = ReadND(d, bs * 28 * 28);
+      std::vector<float> lab = ReadND(l, bs);
+      MXNDArrayFree(d);
+      MXNDArrayFree(l);
+      CHECK_RC(MXNDArraySyncCopyFromCPU(args[data_idx], dat.data(),
+                                        dat.size()));
+      CHECK_RC(MXNDArraySyncCopyFromCPU(args[label_idx], lab.data(),
+                                        lab.size()));
+      CHECK_RC(MXExecutorForward(exe, 1));
+      mx_uint n_out = 0;
+      NDArrayHandle *outs = nullptr;
+      CHECK_RC(MXExecutorOutputs(exe, &n_out, &outs));
+      std::vector<float> probs = ReadND(outs[0], bs * 10);
+      for (mx_uint i = 0; i < n_out; ++i) MXNDArrayFree(outs[i]);
+      for (mx_uint i = 0; i < bs; ++i) {
+        int am = 0;
+        for (int k = 1; k < 10; ++k)
+          if (probs[i * 10 + k] > probs[i * 10 + am]) am = k;
+        correct += (am == static_cast<int>(lab[i]));
+        ++total;
+      }
+      CHECK_RC(MXExecutorBackward(exe, 0, nullptr));
+      for (mx_uint i = 0; i < in_n; ++i)
+        if (reqs[i])
+          CHECK_RC(MXOptimizerUpdate(opt, i, args[i], grads[i], 0.1f, 0.f));
+    }
+    acc = static_cast<float>(correct) / static_cast<float>(total);
+    std::printf("epoch %d train-accuracy %.4f\n", epoch, acc);
+    if (acc > 0.95f) break;
+  }
+
+  MXExecutorFree(exe);
+  MXDataIterFree(it);
+  MXOptimizerFree(opt);
+  MXSymbolFree(net);
+  if (acc <= 0.9f) {
+    std::fprintf(stderr, "training failed: accuracy %.4f\n", acc);
+    return 1;
+  }
+  std::printf("C++ binding: LeNet trained through libc_api.so OK\n");
+  return 0;
+}

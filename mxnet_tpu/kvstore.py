@@ -204,8 +204,16 @@ class KVStore:
 
     def send_command_to_servers(self, head, body):
         """ref: kvstore.py:318. No server processes exist on TPU; commands
-        apply locally (matching single-process reference behavior)."""
-        if head == 0:  # kController optimizer command
+        apply locally (matching single-process reference behavior). A
+        controller installed by MXKVStoreRunServer takes precedence, as
+        the reference's server-side controller would."""
+        ctrl = getattr(self, "_server_controller", None)
+        if ctrl is not None:
+            ctrl(head, body)
+            return
+        if head == 0:  # kController optimizer command (body is a pickle)
+            if isinstance(body, str):
+                body = body.encode("latin-1")
             self.set_optimizer(pickle.loads(body))
 
     def get_num_dead_node(self, node_id, timeout=60):
@@ -215,7 +223,8 @@ class KVStore:
 
     @property
     def barrier_before_exit(self):
-        return True
+        """ref: kvstore.h:194 — settable via MXKVStoreSetBarrierBeforeExit."""
+        return getattr(self, "_barrier_before_exit", True)
 
     def save_optimizer_states(self, fname):
         assert self._optimizer is not None

@@ -40,6 +40,8 @@ class KVStoreServer:
         """ref: kvstore_server.py:33 — head 0 carries a pickled
         optimizer; apply it like the server's updater installation."""
         if cmd_id == 0:
+            if isinstance(cmd_body, str):
+                cmd_body = cmd_body.encode("latin-1")
             optimizer = pickle.loads(cmd_body)
             self.kvstore.set_optimizer(optimizer)
         else:

@@ -113,6 +113,67 @@ def get_all_registered():
     return dict(_CUSTOM_REGISTRY)
 
 
+def register_custom_c_op(op_type, fns):
+    """Register a custom op whose kernels are foreign-language callbacks
+    (the C ABI's MXCustomOpRegister, ref: c_api.h:1418 + custom-inl.h).
+
+    fns keys:
+      num_inputs, num_outputs : ints
+      forward(in_nps, out_nps) : fill the output numpy arrays (f32)
+      backward(out_grad_nps, in_nps, in_grad_nps) : optional
+      infer_shape(in_shapes) -> (in_shapes, out_shapes) : optional;
+          default gives every output input[0]'s shape
+    The op becomes usable as sym.Custom(..., op_type=op_type), same as
+    Python-registered CustomOpProps.
+    """
+    num_in = int(fns.get("num_inputs", 1))
+    num_out = int(fns.get("num_outputs", 1))
+
+    class _CCallbackOp(CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            ins = [_np.asarray(a.asnumpy(), _np.float32) for a in in_data]
+            outs = [_np.zeros(a.asnumpy().shape, _np.float32) for a in out_data]
+            fns["forward"](ins, outs)
+            for i, o in enumerate(outs):
+                self.assign(out_data[i], req[i], o)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            bwd = fns.get("backward")
+            if bwd is None:
+                raise MXNetError(
+                    "custom C op %r declares no backward" % op_type)
+            ogs = [_np.asarray(a.asnumpy(), _np.float32) for a in out_grad]
+            ins = [_np.asarray(a.asnumpy(), _np.float32) for a in in_data]
+            igs = [_np.zeros(a.asnumpy().shape, _np.float32) for a in in_grad]
+            bwd(ogs, ins, igs)
+            for i, g in enumerate(igs):
+                self.assign(in_grad[i], req[i], g)
+
+    class _CCallbackProp(CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=bool(fns.get("need_top_grad", True)))
+
+        def list_arguments(self):
+            return ["data%d" % i for i in range(num_in)] if num_in != 1 else ["data"]
+
+        def list_outputs(self):
+            return (["output%d" % i for i in range(num_out)]
+                    if num_out != 1 else ["output"])
+
+        def infer_shape(self, in_shape):
+            f = fns.get("infer_shape")
+            if f is None:
+                return in_shape, [in_shape[0]] * num_out, []
+            ins, outs = f([list(s) for s in in_shape])
+            return ins, outs, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _CCallbackOp()
+
+    _CUSTOM_REGISTRY[op_type] = _CCallbackProp
+    return 0
+
+
 def _custom_fwd(params, inputs, aux, is_train, rng):
     import jax
     import jax.numpy as jnp
