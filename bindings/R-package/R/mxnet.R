@@ -1,0 +1,46 @@
+# R frontend over the .Call glue in src/mxnet_r.cc (role of the
+# reference's R-package/R/*.R over its Rcpp modules).
+
+#' Create an NDArray from an R array.
+#' R arrays are column-major; the framework is row-major, so dims are
+#' reversed and the data transposed on the way in (and back on the way
+#' out) — same convention as the reference R binding.
+mx.nd.array <- function(x) {
+  d <- dim(x)
+  if (is.null(d)) d <- length(x)
+  xt <- aperm(array(as.double(x), dim = d), rev(seq_along(d)))
+  .Call("MXR_NDCreate", as.double(xt), as.integer(rev(d)),
+        PACKAGE = "mxnet")
+}
+
+#' Copy an NDArray back into an R array.
+as.array.MXNDArray <- function(h) {
+  flat <- .Call("MXR_NDAsArray", h, PACKAGE = "mxnet")
+  d <- dim(flat)
+  aperm(array(flat, dim = rev(d)), rev(seq_along(d)))
+}
+
+#' Load a checkpoint (prefix-symbol.json + prefix-%04d.params).
+mx.model.load <- function(prefix, epoch) {
+  json <- paste(readLines(sprintf("%s-symbol.json", prefix)),
+                collapse = "\n")
+  params <- readBin(sprintf("%s-%04d.params", prefix, epoch), what = "raw",
+                    n = file.size(sprintf("%s-%04d.params", prefix, epoch)))
+  structure(list(symbol = json, params = params), class = "mx.model")
+}
+
+#' Predict: batch is an R array with dims (H, W, C, N) image-style or
+#' any row-major-compatible layout; pass input.shape in framework order
+#' (N, C, H, W).
+predict.mx.model <- function(model, batch, input.shape) {
+  pred <- .Call("MXR_PredCreate", model$symbol, model$params,
+                as.integer(input.shape), PACKAGE = "mxnet")
+  xt <- aperm(batch, rev(seq_along(dim(batch))))
+  out <- .Call("MXR_PredForward", pred, as.double(xt), PACKAGE = "mxnet")
+  aperm(array(out, dim = rev(dim(out))), rev(seq_along(dim(out))))
+}
+
+#' Round-trip a symbol's JSON through the graph loader (validation).
+mx.symbol.load.json <- function(json) {
+  .Call("MXR_SymbolLoadJSON", json, PACKAGE = "mxnet")
+}

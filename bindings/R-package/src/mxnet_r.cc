@@ -1,0 +1,173 @@
+// R glue over the flat C ABI (ref: R-package/src/ndarray.cc et al. play
+// this role over libmxnet; here the .Call interface wraps libc_api.so).
+// Built by R CMD INSTALL via src/Makevars; uses only Rinternals.h (no
+// Rcpp dependency, unlike the reference) so the package needs nothing
+// beyond a stock R toolchain.
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../../include/c_api.h"
+#include "../../../include/c_predict_api.h"
+
+namespace {
+
+void FinalizeND(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    MXNDArrayFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+void FinalizePred(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    MXPredFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+void CheckRC(int rc, const char *what) {
+  if (rc != 0) Rf_error("%s failed: %s", what, MXGetLastError());
+}
+
+}  // namespace
+
+extern "C" {
+
+// mx.nd.array: R numeric array (with dim attr) -> NDArrayHandle extptr.
+SEXP MXR_NDCreate(SEXP data, SEXP dim) {
+  int ndim = Rf_length(dim);
+  std::vector<mx_uint> shape(ndim);
+  // R is column-major; the framework is row-major. The R wrapper
+  // passes dims reversed and data transposed (see R/ndarray.R).
+  for (int i = 0; i < ndim; ++i) shape[i] = (mx_uint)INTEGER(dim)[i];
+  NDArrayHandle h = nullptr;
+  CheckRC(MXNDArrayCreate(shape.data(), ndim, 1, 0, 0, &h),
+          "MXNDArrayCreate");
+  size_t n = (size_t)Rf_length(data);
+  std::vector<float> buf(n);
+  const double *src = REAL(data);
+  for (size_t i = 0; i < n; ++i) buf[i] = (float)src[i];
+  CheckRC(MXNDArraySyncCopyFromCPU(h, buf.data(), n),
+          "MXNDArraySyncCopyFromCPU");
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, FinalizeND, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+// as.array: NDArrayHandle -> R numeric vector + dim attribute.
+SEXP MXR_NDAsArray(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h == nullptr) Rf_error("null NDArray handle");
+  mx_uint ndim = 0;
+  const mx_uint *shape = nullptr;
+  CheckRC(MXNDArrayGetShape(h, &ndim, &shape), "MXNDArrayGetShape");
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+  std::vector<float> buf(n);
+  CheckRC(MXNDArraySyncCopyToCPU(h, buf.data(), n),
+          "MXNDArraySyncCopyToCPU");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)n));
+  for (size_t i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  SEXP dim = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(dim)[i] = (int)shape[i];
+  Rf_setAttrib(out, R_DimSymbol, dim);
+  UNPROTECT(2);
+  return out;
+}
+
+// mx.nd.save / mx.nd.load round-trip via the shared binary format.
+SEXP MXR_NDSave(SEXP fname, SEXP handles, SEXP names) {
+  int n = Rf_length(handles);
+  bool named = !Rf_isNull(names);
+  std::vector<NDArrayHandle> hs(n);
+  std::vector<const char *> ks(n);
+  for (int i = 0; i < n; ++i) {
+    hs[i] = R_ExternalPtrAddr(VECTOR_ELT(handles, i));
+    if (named) ks[i] = CHAR(STRING_ELT(names, i));
+  }
+  CheckRC(MXNDArraySave(CHAR(STRING_ELT(fname, 0)), n, hs.data(),
+                        named ? ks.data() : nullptr),
+          "MXNDArraySave");
+  return R_NilValue;
+}
+
+// mx.predict: create-or-reuse predictor, set input, forward, output 0.
+SEXP MXR_PredCreate(SEXP symbol_json, SEXP param_raw, SEXP input_shape) {
+  int ndim = Rf_length(input_shape);
+  std::vector<mx_uint> shape(ndim);
+  for (int i = 0; i < ndim; ++i) shape[i] = (mx_uint)INTEGER(input_shape)[i];
+  std::vector<mx_uint> indptr = {0, (mx_uint)ndim};
+  const char *keys[] = {"data"};
+  PredictorHandle h = nullptr;
+  CheckRC(MXPredCreate(CHAR(STRING_ELT(symbol_json, 0)), RAW(param_raw),
+                       Rf_length(param_raw), 1, 0, 1, keys, indptr.data(),
+                       shape.data(), &h),
+          "MXPredCreate");
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, FinalizePred, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP MXR_PredForward(SEXP ptr, SEXP data) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h == nullptr) Rf_error("null predictor handle");
+  size_t n = (size_t)Rf_length(data);
+  std::vector<float> buf(n);
+  for (size_t i = 0; i < n; ++i) buf[i] = (float)REAL(data)[i];
+  CheckRC(MXPredSetInput(h, "data", buf.data(), (mx_uint)n),
+          "MXPredSetInput");
+  CheckRC(MXPredForward(h), "MXPredForward");
+  mx_uint *oshape = nullptr, ondim = 0;
+  CheckRC(MXPredGetOutputShape(h, 0, &oshape, &ondim),
+          "MXPredGetOutputShape");
+  size_t on = 1;
+  for (mx_uint i = 0; i < ondim; ++i) on *= oshape[i];
+  std::vector<float> out(on);
+  CheckRC(MXPredGetOutput(h, 0, out.data(), (mx_uint)on),
+          "MXPredGetOutput");
+  SEXP r = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)on));
+  for (size_t i = 0; i < on; ++i) REAL(r)[i] = out[i];
+  SEXP dim = PROTECT(Rf_allocVector(INTSXP, ondim));
+  for (mx_uint i = 0; i < ondim; ++i) INTEGER(dim)[i] = (int)oshape[i];
+  Rf_setAttrib(r, R_DimSymbol, dim);
+  UNPROTECT(2);
+  return r;
+}
+
+// symbol json load (file) — returns the json text for R-side storage.
+SEXP MXR_SymbolLoadJSON(SEXP json) {
+  SymbolHandle h = nullptr;
+  CheckRC(MXSymbolCreateFromJSON(CHAR(STRING_ELT(json, 0)), &h),
+          "MXSymbolCreateFromJSON");
+  const char *out = nullptr;
+  CheckRC(MXSymbolSaveToJSON(h, &out), "MXSymbolSaveToJSON");
+  SEXP r = PROTECT(Rf_mkString(out));
+  MXSymbolFree(h);
+  UNPROTECT(1);
+  return r;
+}
+
+static const R_CallMethodDef CallEntries[] = {
+    {"MXR_NDCreate", (DL_FUNC)&MXR_NDCreate, 2},
+    {"MXR_NDAsArray", (DL_FUNC)&MXR_NDAsArray, 1},
+    {"MXR_NDSave", (DL_FUNC)&MXR_NDSave, 3},
+    {"MXR_PredCreate", (DL_FUNC)&MXR_PredCreate, 3},
+    {"MXR_PredForward", (DL_FUNC)&MXR_PredForward, 2},
+    {"MXR_SymbolLoadJSON", (DL_FUNC)&MXR_SymbolLoadJSON, 1},
+    {NULL, NULL, 0}};
+
+void R_init_mxnet(DllInfo *dll) {
+  R_registerRoutines(dll, NULL, CallEntries, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

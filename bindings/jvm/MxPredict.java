@@ -1,0 +1,94 @@
+// JVM binding example: the predict API over libc_api.so via JNA —
+// the role of the reference's scala-package JNI shim (SURVEY §2.18),
+// without a hand-written native layer (JNA maps the C ABI directly).
+//
+// Build/run (needs jna.jar on the classpath; JDK not present in this
+// dev image, so this file is validated structurally):
+//   javac -cp jna.jar MxPredict.java
+//   PYTHONPATH=<repo> java -cp jna.jar:. MxPredict model/lenet 10
+//
+// The library embeds CPython; PYTHONPATH must point at the repo root.
+
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+import com.sun.jna.Pointer;
+import com.sun.jna.ptr.PointerByReference;
+import com.sun.jna.ptr.IntByReference;
+
+import java.nio.file.Files;
+import java.nio.file.Paths;
+
+public class MxPredict {
+
+  public interface CApi extends Library {
+    String MXGetLastError();
+
+    int MXPredCreate(String symbolJson, byte[] paramBytes, int paramSize,
+                     int devType, int devId, int numInputNodes,
+                     String[] inputKeys, int[] inputShapeIndptr,
+                     int[] inputShapeData, PointerByReference out);
+
+    int MXPredSetInput(Pointer handle, String key, float[] data, int size);
+
+    int MXPredForward(Pointer handle);
+
+    int MXPredGetOutputShape(Pointer handle, int index,
+                             PointerByReference shapeData,
+                             IntByReference shapeNdim);
+
+    int MXPredGetOutput(Pointer handle, int index, float[] data, int size);
+
+    int MXPredFree(Pointer handle);
+  }
+
+  static void check(CApi api, int rc, String what) {
+    if (rc != 0)
+      throw new RuntimeException(what + " failed: " + api.MXGetLastError());
+  }
+
+  public static void main(String[] args) throws Exception {
+    String prefix = args.length > 0 ? args[0] : "lenet";
+    int epoch = args.length > 1 ? Integer.parseInt(args[1]) : 10;
+
+    CApi api = Native.load("c_api", CApi.class);
+
+    String json = new String(
+        Files.readAllBytes(Paths.get(prefix + "-symbol.json")));
+    byte[] params = Files.readAllBytes(
+        Paths.get(String.format("%s-%04d.params", prefix, epoch)));
+
+    int batch = 1;
+    int[] indptr = {0, 4};
+    int[] shape = {batch, 1, 28, 28};
+    PointerByReference pred = new PointerByReference();
+    check(api, api.MXPredCreate(json, params, params.length, /*cpu=*/1, 0,
+                                1, new String[] {"data"}, indptr, shape,
+                                pred),
+          "MXPredCreate");
+
+    float[] input = new float[batch * 28 * 28];  // zeros: smoke input
+    check(api, api.MXPredSetInput(pred.getValue(), "data", input,
+                                  input.length),
+          "MXPredSetInput");
+    check(api, api.MXPredForward(pred.getValue()), "MXPredForward");
+
+    PointerByReference sd = new PointerByReference();
+    IntByReference snd = new IntByReference();
+    check(api, api.MXPredGetOutputShape(pred.getValue(), 0, sd, snd),
+          "MXPredGetOutputShape");
+    int[] oshape = sd.getValue().getIntArray(0, snd.getValue());
+    int n = 1;
+    for (int d : oshape) n *= d;
+
+    float[] out = new float[n];
+    check(api, api.MXPredGetOutput(pred.getValue(), 0, out, n),
+          "MXPredGetOutput");
+
+    int arg = 0;
+    for (int i = 1; i < out.length; ++i)
+      if (out[i] > out[arg]) arg = i;
+    System.out.println("predicted class: " + arg);
+
+    api.MXPredFree(pred.getValue());
+  }
+}

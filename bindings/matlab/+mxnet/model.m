@@ -1,0 +1,136 @@
+classdef model < handle
+%MODEL A predict-only handle over the TPU-native framework's C predict
+% API — the same surface the reference's matlab/+mxnet/model.m exposes
+% over libmxnet's c_predict_api (load a -symbol.json / -NNNN.params
+% checkpoint pair, run forward, fetch outputs).
+%
+% Device codes: 1 = cpu, 6 = tpu (include/c_api.h).
+%
+% Example:
+%   m = mxnet.model;
+%   m.load('model/lenet', 10);         % lenet-symbol.json + lenet-0010.params
+%   out = m.forward(img);              % img: H x W [x C x N] single/double
+%   out = m.forward(img, 'device', 'tpu', 0);
+
+properties
+  symbol   % symbol JSON text
+  params   % raw bytes of the .params file
+  verbose
+end
+
+properties (Access = private)
+  predictor
+  prev_input_size
+  prev_dev_type
+  prev_dev_id
+end
+
+methods
+  function obj = model()
+    obj.predictor = libpointer('voidPtr', 0);
+    obj.prev_input_size = [];
+    obj.verbose = 1;
+    obj.prev_dev_type = -1;
+    obj.prev_dev_id = -1;
+  end
+
+  function delete(obj)
+    obj.free_predictor();
+  end
+
+  function load(obj, model_prefix, num_epoch)
+  %LOAD read a checkpoint saved by save_checkpoint / FeedForward.save
+  % (prefix-symbol.json + prefix-%04d.params — same format as the
+  % reference, model.py save_checkpoint).
+    obj.symbol = fileread([model_prefix, '-symbol.json']);
+    fid = fopen(sprintf('%s-%04d.params', model_prefix, num_epoch), 'rb');
+    assert(fid ~= -1, 'cannot open params file');
+    obj.params = fread(fid, inf, '*uint8');
+    fclose(fid);
+  end
+
+  function outputs = forward(obj, input, varargin)
+  %FORWARD run the network on a batch of inputs.
+  %
+  % MATLAB images are W x H x C x N column-major; the framework wants
+  % N x C x H x W row-major — permuting dims [2 1 3 4] and reversing
+  % the shape vector gives the right memory order, exactly the
+  % transform the reference's model.m documents.
+    dev_type = 1;  % cpu
+    dev_id = 0;
+    i = 1;
+    while i <= numel(varargin)
+      switch lower(varargin{i})
+        case 'device'
+          assert(i + 2 <= numel(varargin) + 1);
+          if strcmpi(varargin{i+1}, 'tpu') || strcmpi(varargin{i+1}, 'gpu')
+            dev_type = 6;
+          end
+          dev_id = varargin{i+2};
+          i = i + 3;
+        otherwise
+          error('unknown option %s', varargin{i});
+      end
+    end
+
+    siz = size(input);
+    if numel(siz) < 4
+      siz = [siz, ones(1, 4 - numel(siz))];
+    end
+    input = permute(input, [2 1 3 4]);
+    input_size = siz([4 3 1 2]);  % N C H W
+
+    if isempty(obj.prev_input_size) || any(obj.prev_input_size ~= input_size) ...
+       || dev_type ~= obj.prev_dev_type || dev_id ~= obj.prev_dev_id
+      obj.free_predictor();
+    end
+    obj.prev_input_size = input_size;
+    obj.prev_dev_type = dev_type;
+    obj.prev_dev_id = dev_id;
+
+    if obj.predictor.Value == 0
+      if obj.verbose
+        fprintf('create predictor with input size ');
+        fprintf('%d ', input_size);
+        fprintf('\n');
+      end
+      csize = uint32(input_size);
+      callmxnet('MXPredCreate', obj.symbol, ...
+                libpointer('voidPtr', obj.params), ...
+                int32(numel(obj.params)), ...
+                int32(dev_type), int32(dev_id), ...
+                uint32(1), {'data'}, ...
+                uint32([0, 4]), csize, ...
+                obj.predictor);
+    end
+
+    callmxnet('MXPredSetInput', obj.predictor, 'data', ...
+              single(input(:)), uint32(numel(input)));
+    callmxnet('MXPredForward', obj.predictor);
+
+    % output 0
+    out_dim = libpointer('uint32Ptr', 0);
+    out_shape = libpointer('uint32PtrPtr', zeros(4, 1));
+    callmxnet('MXPredGetOutputShape', obj.predictor, uint32(0), ...
+              out_shape, out_dim);
+    setdatatype(out_shape.Value, 'uint32Ptr', out_dim.Value);
+    osize = double(out_shape.Value.Value);
+    n = prod(osize);
+    outputs = libpointer('singlePtr', single(zeros(n, 1)));
+    callmxnet('MXPredGetOutput', obj.predictor, uint32(0), ...
+              outputs, uint32(n));
+    % row-major -> column-major
+    outputs = reshape(outputs.Value, fliplr(osize(:)'));
+    outputs = permute(outputs, numel(osize):-1:1);
+  end
+end
+
+methods (Access = private)
+  function free_predictor(obj)
+    if obj.predictor.Value ~= 0
+      callmxnet('MXPredFree', obj.predictor);
+      obj.predictor = libpointer('voidPtr', 0);
+    end
+  end
+end
+end

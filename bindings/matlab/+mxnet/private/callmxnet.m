@@ -1,0 +1,30 @@
+function callmxnet(func, varargin)
+%CALLMXNET invoke a libc_api entry point, asserting rc == 0.
+%
+% Loads the TPU-native framework's C library on first use. The library
+% embeds CPython, so MXNETTPU_PYTHONPATH (or the repo root two levels up
+% from this file) must point at the package for the embedded interpreter.
+% ref behavior: matlab/+mxnet/private/callmxnet.m in the reference wraps
+% libmxnet the same way.
+
+if ~libisloaded('libc_api')
+  here = fileparts(mfilename('fullpath'));
+  root = fullfile(here, '..', '..', '..', '..');  % repo root
+  libdir = fullfile(root, 'mxnet_tpu', '_native');
+  header = fullfile(root, 'include', 'c_predict_api.h');
+  assert(exist(fullfile(libdir, 'libc_api.so'), 'file') == 2, ...
+         'build the native library first (python -c "from mxnet_tpu import _native; _native.load(''c_api'')")');
+  assert(exist(header, 'file') == 2, 'missing include/c_predict_api.h');
+  % the embedded interpreter resolves mxnet_tpu from PYTHONPATH
+  if isempty(getenv('PYTHONPATH'))
+    setenv('PYTHONPATH', root);
+  end
+  [err, warn] = loadlibrary(fullfile(libdir, 'libc_api'), header);
+  assert(isempty(err));
+  if warn, warn, end %#ok<NOPRT>
+end
+
+assert(ischar(func))
+ret = calllib('libc_api', func, varargin{:});
+assert(ret == 0, 'mxnet call %s failed', func);
+end

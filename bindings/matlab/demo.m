@@ -1,0 +1,19 @@
+%% Demo: predict with a trained checkpoint from MATLAB
+% Train any model with the Python frontend first, e.g.
+%   model.save('lenet', 10)
+% then classify a batch from MATLAB (ref: matlab/demo.m workflow).
+
+clear model
+model = mxnet.model;
+model.load('lenet', 10);
+
+% a batch of 28x28 grayscale images, W x H x C x N
+img = rand(28, 28, 1, 4, 'single');
+
+pred = model.forward(img);
+[~, cls] = max(pred, [], 2);
+fprintf('predicted classes: ');
+fprintf('%d ', cls - 1);
+fprintf('\n');
+
+% TPU inference: model.forward(img, 'device', 'tpu', 0)

@@ -330,12 +330,17 @@ def symbol_grad(sym, wrt):
 
 
 def symbol_infer_shape_partial(sym, keys, shapes):
+    """Returns (arg, out, aux, complete) — unknown shapes become () rows
+    and complete is 0 when any remain (matching the reference's
+    MXSymbolInferShapePartial complete flag)."""
     kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
     arg, out, aux = sym.infer_shape_partial(**kwargs)
     if arg is None:
         return None
+    complete = int(all(
+        s is not None for grp in (arg, out, aux) for s in grp))
     fix = lambda ss: [tuple(map(int, s)) if s is not None else () for s in ss]
-    return (fix(arg), fix(out), fix(aux))
+    return (fix(arg), fix(out), fix(aux), complete)
 
 
 def symbol_infer_type(sym, keys, type_codes):
@@ -576,16 +581,12 @@ def kvstore_set_barrier_before_exit(kv, flag):
 
 
 def kvstore_run_server(kv, pyfn):
-    """Server loop; pyfn(head, body) receives controller commands
-    (ref: MXKVStoreRunServer → KVStore::RunServer). With no server role
-    (SURVEY §5.8 redesign) the controller is installed so subsequent
-    SendCommandToServers calls reach it, then run() returns."""
-    from .kvstore_server import KVStoreServer
-
-    server = KVStoreServer(kv)
+    """ref: MXKVStoreRunServer → KVStore::RunServer. With no server role
+    (SURVEY §5.8 redesign) there is no event loop to block in; the call
+    installs the controller so subsequent SendCommandToServers calls
+    reach it, then returns — matching KVStoreServer.run()'s no-op."""
     if pyfn is not None:
         kv._server_controller = pyfn
-    server.run()
     return 0
 
 

@@ -923,6 +923,15 @@ PyObject *CustomShapeTramp(PyObject *self, PyObject *args) {
 PyMethodDef custom_shape_def = {"custom_infer_shape", CustomShapeTramp,
                                 METH_VARARGS, nullptr};
 
+// Common pattern: call impl fn, hand the new reference to the caller as
+// an opaque handle. Caller must hold the GIL (GILGuard).
+int CallNewRef(const char *fn, PyObject *args, void **out) {
+  PyObject *r = CallImpl(fn, args);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
 // Common pattern: call impl fn with (handle,) and discard result.
 int CallHandleNoRet(const char *fn, void *handle) {
   GILGuard g;
@@ -961,11 +970,9 @@ extern "C" {
 
 int MXSymbolCopy(SymbolHandle handle, SymbolHandle *out) {
   GILGuard g;
-  PyObject *h = static_cast<PyObject *>(handle);
-  PyObject *r = CallImpl("symbol_copy", Py_BuildValue("(O)", h));
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("symbol_copy",
+                    Py_BuildValue("(O)", static_cast<PyObject *>(handle)),
+                    out);
 }
 
 int MXSymbolPrint(SymbolHandle handle, const char **out_str) {
@@ -1039,28 +1046,21 @@ int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
   GILGuard g;
   PyObject *t = PyTuple_New(1);
   PyTuple_SET_ITEM(t, 0, HandleList(symbols, num_symbols));
-  PyObject *r = CallImpl("symbol_create_group", t);
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("symbol_create_group", t, out);
 }
 
 int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle *out) {
   GILGuard g;
-  PyObject *h = static_cast<PyObject *>(handle);
-  PyObject *r = CallImpl("symbol_get_internals", Py_BuildValue("(O)", h));
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("symbol_get_internals",
+                    Py_BuildValue("(O)", static_cast<PyObject *>(handle)),
+                    out);
 }
 
 int MXSymbolGetOutput(SymbolHandle handle, mx_uint index, SymbolHandle *out) {
   GILGuard g;
-  PyObject *h = static_cast<PyObject *>(handle);
-  PyObject *r = CallImpl("symbol_get_output", Py_BuildValue("(OI)", h, index));
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef(
+      "symbol_get_output",
+      Py_BuildValue("(OI)", static_cast<PyObject *>(handle), index), out);
 }
 
 int MXSymbolGrad(SymbolHandle handle, mx_uint num_wrt, const char **wrt,
@@ -1182,8 +1182,12 @@ static int InferShapeCommon(const char *implfn, SymbolHandle handle,
     *ndims[grp] = nd.data();
     *datas[grp] = ptrs.data();
   }
+  // partial inference returns a 4th element: the complete flag
+  // (unknown shapes are rank-0 rows); the full path's 3-tuple means done
+  *complete = PyTuple_Size(r) >= 4
+                  ? static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)))
+                  : 1;
   Py_DECREF(r);
-  *complete = 1;
   return 0;
 }
 
@@ -1541,10 +1545,7 @@ int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
 
 int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
   GILGuard g;
-  PyObject *r = CallImpl("kvstore_create", Py_BuildValue("(s)", type));
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("kvstore_create", Py_BuildValue("(s)", type), out);
 }
 
 int MXKVStoreFree(KVStoreHandle handle) {
@@ -1707,10 +1708,8 @@ int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number,
 
 int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
   GILGuard g;
-  PyObject *r = CallImpl("recordio_writer_create", Py_BuildValue("(s)", uri));
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("recordio_writer_create", Py_BuildValue("(s)", uri),
+                    out);
 }
 
 int MXRecordIOWriterFree(RecordIOHandle handle) {
@@ -1755,10 +1754,8 @@ int MXRecordIOWriterTell(RecordIOHandle *handle, size_t *pos) {
 
 int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
   GILGuard g;
-  PyObject *r = CallImpl("recordio_reader_create", Py_BuildValue("(s)", uri));
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("recordio_reader_create", Py_BuildValue("(s)", uri),
+                    out);
 }
 
 int MXRecordIOReaderFree(RecordIOHandle *handle) {
@@ -1870,10 +1867,7 @@ int MXOptimizerCreateOptimizer(const char *creator, mx_uint num_param,
   PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(creator));
   PyTuple_SET_ITEM(t, 1, StrList(keys, num_param));
   PyTuple_SET_ITEM(t, 2, StrList(vals, num_param));
-  PyObject *r = CallImpl("optimizer_create", t);
-  if (r == nullptr) return HandleException();
-  *out = r;
-  return 0;
+  return CallNewRef("optimizer_create", t, out);
 }
 
 int MXOptimizerFree(OptimizerHandle handle) {

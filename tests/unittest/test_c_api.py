@@ -797,3 +797,25 @@ def test_c_api_custom_op_infer_shape_callback(lib):
     exe = out.bind(mx.cpu(0), {"data": mx.nd.array(x)}, grad_req="null")
     exe.forward(is_train=False)
     np.testing.assert_allclose(exe.outputs[0].asnumpy(), x.sum(1, keepdims=True))
+
+
+def test_c_api_infer_shape_partial_complete_flag(lib):
+    """Partial inference with unknowns must report complete=0 (the
+    reference's MXSymbolInferShapePartial contract)."""
+    sym = mx.sym.FullyConnected(data=mx.sym.Variable("data"), num_hidden=4)
+    h = ctypes.c_void_p()
+    check(lib, lib.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                          ctypes.byref(h)))
+    indptr = (ctypes.c_uint * 1)(0)
+    sz = [ctypes.c_uint() for _ in range(3)]
+    nd = [c_uint_p() for _ in range(3)]
+    da = [ctypes.POINTER(c_uint_p)() for _ in range(3)]
+    comp = ctypes.c_int(-1)
+    check(lib, lib.MXSymbolInferShapePartial(
+        h, 0, None, indptr, None,
+        ctypes.byref(sz[0]), ctypes.byref(nd[0]), ctypes.byref(da[0]),
+        ctypes.byref(sz[1]), ctypes.byref(nd[1]), ctypes.byref(da[1]),
+        ctypes.byref(sz[2]), ctypes.byref(nd[2]), ctypes.byref(da[2]),
+        ctypes.byref(comp)))
+    assert comp.value == 0
+    lib.MXSymbolFree(h)
