@@ -2,22 +2,22 @@
 # reference's R-package/R/*.R over its Rcpp modules).
 
 #' Create an NDArray from an R array.
-#' R arrays are column-major; the framework is row-major, so dims are
-#' reversed and the data transposed on the way in (and back on the way
-#' out) — same convention as the reference R binding.
+#' R memory is column-major: the SAME buffer read row-major has shape
+#' rev(dim(x)), so the framework array gets reversed dims and the raw
+#' buffer untouched — the reference R binding's convention. A (H, W, C,
+#' N) R image batch therefore lands as an (N, C, W, H) framework array.
 mx.nd.array <- function(x) {
   d <- dim(x)
   if (is.null(d)) d <- length(x)
-  xt <- aperm(array(as.double(x), dim = d), rev(seq_along(d)))
-  .Call("MXR_NDCreate", as.double(xt), as.integer(rev(d)),
+  .Call("MXR_NDCreate", as.double(x), as.integer(rev(d)),
         PACKAGE = "mxnet")
 }
 
-#' Copy an NDArray back into an R array.
+#' Copy an NDArray back into an R array (dims reversed, buffer shared
+#' semantics as above — inverse of mx.nd.array).
 as.array.MXNDArray <- function(h) {
   flat <- .Call("MXR_NDAsArray", h, PACKAGE = "mxnet")
-  d <- dim(flat)
-  aperm(array(flat, dim = rev(d)), rev(seq_along(d)))
+  array(as.vector(flat), dim = rev(dim(flat)))
 }
 
 #' Load a checkpoint (prefix-symbol.json + prefix-%04d.params).
@@ -29,15 +29,19 @@ mx.model.load <- function(prefix, epoch) {
   structure(list(symbol = json, params = params), class = "mx.model")
 }
 
-#' Predict: batch is an R array with dims (H, W, C, N) image-style or
-#' any row-major-compatible layout; pass input.shape in framework order
-#' (N, C, H, W).
+#' Predict. `batch` must be an R array whose REVERSED dims equal
+#' `input.shape` (framework order N, C, H, W) — e.g. a (W, H, C, N)
+#' image batch, the same W-and-H-swapped convention as the MATLAB
+#' binding. The raw column-major buffer is passed through unchanged;
+#' the result comes back with dims reversed the same way.
 predict.mx.model <- function(model, batch, input.shape) {
+  d <- dim(batch)
+  stopifnot(identical(as.integer(rev(d)), as.integer(input.shape)))
   pred <- .Call("MXR_PredCreate", model$symbol, model$params,
                 as.integer(input.shape), PACKAGE = "mxnet")
-  xt <- aperm(batch, rev(seq_along(dim(batch))))
-  out <- .Call("MXR_PredForward", pred, as.double(xt), PACKAGE = "mxnet")
-  aperm(array(out, dim = rev(dim(out))), rev(seq_along(dim(out))))
+  out <- .Call("MXR_PredForward", pred, as.double(batch),
+               PACKAGE = "mxnet")
+  array(as.vector(out), dim = rev(dim(out)))
 }
 
 #' Round-trip a symbol's JSON through the graph loader (validation).

@@ -38,6 +38,8 @@ def _extra_flags(name):
                  or "%d.%d" % tuple(__import__("sys").version_info[:2]))
         return ["-I" + inc, "-L" + libdir, "-lpython" + ldver,
                 "-Wl,-rpath," + libdir]
+    if name == "imagedec":
+        return ["-ljpeg"]
     return []
 
 

@@ -471,7 +471,10 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_img=None, mean_r=0, mean_g=0, mean_b=0, scale=1.0,
                  round_batch=True, prefetch_depth=4, seed=0,
-                 num_parts=1, part_index=0, preprocess_threads=4, **kwargs):
+                 num_parts=1, part_index=0, preprocess_threads=4,
+                 max_random_scale=1.0, min_random_scale=1.0,
+                 max_aspect_ratio=0.0, random_h=0, random_s=0, random_l=0,
+                 **kwargs):
         super().__init__()
         from . import recordio as _recordio
 
@@ -483,6 +486,15 @@ class ImageRecordIter(DataIter):
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.scale = scale
+        # scale/aspect/color jitter (ref: image_aug_default.cc params;
+        # random_h in degrees [0,180], random_s/random_l as cv HLS byte
+        # deltas [0,255] — converted to fractions for the HLS math)
+        self.max_random_scale = float(max_random_scale)
+        self.min_random_scale = float(min_random_scale)
+        self.max_aspect_ratio = float(max_aspect_ratio)
+        self.random_h = float(random_h)
+        self.random_s = float(random_s) / 255.0
+        self.random_l = float(random_l) / 255.0
         self.mean = None
         if mean_img is not None and os.path.exists(str(mean_img)):
             from .ndarray import load as _ndload
@@ -512,14 +524,26 @@ class ImageRecordIter(DataIter):
             self._records = self._records[: i // num_parts]
         self._order = _np.arange(len(self._records))
         self.cursor = -batch_size
-        # parallel JPEG decode, the OMP-worker role of the reference's
-        # ImageRecordIOParser (ref: src/io/iter_image_recordio.cc:150,
-        # `preprocess_threads` param); PIL releases the GIL while decoding
+        # Native decode+augment pipeline (src/imagedec.cc), the
+        # OMP-worker role of the reference's ImageRecordIOParser
+        # (ref: src/io/iter_image_recordio.cc:150, `preprocess_threads`).
+        # Falls back to a PIL thread pool when the native build is
+        # unavailable (GIL-bound, ~8x slower — see docs/perf_analysis.md).
+        self.preprocess_threads = max(1, int(preprocess_threads))
+        self._nlib = None
+        from . import _native
+
+        lib = _native.load("imagedec")
+        if lib is not None:
+            import ctypes
+
+            lib.ImgdecBatch.restype = ctypes.c_int
+            self._nlib = lib
         self._pool = None
-        if preprocess_threads and preprocess_threads > 1:
+        if self._nlib is None and self.preprocess_threads > 1:
             from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+            self._pool = ThreadPoolExecutor(max_workers=self.preprocess_threads)
 
     def __del__(self):
         pool = getattr(self, "_pool", None)
@@ -544,9 +568,52 @@ class ImageRecordIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor + self.batch_size <= len(self._records)
 
+    @staticmethod
+    def _hls_jitter(arr, dh, ds, dl):
+        """Vectorized RGB->HLS->RGB jitter on an HWC f32 [0,255] array
+        (dh in turns, ds/dl as fractions) — numpy port of the native
+        pipeline's per-pixel conversion (src/imagedec.cc)."""
+        rgb = arr.reshape(-1, 3) / 255.0
+        mx_ = rgb.max(axis=1)
+        mn = rgb.min(axis=1)
+        l = (mx_ + mn) / 2
+        d = mx_ - mn
+        nz = d > 1e-6
+        s = _np.zeros_like(l)
+        denom = _np.where(l > 0.5, 2.0 - mx_ - mn, mx_ + mn)
+        s[nz] = d[nz] / _np.maximum(denom[nz], 1e-12)
+        h = _np.zeros_like(l)
+        r, g, b = rgb[:, 0], rgb[:, 1], rgb[:, 2]
+        dd = _np.where(nz, d, 1.0)
+        is_r = nz & (mx_ == r)
+        is_g = nz & ~is_r & (mx_ == g)
+        is_b = nz & ~is_r & ~is_g
+        h[is_r] = _np.mod((g - b)[is_r] / dd[is_r], 6.0) / 6.0
+        h[is_g] = ((b - r)[is_g] / dd[is_g] + 2.0) / 6.0
+        h[is_b] = ((r - g)[is_b] / dd[is_b] + 4.0) / 6.0
+        h = _np.mod(h + dh, 1.0)
+        l = _np.clip(l + dl, 0.0, 1.0)
+        s = _np.clip(s + ds, 0.0, 1.0)
+        q = _np.where(l < 0.5, l * (1 + s), l + s - l * s)
+        p = 2 * l - q
+
+        def hue(t):
+            t = _np.mod(t, 1.0)
+            out = _np.where(t < 1 / 6, p + (q - p) * 6 * t, q)
+            out = _np.where(t >= 1 / 2,
+                            _np.where(t < 2 / 3,
+                                      p + (q - p) * (2 / 3 - t) * 6, p), out)
+            return out
+
+        out = _np.stack([hue(h + 1 / 3), hue(h), hue(h - 1 / 3)], axis=1)
+        out = _np.where(s[:, None] < 1e-6, l[:, None], out)
+        return (out * 255.0).reshape(arr.shape).astype(_np.float32)
+
     def _decode(self, s, aug):
-        """aug = (crop_rx, crop_ry, mirror_r) uniform floats drawn on the
-        iterator thread, so thread-pool decode stays deterministic."""
+        """PIL fallback path; aug = 8 uniforms (crop_scale, crop_aspect,
+        crop_x, crop_y, mirror, dh, ds, dl) drawn on the iterator thread
+        so thread-pool decode stays deterministic. Mirrors
+        src/imagedec.cc's augment order."""
         from . import recordio as _recordio
 
         header, img_bytes = _recordio.unpack(s)
@@ -559,14 +626,25 @@ class ImageRecordIter(DataIter):
         img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
         c, h, w = self.data_shape
         iw, ih = img.size
-        rx, ry, rm = aug
-        if self.rand_crop and (iw > w and ih > h):
-            x0 = int(rx * (iw - w + 1))
-            y0 = int(ry * (ih - h + 1))
-            img = img.crop((x0, y0, x0 + w, y0 + h))
-        else:
-            img = img.resize((w, h))
-        arr = _np.asarray(img, _np.float32).transpose(2, 0, 1)  # CHW, RGB
+        rsc, rar, rx, ry, rm, rh, rs, rl = aug
+        if self.rand_crop:
+            s_ = self.min_random_scale + (
+                self.max_random_scale - self.min_random_scale) * rsc
+            ar = 1.0 + self.max_aspect_ratio * (2 * rar - 1)
+            cw = min(iw, max(1, int(w * s_ * ar + 0.5)))
+            ch = min(ih, max(1, int(h * s_ + 0.5)))
+            x0 = int(rx * (iw - cw + 1))
+            y0 = int(ry * (ih - ch + 1))
+            img = img.crop((x0, y0, x0 + cw, y0 + ch))
+        img = img.resize((w, h))
+        arr = _np.asarray(img, _np.float32)  # HWC
+        if self.random_h or self.random_s or self.random_l:
+            arr = self._hls_jitter(
+                arr,
+                self.random_h * (2 * rh - 1) / 360.0,
+                self.random_s * (2 * rs - 1),
+                self.random_l * (2 * rl - 1))
+        arr = arr.transpose(2, 0, 1)  # CHW, RGB
         if self.rand_mirror and rm < 0.5:
             arr = arr[:, :, ::-1]
         if self.mean is not None:
@@ -575,19 +653,76 @@ class ImageRecordIter(DataIter):
         label = header.label
         return arr, label
 
+    def _decode_batch_native(self, recs, augs):
+        """One C call decodes+augments the whole batch in parallel
+        (src/imagedec.cc ImgdecBatch)."""
+        import ctypes
+
+        from . import recordio as _recordio
+
+        c, h, w = self.data_shape
+        n = len(recs)
+        headers = []
+        bufs = (ctypes.POINTER(ctypes.c_ubyte) * n)()
+        sizes = (ctypes.c_size_t * n)()
+        keepalive = []
+        for i, s in enumerate(recs):
+            header, img_bytes = _recordio.unpack(s)
+            headers.append(header)
+            keepalive.append(img_bytes)
+            bufs[i] = ctypes.cast(ctypes.c_char_p(img_bytes),
+                                  ctypes.POINTER(ctypes.c_ubyte))
+            sizes[i] = len(img_bytes)
+        flags = ((1 if self.rand_crop else 0)
+                 | (2 if self.rand_mirror else 0)
+                 | (4 if (self.random_h or self.random_s or self.random_l)
+                    else 0))
+        rands = _np.ascontiguousarray(augs, _np.float32)
+        if self.mean is None:
+            mean_p, mean_kind = None, 0
+        elif self.mean.size == 3:
+            mean_p = _np.ascontiguousarray(self.mean.ravel(), _np.float32)
+            mean_kind = 1
+        else:
+            mean_p = _np.ascontiguousarray(self.mean, _np.float32)
+            mean_kind = 2
+        out = _np.empty((n, c, h, w), _np.float32)
+        rc = self._nlib.ImgdecBatch(
+            bufs, sizes, n, h, w, self.preprocess_threads,
+            ctypes.c_uint(flags),
+            rands.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            None if mean_p is None else
+            mean_p.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            mean_kind, ctypes.c_float(self.scale),
+            ctypes.c_float(self.max_aspect_ratio),
+            ctypes.c_float(self.min_random_scale),
+            ctypes.c_float(self.max_random_scale),
+            ctypes.c_float(self.random_h),
+            ctypes.c_float(self.random_s),
+            ctypes.c_float(self.random_l),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise MXNetError(
+                "ImageRecordIter: corrupt JPEG at batch index %d" % (-rc - 1))
+        labels = [hd.label for hd in headers]
+        return out, labels
+
     def next(self):
         if not self.iter_next():
             raise StopIteration
         recs = [self._records[self._order[self.cursor + i]]
                 for i in range(self.batch_size)]
-        augs = [tuple(self._rng.rand(3)) for _ in recs]
-        if self._pool is not None:
-            results = list(self._pool.map(self._decode, recs, augs))
+        augs = [tuple(self._rng.rand(8)) for _ in recs]
+        if self._nlib is not None:
+            stacked, labels = self._decode_batch_native(recs, augs)
+            data = array(stacked)
         else:
-            results = [self._decode(s, a) for s, a in zip(recs, augs)]
-        datas = [d for d, _ in results]
-        labels = [l for _, l in results]
-        data = array(_np.stack(datas))
+            if self._pool is not None:
+                results = list(self._pool.map(self._decode, recs, augs))
+            else:
+                results = [self._decode(s, a) for s, a in zip(recs, augs)]
+            data = array(_np.stack([d for d, _ in results]))
+            labels = [l for _, l in results]
         label = array(_np.asarray(labels, _np.float32).reshape(
             (self.batch_size,) if self.label_width == 1 else (self.batch_size, self.label_width)
         ))

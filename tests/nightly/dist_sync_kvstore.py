@@ -57,6 +57,27 @@ def main():
     print("rank %d/%d: dist_sync arithmetic OK (value=%s)"
           % (rank, nworker, expect))
 
+    # bucketed multi-key push: a tiny bucket budget forces several fused
+    # collectives per push (kvstore._global_reduce_many); arithmetic must
+    # be identical to per-key pushes
+    mx.kvstore.KVStore._BUCKET_BYTES = 4096
+    bkeys = [str(200 + i) for i in range(6)]
+    bshapes = [(17,), (33, 3), (5, 5), (1200, 40), (7,), (64, 64)]
+    for k, shp in zip(bkeys, bshapes):
+        kv.init(k, mx.nd.ones(shp))
+    kv.barrier()
+    for _ in range(nrepeat):
+        kv.push(bkeys, [mx.nd.ones(shp) * (rank + 1) for shp in bshapes])
+    kv.barrier()
+    for k, shp in zip(bkeys, bshapes):
+        out = mx.nd.zeros(shp)
+        kv.pull(k, out=out)
+        err = np.abs(out.asnumpy() - expect).max()
+        assert err < 1e-4, (
+            "rank %d bucketed key %s: expect %s, max err %s"
+            % (rank, k, expect, err))
+    print("rank %d/%d: bucketed dist push OK" % (rank, nworker))
+
 
 if __name__ == "__main__":
     main()

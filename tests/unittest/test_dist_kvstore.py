@@ -31,6 +31,8 @@ def test_dist_sync_kvstore_3_workers():
     for rank in range(3):
         assert ("rank %d/3: dist_sync arithmetic OK" % rank) in r.stdout, \
             r.stdout + r.stderr
+        assert ("rank %d/3: bucketed dist push OK" % rank) in r.stdout, \
+            r.stdout + r.stderr
 
 
 def test_dist_lenet_2_workers():
@@ -54,4 +56,28 @@ def test_dist_lenet_2_workers():
     assert r.returncode == 0, r.stdout + r.stderr
     for rank in range(2):
         assert ("rank %d/2: dist lenet OK" % rank) in r.stdout, \
+            r.stdout + r.stderr
+
+
+def test_dist_liveness_3_workers():
+    """Heartbeat failure detection: a rank that stops beating is counted
+    dead by get_num_dead_node on every rank (ref ps-lite heartbeats)."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",
+        "MXNET_COORDINATOR": "127.0.0.1:29424",
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.3",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", "--launcher", "local", "--coordinator",
+         "127.0.0.1:29424", sys.executable,
+         os.path.join(REPO, "tests", "nightly", "dist_liveness.py")],
+        capture_output=True, text=True, env=env, timeout=280)
+    assert r.returncode == 0, r.stdout + r.stderr
+    for rank in range(3):
+        assert ("rank %d/3: liveness OK" % rank) in r.stdout, \
             r.stdout + r.stderr

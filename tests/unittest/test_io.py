@@ -2,6 +2,8 @@
 import numpy as np
 import os
 
+import pytest
+
 import mxnet_tpu as mx
 from mxnet_tpu import io as mio
 from mxnet_tpu import recordio as mrec
@@ -113,3 +115,121 @@ def test_csv_iter(tmp_path):
                      batch_size=5)
     b = next(it)
     assert b.data[0].shape == (5, 2)
+
+
+def _make_jpeg_rec(tmp_path, n=8, size=64, name="t.rec"):
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / name)
+    w = recordio.MXRecordIO(path, "w")
+    # smooth gradient images: photo-like content (noise images make the
+    # chroma-upsampling difference between decoders look enormous)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    for i in range(n):
+        arr = np.stack([
+            127 + 120 * np.sin(2 * np.pi * (xx + i * 0.1)),
+            127 + 120 * np.cos(2 * np.pi * (yy - i * 0.05)),
+            255 * (xx + yy) / 2,
+        ], axis=-1).astype(np.uint8)
+        buf = _io.BytesIO()
+        # 4:4:4 subsampling: makes decode comparable across chroma
+        # upsampling strategies (PIL fancy vs pipeline plain)
+        Image.fromarray(arr).save(buf, "JPEG", quality=95, subsampling=0)
+        w.write(recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), buf.getvalue()))
+    w.close()
+    return path
+
+
+def test_image_record_iter_native_matches_pil(tmp_path):
+    """Native decode (src/imagedec.cc) must agree with the PIL path when
+    the image is exactly target-sized (no resample filter in play; both
+    stacks decode with libjpeg)."""
+    from mxnet_tpu import _native
+
+    if _native.load("imagedec") is None:
+        pytest.skip("native imagedec unavailable")
+    rec = _make_jpeg_rec(tmp_path, n=8, size=32)
+    a = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                              batch_size=8, seed=5)
+    assert a._nlib is not None
+    b = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                              batch_size=8, seed=5, preprocess_threads=1)
+    b._nlib = None
+    da = next(a).data[0].asnumpy()
+    db = next(b).data[0].asnumpy()
+    # fast-DCT decode differs from PIL's by a few counts per pixel
+    assert np.abs(da - db).mean() < 3.0
+    assert np.abs(da - db).max() <= 40.0
+
+
+def test_image_record_iter_hsl_jitter_bounds(tmp_path):
+    """HSL jitter must keep pixels in range and actually change them."""
+    from mxnet_tpu import _native
+
+    if _native.load("imagedec") is None:
+        pytest.skip("native imagedec unavailable")
+    rec = _make_jpeg_rec(tmp_path, n=8, size=32)
+
+    def batch(**kw):
+        it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                                   batch_size=8, seed=7, **kw)
+        return next(it).data[0].asnumpy()
+
+    plain = batch()
+    jit = batch(random_h=90, random_s=80, random_l=80)
+    assert jit.min() >= 0.0 and jit.max() <= 255.0
+    assert np.abs(jit - plain).mean() > 1.0
+
+
+def test_image_record_iter_aspect_crop_shapes(tmp_path):
+    """Scale/aspect-ratio random crop still yields the target shape."""
+    rec = _make_jpeg_rec(tmp_path, n=8, size=64)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 48, 48), batch_size=8,
+        rand_crop=True, rand_mirror=True, max_aspect_ratio=0.25,
+        min_random_scale=0.8, max_random_scale=1.3, seed=2)
+    b = next(it)
+    assert b.data[0].shape == (8, 3, 48, 48)
+    assert b.label[0].shape == (8,)
+
+
+def test_image_record_iter_corrupt_jpeg_raises(tmp_path):
+    from mxnet_tpu import _native
+
+    if _native.load("imagedec") is None:
+        pytest.skip("native imagedec unavailable")
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "bad.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(recordio.pack(recordio.IRHeader(0, 1.0, 0, 0),
+                          b"definitely not a jpeg"))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                               batch_size=1)
+    with pytest.raises(mx.MXNetError, match="corrupt JPEG"):
+        next(it)
+
+
+def test_hls_jitter_matches_colorsys():
+    """The vectorized fallback HLS jitter must match the stdlib
+    conversion pixel-for-pixel."""
+    import colorsys
+
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(7, 5, 3) * 255).astype(np.float32)
+    dh, ds, dl = 0.12, -0.2, 0.15
+    got = mio.ImageRecordIter._hls_jitter(arr, dh, ds, dl)
+    for (r, g, b), (er, eg, eb) in zip(
+            arr.reshape(-1, 3) / 255.0, got.reshape(-1, 3) / 255.0):
+        h, l, s = colorsys.rgb_to_hls(r, g, b)
+        h = (h + dh) % 1.0
+        l = min(max(l + dl, 0.0), 1.0)
+        s = min(max(s + ds, 0.0), 1.0)
+        rr, gg, bb = colorsys.hls_to_rgb(h, l, s)
+        np.testing.assert_allclose([er, eg, eb], [rr, gg, bb], atol=2e-5)
