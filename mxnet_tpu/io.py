@@ -480,6 +480,10 @@ class ImageRecordIter(DataIter):
 
         self.rec = _recordio.MXRecordIO(path_imgrec, "r")
         self.data_shape = tuple(data_shape)
+        if len(self.data_shape) != 3 or self.data_shape[0] not in (1, 3):
+            raise MXNetError(
+                "ImageRecordIter: data_shape must be (1|3, h, w), got %s"
+                % (self.data_shape,))
         self.batch_size = batch_size
         self.label_width = label_width
         self.shuffle = shuffle
@@ -502,6 +506,17 @@ class ImageRecordIter(DataIter):
             self.mean = list(_ndload(mean_img).values())[0].asnumpy()
         elif mean_r or mean_g or mean_b:
             self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32).reshape(3, 1, 1)
+        if self.mean is not None and self.data_shape[0] == 1:
+            # a 3-channel mean must not broadcast a (1,h,w) image into a
+            # 3-channel batch behind provide_data's back: a mean_img
+            # plane collapses to its channel average; scalar mean_r is
+            # the gray mean as given (ref image_aug_default.cc subtracts
+            # mean_r_ from channel 0)
+            if mean_img is not None and self.mean.ndim == 3 and self.mean.shape[0] == 3:
+                self.mean = self.mean.mean(axis=0, keepdims=True)
+            elif self.mean.shape == (3, 1, 1):
+                self.mean = self.mean[:1]
+            self.mean = self.mean.astype(_np.float32)
         self._rng = _np.random.RandomState(seed)
         # round-robin sharding during the scan: out-of-shard record bytes are
         # dropped immediately so per-worker memory is O(dataset/num_parts);
@@ -540,7 +555,10 @@ class ImageRecordIter(DataIter):
             lib.ImgdecBatch.restype = ctypes.c_int
             self._nlib = lib
         self._pool = None
-        if self._nlib is None and self.preprocess_threads > 1:
+        # the pool backs every batch that routes through the PIL path —
+        # either no native lib, or a channel count ImgdecBatch can't emit
+        if ((self._nlib is None or self.data_shape[0] != 3)
+                and self.preprocess_threads > 1):
             from concurrent.futures import ThreadPoolExecutor
 
             self._pool = ThreadPoolExecutor(max_workers=self.preprocess_threads)
@@ -623,8 +641,10 @@ class ImageRecordIter(DataIter):
             from PIL import Image
         except ImportError as e:  # pragma: no cover
             raise MXNetError("ImageRecordIter requires PIL for decode") from e
-        img = Image.open(_io.BytesIO(img_bytes)).convert("RGB")
         c, h, w = self.data_shape
+        # c==1 decodes grayscale, like the reference's gray flag
+        # (iter_image_recordio.cc flag-driven cv::imread mode)
+        img = Image.open(_io.BytesIO(img_bytes)).convert("RGB" if c == 3 else "L")
         iw, ih = img.size
         rsc, rar, rx, ry, rm, rh, rs, rl = aug
         if self.rand_crop:
@@ -637,8 +657,15 @@ class ImageRecordIter(DataIter):
             y0 = int(ry * (ih - ch + 1))
             img = img.crop((x0, y0, x0 + cw, y0 + ch))
         img = img.resize((w, h))
-        arr = _np.asarray(img, _np.float32)  # HWC
-        if self.random_h or self.random_s or self.random_l:
+        arr = _np.asarray(img, _np.float32)  # HWC (HW when grayscale)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+            # hue/saturation are undefined on gray (cv HLS leaves them
+            # no-op), but lightness jitter still applies
+            if self.random_l:
+                dl = self.random_l * (2 * rl - 1)
+                arr = _np.clip(arr / 255.0 + dl, 0.0, 1.0) * 255.0
+        if c == 3 and (self.random_h or self.random_s or self.random_l):
             arr = self._hls_jitter(
                 arr,
                 self.random_h * (2 * rh - 1) / 360.0,
@@ -684,6 +711,14 @@ class ImageRecordIter(DataIter):
             mean_p = _np.ascontiguousarray(self.mean.ravel(), _np.float32)
             mean_kind = 1
         else:
+            # ImgdecBatch indexes the mean as a dense (3, h, w) plane; any
+            # other layout would read out of bounds natively (the PIL path
+            # fails the same input with a broadcast error)
+            if tuple(self.mean.shape) != (3, h, w):
+                raise MXNetError(
+                    "ImageRecordIter: mean_img shape %s does not match "
+                    "data_shape-derived (3, %d, %d)"
+                    % (tuple(self.mean.shape), h, w))
             mean_p = _np.ascontiguousarray(self.mean, _np.float32)
             mean_kind = 2
         out = _np.empty((n, c, h, w), _np.float32)
@@ -713,7 +748,10 @@ class ImageRecordIter(DataIter):
         recs = [self._records[self._order[self.cursor + i]]
                 for i in range(self.batch_size)]
         augs = [tuple(self._rng.rand(8)) for _ in recs]
-        if self._nlib is not None:
+        # ImgdecBatch always emits 3 channels (n*3*h*w floats); route
+        # grayscale/other channel counts through the PIL path instead of
+        # overflowing the (n, c, h, w) output allocation
+        if self._nlib is not None and self.data_shape[0] == 3:
             stacked, labels = self._decode_batch_native(recs, augs)
             data = array(stacked)
         else:

@@ -97,8 +97,13 @@ class KVStore:
             self._hb_client = None
             return
 
+        # capture locals, not self: a closure over self would pin the
+        # KVStore (and its device-resident _store) alive for the daemon
+        # thread's whole life even after the user drops the store
+        stop, interval = self._hb_stop, self._hb_interval
+
         def _beat():
-            while not self._hb_stop.wait(self._hb_interval):
+            while not stop.wait(interval):
                 # transient coordinator errors must not kill the beat
                 # thread (a healthy rank would read as dead forever);
                 # the capability probe already ran above, so just retry
@@ -108,6 +113,12 @@ class KVStore:
         self._hb_thread = threading.Thread(
             target=_beat, name="mxtpu-kvstore-heartbeat", daemon=True)
         self._hb_thread.start()
+        # when the store is garbage-collected without an explicit
+        # stop_heartbeat(), stop beating so a dead object can't keep
+        # masquerading as a live rank
+        import weakref
+
+        weakref.finalize(self, stop.set)
 
     def stop_heartbeat(self):
         """Stop publishing this rank's liveness (test hook / shutdown)."""
@@ -246,10 +257,14 @@ class KVStore:
                              merged.context.jax_device)
         return NDArray(out, merged.context)
 
-    # gradient bucket size for fused dist collectives; mirrors the
-    # role (inverted) of MXNET_KVSTORE_BIGARRAY_BOUND (comm.h:50)
-    _BUCKET_BYTES = int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
-                                       64 * 1024 * 1024))
+    @property
+    def _BUCKET_BYTES(self):
+        """Gradient bucket size for fused dist collectives; mirrors the
+        role (inverted) of MXNET_KVSTORE_BIGARRAY_BOUND (comm.h:50).
+        Read per use so setting the env var after import still works
+        (consistent with MXNET_KVSTORE_HEARTBEAT_INTERVAL)."""
+        return int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
+                                  64 * 1024 * 1024))
 
     def _global_reduce_many(self, merged_list):
         """Bucketed cross-process reduce: flatten+concat the push's keys
@@ -279,12 +294,13 @@ class KVStore:
             else:
                 out[idx] = self._global_reduce(m)
 
+        bucket_bytes = self._BUCKET_BYTES  # one env read per push, not per key
         for idxs in groups.values():
             buckets = []
             cur, cur_bytes = [], 0
             for idx in idxs:
                 nbytes = int(_np.prod(merged_list[idx].shape)) * 4
-                if cur and cur_bytes + nbytes > self._BUCKET_BYTES:
+                if cur and cur_bytes + nbytes > bucket_bytes:
                     buckets.append(cur)
                     cur, cur_bytes = [], 0
                 cur.append(idx)
@@ -382,7 +398,27 @@ class KVStore:
             # a rank still starting up gets the full grace period before
             # being declared dead (no startup-race false positives)
             prev = seen.get(r)
-            if prev is None or prev[0] != v:
+            if prev is None:
+                # First observation: change detection has no baseline yet,
+                # so a one-shot health check (construct, query once) would
+                # always report 0. Fall back to the sender-embedded wall
+                # time for ranks that stopped beating long ago, with 2x
+                # timeout of slack absorbing cross-host clock skew. The
+                # baseline is back-dated by the observed age so follow-up
+                # polls keep reporting the rank dead (no alive-flap) until
+                # its value actually changes.
+                base = now
+                try:
+                    sent = float(v)
+                except (TypeError, ValueError):
+                    sent = None
+                if sent is not None:
+                    age = time.time() - sent
+                    if age > 2 * timeout:
+                        dead += 1
+                        base = now - age
+                seen[r] = (v, base)
+            elif prev[0] != v:
                 seen[r] = (v, now)  # state change observed locally
             elif now - prev[1] > timeout:
                 dead += 1

@@ -15,7 +15,7 @@
 //          max_random_scale/min_random_scale/max_aspect_ratio)
 //   bit 1: random horizontal mirror
 //   bit 2: HSL jitter (random_h/random_s/random_l, HLS color space)
-// Per-image randomness comes in from the caller (6 uniforms per image)
+// Per-image randomness comes in from the caller (8 uniforms per image)
 // so decode is deterministic given the caller's RNG — same discipline as
 // the Python path.
 

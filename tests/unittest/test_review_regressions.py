@@ -84,3 +84,79 @@ def test_prefetching_iter_protocol():
     pf.reset()
     assert pf._queue.maxsize == 4  # depth preserved across reset
     assert len(list(pf)) == 2
+
+
+def _tiny_recfile(tmp_path, n=8, size=40):
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(n):
+        img = Image.fromarray(
+            (np.random.rand(size, size, 3) * 255).astype("u1"))
+        b = _io.BytesIO()
+        img.save(b, "JPEG")
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              b.getvalue()))
+    w.close()
+    return rec
+
+
+def test_image_record_iter_grayscale_with_mean(tmp_path):
+    """c=1 must route around ImgdecBatch (which always emits 3 channels)
+    and a 3-channel mean must collapse instead of broadcasting the batch
+    to (N,3,h,w) behind provide_data's back."""
+    rec = _tiny_recfile(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(1, 16, 16),
+                               batch_size=4, mean_r=100,
+                               preprocess_threads=2)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 1, 16, 16)
+    assert it._pool is not None  # PIL routing keeps the decode pool
+    # scalar mean_r applies as-given to the gray channel (not averaged
+    # with the unset g/b zeros)
+    assert it.mean.shape == (1, 1, 1) and it.mean[0, 0, 0] == 100.0
+    # gray + lightness jitter still augments (hue/sat are no-ops on gray)
+    it_l = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(1, 16, 16),
+                                 batch_size=4, random_l=128, seed=3)
+    it_p = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(1, 16, 16),
+                                 batch_size=4, seed=3)
+    assert not np.allclose(next(iter(it_l)).data[0].asnumpy(),
+                           next(iter(it_p)).data[0].asnumpy())
+    try:
+        mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(2, 16, 16),
+                              batch_size=4)
+        assert False, "c=2 must be rejected"
+    except mx.base.MXNetError:
+        pass
+
+
+def test_dead_node_one_shot_and_no_flap():
+    """A rank that stopped beating long before this store existed must be
+    counted dead on the FIRST poll (sender-timestamp fallback) and stay
+    dead on immediate re-polls (back-dated baseline, no alive-flap)."""
+    import time
+
+    class FakeClient:
+        def __init__(self, vals):
+            self.vals = vals
+
+        def key_value_try_get(self, k):
+            return self.vals.get(k)
+
+    kv = mx.kvstore.create("local")
+    kv._hb_client = FakeClient({
+        "mxtpu_hb/0": repr(time.time()),        # alive
+        "mxtpu_hb/1": repr(time.time() - 600),  # long dead
+    })
+    old = type(kv).num_workers
+    type(kv).num_workers = property(lambda self: 2)
+    try:
+        assert kv.get_num_dead_node(timeout=60) == 1
+        assert kv.get_num_dead_node(timeout=60) == 1
+    finally:
+        type(kv).num_workers = old
