@@ -1,0 +1,159 @@
+"""Deep Q-Network with replay buffer and target network.
+
+TPU-native counterpart of the reference's example/dqn/ (dqn_run_test.py /
+base.py + operators.py: Q-learning with an experience-replay buffer, a
+periodically-synced target network, epsilon-greedy exploration, and the
+Bellman regression loss). Atari ROMs aren't available air-gapped, so the
+environment is a windy 6x6 gridworld with a pit row — small enough to
+verify the learned greedy policy actually reaches the goal, which the
+reference's smoke run (a few epochs of breakout) never could.
+
+Run: PYTHONPATH=. python examples/dqn/dqn_gridworld.py
+"""
+import argparse
+import os
+from collections import deque
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+SIZE = 6
+ACTIONS = 4  # N, S, E, W
+MOVES = {0: (-1, 0), 1: (1, 0), 2: (0, 1), 3: (0, -1)}
+GOAL = (5, 5)
+PITS = {(3, c) for c in range(1, 5)}  # a wall of pits to route around
+
+
+class GridWorld:
+    """Deterministic moves, -1 step cost, +20 goal, -20 pit (terminal)."""
+
+    def reset(self):
+        self.pos = (0, 0)
+        return self.pos
+
+    def step(self, a):
+        dr, dc = MOVES[a]
+        r = min(max(self.pos[0] + dr, 0), SIZE - 1)
+        c = min(max(self.pos[1] + dc, 0), SIZE - 1)
+        self.pos = (r, c)
+        if self.pos == GOAL:
+            return self.pos, 20.0, True
+        if self.pos in PITS:
+            return self.pos, -20.0, True
+        return self.pos, -1.0, False
+
+
+def encode(pos):
+    v = np.zeros(SIZE * SIZE, "f")
+    v[pos[0] * SIZE + pos[1]] = 1.0
+    return v
+
+
+def q_symbol():
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=64, name="fc1"),
+                       act_type="relu")
+    q = sym.FullyConnected(h, num_hidden=ACTIONS, name="q")
+    # Bellman regression: targets enter as the label (ref operators.py
+    # DQNOutput computes (q - target) masked to the taken action; here the
+    # label IS the full target vector with non-taken entries set to q)
+    return sym.LinearRegressionOutput(q, sym.Variable("target"), name="out")
+
+
+def build(batch):
+    net = q_symbol()
+    init = mx.initializer.Xavier()
+    arg_shapes, _, _ = net.infer_shape(data=(batch, SIZE * SIZE))
+    args, grads = {}, {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        arr = mx.nd.zeros(shape)
+        if name not in ("data", "target"):
+            init(name, arr)
+            grads[name] = mx.nd.zeros(shape)
+        args[name] = arr
+    exe = net.bind(mx.cpu(), args, args_grad=grads,
+                   grad_req={n: ("write" if n in grads else "null")
+                             for n in args})
+    return exe, args, grads
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--sync-every", type=int, default=25)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    env = GridWorld()
+    exe, qargs, qgrads = build(args.batch_size)
+    texe, targs, _ = build(args.batch_size)  # target network
+    opt = mx.optimizer.Adam(learning_rate=1e-3)
+    states = {n: opt.create_state(i, qargs[n])
+              for i, n in enumerate(qgrads)}
+    replay = deque(maxlen=5000)
+
+    def q_values(exe_, args_, batch_states):
+        args_["data"][:] = batch_states
+        return exe_.forward(is_train=False)[0].asnumpy()
+
+    def sync_target():
+        for n in qgrads:
+            targs[n][:] = qargs[n].asnumpy()
+
+    sync_target()
+    eps = 1.0
+    for ep in range(args.episodes):
+        s, done, steps = env.reset(), False, 0
+        while not done and steps < 50:
+            if rng.rand() < eps:
+                a = rng.randint(ACTIONS)
+            else:
+                pad = np.tile(encode(s), (args.batch_size, 1))
+                a = int(q_values(exe, qargs, pad)[0].argmax())
+            s2, r, done = env.step(a)
+            replay.append((encode(s), a, r, encode(s2), done))
+            s, steps = s2, steps + 1
+            if len(replay) >= args.batch_size:
+                idx = rng.choice(len(replay), args.batch_size, replace=False)
+                bs = np.array([replay[i][0] for i in idx])
+                ba = np.array([replay[i][1] for i in idx])
+                br = np.array([replay[i][2] for i in idx])
+                bs2 = np.array([replay[i][3] for i in idx])
+                bd = np.array([float(replay[i][4]) for i in idx])
+                qn = q_values(texe, targs, bs2).max(1)
+                target = q_values(exe, qargs, bs).copy()
+                target[np.arange(args.batch_size), ba] = \
+                    br + args.gamma * qn * (1.0 - bd)
+                qargs["data"][:] = bs
+                qargs["target"][:] = target
+                exe.forward(is_train=True)
+                exe.backward()
+                for i, n in enumerate(qgrads):
+                    opt.update(i, qargs[n], qgrads[n], states[n])
+        eps = max(0.05, eps * 0.99)
+        if ep % args.sync_every == 0:
+            sync_target()
+
+    # evaluate the greedy policy
+    wins = 0
+    for _ in range(20):
+        s, done, steps, total = env.reset(), False, 0, 0.0
+        while not done and steps < 50:
+            pad = np.tile(encode(s), (args.batch_size, 1))
+            a = int(q_values(exe, qargs, pad)[0].argmax())
+            s, r, done = env.step(a)
+            total += r
+            steps += 1
+        wins += int(done and total > 0)
+    print("greedy policy reached the goal in %d/20 episodes" % wins)
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert wins >= 18, "DQN failed to learn the gridworld"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Fully-convolutional network for per-pixel segmentation.
+
+TPU-native counterpart of the reference's example/fcn-xs/ (symbol_fcnxs.py
+builds FCN-32s/16s/8s from a VGG trunk: stride-down conv features,
+Deconvolution upsampling back to input resolution, Crop to align, skip
+fusion by ElementWiseSum, and a multi_output SoftmaxOutput per pixel —
+fcn_xs.py trains it). No VGG weights exist in an air-gapped image, so a
+small trunk learns from scratch on synthetic scenes (random rectangles of
+three classes on background); the FCN-8s-style topology is identical:
+two skip levels, deconv upsampling, crop alignment, per-pixel softmax.
+
+Run: PYTHONPATH=. python examples/fcn-xs/fcn_xs.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+NUM_CLS = 4  # background + 3 shape classes
+
+
+def conv_block(x, num_filter, name, stride=(1, 1)):
+    c = sym.Convolution(x, kernel=(3, 3), pad=(1, 1), stride=stride,
+                        num_filter=num_filter, name=name)
+    return sym.Activation(c, act_type="relu")
+
+
+def fcn_symbol():
+    """Stride-8 trunk with two skip fusions, mirroring symbol_fcnxs.py's
+    fcn8s topology at toy scale."""
+    data = sym.Variable("data")
+    s1 = conv_block(data, 16, "c1")            # /1
+    s2 = conv_block(s1, 32, "c2", stride=(2, 2))   # /2
+    s4 = conv_block(s2, 48, "c3", stride=(2, 2))   # /4
+    s8 = conv_block(s4, 64, "c4", stride=(2, 2))   # /8
+    score8 = sym.Convolution(s8, kernel=(1, 1), num_filter=NUM_CLS,
+                             name="score8")
+    # upsample /8 -> /4, fuse with the /4 skip (crop aligns shapes)
+    up4 = sym.Deconvolution(score8, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                            num_filter=NUM_CLS, no_bias=True, name="up4")
+    score4 = sym.Convolution(s4, kernel=(1, 1), num_filter=NUM_CLS,
+                             name="score4")
+    fuse4 = sym.Crop(up4, score4, num_args=2, name="crop4") + score4
+    # upsample /4 -> /1, fuse with a /1 score, per-pixel softmax
+    up1 = sym.Deconvolution(fuse4, kernel=(8, 8), stride=(4, 4), pad=(2, 2),
+                            num_filter=NUM_CLS, no_bias=True, name="up1")
+    score1 = sym.Convolution(s1, kernel=(1, 1), num_filter=NUM_CLS,
+                             name="score1")
+    fuse1 = sym.Crop(up1, score1, num_args=2, name="crop1") + score1
+    return sym.SoftmaxOutput(fuse1, multi_output=True, name="softmax")
+
+
+def make_batch(n, hw, rng):
+    """Scenes of axis-aligned rectangles; class = which texture fills the
+    rectangle (per-pixel supervision)."""
+    img = rng.rand(n, 3, hw, hw).astype("f") * 0.2
+    lab = np.zeros((n, hw, hw), "f")
+    for b in range(n):
+        for _ in range(rng.randint(1, 4)):
+            c = rng.randint(1, NUM_CLS)
+            h0, w0 = rng.randint(0, hw - 8, size=2)
+            h1, w1 = h0 + rng.randint(4, 8), w0 + rng.randint(4, 8)
+            img[b, :, h0:h1, w0:w1] = 0.2
+            img[b, c - 1, h0:h1, w0:w1] = 1.0  # channel encodes the class
+            lab[b, h0:h1, w0:w1] = c
+    return img, lab
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=150)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(4)
+    N, HW = args.batch_size, args.image_size
+    net = fcn_symbol()
+    init = mx.initializer.Xavier()
+    arg_shapes, _, _ = net.infer_shape(data=(N, 3, HW, HW))
+    arg_arrays, grad_arrays = {}, {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        arr = mx.nd.zeros(shape)
+        if name not in ("data", "softmax_label"):
+            init(name, arr)
+            grad_arrays[name] = mx.nd.zeros(shape)
+        arg_arrays[name] = arr
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grad_arrays,
+                   grad_req={n: ("write" if n in grad_arrays else "null")
+                             for n in arg_arrays})
+    opt = mx.optimizer.Adam(learning_rate=2e-3)
+    states = {n: opt.create_state(i, arg_arrays[n])
+              for i, n in enumerate(grad_arrays)}
+
+    miou = 0.0
+    for step in range(args.steps):
+        img, lab = make_batch(N, HW, rng)
+        arg_arrays["data"][:] = img
+        arg_arrays["softmax_label"][:] = lab
+        probs = exe.forward(is_train=True)[0]
+        exe.backward()
+        for i, n in enumerate(grad_arrays):
+            opt.update(i, arg_arrays[n], grad_arrays[n], states[n])
+        if step % 30 == 0 or step == args.steps - 1:
+            pred = probs.asnumpy().argmax(1)
+            ious = []
+            for c in range(NUM_CLS):
+                inter = ((pred == c) & (lab == c)).sum()
+                union = ((pred == c) | (lab == c)).sum()
+                if union:
+                    ious.append(inter / union)
+            miou = float(np.mean(ious))
+            acc = float((pred == lab).mean())
+            print("step %3d  pixel-acc %.3f  mIoU %.3f" % (step, acc, miou))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert miou > 0.7, "FCN failed to segment (mIoU %.3f)" % miou
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Neural style transfer: optimize the image, not the weights.
+
+TPU-native counterpart of the reference's example/neural-style/
+(nstyle.py: VGG19 features, content loss + Gram-matrix style loss + TV
+regularization, gradient descent ON THE INPUT via an executor bound with
+grad w.r.t. data). No pretrained VGG ships in an air-gapped image; the
+feature extractor is a fixed random conv stack — random filters are a
+standard texture basis (Ustyuzhaninov et al. 2017 showed they support
+style synthesis) and exercise the identical machinery: the whole
+content/style/TV loss is built symbolically with MakeLoss, and Adam
+walks the pixels.
+
+Run: PYTHONPATH=. python examples/neural-style/neural_style.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def features(x, widths):
+    """Fixed random conv stack; returns one feature map per depth."""
+    outs = []
+    for i, w in enumerate(widths):
+        x = sym.Convolution(x, kernel=(3, 3), pad=(1, 1), num_filter=w,
+                            name="feat%d" % i)
+        x = sym.Activation(x, act_type="tanh")  # bounded, keeps grads sane
+        outs.append(x)
+        if i < len(widths) - 1:
+            x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    return outs
+
+
+def gram(f, channels, hw):
+    """(1,C,H,W) -> (C,C)/CHW Gram, the style statistic (ref nstyle.py
+    style_gram executor)."""
+    flat = sym.Reshape(f, shape=(channels, hw))
+    return sym.dot(flat, flat, transpose_b=True) * (1.0 / (channels * hw))
+
+
+def style_transfer_symbol(size, widths, style_w, content_w, tv_w):
+    """One symbol whose single output is the total loss; data is the
+    image being optimized, targets are constant inputs."""
+    data = sym.Variable("data")  # (1, 3, S, S) — the canvas
+    feats = features(data, widths)
+    losses = []
+    s = size
+    for i, (f, w) in enumerate(zip(feats, widths)):
+        g = gram(f, w, s * s)
+        gt = sym.Variable("gram_target%d" % i)  # style statistics
+        losses.append(sym.sum(sym.square(g - gt)) * style_w)
+        if i == len(widths) - 1:
+            ct = sym.Variable("content_target")  # deepest feature map
+            losses.append(sym.sum(sym.square(f - ct))
+                          * (content_w / (w * s * s)))
+        if i < len(widths) - 1:
+            s //= 2
+    # total-variation smoothness on the canvas (ref nstyle.py get_tv_grad)
+    dh = sym.slice_axis(data, axis=2, begin=1, end=size) - \
+        sym.slice_axis(data, axis=2, begin=0, end=size - 1)
+    dw = sym.slice_axis(data, axis=3, begin=1, end=size) - \
+        sym.slice_axis(data, axis=3, begin=0, end=size - 1)
+    losses.append((sym.sum(sym.square(dh)) + sym.sum(sym.square(dw))) * tv_w)
+    total = losses[0]
+    for l in losses[1:]:
+        total = total + l
+    return sym.MakeLoss(total)
+
+
+def synth_image(kind, size, rng):
+    """Content: a big disk. Style: diagonal stripes."""
+    yy, xx = np.mgrid[0:size, 0:size].astype("f")
+    if kind == "content":
+        img = 0.2 + 0.6 * (((yy - size / 2) ** 2 + (xx - size / 2) ** 2)
+                           < (size / 3) ** 2)
+        img = np.stack([img, 0.5 * img, 1 - img])
+    else:
+        stripes = 0.5 + 0.5 * np.sin((xx + yy) * (2 * np.pi / 8))
+        img = np.stack([stripes, 1 - stripes, stripes * 0.3])
+    return (img[None] + rng.rand(1, 3, size, size) * 0.05).astype("f")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--style-weight", type=float, default=1.0)
+    ap.add_argument("--content-weight", type=float, default=8.0)
+    ap.add_argument("--tv-weight", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(1)
+    widths = (12, 24, 32)
+    S = args.size
+    net = style_transfer_symbol(S, widths, args.style_weight,
+                                args.content_weight, args.tv_weight)
+
+    # fixed random filter bank, shared by target extraction + optimization
+    conv_params = {}
+    for i, w in enumerate(widths):
+        cin = 3 if i == 0 else widths[i - 1]
+        conv_params["feat%d_weight" % i] = mx.nd.array(
+            (rng.randn(w, cin, 3, 3) / np.sqrt(cin * 9)).astype("f"))
+        conv_params["feat%d_bias" % i] = mx.nd.zeros((w,))
+
+    # extract targets: run features on style / content images
+    fsym = sym.Group(features(sym.Variable("data"), widths))
+    fexe = fsym.bind(mx.cpu(), {"data": mx.nd.zeros((1, 3, S, S)),
+                                **conv_params}, grad_req="null")
+    fexe.arg_dict["data"][:] = synth_image("style", S, rng)
+    style_feats = [o.asnumpy() for o in fexe.forward()]
+    fexe.arg_dict["data"][:] = synth_image("content", S, rng)
+    content_feats = [o.asnumpy() for o in fexe.forward()]
+
+    targets = {}
+    s = S
+    for i, (f, w) in enumerate(zip(style_feats, widths)):
+        flat = f.reshape(w, s * s)
+        targets["gram_target%d" % i] = mx.nd.array(
+            flat @ flat.T / (w * s * s))
+        s //= 2
+    targets["content_target"] = mx.nd.array(content_feats[-1])
+
+    canvas = mx.nd.array(synth_image("content", S, rng))
+    arg_arrays = {"data": canvas, **conv_params, **targets}
+    grad_arrays = {"data": mx.nd.zeros(canvas.shape)}
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grad_arrays,
+                   grad_req={n: ("write" if n == "data" else "null")
+                             for n in arg_arrays})
+    opt = mx.optimizer.Adam(learning_rate=0.02)
+    state = opt.create_state(0, arg_arrays["data"])
+
+    first = None
+    for step in range(args.steps):
+        loss = exe.forward(is_train=True)[0].asnumpy()[0]
+        exe.backward()
+        opt.update(0, arg_arrays["data"], grad_arrays["data"], state)
+        if first is None:
+            first = loss
+        if step % 30 == 0 or step == args.steps - 1:
+            print("step %3d  loss %.4f" % (step, loss))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert loss < 0.5 * first, (
+            "style optimization did not converge (%.4f -> %.4f)" % (first, loss))
+    out = arg_arrays["data"].asnumpy()
+    print("canvas range [%.2f, %.2f]; loss %.4f -> %.4f  ok"
+          % (out.min(), out.max(), first, loss))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Time-major RNN language model — layout as a performance lever.
+
+TPU-native counterpart of the reference's example/rnn-time-major/
+(bucket_io.py + lstm.py: the PTB LSTM rewritten so batches arrive
+(T, N) instead of (N, T), which removes per-step transposes and was
+"up to 1.5x faster" on CUDA). On TPU the same idea holds one level
+down: the RNN op's `lax.scan` carries (N, E) slices, so a time-major
+feed is scanned directly while a batch-major feed costs one transpose
+per batch. This example trains the same char-LM both ways, checks they
+learn equally, and prints the measured step-time ratio.
+
+Run: PYTHONPATH=. python examples/rnn-time-major/rnn_time_major.py
+"""
+import argparse
+import os
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def lm_symbol(time_major, num_hidden, embed, vocab):
+    """Next-token LM over a (T,N) or (N,T) int feed."""
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=vocab, output_dim=embed, name="emb")
+    tm = emb if time_major else sym.transpose(emb, axes=(1, 0, 2))
+    rnn = sym.RNN(tm, sym.Variable("rnn_params"), sym.Variable("rnn_state"),
+                  sym.Variable("rnn_state_cell"), state_size=num_hidden,
+                  num_layers=1, mode="lstm", name="rnn")
+    flat = sym.Reshape(rnn, shape=(-1, num_hidden))
+    fc = sym.FullyConnected(flat, num_hidden=vocab, name="cls")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def run(time_major, steps, N, T, vocab, embed, num_hidden, next_tok, rng):
+    from mxnet_tpu.ops.sequence import rnn_param_size
+
+    net = lm_symbol(time_major, num_hidden, embed, vocab)
+    dshape = (T, N) if time_major else (N, T)
+    psize = rnn_param_size("lstm", embed, num_hidden, 1, False)
+    init = mx.initializer.Xavier()
+    arg_arrays = {
+        "data": mx.nd.zeros(dshape),
+        "rnn_params": mx.nd.array(rng.uniform(-0.08, 0.08, psize).astype("f")),
+        "rnn_state": mx.nd.zeros((1, N, num_hidden)),
+        "rnn_state_cell": mx.nd.zeros((1, N, num_hidden)),
+        "softmax_label": mx.nd.zeros((T * N,)),
+    }
+    shapes = dict(zip(net.list_arguments(), net.infer_shape(
+        data=dshape, softmax_label=(T * N,))[0]))
+    for name in ("emb_weight", "cls_weight", "cls_bias"):
+        arr = mx.nd.zeros(shapes[name])
+        init(name, arr)
+        arg_arrays[name] = arr
+    skip = ("data", "softmax_label", "rnn_state", "rnn_state_cell")
+    grad_arrays = {k: mx.nd.zeros(v.shape) for k, v in arg_arrays.items()
+                   if k not in skip}
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grad_arrays,
+                   grad_req={k: ("write" if k in grad_arrays else "null")
+                             for k in arg_arrays})
+    opt = mx.optimizer.Adam(learning_rate=5e-3)
+    states = {k: opt.create_state(i, arg_arrays[k])
+              for i, k in enumerate(grad_arrays)}
+
+    acc, t_train = 0.0, 0.0
+    for step in range(steps):
+        seq = np.empty((N, T + 1), np.int64)
+        seq[:, 0] = rng.randint(0, vocab, size=N)
+        for t in range(T):
+            seq[:, t + 1] = next_tok[seq[:, t]]
+        x = seq[:, :-1].astype("f")
+        y = seq[:, 1:]  # (N, T)
+        t0 = time.perf_counter()
+        arg_arrays["data"][:] = x.T if time_major else x
+        arg_arrays["softmax_label"][:] = y.T.ravel()
+        probs = exe.forward(is_train=True)[0]
+        exe.backward()
+        for i, k in enumerate(grad_arrays):
+            opt.update(i, arg_arrays[k], grad_arrays[k], states[k])
+        p = probs.asnumpy()  # D2H fence so the timing is honest
+        if step >= 2:  # skip compile steps
+            t_train += time.perf_counter() - t0
+        if step == steps - 1:
+            acc = float((p.reshape(T, N, vocab).argmax(-1) == y.T).mean())
+    return acc, t_train / max(steps - 2, 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=24)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=150)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(9)
+    next_tok = rng.permutation(args.vocab)  # deterministic char transitions
+    common = dict(steps=args.steps, N=args.batch_size, T=args.seq_len,
+                  vocab=args.vocab, embed=32, num_hidden=64,
+                  next_tok=next_tok, rng=rng)
+    acc_tm, dt_tm = run(True, **common)
+    acc_bm, dt_bm = run(False, **common)
+    print("time-major:  acc %.3f  %.2f ms/step" % (acc_tm, dt_tm * 1e3))
+    print("batch-major: acc %.3f  %.2f ms/step" % (acc_bm, dt_bm * 1e3))
+    print("layout speedup: %.2fx" % (dt_bm / dt_tm))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert acc_tm > 0.9 and acc_bm > 0.9, "LM failed to learn transitions"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""MLP trained with a multiclass SVM head instead of softmax.
+
+TPU-native counterpart of the reference's example/svm_mnist/svm_mnist.py
+(same swap: SoftmaxOutput -> SVMOutput, L2-SVM squared-hinge by default;
+ref src/operator/svm_output-inl.h). Demonstrates the SVMOutput head
+training end-to-end through FeedForward.
+
+Run: PYTHONPATH=. python examples/svm_mnist/svm_mnist.py
+"""
+import argparse
+import os
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def svm_mlp(use_linear):
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=256, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return sym.SVMOutput(h, name="svm", margin=1.0,
+                         regularization_coefficient=1.0,
+                         use_linear=use_linear)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--l1", action="store_true",
+                    help="L1-SVM hinge instead of the default L2 squared hinge")
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(batch_size=args.batch_size, num_synthetic=2000,
+                            seed=1, flat=True, label_name="svm_label")
+    val = mx.io.MNISTIter(batch_size=args.batch_size, num_synthetic=1000,
+                          seed=2, flat=True, shuffle=False,
+                          label_name="svm_label")
+    # hinge gradients are +-reg_coef per violating class — an order larger
+    # than softmax residuals, so the classic 0.1/0.9 SGD recipe diverges
+    model = mx.FeedForward(svm_mlp(args.l1), ctx=mx.cpu(),
+                           num_epoch=args.epochs, learning_rate=0.01,
+                           momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val)
+    acc = model.score(val)
+    print("val accuracy %.3f" % acc)
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert acc > 0.9, "SVM head failed to train"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""LSTM + CTC sequence labeling on synthetic "OCR" strips.
+
+TPU-native counterpart of the reference's example/warpctc/lstm_ocr.py
+(captcha OCR through the warpctc plugin; example/warpctc/lstm_model.py
+feeds per-step FC outputs of an unrolled LSTM into WarpCTC as a
+(T*N, alphabet) block). Without a captcha generator in an air-gapped
+image, each sample here is a strip whose columns carry either a one-hot
+"glyph" row or background noise; the label is the variable-length digit
+string in column order. The net reads columns with an LSTM (a skip
+connection gives the classifier the raw column too — CTC's blank-collapse
+plateau is notoriously slow for pure recurrent nets at smoke-test
+budgets) and must handle alignment-free supervision: the capability the
+reference example proves.
+
+Run: PYTHONPATH=. python examples/warpctc/lstm_ocr.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+NUM_DIGITS = 10  # alphabet 1..10; CTC blank is 0 (warpctc-inl.h blank=0)
+
+
+def make_batch(batch_size, T, height, label_len, rng):
+    """Digits appear as one-hot rows at distinct random columns; other
+    columns light one of the top (height-10) noise rows."""
+    data = np.zeros((batch_size, T, height), "f")
+    labels = np.zeros((batch_size, label_len), "f")
+    for b in range(batch_size):
+        n = rng.randint(1, label_len + 1)
+        digits = rng.randint(0, NUM_DIGITS, size=n)
+        pos = sorted(rng.choice(np.arange(1, T - 1), size=n, replace=False))
+        for t in range(T):
+            data[b, t, NUM_DIGITS + rng.randint(0, height - NUM_DIGITS)] = 1.0
+        for p, d in zip(pos, digits):
+            data[b, p, :] = 0.0
+            data[b, p, d] = 1.0
+        labels[b, :n] = digits + 1  # 0 is reserved for CTC blank
+    return data, labels
+
+
+def ctc_symbol(num_hidden, height, T, label_len):
+    """Column LSTM + input skip -> per-step FC -> CTC over the flattened
+    (T*N, A) activations, the layout the reference feeds WarpCTC."""
+    data = sym.Variable("data")  # (N, T, H)
+    tm = sym.transpose(data, axes=(1, 0, 2))  # time-major for RNN
+    rnn = sym.RNN(tm, sym.Variable("rnn_params"), sym.Variable("rnn_state"),
+                  sym.Variable("rnn_state_cell"), state_size=num_hidden,
+                  num_layers=1, mode="lstm", name="rnn")
+    cat = sym.Concat(rnn, tm, num_args=2, dim=2)  # (T, N, hidden+H)
+    flat = sym.Reshape(cat, shape=(-1, num_hidden + height))
+    fc = sym.FullyConnected(flat, num_hidden=NUM_DIGITS + 1, name="cls")
+    return sym.WarpCTC(data=fc, label=sym.Variable("label"),
+                       input_length=T, label_length=label_len)
+
+
+def greedy_decode(probs, T, batch_size):
+    """Best-path decode: argmax per step, collapse repeats, drop blanks."""
+    path = probs.reshape(T, batch_size, -1).argmax(-1)
+    out = []
+    for b in range(batch_size):
+        seq, prev = [], -1
+        for t in range(T):
+            k = int(path[t, b])
+            if k != prev and k != 0:
+                seq.append(k)
+            prev = k
+        out.append(seq)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--height", type=int, default=12)
+    ap.add_argument("--label-len", type=int, default=3)
+    ap.add_argument("--num-hidden", type=int, default=48)
+    ap.add_argument("--steps", type=int, default=300)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(5)
+    from mxnet_tpu.ops.sequence import rnn_param_size
+
+    psize = rnn_param_size("lstm", args.height, args.num_hidden, 1, False)
+    net = ctc_symbol(args.num_hidden, args.height, args.seq_len,
+                     args.label_len)
+    arg_arrays = {
+        "data": mx.nd.zeros((args.batch_size, args.seq_len, args.height)),
+        "rnn_params": mx.nd.array(
+            rng.uniform(-0.1, 0.1, psize).astype("f")),
+        "rnn_state": mx.nd.zeros((1, args.batch_size, args.num_hidden)),
+        "rnn_state_cell": mx.nd.zeros((1, args.batch_size, args.num_hidden)),
+        "cls_weight": mx.nd.array(rng.uniform(
+            -0.1, 0.1,
+            (NUM_DIGITS + 1, args.num_hidden + args.height)).astype("f")),
+        "cls_bias": mx.nd.zeros((NUM_DIGITS + 1,)),
+        "label": mx.nd.zeros((args.batch_size * args.label_len,)),
+    }
+    grad_arrays = {k: mx.nd.zeros(v.shape) for k, v in arg_arrays.items()
+                   if k not in ("data", "label", "rnn_state", "rnn_state_cell")}
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grad_arrays,
+                   grad_req={k: ("write" if k in grad_arrays else "null")
+                             for k in arg_arrays})
+    opt = mx.optimizer.Adam(learning_rate=1e-2)
+    states = {k: opt.create_state(i, arg_arrays[k])
+              for i, k in enumerate(grad_arrays)}
+
+    rate = 1.0
+    for step in range(args.steps):
+        d, l = make_batch(args.batch_size, args.seq_len, args.height,
+                          args.label_len, rng)
+        arg_arrays["data"][:] = d
+        arg_arrays["label"][:] = l.ravel()
+        probs = exe.forward(is_train=True)[0]
+        exe.backward()
+        for i, k in enumerate(grad_arrays):
+            opt.update(i, arg_arrays[k], grad_arrays[k], states[k])
+        if step % 50 == 0 or step == args.steps - 1:
+            decoded = greedy_decode(probs.asnumpy(), args.seq_len,
+                                    args.batch_size)
+            errs = sum(
+                1 for b in range(args.batch_size)
+                if decoded[b] != [int(v) for v in l[b] if v > 0])
+            rate = errs / args.batch_size
+            print("step %3d  seq-err %.2f" % (step, rate))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert rate < 0.2, "CTC training failed (seq-err %.2f)" % rate
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -500,10 +500,12 @@ class ImageRecordIter(DataIter):
         self.random_s = float(random_s) / 255.0
         self.random_l = float(random_l) / 255.0
         self.mean = None
+        mean_from_img = False
         if mean_img is not None and os.path.exists(str(mean_img)):
             from .ndarray import load as _ndload
 
             self.mean = list(_ndload(mean_img).values())[0].asnumpy()
+            mean_from_img = True
         elif mean_r or mean_g or mean_b:
             self.mean = _np.array([mean_r, mean_g, mean_b], _np.float32).reshape(3, 1, 1)
         if self.mean is not None and self.data_shape[0] == 1:
@@ -512,7 +514,7 @@ class ImageRecordIter(DataIter):
             # plane collapses to its channel average; scalar mean_r is
             # the gray mean as given (ref image_aug_default.cc subtracts
             # mean_r_ from channel 0)
-            if mean_img is not None and self.mean.ndim == 3 and self.mean.shape[0] == 3:
+            if mean_from_img and self.mean.ndim == 3 and self.mean.shape[0] == 3:
                 self.mean = self.mean.mean(axis=0, keepdims=True)
             elif self.mean.shape == (3, 1, 1):
                 self.mean = self.mean[:1]
