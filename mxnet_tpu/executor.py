@@ -585,21 +585,52 @@ class Executor:
         arg_types, _, aux_types = symbol.infer_type(
             **{k: v for k, v in (type_dict or {}).items()}
         )
-        # reuse shared_exec buffers when shapes match (bucketing memory share)
+        # Bucketing memory share (the GraphStoragePool role of
+        # graph_memory_allocator.h:40-122 / graph_executor.h:274): a bucket
+        # bound with shared_exec reuses the shared executor's argument,
+        # GRADIENT and aux buffers whenever name+shape+dtype line up — for
+        # an RNN bucket family that is every parameter, so per-bucket
+        # NDArray memory is O(data shapes), not O(params x buckets).
+        # Shapes that differ between buckets (data/label/states) get fresh
+        # arrays; their old per-bucket intermediates live INSIDE each jit
+        # program where XLA's arena (not Python) owns reuse, so the
+        # reference's size-range matching has no analog to do here.
         shared_args = shared_exec.arg_dict if shared_exec is not None else {}
+        shared_grads = shared_exec.grad_dict if shared_exec is not None else {}
+        shared_aux = shared_exec.aux_dict if shared_exec is not None else {}
+        shared_reqs = (dict(zip(shared_exec._arg_names, shared_exec._reqs))
+                       if shared_exec is not None else {})
         args = {}
         for name, shape, t in zip(arg_names, arg_shapes, arg_types):
-            if name in shared_args and shared_args[name].shape == tuple(shape):
-                args[name] = shared_args[name]
+            cand = shared_args.get(name)
+            if cand is not None and cand.shape == tuple(shape) and cand.dtype == t:
+                args[name] = cand
             else:
                 args[name] = zeros(shape, ctx, dtype=t)
         reqs = _as_req_list(grad_req, arg_names)
         args_grad = {}
         for name, shape, t, r in zip(arg_names, arg_shapes, arg_types, reqs):
-            if r != "null":
+            if r == "null":
+                continue
+            cand = shared_grads.get(name)
+            # "add" keeps private buffers ON BOTH SIDES: a shared
+            # accumulator would mix gradient sums across buckets between
+            # updates, and a "write" bucket aliasing an "add" accumulator
+            # would clobber partially accumulated state
+            if (r == "write" and shared_reqs.get(name) == "write"
+                    and cand is not None
+                    and cand.shape == tuple(shape) and cand.dtype == t):
+                args_grad[name] = cand
+            else:
                 args_grad[name] = zeros(shape, ctx, dtype=t)
         aux_states = []
         for i, (name, shape, t) in enumerate(zip(aux_names, aux_shapes, aux_types)):
+            cand = shared_aux.get(name)
+            if cand is not None and cand.shape == tuple(shape) and cand.dtype == t:
+                # shared aux keeps moving stats consistent across buckets,
+                # like the reference's shared data_entry for aux
+                aux_states.append(cand)
+                continue
             # default aux init: variance-like states to 1 (ref: initializer.py
             # _init_one for moving_var), others 0
             if "var" in name:

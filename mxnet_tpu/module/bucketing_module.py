@@ -100,6 +100,7 @@ class BucketingModule(BaseModule):
             return
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
         self.binded = True
 
         symbol, data_names, label_names = self._call_sym_gen(self._default_bucket_key)
@@ -127,7 +128,13 @@ class BucketingModule(BaseModule):
                 data_shapes, label_shapes, self._curr_module.for_training,
                 self._curr_module.inputs_need_grad, force_rebind=False,
                 shared_module=self._buckets[self._default_bucket_key],
+                grad_req=getattr(self, "_grad_req", "write"),
             )
+            # a bucket created after init_optimizer must share the live
+            # optimizer state too (ref bucketing_module.py:219-221)
+            if self.optimizer_initialized:
+                module.borrow_optimizer(
+                    self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
 
@@ -169,10 +176,25 @@ class BucketingModule(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._curr_module.update()
-        # propagate updated params to sibling buckets sharing arrays
-        arg, aux = self._curr_module.get_params()
-        for key, mod in self._buckets.items():
-            if mod is not self._curr_module and mod.params_initialized:
+        # Sibling buckets alias the same parameter NDArrays (the shared
+        # memory pool in executor._simple_bind), so the update is already
+        # visible to them — no per-step propagation. Only a bucket whose
+        # executor did NOT share a buffer (shape/dtype mismatch) needs a
+        # copy; detect by identity and copy just those.
+        cur_execs = self._curr_module._execs
+        for mod in self._buckets.values():
+            if mod is self._curr_module or not mod.params_initialized:
+                continue
+            stale = [
+                name
+                for name, arr in mod._execs[0].arg_dict.items()
+                if name in cur_execs[0].arg_dict
+                and arr is not cur_execs[0].arg_dict[name]
+                and name not in mod.data_names
+                and name not in (mod.label_names or ())
+            ]
+            if stale:
+                arg, aux = self._curr_module.get_params()
                 mod.set_params(arg, aux)
 
     def get_outputs(self, merge_multi_context=True):

@@ -165,3 +165,103 @@ def test_async_checkpoint_fenced_by_load_and_waitall(tmp_path):
         args2["fc1_weight"].asnumpy(), args["fc1_weight"].asnumpy())
     mx.nd.waitall()
     assert mx.engine.get().pending_count() == 0
+
+
+def test_bucketing_shared_memory_pool():
+    """Bucket executors must SHARE parameter, gradient, and aux NDArrays
+    with the default bucket (the GraphStoragePool role,
+    graph_memory_allocator.h:40-122): bucket count must not multiply
+    param/grad memory, and training one bucket must move the other's
+    view of the weights."""
+    mx.random.seed(5)
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=8, name="emb")
+        pooled = mx.sym.sum(emb, axis=(1,))  # (N, 8) regardless of seq_len
+        bn = mx.sym.BatchNorm(pooled, name="bn")
+        out = mx.sym.FullyConnected(bn, num_hidden=2, name="out")
+        return (mx.sym.SoftmaxOutput(out, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=10,
+                                    context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (4, 10))],
+             label_shapes=[DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+
+    def batch(key):
+        return DataBatch(
+            data=[mx.nd.array(np.random.randint(0, 20, (4, key)).astype("f"))],
+            label=[mx.nd.array(np.array([0, 1, 0, 1], "f"))], pad=0,
+            index=None, bucket_key=key,
+            provide_data=[DataDesc("data", (4, key))],
+            provide_label=[DataDesc("softmax_label", (4,))],
+        )
+
+    mod.forward(batch(5))  # creates the 5-bucket via switch_bucket
+    mod.backward()
+    mod.update()
+    m10 = mod._buckets[10]._execs[0]
+    m5 = mod._buckets[5]._execs[0]
+    for name in ("emb_weight", "bn_gamma", "bn_beta", "out_weight", "out_bias"):
+        assert m5.arg_dict[name] is m10.arg_dict[name], name
+        assert m5.grad_dict[name] is m10.grad_dict[name], name
+    for name in ("bn_moving_mean", "bn_moving_var"):
+        assert m5.aux_dict[name] is m10.aux_dict[name], name
+    # data-dependent buffers stay private
+    assert m5.arg_dict["data"] is not m10.arg_dict["data"]
+
+    # training through alternating buckets converges on a learnable rule
+    rng = np.random.RandomState(0)
+    for step in range(60):
+        key = 10 if step % 2 == 0 else 5
+        x = rng.randint(10, 12, (4, key)).astype("f")
+        y = (x[:, 0] == 11).astype("f")
+        b = DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)], pad=0,
+                      index=None, bucket_key=key,
+                      provide_data=[DataDesc("data", (4, key))],
+                      provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(b)
+        mod.backward()
+        mod.update()
+    x = rng.randint(10, 12, (4, 5)).astype("f")
+    b = DataBatch(data=[mx.nd.array(x)], label=None, pad=0, index=None,
+                  bucket_key=5, provide_data=[DataDesc("data", (4, 5))],
+                  provide_label=[DataDesc("softmax_label", (4,))])
+    mod.forward(b, is_train=False)
+    pred = mod.get_outputs()[0].asnumpy().argmax(1)
+    assert (pred == (x[:, 0] == 11)).mean() >= 0.75
+
+
+def test_bucketing_grad_req_add_not_aliased():
+    """grad_req='add' accumulators must stay private per bucket (a shared
+    buffer would clobber partially accumulated gradients), and the req
+    must survive switch_bucket."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=8, name="emb")
+        pooled = mx.sym.sum(emb, axis=(1,))
+        out = mx.sym.FullyConnected(pooled, num_hidden=2, name="out")
+        return (mx.sym.SoftmaxOutput(out, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=10,
+                                    context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (4, 10))],
+             label_shapes=[DataDesc("softmax_label", (4,))],
+             grad_req="add")
+    mod.init_params()
+    b = DataBatch(data=[mx.nd.zeros((4, 5))],
+                  label=[mx.nd.zeros((4,))], pad=0, index=None, bucket_key=5,
+                  provide_data=[DataDesc("data", (4, 5))],
+                  provide_label=[DataDesc("softmax_label", (4,))])
+    mod.forward(b)
+    m10, m5 = mod._buckets[10]._execs[0], mod._buckets[5]._execs[0]
+    assert m5.arg_dict["emb_weight"] is m10.arg_dict["emb_weight"]  # params shared
+    assert m5.grad_dict["emb_weight"] is not m10.grad_dict["emb_weight"]  # accs private
+    assert m5._reqs[m5._arg_names.index("emb_weight")] == "add"
