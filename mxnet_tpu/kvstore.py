@@ -65,12 +65,7 @@ class KVStore:
 
         if jax.process_count() <= 1:
             return
-        try:
-            from jax._src import distributed
-
-            client = distributed.global_state.client
-        except Exception:  # pragma: no cover - jax internals moved
-            client = None
+        client = _coordination_client()
         if client is None:
             return
         self._hb_client = client
@@ -467,21 +462,342 @@ def create(name="local"):
     )
     if name not in known:
         raise MXNetError("unknown KVStore type %s (known: %s)" % (name, known))
-    if name.startswith("dist_async"):
-        # Explicit scope decision (SURVEY §2.7 "Async SGD ... not
-        # idiomatic on TPU"): apply-on-arrival PS semantics need a
-        # server role and point-to-point transport; the SPMD collective
-        # design applies every push synchronously across ranks. Running
-        # dist_async therefore gives SYNC update semantics (a superset
-        # of async's convergence guarantees, minus straggler tolerance).
-        warnings.warn(
-            "dist_async runs with synchronous all-reduce semantics on "
-            "the TPU backend (no parameter-server role; see "
-            "docs/distributed.md). Updates are applied in lock-step, "
-            "not on-arrival.", stacklevel=2)
     if name.startswith("dist"):
         _maybe_init_distributed()
+    if name.startswith("dist_async"):
+        import jax
+
+        if jax.process_count() > 1:
+            client = _coordination_client()
+            if client is not None and _supports_overwrite(client):
+                return _AsyncDistKVStore(name, client)
+            # No P2P transport available: fall back to lock-step
+            # all-reduce semantics (a superset of async's convergence
+            # guarantees, minus straggler tolerance) and say so.
+            warnings.warn(
+                "dist_async: coordination-service transport unavailable; "
+                "falling back to synchronous all-reduce semantics "
+                "(updates in lock-step, not on-arrival; see "
+                "docs/distributed.md).", stacklevel=2)
     return KVStore(name)
+
+
+def _coordination_client():
+    """The jax.distributed coordination-service client, or None."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+
+
+def _supports_overwrite(client):
+    """Probe for key_value_set(..., allow_overwrite=True) support."""
+    try:
+        client.key_value_set("mxtpu_probe/ow", "1", allow_overwrite=True)
+        client.key_value_set("mxtpu_probe/ow", "2", allow_overwrite=True)
+        return True
+    except Exception:
+        return False
+
+
+def _b64(obj):
+    import base64
+
+    return base64.b64encode(pickle.dumps(obj)).decode("ascii")
+
+
+def _unb64(s):
+    import base64
+
+    return pickle.loads(base64.b64decode(s))
+
+
+class _AsyncServer:
+    """The reference's parameter-server role (kvstore_dist_server.h),
+    hosted as a thread on rank 0. Applies each worker's gradient group ON
+    ARRIVAL (ref kvstore_dist_server.h:200-207 async UpdateBuf: no
+    cross-worker aggregation, no barrier) and republishes weights; the
+    jax.distributed coordination KV is the ZMQ van's role.
+
+    Per-rank apply order is preserved (groups consumed in sequence
+    number order); cross-rank order is whatever arrival order the poll
+    observes — exactly the reference's async contract."""
+
+    POLL_S = 0.005
+
+    def __init__(self, client, nworkers):
+        self._client = client
+        self._n = nworkers
+        self._weights = {}           # key(str) -> NDArray (cpu)
+        self._versions = {}          # key(str) -> int
+        self._applied = [0] * nworkers
+        self._updater = None
+        self._optv = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-kvstore-async-server", daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def init_key(self, key, arr):
+        """Rank-0 direct init (program order guarantees this precedes any
+        of rank 0's own pushes; other ranks block in init until the
+        publish lands)."""
+        self._weights[key] = NDArray(arr, cpu(0))
+        self._versions[key] = 0
+        self._publish(key)
+
+    def _publish(self, key):
+        self._client.key_value_set(
+            "mxtpu_as/w/%s" % key,
+            _b64((self._versions[key], self._weights[key].asnumpy())),
+            allow_overwrite=True)
+
+    def _try_get(self, k):
+        try:
+            return self._client.key_value_try_get(k)
+        except Exception:
+            return None
+
+    def _check_optimizer(self):
+        v = self._try_get("mxtpu_as/optv")
+        if v is None or int(v) == self._optv:
+            return
+        blob = self._try_get("mxtpu_as/opt")
+        if blob is None:
+            return
+        from . import optimizer as opt
+
+        self._optv = int(v)
+        self._updater = opt.get_updater(_unb64(blob))
+
+    def _run(self):
+        # Failure discipline: _applied[r] advances IMMEDIATELY after a
+        # group's updater calls, before any network write, so a transient
+        # publish/ack error can never cause the same gradient to be
+        # applied twice. Publishes and acks are idempotent re-asserted
+        # state (dirty set / applied counters), so a failed write heals
+        # on the next poll instead of wedging async_fence forever.
+        dirty = set()
+        acked = [0] * self._n
+        while not self._stop.wait(self.POLL_S):
+            try:
+                self._check_optimizer()
+            except Exception:  # pragma: no cover - keep serving
+                import logging
+
+                logging.exception("async server optimizer check failed")
+            for r in range(self._n):
+                s = self._try_get("mxtpu_as/s/%d" % r)
+                if s is None:
+                    continue
+                s = int(s)
+                while self._applied[r] < s and not self._stop.is_set():
+                    n = self._applied[r] + 1
+                    blob = self._try_get("mxtpu_as/g/%d/%d" % (r, n))
+                    if blob is None:
+                        break  # seq bumped before payload landed
+                    try:
+                        for key, grad in _unb64(blob):
+                            w = self._weights.get(key)
+                            if w is None:
+                                continue  # push raced an unknown key
+                            g = NDArray(grad, cpu(0))
+                            if self._updater is not None:
+                                self._updater(_key_int(key), g, w)
+                            else:
+                                # no optimizer: per-arrival assign, the
+                                # sync path's "store = merged" analog
+                                w[:] = g.asnumpy()
+                            self._versions[key] += 1
+                            dirty.add(key)
+                    except Exception:  # pragma: no cover - poison group
+                        import logging
+
+                        logging.exception(
+                            "async server failed applying group %d/%d; "
+                            "skipping it", r, n)
+                    self._applied[r] = n
+                    try:  # consumed: free the coordinator's copy
+                        self._client.key_value_delete(
+                            "mxtpu_as/g/%d/%d" % (r, n))
+                    except Exception:
+                        pass
+            for key in list(dirty):
+                try:
+                    self._publish(key)
+                    dirty.discard(key)
+                except Exception:
+                    pass  # retry next poll
+            for r in range(self._n):
+                if acked[r] != self._applied[r] and not dirty:
+                    try:
+                        self._client.key_value_set(
+                            "mxtpu_as/a/%d" % r, str(self._applied[r]),
+                            allow_overwrite=True)
+                        acked[r] = self._applied[r]
+                    except Exception:
+                        pass  # retry next poll
+
+
+class _AsyncDistKVStore(KVStore):
+    """dist_async with REAL apply-on-arrival semantics (VERDICT r1 §7).
+
+    Worker push = serialize the locally merged gradient group and hand it
+    to the rank-0 server thread through the coordination KV, returning
+    immediately — no collective, no lock-step. Worker pull = read the
+    latest published weights (possibly missing other workers' in-flight
+    updates: async staleness by design). `async_fence()` waits for the
+    server to drain every rank's published pushes (test/shutdown hook;
+    the reference exposed the same need as ps-lite's Wait on push
+    timestamps).
+
+    Transport note: coordination-KV messages are base64-pickled host
+    arrays — correctness-first plumbing sized for modest parameter sets;
+    bandwidth-critical jobs should use dist_sync's fused device
+    collectives (docs/distributed.md)."""
+
+    def __init__(self, kv_type, client):
+        self._client = client
+        self._seq = 0
+        self._server = None
+        super().__init__(kv_type)
+        import jax
+
+        self._rank = jax.process_index()
+        self._nworkers = jax.process_count()
+        if self._rank == 0:
+            self._server = _AsyncServer(client, self._nworkers)
+            self._server.start()
+            import weakref
+
+            weakref.finalize(self, self._server._stop.set)
+
+    # -- API overrides ---------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._key_value(key, value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % k)
+            self._store[k] = v.copyto(v.context)
+            if self._rank == 0:
+                self._server.init_key(k, v.asnumpy())
+            else:
+                self._wait_key("mxtpu_as/w/%s" % k)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._key_value(key, value, allow_list_per_key=True)
+        group = []
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            merged = self._reduce(list(vals), self._store[k])
+            group.append((k, merged.asnumpy()))
+        self._seq += 1
+        # payload first, then the sequence bump that makes it visible
+        self._client.key_value_set(
+            "mxtpu_as/g/%d/%d" % (self._rank, self._seq), _b64(group))
+        self._client.key_value_set(
+            "mxtpu_as/s/%d" % self._rank, str(self._seq),
+            allow_overwrite=True)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = self._key_value(key, out, allow_list_per_key=True)
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            blob = self._client.key_value_try_get("mxtpu_as/w/%s" % k)
+            if blob is None:
+                raise MXNetError("async weight for key %s not published" % k)
+            _, arr = _unb64(blob)
+            nd = NDArray(arr, cpu(0))
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                nd.copyto(t)
+
+    def set_optimizer(self, optimizer):
+        """Ship the pickled optimizer to the server (the reference's
+        kController command, python/mxnet/kvstore.py:231) instead of
+        installing a local updater."""
+        blob = pickle.dumps(optimizer)
+        pickle.loads(blob)  # fail early if unpicklable, like the reference
+        self._optimizer = optimizer
+        if self._rank == 0:
+            v = int(time.time() * 1e6)
+            self._client.key_value_set("mxtpu_as/opt", _b64(optimizer),
+                                       allow_overwrite=True)
+            self._client.key_value_set("mxtpu_as/optv", str(v),
+                                       allow_overwrite=True)
+            # Block until the server thread installed the updater:
+            # returning earlier would let a racing push be applied with
+            # ASSIGN semantics. Callers barrier() after set_optimizer
+            # (as the reference tests do), which extends the guarantee
+            # to every rank's pushes.
+            deadline = time.monotonic() + 10.0
+            while self._server._optv != v:
+                if time.monotonic() > deadline:
+                    raise MXNetError("async server did not install optimizer")
+                time.sleep(0.005)
+
+    def async_fence(self, timeout=60.0):
+        """Block until the server has applied every push published by
+        every rank at call time. Call after barrier() for a global
+        quiescence point."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            done = True
+            for r in range(self._nworkers):
+                # NOT_FOUND means the rank truly never pushed (done);
+                # any other error is UNKNOWN state, not "no pushes" —
+                # returning early on a transient coordinator error would
+                # be exactly the lost-update the fence prevents
+                ss, s = self._read_kv("mxtpu_as/s/%d" % r)
+                if ss == "absent":
+                    continue
+                sa, a = self._read_kv("mxtpu_as/a/%d" % r)
+                if ss == "error" or sa == "error" or int(s) > int(a or 0):
+                    done = False
+                    break
+            if done:
+                return
+            time.sleep(0.01)
+        raise MXNetError("async_fence timed out after %.1fs" % timeout)
+
+    # -- helpers ---------------------------------------------------------------
+    def _try_get(self, k):
+        try:
+            return self._client.key_value_try_get(k)
+        except Exception:
+            return None
+
+    def _read_kv(self, k):
+        """('ok', value) | ('absent', None) — only on NOT_FOUND — |
+        ('error', None) for transient coordinator failures."""
+        try:
+            return "ok", self._client.key_value_try_get(k)
+        except Exception as e:
+            if "NOT_FOUND" in str(e):
+                return "absent", None
+            return "error", None
+
+    def _wait_key(self, k, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._try_get(k) is not None:
+                return
+            time.sleep(0.01)
+        raise MXNetError("timed out waiting for %s" % k)
 
 
 def _maybe_init_distributed():

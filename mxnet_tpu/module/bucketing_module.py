@@ -185,13 +185,18 @@ class BucketingModule(BaseModule):
         for mod in self._buckets.values():
             if mod is self._curr_module or not mod.params_initialized:
                 continue
+            data_like = set(mod.data_names) | set(mod._label_names or ())
             stale = [
                 name
                 for name, arr in mod._execs[0].arg_dict.items()
                 if name in cur_execs[0].arg_dict
                 and arr is not cur_execs[0].arg_dict[name]
-                and name not in mod.data_names
-                and name not in (mod.label_names or ())
+                and name not in data_like
+            ] + [
+                name
+                for name, arr in mod._execs[0].aux_dict.items()
+                if name in cur_execs[0].aux_dict
+                and arr is not cur_execs[0].aux_dict[name]
             ]
             if stale:
                 arg, aux = self._curr_module.get_params()

@@ -1,0 +1,83 @@
+"""Distributed ASYNC kvstore check: apply-on-arrival semantics, run as
+one worker of a multi-process job (ref: the async server path of
+src/kvstore/kvstore_dist_server.h:200-207; the reference had no async
+acceptance test — this one proves the semantics the sync test cannot).
+
+Launch:
+    python tools/launch.py -n 3 --launcher local \\
+        python tests/nightly/dist_async_kvstore.py
+
+Phase 1 (interleaving proof): rank 0 pushes 3 gradient groups and reads
+back the applied result WHILE every other rank is still asleep and has
+pushed nothing. Under lock-step (dist_sync) semantics a push is a
+collective that cannot complete without every rank; under async
+semantics rank 0's updates must be applied and visible alone. The pulled
+value must equal init + 3 (Test optimizer: w += rescale_grad * grad) with
+no contribution from the sleepers.
+
+Phase 2 (totality): after a barrier every rank pushes (rank+1) twice;
+after barrier + async_fence the weight must hold the full sum — async
+staleness never loses an update.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+shape = (4, 4)
+
+
+def main():
+    kv = mx.kvstore.create("dist_async")
+    rank, nworker = kv.rank, kv.num_workers
+    assert type(kv).__name__ == "_AsyncDistKVStore", (
+        "dist_async fell back to sync semantics: %s" % type(kv).__name__)
+
+    kv.init("w", mx.nd.ones(shape))
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=1.0))
+    kv.barrier()
+
+    # -- phase 1: rank 0 alone, others asleep -------------------------------
+    if rank == 0:
+        for _ in range(3):
+            kv.push("w", mx.nd.ones(shape))
+        kv.async_fence()
+        out = mx.nd.zeros(shape)
+        kv.pull("w", out=out)
+        got = out.asnumpy()
+        expect = 1.0 + 3.0  # init + rank0's three unit gradients, nobody else
+        err = np.abs(got - expect).max()
+        assert err < 1e-5, (
+            "apply-on-arrival violated: expected %s from rank 0's solo "
+            "pushes, got %s" % (expect, got.ravel()[:4]))
+        print("rank 0: solo async updates applied on arrival (w=%s)" % expect)
+    else:
+        time.sleep(1.5)  # stay silent while rank 0 proves interleaving
+
+    kv.barrier()
+
+    # -- phase 2: everyone pushes; fence; total must be exact ---------------
+    for _ in range(2):
+        kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()
+    kv.async_fence()
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = 4.0 + 2.0 * nworker * (nworker + 1) / 2.0
+    err = np.abs(out.asnumpy() - expect).max()
+    assert err < 1e-5, (
+        "rank %d: expected %s after fence, max err %s" % (rank, expect, err))
+    print("rank %d/%d: dist_async totality OK (value=%s)"
+          % (rank, nworker, expect))
+
+    kv.barrier()
+
+
+if __name__ == "__main__":
+    main()
