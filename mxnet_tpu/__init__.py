@@ -19,6 +19,7 @@ from . import opencv as cv
 from . import sframe_plugin
 from . import ndarray
 from . import ndarray as nd
+from . import stream
 from . import random
 from .attribute import AttrScope
 from .name import NameManager, Prefix

@@ -514,6 +514,11 @@ def _unb64(s):
     return pickle.loads(base64.b64decode(s))
 
 
+# rank 0's live async server (at most one per process; a new dist_async
+# store retires the previous generation's server)
+_ASYNC_SERVER = None
+
+
 class _AsyncServer:
     """The reference's parameter-server role (kvstore_dist_server.h),
     hosted as a thread on rank 0. Applies each worker's gradient group ON
@@ -527,8 +532,9 @@ class _AsyncServer:
 
     POLL_S = 0.005
 
-    def __init__(self, client, nworkers):
+    def __init__(self, client, nworkers, ns="mxtpu_as"):
         self._client = client
+        self._ns = ns
         self._n = nworkers
         self._weights = {}           # key(str) -> NDArray (cpu)
         self._versions = {}          # key(str) -> int
@@ -555,7 +561,7 @@ class _AsyncServer:
 
     def _publish(self, key):
         self._client.key_value_set(
-            "mxtpu_as/w/%s" % key,
+            "%s/w/%s" % (self._ns, key),
             _b64((self._versions[key], self._weights[key].asnumpy())),
             allow_overwrite=True)
 
@@ -566,10 +572,10 @@ class _AsyncServer:
             return None
 
     def _check_optimizer(self):
-        v = self._try_get("mxtpu_as/optv")
+        v = self._try_get("%s/optv" % self._ns)
         if v is None or int(v) == self._optv:
             return
-        blob = self._try_get("mxtpu_as/opt")
+        blob = self._try_get("%s/opt" % self._ns)
         if blob is None:
             return
         from . import optimizer as opt
@@ -594,13 +600,13 @@ class _AsyncServer:
 
                 logging.exception("async server optimizer check failed")
             for r in range(self._n):
-                s = self._try_get("mxtpu_as/s/%d" % r)
+                s = self._try_get("%s/s/%d" % (self._ns, r))
                 if s is None:
                     continue
                 s = int(s)
                 while self._applied[r] < s and not self._stop.is_set():
                     n = self._applied[r] + 1
-                    blob = self._try_get("mxtpu_as/g/%d/%d" % (r, n))
+                    blob = self._try_get("%s/g/%d/%d" % (self._ns, r, n))
                     if blob is None:
                         break  # seq bumped before payload landed
                     try:
@@ -626,7 +632,7 @@ class _AsyncServer:
                     self._applied[r] = n
                     try:  # consumed: free the coordinator's copy
                         self._client.key_value_delete(
-                            "mxtpu_as/g/%d/%d" % (r, n))
+                            "%s/g/%d/%d" % (self._ns, r, n))
                     except Exception:
                         pass
             for key in list(dirty):
@@ -639,7 +645,7 @@ class _AsyncServer:
                 if acked[r] != self._applied[r] and not dirty:
                     try:
                         self._client.key_value_set(
-                            "mxtpu_as/a/%d" % r, str(self._applied[r]),
+                            "%s/a/%d" % (self._ns, r), str(self._applied[r]),
                             allow_overwrite=True)
                         acked[r] = self._applied[r]
                     except Exception:
@@ -672,12 +678,34 @@ class _AsyncDistKVStore(KVStore):
 
         self._rank = jax.process_index()
         self._nworkers = jax.process_count()
+        # Generation-scoped key namespace: a second dist_async store in
+        # the same job must not see the previous store's published
+        # weights/sequence counters (stale-init + double-server races).
+        # Rank 0 bumps the generation, retires any previous server
+        # thread, and starts a fresh one; the constructor barrier makes
+        # the new generation visible before any rank proceeds (create()
+        # is SPMD — every rank constructs the store together).
         if self._rank == 0:
-            self._server = _AsyncServer(client, self._nworkers)
+            global _ASYNC_SERVER
+            if _ASYNC_SERVER is not None:
+                _ASYNC_SERVER.stop()
+            st, g = self._read_kv("mxtpu_as/gen")
+            gen = (int(g) + 1) if st == "ok" and g is not None else 1
+            client.key_value_set("mxtpu_as/gen", str(gen),
+                                 allow_overwrite=True)
+            self._ns = "mxtpu_as%d" % gen
+            self._server = _AsyncServer(client, self._nworkers, self._ns)
+            _ASYNC_SERVER = self._server
             self._server.start()
             import weakref
 
             weakref.finalize(self, self._server._stop.set)
+        self.barrier()
+        if self._rank != 0:
+            st, g = self._read_kv("mxtpu_as/gen")
+            if st != "ok" or g is None:
+                raise MXNetError("dist_async: generation key unreadable")
+            self._ns = "mxtpu_as%s" % g
 
     # -- API overrides ---------------------------------------------------------
     def init(self, key, value):
@@ -690,7 +718,7 @@ class _AsyncDistKVStore(KVStore):
             if self._rank == 0:
                 self._server.init_key(k, v.asnumpy())
             else:
-                self._wait_key("mxtpu_as/w/%s" % k)
+                self._wait_key("%s/w/%s" % (self._ns, k))
 
     def push(self, key, value, priority=0):
         keys, values = self._key_value(key, value, allow_list_per_key=True)
@@ -705,9 +733,9 @@ class _AsyncDistKVStore(KVStore):
         self._seq += 1
         # payload first, then the sequence bump that makes it visible
         self._client.key_value_set(
-            "mxtpu_as/g/%d/%d" % (self._rank, self._seq), _b64(group))
+            "%s/g/%d/%d" % (self._ns, self._rank, self._seq), _b64(group))
         self._client.key_value_set(
-            "mxtpu_as/s/%d" % self._rank, str(self._seq),
+            "%s/s/%d" % (self._ns, self._rank), str(self._seq),
             allow_overwrite=True)
 
     def pull(self, key, out=None, priority=0):
@@ -717,9 +745,13 @@ class _AsyncDistKVStore(KVStore):
             k = str(k)
             if k not in self._store:
                 raise MXNetError("key %s has not been inited" % k)
-            blob = self._client.key_value_try_get("mxtpu_as/w/%s" % k)
-            if blob is None:
+            st, blob = self._read_kv("%s/w/%s" % (self._ns, k))
+            if st == "absent" or blob is None:
                 raise MXNetError("async weight for key %s not published" % k)
+            if st == "error":
+                raise MXNetError(
+                    "async pull of key %s failed: coordination service "
+                    "unreachable" % k)
             _, arr = _unb64(blob)
             nd = NDArray(arr, cpu(0))
             targets = o if isinstance(o, (list, tuple)) else [o]
@@ -735,9 +767,9 @@ class _AsyncDistKVStore(KVStore):
         self._optimizer = optimizer
         if self._rank == 0:
             v = int(time.time() * 1e6)
-            self._client.key_value_set("mxtpu_as/opt", _b64(optimizer),
+            self._client.key_value_set("%s/opt" % self._ns, _b64(optimizer),
                                        allow_overwrite=True)
-            self._client.key_value_set("mxtpu_as/optv", str(v),
+            self._client.key_value_set("%s/optv" % self._ns, str(v),
                                        allow_overwrite=True)
             # Block until the server thread installed the updater:
             # returning earlier would let a racing push be applied with
@@ -762,10 +794,10 @@ class _AsyncDistKVStore(KVStore):
                 # any other error is UNKNOWN state, not "no pushes" —
                 # returning early on a transient coordinator error would
                 # be exactly the lost-update the fence prevents
-                ss, s = self._read_kv("mxtpu_as/s/%d" % r)
+                ss, s = self._read_kv("%s/s/%d" % (self._ns, r))
                 if ss == "absent":
                     continue
-                sa, a = self._read_kv("mxtpu_as/a/%d" % r)
+                sa, a = self._read_kv("%s/a/%d" % (self._ns, r))
                 if ss == "error" or sa == "error" or int(s) > int(a or 0):
                     done = False
                     break

@@ -552,7 +552,9 @@ def save(fname, data):
         arrays = list(data)
     else:
         raise MXNetError("save requires a list or dict of NDArray")
-    with open(fname, "wb") as f:
+    from .stream import open_stream
+
+    with open_stream(fname, "wb") as f:
         f.write(struct.pack("<QQQ", _ND_MAGIC, 0, len(arrays)))
         f.write(struct.pack("<Q", len(names)))
         for name in names:
@@ -564,8 +566,11 @@ def save(fname, data):
 
 
 def load(fname, ctx=None):
-    """Load list or dict of NDArray (ref: python/mxnet/ndarray.py:876)."""
-    with open(fname, "rb") as f:
+    """Load list or dict of NDArray (ref: python/mxnet/ndarray.py:876).
+    Accepts stream URIs (s3://, hdfs://, mem://) like dmlc::Stream."""
+    from .stream import open_stream
+
+    with open_stream(fname, "rb") as f:
         return load_frombuffer(f.read(), ctx)
 
 

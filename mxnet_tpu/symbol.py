@@ -422,7 +422,9 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from .stream import open_stream
+
+        with open_stream(fname, "w") as f:
             f.write(self.tojson())
 
     def debug_str(self):
@@ -570,7 +572,11 @@ def load_json(json_str):
 
 
 def load(fname):
-    with open(fname) as f:
+    """Load a Symbol from a JSON file or stream URI (s3://, hdfs://,
+    mem://), like dmlc::Stream."""
+    from .stream import open_stream
+
+    with open_stream(fname, "r") as f:
         return load_json(f.read())
 
 

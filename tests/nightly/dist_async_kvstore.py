@@ -78,6 +78,20 @@ def main():
 
     kv.barrier()
 
+    # -- phase 3: a second store must get a FRESH generation ----------------
+    # (stale published weights from kv must not leak into kv2's init;
+    # regression for the generation-namespace fix)
+    kv2 = mx.kvstore.create("dist_async")
+    kv2.init("w", mx.nd.zeros(shape))  # same key name, new value
+    kv2.barrier()
+    out = mx.nd.ones(shape)
+    kv2.pull("w", out=out)
+    assert np.abs(out.asnumpy()).max() < 1e-6, (
+        "rank %d: second dist_async store saw the first store's stale "
+        "weights" % rank)
+    print("rank %d/%d: dist_async regeneration OK" % (rank, nworker))
+    kv2.barrier()
+
 
 if __name__ == "__main__":
     main()

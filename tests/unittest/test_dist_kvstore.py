@@ -108,3 +108,5 @@ def test_dist_async_kvstore_3_workers():
     for rank in range(3):
         assert ("rank %d/3: dist_async totality OK" % rank) in r.stdout, \
             r.stdout + r.stderr
+        assert ("rank %d/3: dist_async regeneration OK" % rank) in r.stdout, \
+            r.stdout + r.stderr

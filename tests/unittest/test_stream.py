@@ -1,0 +1,63 @@
+"""URI-dispatched stream IO (the dmlc::Stream role, VERDICT r1 item 7):
+NDArray/Symbol/checkpoint save+load must accept scheme URIs transparently;
+remote schemes without their client library must fail with a clear error,
+matching the reference's USE_S3/USE_HDFS build gates."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_ndarray_save_load_mem_uri():
+    data = {"w": mx.nd.array(np.arange(12, dtype="f").reshape(3, 4)),
+            "b": mx.nd.ones((4,))}
+    mx.nd.save("mem://ckpt/test.params", data)
+    assert "ckpt/test.params" in mx.stream.mem_store()
+    back = mx.nd.load("mem://ckpt/test.params")
+    assert set(back) == {"w", "b"}
+    assert np.allclose(back["w"].asnumpy(), data["w"].asnumpy())
+
+
+def test_symbol_save_load_mem_uri():
+    s = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc")
+    s.save("mem://sym/net.json")
+    s2 = mx.symbol.load("mem://sym/net.json")
+    assert s2.list_arguments() == s.list_arguments()
+
+
+def test_checkpoint_roundtrip_mem_uri():
+    s = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    arg = {"fc_weight": mx.nd.ones((2, 3)), "fc_bias": mx.nd.zeros((2,))}
+    mx.model.save_checkpoint("mem://run/model", 7, s, arg, {}, sync=True)
+    sym2, arg2, aux2 = mx.model.load_checkpoint("mem://run/model", 7)
+    assert sym2.list_arguments() == s.list_arguments()
+    assert np.allclose(arg2["fc_weight"].asnumpy(), 1.0)
+    assert aux2 == {}
+
+
+def test_file_scheme_equals_plain_path(tmp_path):
+    p = tmp_path / "x.params"
+    mx.nd.save("file://%s" % p, [mx.nd.ones((2, 2))])
+    [back] = mx.nd.load(str(p))
+    assert np.allclose(back.asnumpy(), 1.0)
+
+
+def test_unknown_scheme_and_gated_s3():
+    with pytest.raises(mx.base.MXNetError, match="unknown stream scheme"):
+        mx.stream.open_stream("gopher://x/y", "rb")
+    try:
+        import boto3  # noqa: F401
+        pytest.skip("boto3 installed; gate not exercised")
+    except ImportError:
+        pass
+    with pytest.raises(mx.base.MXNetError, match="boto3"):
+        mx.nd.load("s3://bucket/key.params")
+
+
+def test_exists_and_missing_mem():
+    assert not mx.stream.exists("mem://never/written")
+    with pytest.raises(FileNotFoundError):
+        mx.stream.open_stream("mem://never/written", "rb")
