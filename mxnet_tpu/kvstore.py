@@ -690,6 +690,11 @@ class _AsyncDistKVStore(KVStore):
             if _ASYNC_SERVER is not None:
                 _ASYNC_SERVER.stop()
             st, g = self._read_kv("mxtpu_as/gen")
+            if st == "error":
+                # defaulting to gen 1 on a transient read error would
+                # collide with a previous generation's stale keys — the
+                # exact bug the namespace exists to prevent
+                raise MXNetError("dist_async: generation key unreadable")
             gen = (int(g) + 1) if st == "ok" and g is not None else 1
             client.key_value_set("mxtpu_as/gen", str(gen),
                                  allow_overwrite=True)
@@ -706,6 +711,10 @@ class _AsyncDistKVStore(KVStore):
             if st != "ok" or g is None:
                 raise MXNetError("dist_async: generation key unreadable")
             self._ns = "mxtpu_as%s" % g
+        # second barrier: rank 0 must not proceed (and possibly start
+        # constructing a NEXT store that bumps the generation) until
+        # every rank has captured THIS generation
+        self.barrier()
 
     # -- API overrides ---------------------------------------------------------
     def init(self, key, value):

@@ -91,7 +91,11 @@ def make_symbol_train_step(symbol, input_shapes, optimizer=None,
                 (batch[n] if n in batch else pc[n]) for n in arg_names
             ]
             outs, new_aux = run(vals, aux, rng, is_train=True)
-            return outs, new_aux
+            # moving stats are state, not a differentiable output: cut
+            # their cotangent path at trace time so the vjp never builds
+            # a backward graph for them (the zero cotangents below would
+            # otherwise rely on XLA zero-propagation to DCE it)
+            return outs, [jax.lax.stop_gradient(a) for a in new_aux]
 
         (outs, new_aux), vjp_fn = jax.vjp(f, params)
         head_grads = [jnp.ones(o.shape, o.dtype) for o in outs]

@@ -134,15 +134,35 @@ def _open_file(path, mode):
     return open(path, mode)
 
 
-def _open_s3(path, mode):
+def _s3_client():
+    """Shared boto3 client + import gate for open/exists."""
     try:
         import boto3
     except ImportError as e:
         raise MXNetError(
             "s3:// stream requires boto3 (the reference likewise needs "
             "USE_S3=1; ref dmlc-core/src/io.cc:49)") from e
+    return boto3.client("s3")
+
+
+def _hdfs_fs(path):
+    """Shared HadoopFileSystem + path parse + import gate: returns
+    (fs, absolute_path)."""
+    try:
+        from pyarrow import fs as _pafs
+    except ImportError as e:
+        raise MXNetError(
+            "hdfs:// stream requires pyarrow (the reference likewise "
+            "needs USE_HDFS=1; ref dmlc-core/src/io.cc:61)") from e
+    host, _, rest = path.partition("/")
+    h, _, p = host.partition(":")
+    fs = _pafs.HadoopFileSystem(h or "default", int(p) if p else 8020)
+    return fs, "/" + rest
+
+
+def _open_s3(path, mode):
     bucket, _, key = path.partition("/")
-    s3 = boto3.client("s3")
+    s3 = _s3_client()
     if "w" in mode:
         return _write_behind(
             lambda data: s3.put_object(Bucket=bucket, Key=key, Body=data),
@@ -153,22 +173,14 @@ def _open_s3(path, mode):
 
 
 def _open_hdfs(path, mode):
-    try:
-        from pyarrow import fs as _pafs
-    except ImportError as e:
-        raise MXNetError(
-            "hdfs:// stream requires pyarrow (the reference likewise "
-            "needs USE_HDFS=1; ref dmlc-core/src/io.cc:61)") from e
-    host, _, rest = path.partition("/")
-    h, _, p = host.partition(":")
-    hdfs = _pafs.HadoopFileSystem(h or "default", int(p) if p else 8020)
+    hdfs, abspath = _hdfs_fs(path)
     if "w" in mode:
         def commit(data):
-            with hdfs.open_output_stream("/" + rest) as f:
+            with hdfs.open_output_stream(abspath) as f:
                 f.write(data)
 
         return _write_behind(commit, mode)
-    with hdfs.open_input_stream("/" + rest) as f:
+    with hdfs.open_input_stream(abspath) as f:
         body = f.read()
     return io.BytesIO(body) if "b" in mode else io.StringIO(
         body.decode("utf-8"))
@@ -214,28 +226,23 @@ def exists(uri):
         with _MEM_LOCK:
             return path in _MEM
     if scheme == "s3":
-        try:
-            import boto3
-            import botocore.exceptions
-        except ImportError as e:
-            raise MXNetError(
-                "s3:// stream requires boto3 (ref USE_S3 gate)") from e
+        s3 = _s3_client()
+        import botocore.exceptions
+
         bucket, _, key = path.partition("/")
         try:
-            boto3.client("s3").head_object(Bucket=bucket, Key=key)
+            s3.head_object(Bucket=bucket, Key=key)
             return True
-        except botocore.exceptions.ClientError:
-            return False
+        except botocore.exceptions.ClientError as e:
+            code = str(e.response.get("Error", {}).get("Code", ""))
+            if code in ("404", "NoSuchKey", "NotFound"):
+                return False
+            raise  # 403/throttling etc. is an error, not "absent"
     if scheme == "hdfs":
-        try:
-            from pyarrow import fs as _pafs
-        except ImportError as e:
-            raise MXNetError(
-                "hdfs:// stream requires pyarrow (ref USE_HDFS gate)") from e
-        host, _, rest = path.partition("/")
-        h, _, p = host.partition(":")
-        hdfs = _pafs.HadoopFileSystem(h or "default", int(p) if p else 8020)
-        info = hdfs.get_file_info("/" + rest)
+        from pyarrow import fs as _pafs
+
+        hdfs, abspath = _hdfs_fs(path)
+        info = hdfs.get_file_info(abspath)
         return info.type != _pafs.FileType.NotFound
     try:
         open_stream(uri, "rb").close()
