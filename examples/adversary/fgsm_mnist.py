@@ -1,0 +1,85 @@
+"""Fast-gradient-sign adversarial examples against a trained MLP.
+
+TPU-native counterpart of the reference's example/adversary/
+(adversary_generation.ipynb: train on MNIST, take the loss gradient
+WITH RESPECT TO THE INPUT via an executor bound with inputs_need_grad,
+perturb by epsilon * sign(grad), and watch accuracy collapse). Same
+machinery here: bind with a gradient buffer on 'data', backward fills
+it, the FGSM step uses its sign.
+
+Run: PYTHONPATH=. python examples/adversary/fgsm_mnist.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def mlp():
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=128, name="fc1"),
+                       act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--epsilon", type=float, default=0.3)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(batch_size=args.batch_size, num_synthetic=2000,
+                            seed=1, flat=True)
+    val = mx.io.MNISTIter(batch_size=args.batch_size, num_synthetic=1000,
+                          seed=2, flat=True, shuffle=False)
+    model = mx.FeedForward(mlp(), ctx=mx.cpu(), num_epoch=args.epochs,
+                           learning_rate=0.1, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val)
+    clean_acc = model.score(val)
+    print("clean accuracy %.3f" % clean_acc)
+
+    # rebind the trained net with a gradient buffer on the INPUT
+    net = mlp()
+    arg_arrays = {"data": mx.nd.zeros((args.batch_size, 784)),
+                  "softmax_label": mx.nd.zeros((args.batch_size,))}
+    for name, arr in model.arg_params.items():
+        arg_arrays[name] = arr
+    grads = {"data": mx.nd.zeros((args.batch_size, 784))}
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grads,
+                   grad_req={n: ("write" if n == "data" else "null")
+                             for n in arg_arrays})
+
+    val.reset()
+    total, fooled_correct = 0, 0
+    for batch in val:
+        x = batch.data[0].asnumpy().reshape(args.batch_size, 784)
+        y = batch.label[0].asnumpy()
+        arg_arrays["data"][:] = x
+        arg_arrays["softmax_label"][:] = y
+        p = exe.forward(is_train=True)[0].asnumpy()
+        exe.backward()
+        # FGSM: one epsilon-step along sign of dLoss/dInput
+        x_adv = x + args.epsilon * np.sign(grads["data"].asnumpy())
+        arg_arrays["data"][:] = np.clip(x_adv, 0, 1)
+        p_adv = exe.forward(is_train=False)[0].asnumpy()
+        total += args.batch_size
+        fooled_correct += (p_adv.argmax(1) == y).sum()
+    adv_acc = fooled_correct / total
+    print("adversarial accuracy %.3f (epsilon=%.2f)" % (adv_acc, args.epsilon))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert clean_acc > 0.9, "model failed to train"
+        assert adv_acc < clean_acc - 0.3, (
+            "FGSM failed to reduce accuracy (%.3f -> %.3f)"
+            % (clean_acc, adv_acc))
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

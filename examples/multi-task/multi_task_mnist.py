@@ -1,0 +1,90 @@
+"""Multi-task training: one trunk, two heads, one Grouped symbol.
+
+TPU-native counterpart of the reference's example/multi-task/
+(example_multi_task.py: Group(softmax_digit, softmax_parity) over a
+shared LeNet trunk, a custom Multi_Accuracy metric, and a module fed two
+labels). Task here: classify the digit AND its parity from the same
+trunk; both heads backpropagate into shared weights in one step.
+
+Run: PYTHONPATH=. python examples/multi-task/multi_task_mnist.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def multi_task_symbol():
+    data = sym.Variable("data")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=128, name="fc1"),
+                       act_type="relu")
+    digit = sym.SoftmaxOutput(
+        sym.FullyConnected(h, num_hidden=10, name="fc_digit"),
+        name="softmax_digit")
+    parity = sym.SoftmaxOutput(
+        sym.FullyConnected(h, num_hidden=2, name="fc_parity"),
+        name="softmax_parity", grad_scale=0.5)
+    return sym.Group([digit, parity])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--steps", type=int, default=120)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    N = args.batch_size
+    it = mx.io.MNISTIter(batch_size=N, num_synthetic=2000, seed=1, flat=True)
+    net = multi_task_symbol()
+    init = mx.initializer.Xavier()
+    shapes = {"data": (N, 784), "softmax_digit_label": (N,),
+              "softmax_parity_label": (N,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    arg_arrays, grad_arrays = {}, {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        arr = mx.nd.zeros(shape)
+        if name not in shapes:
+            init(name, arr)
+            grad_arrays[name] = mx.nd.zeros(shape)
+        arg_arrays[name] = arr
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grad_arrays,
+                   grad_req={n: ("write" if n in grad_arrays else "null")
+                             for n in arg_arrays})
+    opt = mx.optimizer.Adam(learning_rate=2e-3)
+    states = {n: opt.create_state(i, arg_arrays[n])
+              for i, n in enumerate(grad_arrays)}
+
+    acc_d = acc_p = 0.0
+    step = 0
+    while step < args.steps:
+        it.reset()
+        for batch in it:
+            if step >= args.steps:
+                break
+            x = batch.data[0].asnumpy().reshape(N, 784)
+            y = batch.label[0].asnumpy()
+            arg_arrays["data"][:] = x
+            arg_arrays["softmax_digit_label"][:] = y
+            arg_arrays["softmax_parity_label"][:] = y % 2
+            outs = exe.forward(is_train=True)
+            exe.backward()  # BOTH heads contribute in one backward
+            for i, n in enumerate(grad_arrays):
+                opt.update(i, arg_arrays[n], grad_arrays[n], states[n])
+            acc_d = float((outs[0].asnumpy().argmax(1) == y).mean())
+            acc_p = float((outs[1].asnumpy().argmax(1) == y % 2).mean())
+            step += 1
+        print("step %3d  digit-acc %.3f  parity-acc %.3f"
+              % (step, acc_d, acc_p))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert acc_d > 0.9 and acc_p > 0.9, (
+            "multi-task training failed (digit %.2f parity %.2f)"
+            % (acc_d, acc_p))
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Stochastic depth: residual units that randomly drop during training.
+
+TPU-native counterpart of the reference's example/stochastic-depth/
+(sd_module.py + sd_cifar10.py: Huang et al. 2016 — each residual unit is
+skipped with a depth-dependent "death rate" at train time and scaled by
+its survival probability at test time; the reference implements the gate
+with a per-unit module switcher). Here the gate is a per-unit Dropout on
+the RESIDUAL BRANCH with linearly increasing death rate — under XLA the
+whole stochastic net stays one compiled program, no module switching
+needed, and Dropout's train/eval split gives the survival-probability
+scaling for free (inverted-dropout scaling at train time).
+
+Run: PYTHONPATH=. python examples/stochastic-depth/sd_cifar.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def residual_unit(data, num_filter, name, death_rate):
+    c = sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                        num_filter=num_filter, name=name + "_conv1")
+    c = sym.Activation(c, act_type="relu")
+    c = sym.Convolution(c, kernel=(3, 3), pad=(1, 1),
+                        num_filter=num_filter, name=name + "_conv2")
+    if death_rate > 0:
+        # Per-SAMPLE branch gate (Huang et al.: the whole unit is
+        # skipped, not individual activations): build a (N,1,1,1) ones
+        # tensor, Dropout it — one Bernoulli draw per sample — and
+        # broadcast onto the branch. Dropout's eval identity + inverted
+        # train-time 1/(1-p) scaling is exactly the survival-probability
+        # calibration of eq. (6).
+        ones = sym.sum(c, axis=(1, 2, 3), keepdims=True) * 0.0 + 1.0
+        gate = sym.Dropout(ones, p=death_rate, name=name + "_sdgate")
+        c = sym.broadcast_mul(c, gate)
+    return sym.Activation(data + c, act_type="relu")
+
+
+def sd_net(num_units, num_filter, num_classes, final_death_rate):
+    data = sym.Variable("data")
+    body = sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                           num_filter=num_filter, name="conv0")
+    body = sym.Activation(body, act_type="relu")
+    for i in range(num_units):
+        # linearly increasing death rate, shallow units most reliable
+        dr = final_death_rate * (i + 1) / num_units
+        body = residual_unit(body, num_filter, "unit%d" % i, dr)
+    pool = sym.Pooling(body, global_pool=True, kernel=(8, 8),
+                       pool_type="avg", name="pool")
+    fc = sym.FullyConnected(sym.Flatten(pool), num_hidden=num_classes,
+                            name="fc")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def make_batch(n, rng):
+    """Synthetic CIFAR-like task: class = dominant quadrant pattern."""
+    x = rng.rand(n, 3, 16, 16).astype("f") * 0.3
+    y = rng.randint(0, 4, n).astype("f")
+    for i in range(n):
+        q = int(y[i])
+        r0, c0 = (q // 2) * 8, (q % 2) * 8
+        x[i, q % 3, r0:r0 + 8, c0:c0 + 8] += 0.8
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-units", type=int, default=6)
+    ap.add_argument("--death-rate", type=float, default=0.5)
+    ap.add_argument("--steps", type=int, default=150)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(2)
+    N = args.batch_size
+    net = sd_net(args.num_units, 16, 4, args.death_rate)
+    init = mx.initializer.Xavier()
+    shapes = {"data": (N, 3, 16, 16), "softmax_label": (N,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    arg_arrays, grad_arrays = {}, {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        arr = mx.nd.zeros(shape)
+        if name not in shapes:
+            init(name, arr)
+            grad_arrays[name] = mx.nd.zeros(shape)
+        arg_arrays[name] = arr
+    exe = net.bind(mx.cpu(), arg_arrays, args_grad=grad_arrays,
+                   grad_req={n: ("write" if n in grad_arrays else "null")
+                             for n in arg_arrays})
+    opt = mx.optimizer.Adam(learning_rate=2e-3)
+    states = {n: opt.create_state(i, arg_arrays[n])
+              for i, n in enumerate(grad_arrays)}
+
+    for step in range(args.steps):
+        x, y = make_batch(N, rng)
+        arg_arrays["data"][:] = x
+        arg_arrays["softmax_label"][:] = y
+        exe.forward(is_train=True)  # units drop stochastically here
+        exe.backward()
+        for i, n in enumerate(grad_arrays):
+            opt.update(i, arg_arrays[n], grad_arrays[n], states[n])
+
+    # eval: full depth, survival-scaled (Dropout eval identity)
+    x, y = make_batch(256 // N * N, rng)
+    correct = 0
+    for b in range(0, len(y), N):
+        arg_arrays["data"][:] = x[b:b + N]
+        p = exe.forward(is_train=False)[0].asnumpy()
+        correct += (p.argmax(1) == y[b:b + N]).sum()
+    acc = correct / len(y)
+    print("eval accuracy %.3f (death_rate=%.2f, %d units)"
+          % (acc, args.death_rate, args.num_units))
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert acc > 0.9, "stochastic-depth net failed to train (%.3f)" % acc
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,9 @@ CASES = [
     ("rcnn/proposal.py", []),
     ("memcost/lstm_memcost.py", ["--seq-len", "16"]),
     ("numpy-ops/numpy_softmax.py", []),
+    ("adversary/fgsm_mnist.py", ["--epochs", "1"]),
+    ("multi-task/multi_task_mnist.py", ["--steps", "10"]),
+    ("stochastic-depth/sd_cifar.py", ["--steps", "6"]),
 ]
 
 
