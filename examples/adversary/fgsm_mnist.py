@@ -63,7 +63,7 @@ def main():
         y = batch.label[0].asnumpy()
         arg_arrays["data"][:] = x
         arg_arrays["softmax_label"][:] = y
-        p = exe.forward(is_train=True)[0].asnumpy()
+        exe.forward(is_train=True)
         exe.backward()
         # FGSM: one epsilon-step along sign of dLoss/dInput
         x_adv = x + args.epsilon * np.sign(grads["data"].asnumpy())

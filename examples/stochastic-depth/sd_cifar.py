@@ -106,7 +106,7 @@ def main():
             opt.update(i, arg_arrays[n], grad_arrays[n], states[n])
 
     # eval: full depth, survival-scaled (Dropout eval identity)
-    x, y = make_batch(256 // N * N, rng)
+    x, y = make_batch(max(1, 256 // N) * N, rng)
     correct = 0
     for b in range(0, len(y), N):
         arg_arrays["data"][:] = x[b:b + N]

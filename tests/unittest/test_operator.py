@@ -602,3 +602,152 @@ def test_svm_output_forward_and_grad():
         exe.backward()
         ref = _np_svm_grad(x, y, 0.7, 0.3, use_linear)
         assert reldiff(exe.grad_dict["data"].asnumpy(), ref) < 1e-5, use_linear
+
+
+# ---------------------------------------------------------------------------
+# Coverage for the remaining registered ops that had no dedicated case
+# (LRN vs torch; Crop/Cast/SoftmaxActivation/broadcast family/element
+# selection vs numpy oracles).
+# ---------------------------------------------------------------------------
+
+def test_lrn_vs_torch():
+    torch = pytest.importorskip("torch")
+
+    x = np.random.rand(2, 8, 5, 5).astype("f")
+    alpha, beta, knorm, nsize = 1e-3, 0.75, 2.0, 5
+    s = sym.LRN(sym.Variable("a"), alpha=alpha, beta=beta, knorm=knorm,
+                nsize=nsize)
+    out = _bind_fwd(s, {"a": x})[0]
+    ref = torch.nn.functional.local_response_norm(
+        torch.tensor(x), size=nsize, alpha=alpha, beta=beta, k=knorm).numpy()
+    assert reldiff(out, ref) < 1e-5
+
+
+def test_crop_modes():
+    x = np.random.rand(2, 3, 8, 10).astype("f")
+    s = sym.Crop(sym.Variable("data"), num_args=1, h_w=(4, 5), offset=(2, 3))
+    out = _bind_fwd(s, {"data": x})[0]
+    assert np.allclose(out, x[:, :, 2:6, 3:8])
+    s = sym.Crop(sym.Variable("data"), num_args=1, h_w=(4, 4),
+                 center_crop=True)
+    out = _bind_fwd(s, {"data": x})[0]
+    assert np.allclose(out, x[:, :, 2:6, 3:7])
+    # crop-like second input sets the target size
+    like = np.zeros((2, 1, 3, 3), "f")
+    s = sym.Crop(sym.Variable("data"), sym.Variable("crop_like"), num_args=2,
+                 offset=(1, 1))
+    out = _bind_fwd(s, {"data": x, "crop_like": like})[0]
+    assert np.allclose(out, x[:, :, 1:4, 1:4])
+
+
+def test_crop_nd_and_cast():
+    x = np.arange(24, dtype="f").reshape(2, 3, 4)
+    s = sym.crop_nd(sym.Variable("a"), begin=(0, 1, 1), end=(2, 3, 3))
+    out = _bind_fwd(s, {"a": x})[0]
+    assert np.allclose(out, x[0:2, 1:3, 1:3])
+    s = sym.Cast(sym.Variable("a"), dtype="int32")
+    args = {"a": mx.nd.array(x)}
+    exe = s.bind(mx.cpu(), args, grad_req="null")
+    out = exe.forward()[0]
+    assert out.dtype == np.int32
+
+
+def test_softmax_activation_modes():
+    x = np.random.rand(3, 4, 2, 2).astype("f") * 3
+    s = sym.SoftmaxActivation(sym.Variable("a"), mode="channel")
+    out = _bind_fwd(s, {"a": x})[0]
+    e = np.exp(x - x.max(1, keepdims=True))
+    assert reldiff(out, e / e.sum(1, keepdims=True)) < 1e-5
+    s = sym.SoftmaxActivation(sym.Variable("a"), mode="instance")
+    out = _bind_fwd(s, {"a": x})[0]
+    flat = x.reshape(3, -1)
+    e = np.exp(flat - flat.max(1, keepdims=True))
+    ref = (e / e.sum(1, keepdims=True)).reshape(x.shape)
+    assert reldiff(out, ref) < 1e-5
+
+
+def test_argmax_channel_argmin():
+    x = np.random.rand(4, 6).astype("f")
+    out = _bind_fwd(sym.argmax_channel(sym.Variable("a")), {"a": x})[0]
+    assert np.allclose(out, x.argmax(1))
+    out = _bind_fwd(sym.argmin(sym.Variable("a"), axis=1), {"a": x})[0]
+    assert np.allclose(out, x.argmin(1))
+
+
+def test_broadcast_axis_and_comparisons():
+    x = np.random.rand(2, 1, 4).astype("f")
+    s = sym.broadcast_axis(sym.Variable("a"), axis=1, size=3)
+    out = _bind_fwd(s, {"a": x})[0]
+    assert out.shape == (2, 3, 4)
+    assert np.allclose(out, np.broadcast_to(x, (2, 3, 4)))
+    a = np.random.rand(3, 4).astype("f")
+    b = np.random.rand(1, 4).astype("f")
+    for name, fn in [("broadcast_equal", np.equal),
+                     ("broadcast_greater", np.greater),
+                     ("broadcast_lesser", np.less),
+                     ("broadcast_maximum", np.maximum),
+                     ("broadcast_minimum", np.minimum)]:
+        s = getattr(sym, name)(sym.Variable("a"), sym.Variable("b"))
+        out = _bind_fwd(s, {"a": a, "b": b})[0]
+        assert np.allclose(out, fn(a, b).astype("f")), name
+
+
+def test_element_selection_ops():
+    lhs = np.random.rand(4, 5).astype("f")
+    idx = np.array([0, 2, 4, 1], dtype="f")
+    out = _bind_fwd(sym.choose_element_0index(
+        sym.Variable("lhs"), sym.Variable("rhs")), {"lhs": lhs, "rhs": idx})[0]
+    assert np.allclose(out, lhs[np.arange(4), idx.astype(int)])
+    rhs = np.array([9, 8, 7, 6], dtype="f")
+    out = _bind_fwd(sym.fill_element_0index(
+        sym.Variable("lhs"), sym.Variable("mhs"), sym.Variable("rhs")),
+        {"lhs": lhs, "mhs": idx, "rhs": rhs})[0]
+    ref = lhs.copy()
+    ref[np.arange(4), idx.astype(int)] = rhs
+    assert np.allclose(out, ref)
+    mask = np.array([1, 0, 1, 0], dtype="f")
+    out = _bind_fwd(sym.element_mask(
+        sym.Variable("data"), sym.Variable("mask")),
+        {"data": lhs, "mask": mask})[0]
+    assert np.allclose(out, lhs * mask[:, None])
+
+
+def test_mae_regression_and_aliases():
+    x = np.random.rand(4, 3).astype("f")
+    y = np.random.rand(4, 3).astype("f")
+    s = sym.MAERegressionOutput(sym.Variable("data"), sym.Variable("label"),
+                                name="mae")
+    args = {"data": mx.nd.array(x), "label": mx.nd.array(y)}
+    grads = {"data": mx.nd.zeros(x.shape), "label": mx.nd.zeros(y.shape)}
+    exe = s.bind(mx.cpu(), args, args_grad=grads,
+                 grad_req={"data": "write", "label": "null"})
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert np.allclose(out, x)  # forward is identity
+    exe.backward()
+    assert np.allclose(exe.grad_dict["data"].asnumpy(), np.sign(x - y))
+    # op-name aliases kept for reference parity
+    a = np.random.rand(2, 2).astype("f")
+    out = _bind_fwd(sym.elemwise_add(sym.Variable("a"), sym.Variable("b")),
+                    {"a": a, "b": a})[0]
+    assert np.allclose(out, 2 * a)
+    out = _bind_fwd(sym.tanh_op(sym.Variable("a")), {"a": a})[0]
+    assert np.allclose(out, np.tanh(a), atol=1e-6)
+
+
+def test_batchnorm_use_global_stats():
+    """use_global_stats=True must normalize by the MOVING stats even at
+    train time (ref batch_norm-inl.h), leaving them unchanged."""
+    x = np.random.rand(6, 3, 4, 4).astype("f") * 3
+    s = sym.BatchNorm(sym.Variable("data"), fix_gamma=False,
+                      use_global_stats=True, name="bn")
+    args = {"data": mx.nd.array(x), "bn_gamma": mx.nd.ones((3,)),
+            "bn_beta": mx.nd.zeros((3,))}
+    mm = np.array([0.3, 0.5, 0.7], "f")
+    mv = np.array([1.5, 2.0, 0.5], "f")
+    aux = {"bn_moving_mean": mx.nd.array(mm), "bn_moving_var": mx.nd.array(mv)}
+    exe = s.bind(mx.cpu(), args, aux_states=aux, grad_req="null")
+    out = exe.forward(is_train=True)[0].asnumpy()
+    ref = (x - mm.reshape(1, 3, 1, 1)) / np.sqrt(
+        mv.reshape(1, 3, 1, 1) + 1e-3)
+    assert reldiff(out, ref) < 1e-4
+    assert np.allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), mm)
