@@ -265,3 +265,39 @@ def test_bucketing_grad_req_add_not_aliased():
     assert m5.arg_dict["emb_weight"] is m10.arg_dict["emb_weight"]  # params shared
     assert m5.grad_dict["emb_weight"] is not m10.grad_dict["emb_weight"]  # accs private
     assert m5._reqs[m5._arg_names.index("emb_weight")] == "add"
+
+
+def test_model_zoo_classic_convnets_shapes():
+    """Every zoo symbol must infer the right logit shape and run one
+    tiny forward (classic-architecture parity with the reference's
+    symbol_{alexnet,vgg,googlenet,inception-v3,unet} files)."""
+    from mxnet_tpu import models
+
+    cases = [
+        (models.get_alexnet(num_classes=7), (1, 3, 224, 224), (1, 7)),
+        (models.get_vgg(num_classes=7, num_layers=11, batch_norm=True),
+         (1, 3, 224, 224), (1, 7)),
+        (models.get_googlenet(num_classes=7), (1, 3, 224, 224), (1, 7)),
+        (models.get_inception_v3(num_classes=7), (1, 3, 299, 299), (1, 7)),
+    ]
+    for net, dshape, oshape in cases:
+        _, out_shapes, _ = net.infer_shape(data=dshape)
+        assert tuple(out_shapes[0]) == oshape, (dshape, out_shapes)
+    # forward the cheapest one end-to-end
+    net, dshape, oshape = cases[0]
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=dshape)
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == oshape
+    p = out.asnumpy()
+    assert np.allclose(p.sum(1), 1.0, atol=1e-4)  # softmax head
+
+
+def test_model_zoo_unet_segmentation_shapes():
+    from mxnet_tpu import models
+
+    net = models.get_unet(num_classes=5, base_filter=8, depth=2)
+    _, out_shapes, _ = net.infer_shape(data=(2, 3, 32, 32))
+    assert tuple(out_shapes[0]) == (2, 5, 32, 32)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 32, 32))
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert np.allclose(out.sum(1), 1.0, atol=1e-4)  # per-pixel softmax
