@@ -14,6 +14,16 @@ from .ndarray import NDArray
 
 
 class Monitor:
+    """Per-op tensor tap (ref: python/mxnet/monitor.py Monitor).
+
+    PERFORMANCE: installing a monitor re-executes the monitored graph
+    eagerly and un-jitted on every tapped batch so each op's output can
+    be observed — orders of magnitude slower than the fused jit path.
+    The reference pays an analogous cost (monitoring de-bulks the
+    executor, graph_executor.cc:905-911). Use for debugging, not
+    training runs; the interval only limits how often stats PRINT, not
+    the replay cost."""
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
         if stat_func is None:
             def asum_stat(x):
