@@ -34,6 +34,10 @@ CASES = [
     ("adversary/fgsm_mnist.py", ["--epochs", "1"]),
     ("multi-task/multi_task_mnist.py", ["--steps", "10"]),
     ("stochastic-depth/sd_cifar.py", ["--steps", "6"]),
+    ("bayesian-methods/sgld_regression.py",
+     ["--steps", "60", "--burn-in", "10", "--thin", "10"]),
+    ("dec/dec_clustering.py", ["--pretrain-steps", "20",
+                               "--refine-epochs", "1"]),
 ]
 
 
