@@ -182,7 +182,8 @@ def main():
     dopt = mx.optimizer.Adam(learning_rate=1e-3)
     dst = {n: dopt.create_state(i, dargs[n]) for i, n in enumerate(dgrads)}
     for epoch in range(args.refine_epochs):
-        q = dexe.forward(is_train=True)[0].asnumpy()
+        # infer-only read of q to refresh the target (no backward cost)
+        q = dexe.forward(is_train=False)[0].asnumpy()
         loss_op.set_target(DECLoss.target(q))  # sharpen, then hold fixed
         for _ in range(20):
             dexe.forward(is_train=True)

@@ -1,0 +1,148 @@
+"""The Module API tour: Module, SequentialModule, PythonLossModule.
+
+TPU-native counterpart of the reference's example/module/ (mnist_mlp.py:
+the explicit bind/init_params/init_optimizer/forward/backward/update
+workflow; sequential_module.py: chaining Modules; python_loss.py: a loss
+implemented in a PythonLossModule). One script, three sections, each
+asserting it learns.
+
+Run: PYTHONPATH=. python examples/module/mnist_mlp.py
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _iters(batch_size):
+    train = mx.io.MNISTIter(batch_size=batch_size, num_synthetic=2000,
+                            seed=1, flat=True)
+    val = mx.io.MNISTIter(batch_size=batch_size, num_synthetic=1000, seed=2,
+                          flat=True, shuffle=False)
+    return train, val
+
+
+def explicit_module_workflow(batch_size, epochs):
+    """mnist_mlp.py: the seven-step Module dance, no FeedForward sugar."""
+    train, val = _iters(batch_size)
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(
+            sym.Activation(sym.FullyConnected(
+                sym.Variable("data"), num_hidden=128, name="fc1"),
+                act_type="relu"),
+            num_hidden=10, name="fc2"),
+        name="softmax")
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    for _ in range(epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+    score = mod.score(val, mx.metric.Accuracy())
+    acc = dict([score] if isinstance(score, tuple) else score)["accuracy"]
+    print("explicit Module workflow: val acc %.3f" % acc)
+    return acc
+
+
+def sequential_module_workflow(batch_size, epochs):
+    """sequential_module.py: net split into two chained Modules."""
+    train, val = _iters(batch_size)
+    net1 = sym.Activation(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=64, name="fc1"), act_type="relu")
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=10, name="fc2"), name="softmax")
+    mod = mx.module.SequentialModule()
+    mod.add(mx.module.Module(net1, label_names=()))
+    mod.add(mx.module.Module(net2), take_labels=True, auto_wiring=True)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    metric = mx.metric.Accuracy()
+    for _ in range(epochs):
+        train.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    val.reset()
+    metric.reset()
+    for batch in val:
+        mod.forward(batch, is_train=False)
+        mod.update_metric(metric, batch.label)
+    acc = metric.get()[1]
+    print("SequentialModule workflow: val acc %.3f" % acc)
+    return acc
+
+
+def python_loss_workflow(batch_size, epochs):
+    """python_loss.py: gradient injected by a PythonLossModule."""
+    train, val = _iters(batch_size)
+    net = sym.FullyConnected(
+        sym.Activation(sym.FullyConnected(
+            sym.Variable("data"), num_hidden=64, name="fc1"),
+            act_type="relu"),
+        num_hidden=10, name="fc2")  # raw logits, loss lives in python
+
+    def softmax_ce_grad(scores, labels):
+        e = np.exp(scores - scores.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        p[np.arange(len(labels)), labels.astype(int)] -= 1.0
+        return p / len(labels)
+
+    feat = mx.module.Module(net, label_names=(), context=mx.cpu())
+    feat.bind(data_shapes=train.provide_data, inputs_need_grad=False,
+              for_training=True)
+    feat.init_params(mx.initializer.Xavier())
+    feat.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.5})
+    for _ in range(epochs):
+        train.reset()
+        for batch in train:
+            feat.forward(batch, is_train=True)
+            scores = feat.get_outputs()[0].asnumpy()
+            g = softmax_ce_grad(scores, batch.label[0].asnumpy())
+            feat.backward(out_grads=[mx.nd.array(g)])
+            feat.update()
+    val.reset()
+    correct = total = 0
+    for batch in val:
+        feat.forward(batch, is_train=False)
+        pred = feat.get_outputs()[0].asnumpy().argmax(1)
+        correct += (pred == batch.label[0].asnumpy()).sum()
+        total += len(pred)
+    acc = correct / total
+    print("python-loss workflow: val acc %.3f" % acc)
+    return acc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+    mx.random.seed(0)
+    a1 = explicit_module_workflow(args.batch_size, args.epochs)
+    a2 = sequential_module_workflow(args.batch_size, args.epochs)
+    a3 = python_loss_workflow(args.batch_size, args.epochs)
+    if not os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        assert min(a1, a2, a3) > 0.9, (a1, a2, a3)
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

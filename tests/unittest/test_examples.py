@@ -38,6 +38,9 @@ CASES = [
      ["--steps", "60", "--burn-in", "10", "--thin", "10"]),
     ("dec/dec_clustering.py", ["--pretrain-steps", "20",
                                "--refine-epochs", "1"]),
+    ("module/mnist_mlp.py", ["--epochs", "1"]),
+    ("python-howto/howto.py", []),
+    ("speech-demo/acoustic_dnn.py", ["--epochs", "1"]),
 ]
 
 
