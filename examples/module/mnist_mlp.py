@@ -51,8 +51,7 @@ def explicit_module_workflow(batch_size, epochs):
             mod.update_metric(metric, batch.label)
             mod.backward()
             mod.update()
-    score = mod.score(val, mx.metric.Accuracy())
-    acc = dict([score] if isinstance(score, tuple) else score)["accuracy"]
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
     print("explicit Module workflow: val acc %.3f" % acc)
     return acc
 

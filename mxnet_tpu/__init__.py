@@ -20,6 +20,7 @@ from . import sframe_plugin
 from . import ndarray
 from . import ndarray as nd
 from . import stream
+from . import runtime
 from . import random
 from .attribute import AttrScope
 from .name import NameManager, Prefix

@@ -1,0 +1,20 @@
+"""Runtime feature detection (the make/config.mk surface, SURVEY 2.25)."""
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_runtime_feature_list():
+    """Flags resolve at runtime and reflect the actual build: native libs
+    load here, torch is baked into the image, caffe is not."""
+    feats = mx.runtime.feature_list()
+    assert feats["NATIVE_ENGINE"] and feats["NATIVE_RECORDIO"]
+    assert feats["TORCH"] and not feats["CAFFE"]
+    assert mx.runtime.has_feature("DIST_KVSTORE")
+    with pytest.raises(KeyError):
+        mx.runtime.has_feature("USE_WARP_DRIVE")
+    summary = mx.runtime.features_summary()
+    assert "NATIVE_ENGINE" in summary and "ON" in summary
+    # the returned mapping is a copy: mutating it cannot poison the cache
+    feats["TORCH"] = False
+    assert mx.runtime.has_feature("TORCH")
