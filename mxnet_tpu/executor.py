@@ -443,12 +443,13 @@ class Executor:
             self._monitor_replay(is_train)
 
         rng = _random.next_key() if is_train else None
-        if is_train and self._grad_idx and any(self._head_no_grad):
+        if is_train and self._grad_idx and all(self._head_no_grad):
             # fused fwd+bwd program; gradients cached for backward().
-            # Only worth it when a loss head exists: with pure non-loss
-            # heads backward() REQUIRES out_grads and re-runs the vjp
-            # with real cotangents, so a fused pass here would compute a
-            # full backward against zeros only to discard it.
+            # Only worth it when EVERY head is a loss op: with any
+            # non-loss head, backward() REQUIRES out_grads and re-runs
+            # the vjp with real cotangents, so a fused pass here would
+            # compute a full backward only to discard it (same predicate
+            # as parallel/symbol_trainer.py).
             self._outputs_shape_probe()
             hg = self._default_head_grads()
             outs, new_aux, grads = self._fwd_bwd(

@@ -41,6 +41,7 @@ CASES = [
     ("module/mnist_mlp.py", ["--epochs", "1"]),
     ("python-howto/howto.py", []),
     ("speech-demo/acoustic_dnn.py", ["--epochs", "1"]),
+    ("kaggle-ndsb1/end_to_end.py", ["--epochs", "1", "--per-class", "10"]),
 ]
 
 

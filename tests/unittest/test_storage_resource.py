@@ -83,4 +83,3 @@ def test_request_same_resource_is_cached():
 def test_unknown_request_raises():
     with pytest.raises(MXNetError):
         ResourceManager.get().request(mx.cpu(0), "workspace")
-
