@@ -1,8 +1,7 @@
-"""Multi-process dist_sync kvstore test: launches the nightly arithmetic
-check (tests/nightly/dist_sync_kvstore.py) through tools/launch.py with 3
-real processes rendezvousing over jax.distributed — the reference's
-`tools/launch.py -n 3 ... dist_sync_kvstore.py` acceptance run
-(SURVEY §4.6)."""
+"""Multi-process dist kvstore tests: each launches a nightly script
+through tools/launch.py with real processes rendezvousing over
+jax.distributed — the reference's `tools/launch.py -n N ...` acceptance
+runs (SURVEY §4.6)."""
 import os
 import subprocess
 import sys
@@ -11,7 +10,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def test_dist_sync_kvstore_3_workers():
+def _run_launch(script, n, port, timeout=280, extra_env=None):
+    """Launch tests/nightly/<script> as n local processes on the given
+    coordinator port; returns the CompletedProcess."""
     env = dict(os.environ)
     env.update({
         "PYTHONPATH": REPO,
@@ -19,15 +20,21 @@ def test_dist_sync_kvstore_3_workers():
         "PALLAS_AXON_POOL_IPS": "",
         # each worker gets exactly one cpu device
         "XLA_FLAGS": "",
-        "MXNET_COORDINATOR": "127.0.0.1:29418",
+        "MXNET_COORDINATOR": "127.0.0.1:%d" % port,
     })
+    env.update(extra_env or {})
+    coord = "127.0.0.1:%d" % port
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "3", "--launcher", "local", "--coordinator",
-         "127.0.0.1:29418", sys.executable,
-         os.path.join(REPO, "tests", "nightly", "dist_sync_kvstore.py")],
-        capture_output=True, text=True, env=env, timeout=280)
+         "-n", str(n), "--launcher", "local", "--coordinator", coord,
+         sys.executable, os.path.join(REPO, "tests", "nightly", script)],
+        capture_output=True, text=True, env=env, timeout=timeout)
     assert r.returncode == 0, r.stdout + r.stderr
+    return r
+
+
+def test_dist_sync_kvstore_3_workers():
+    r = _run_launch("dist_sync_kvstore.py", 3, 29418)
     for rank in range(3):
         assert ("rank %d/3: dist_sync arithmetic OK" % rank) in r.stdout, \
             r.stdout + r.stderr
@@ -39,21 +46,7 @@ def test_dist_lenet_2_workers():
     """Distributed training e2e (ref: tests/nightly/dist_lenet.py):
     2 workers, rank-sharded data, sync kvstore; both must converge to
     identical weights."""
-    env = dict(os.environ)
-    env.update({
-        "PYTHONPATH": REPO,
-        "JAX_PLATFORMS": "cpu",
-        "PALLAS_AXON_POOL_IPS": "",
-        "XLA_FLAGS": "",
-        "MXNET_COORDINATOR": "127.0.0.1:29421",
-    })
-    r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "2", "--launcher", "local", "--coordinator",
-         "127.0.0.1:29421", sys.executable,
-         os.path.join(REPO, "tests", "nightly", "dist_lenet.py")],
-        capture_output=True, text=True, env=env, timeout=500)
-    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_launch("dist_lenet.py", 2, 29421, timeout=500)
     for rank in range(2):
         assert ("rank %d/2: dist lenet OK" % rank) in r.stdout, \
             r.stdout + r.stderr
@@ -62,22 +55,8 @@ def test_dist_lenet_2_workers():
 def test_dist_liveness_3_workers():
     """Heartbeat failure detection: a rank that stops beating is counted
     dead by get_num_dead_node on every rank (ref ps-lite heartbeats)."""
-    env = dict(os.environ)
-    env.update({
-        "PYTHONPATH": REPO,
-        "JAX_PLATFORMS": "cpu",
-        "PALLAS_AXON_POOL_IPS": "",
-        "XLA_FLAGS": "",
-        "MXNET_COORDINATOR": "127.0.0.1:29424",
-        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.3",
-    })
-    r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "3", "--launcher", "local", "--coordinator",
-         "127.0.0.1:29424", sys.executable,
-         os.path.join(REPO, "tests", "nightly", "dist_liveness.py")],
-        capture_output=True, text=True, env=env, timeout=280)
-    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_launch("dist_liveness.py", 3, 29424,
+                    extra_env={"MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.3"})
     for rank in range(3):
         assert ("rank %d/3: liveness OK" % rank) in r.stdout, \
             r.stdout + r.stderr
@@ -86,27 +65,22 @@ def test_dist_liveness_3_workers():
 def test_dist_async_kvstore_3_workers():
     """Apply-on-arrival dist_async semantics (VERDICT r1 item 7): rank
     0's updates must apply while other ranks are silent (interleaving),
-    and a fenced total must be exact (no lost updates). Launched as 3
-    real processes like the sync acceptance run."""
-    env = dict(os.environ)
-    env.update({
-        "PYTHONPATH": REPO,
-        "JAX_PLATFORMS": "cpu",
-        "PALLAS_AXON_POOL_IPS": "",
-        "XLA_FLAGS": "",
-        "MXNET_COORDINATOR": "127.0.0.1:29421",
-    })
-    r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "3", "--launcher", "local", "--coordinator",
-         "127.0.0.1:29421", sys.executable,
-         os.path.join(REPO, "tests", "nightly", "dist_async_kvstore.py")],
-        capture_output=True, text=True, env=env, timeout=280)
-    assert r.returncode == 0, r.stdout + r.stderr
+    and a fenced total must be exact (no lost updates)."""
+    r = _run_launch("dist_async_kvstore.py", 3, 29426)
     assert "rank 0: solo async updates applied on arrival" in r.stdout, \
         r.stdout + r.stderr
     for rank in range(3):
         assert ("rank %d/3: dist_async totality OK" % rank) in r.stdout, \
             r.stdout + r.stderr
         assert ("rank %d/3: dist_async regeneration OK" % rank) in r.stdout, \
+            r.stdout + r.stderr
+
+
+def test_dist_async_lenet_2_workers():
+    """End-to-end FeedForward training through the apply-on-arrival
+    dist_async parameter server: both ranks must converge despite
+    gradient staleness (plain SGD; see the nightly's momentum note)."""
+    r = _run_launch("dist_async_lenet.py", 2, 29428, timeout=500)
+    for rank in range(2):
+        assert ("rank %d/2: dist ASYNC lenet OK" % rank) in r.stdout, \
             r.stdout + r.stderr
