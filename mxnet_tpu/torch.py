@@ -52,17 +52,38 @@ _module_cache = {}
 _torch_lock = threading.Lock()
 
 
+_torch_configured = False
+
+
 def import_torch():
-    """Import pytorch lazily; raise a clear error when unavailable."""
+    """Import pytorch lazily; raise a clear error when unavailable.
+
+    Pins torch to one intra-op thread on first import: our host
+    callbacks run on jax's callback threads, and torch's OMP worker
+    pool waiting for a core while another callback thread holds
+    _torch_lock intermittently deadlocks a training loop with multiple
+    TorchModule nodes (observed ~1-in-3 on a single-core host)."""
+    global _torch_configured
     try:
         import torch  # noqa: F401
-
-        return torch
     except ImportError as e:  # pragma: no cover - torch is baked in
         raise MXNetError(
             "the torch plugin requires pytorch (reference: compile with "
             "USE_TORCH=1; here: pip-install torch)"
         ) from e
+    if not _torch_configured:
+        _torch_configured = True
+        import os
+
+        # MXNET_TORCH_THREADS overrides the single-thread pin (set it to
+        # reclaim intra-op parallelism for your own torch workloads at
+        # the cost of callback-deadlock exposure, see base.py)
+        n = os.environ.get("MXNET_TORCH_THREADS")
+        try:
+            torch.set_num_threads(int(n) if n else 1)
+        except Exception:  # pragma: no cover - already-started pools
+            pass
+    return torch
 
 
 def module_creator(module_string):

@@ -54,3 +54,30 @@ def test_example_smoke(script, argv, monkeypatch):
     # examples import siblings relative to their own directory
     monkeypatch.syspath_prepend(os.path.dirname(path))
     runpy.run_path(path, run_name="__main__")
+
+
+def test_example_smoke_torch_subprocess():
+    """examples/torch runs in a SUBPROCESS with retries: host-callback
+    programs can intermittently wedge the CPU backend's runtime (see the
+    async-dispatch note in mxnet_tpu/base.py) — a retry loop keeps a
+    known runtime race from failing CI while still exercising the torch
+    bridge end-to-end."""
+    import subprocess
+    import sys
+
+    path = os.path.join(ROOT, "examples", "torch", "torch_module_mnist.py")
+    env = dict(os.environ, MXNET_EXAMPLE_SMOKE="1", PYTHONPATH=ROOT)
+    last = None
+    for _ in range(3):
+        try:
+            r = subprocess.run(
+                [sys.executable, path, "--epochs", "1"],
+                capture_output=True, text=True, env=env, timeout=180)
+        except subprocess.TimeoutExpired as e:
+            # ONLY the runtime wedge (a hang) is retryable; any real
+            # failure must surface immediately
+            last = "timeout (known CPU host-callback race): %s" % e
+            continue
+        assert r.returncode == 0 and "ok" in r.stdout, r.stdout + r.stderr
+        return
+    raise AssertionError("torch example timed out 3 attempts: %s" % last)
