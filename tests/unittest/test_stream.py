@@ -61,3 +61,19 @@ def test_exists_and_missing_mem():
     assert not mx.stream.exists("mem://never/written")
     with pytest.raises(FileNotFoundError):
         mx.stream.open_stream("mem://never/written", "rb")
+
+
+def test_async_checkpoint_through_engine_to_mem_uri():
+    """The async checkpoint path (dependency-engine write task) must
+    compose with stream URIs: save_checkpoint(sync=False) to mem://,
+    fenced by nd.waitall, then load back."""
+    s = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    arg = {"fc_weight": mx.nd.ones((2, 3)) * 3, "fc_bias": mx.nd.zeros((2,))}
+    mx.model.save_checkpoint("mem://asyncrun/model", 4, s, arg, {},
+                             sync=False)
+    mx.nd.waitall()  # fence the engine's write task
+    assert mx.stream.exists("mem://asyncrun/model-0004.params")
+    _, arg2, _ = mx.model.load_checkpoint("mem://asyncrun/model", 4)
+    assert np.allclose(arg2["fc_weight"].asnumpy(), 3.0)
