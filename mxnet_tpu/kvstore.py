@@ -397,8 +397,11 @@ class KVStore:
                 # First observation: change detection has no baseline yet,
                 # so a one-shot health check (construct, query once) would
                 # always report 0. Fall back to the sender-embedded wall
-                # time for ranks that stopped beating long ago, with 2x
-                # timeout of slack absorbing cross-host clock skew. The
+                # time for ranks that stopped beating long ago. The slack
+                # absorbing cross-host clock skew has an absolute floor:
+                # 2*timeout alone is no protection when timeout is small
+                # (a 0.3s test interval would let sub-second skew
+                # fabricate dead verdicts from the sender's clock). The
                 # baseline is back-dated by the observed age so follow-up
                 # polls keep reporting the rank dead (no alive-flap) until
                 # its value actually changes.
@@ -409,7 +412,7 @@ class KVStore:
                     sent = None
                 if sent is not None:
                     age = time.time() - sent
-                    if age > 2 * timeout:
+                    if age > max(2 * timeout, 30.0):
                         dead += 1
                         base = now - age
                 seen[r] = (v, base)
@@ -469,7 +472,7 @@ def create(name="local"):
 
         if jax.process_count() > 1:
             client = _coordination_client()
-            if client is not None and _supports_overwrite(client):
+            if client is not None and _async_transport_ok(client):
                 return _AsyncDistKVStore(name, client)
             # No P2P transport available: fall back to lock-step
             # all-reduce semantics (a superset of async's convergence
@@ -480,6 +483,46 @@ def create(name="local"):
                 "(updates in lock-step, not on-arrival; see "
                 "docs/distributed.md).", stacklevel=2)
     return KVStore(name)
+
+
+# dist_async creates are SPMD, so every rank's Nth create shares one
+# decision key — the counter keys successive creates apart
+_ASYNC_DECIDE_COUNT = 0
+
+
+def _async_transport_ok(client):
+    """Rank 0 probes overwrite support and PUBLISHES the verdict; other
+    ranks read it. A transient coordinator error during the probe on one
+    rank must not make it fall back to the synchronous store while the
+    rest build _AsyncDistKVStore — the sync rank's psum collectives
+    would then wait on processes that never join, hanging the job."""
+    import jax
+
+    global _ASYNC_DECIDE_COUNT
+    _ASYNC_DECIDE_COUNT += 1
+    key = "mxtpu_as/transport/%d" % _ASYNC_DECIDE_COUNT
+    if jax.process_index() == 0:
+        ok = _supports_overwrite(client)
+        try:
+            client.key_value_set(key, "async" if ok else "sync")
+        except Exception:
+            # decision unpublishable -> nobody can go async; the plain
+            # set (no overwrite) is safe because the counter makes the
+            # key fresh per create
+            return False
+        return ok
+    # An unreadable verdict must RAISE, not default to sync: silently
+    # diverging to the synchronous store on one rank while the rest
+    # build _AsyncDistKVStore recreates the exact split-store hang this
+    # function exists to prevent. Failing the job loudly is the only
+    # consistent outcome when this rank cannot learn the decision.
+    try:
+        v = client.blocking_key_value_get(key, 60_000)
+    except Exception as e:
+        raise MXNetError(
+            "dist_async: transport decision unreadable on rank %d (%s); "
+            "cannot safely choose a store type" % (jax.process_index(), e))
+    return v == "async"
 
 
 def _coordination_client():
@@ -541,6 +584,7 @@ class _AsyncServer:
         self._applied = [0] * nworkers
         self._updater = None
         self._optv = 0
+        self._failed = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="mxtpu-kvstore-async-server", daemon=True)
@@ -592,6 +636,7 @@ class _AsyncServer:
         # on the next poll instead of wedging async_fence forever.
         dirty = set()
         acked = [0] * self._n
+        err_published = 0
         while not self._stop.wait(self.POLL_S):
             try:
                 self._check_optimizer()
@@ -629,6 +674,13 @@ class _AsyncServer:
                         logging.exception(
                             "async server failed applying group %d/%d; "
                             "skipping it", r, n)
+                        # _applied still advances (a poison group must
+                        # not wedge the stream); count the loss —
+                        # async_fence/ack alone would report the dropped
+                        # update as fully applied. Published below in
+                        # the poll loop (retried like acks, so one
+                        # transient publish error can't hide it forever).
+                        self._failed += 1
                     self._applied[r] = n
                     try:  # consumed: free the coordinator's copy
                         self._client.key_value_delete(
@@ -639,6 +691,14 @@ class _AsyncServer:
                 try:
                     self._publish(key)
                     dirty.discard(key)
+                except Exception:
+                    pass  # retry next poll
+            if err_published != self._failed:
+                try:
+                    self._client.key_value_set(
+                        "%s/err" % self._ns, str(self._failed),
+                        allow_overwrite=True)
+                    err_published = self._failed
                 except Exception:
                     pass  # retry next poll
             for r in range(self._n):
@@ -782,14 +842,30 @@ class _AsyncDistKVStore(KVStore):
                                        allow_overwrite=True)
             # Block until the server thread installed the updater:
             # returning earlier would let a racing push be applied with
-            # ASSIGN semantics. Callers barrier() after set_optimizer
-            # (as the reference tests do), which extends the guarantee
-            # to every rank's pushes.
+            # ASSIGN semantics.
             deadline = time.monotonic() + 10.0
             while self._server._optv != v:
                 if time.monotonic() > deadline:
                     raise MXNetError("async server did not install optimizer")
                 time.sleep(0.005)
+        # set_optimizer is SPMD (every rank's Module.init_optimizer /
+        # model._create_kvstore calls it); without this barrier a
+        # non-zero rank could push before rank 0's server installed the
+        # updater, and that push would be applied with assign semantics
+        # (w[:] = grad), silently replacing weights with raw gradients.
+        self.barrier()
+
+    def num_failed_groups(self):
+        """Gradient groups the server dropped because deserialize/apply
+        raised (each logged server-side). The ack counters deliberately
+        advance past poison groups so one bad push cannot wedge the
+        stream — this counter is how training code distinguishes
+        'quiesced' from 'quiesced but updates were lost'."""
+        st, v = self._read_kv("%s/err" % self._ns)
+        if st == "error":
+            raise MXNetError(
+                "num_failed_groups: coordination service unreachable")
+        return int(v) if st == "ok" and v is not None else 0
 
     def async_fence(self, timeout=60.0):
         """Block until the server has applied every push published by

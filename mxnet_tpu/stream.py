@@ -198,9 +198,12 @@ def open_stream(uri, mode="rb"):
     the dmlc::Stream::Create entry point. Returns a file-like usable as
     a context manager. Supported modes: r / rb / w / wb (streams are
     whole-object, like dmlc::Stream; append/update would silently
-    degrade on remote schemes, so they are rejected up front)."""
+    degrade on remote schemes, so they are rejected up front — for
+    EVERY scheme, local files included, so code written against file://
+    cannot quietly depend on modes that break the moment the URI moves
+    to s3:// or hdfs://)."""
     scheme, path = _split(uri)
-    if mode not in ("r", "rb", "w", "wb") and scheme not in ("", "file"):
+    if mode not in ("r", "rb", "w", "wb"):
         raise MXNetError(
             "stream mode %r unsupported for %r (whole-object streams "
             "allow r/rb/w/wb only)" % (mode, uri))

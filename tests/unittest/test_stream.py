@@ -57,6 +57,17 @@ def test_unknown_scheme_and_gated_s3():
         mx.nd.load("s3://bucket/key.params")
 
 
+def test_append_mode_rejected_everywhere(tmp_path):
+    """Whole-object streams allow r/rb/w/wb only — for EVERY scheme,
+    local files included (advisor r2: a file:// escape hatch let code
+    quietly depend on modes that break when the URI moves to s3://)."""
+    for uri in ("mem://x/y", "file://%s/a.bin" % tmp_path,
+                str(tmp_path / "b.bin")):
+        for mode in ("a", "ab", "r+", "rb+", "x"):
+            with pytest.raises(mx.base.MXNetError, match="mode"):
+                mx.stream.open_stream(uri, mode)
+
+
 def test_exists_and_missing_mem():
     assert not mx.stream.exists("mem://never/written")
     with pytest.raises(FileNotFoundError):
