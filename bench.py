@@ -44,7 +44,11 @@ def main():
     from mxnet_tpu.models import get_resnet
     from mxnet_tpu.parallel.symbol_trainer import make_symbol_train_step
 
-    sym = get_resnet(num_classes=1000, num_layers=50)
+    # s2d stem: arithmetically equivalent to the 7x7/s2 stem (weight-fold
+    # equivalence tested in test_models.py), ~3x better MXU utilization on
+    # the first conv; BENCH_STEM=conv7 measures the reference-layout stem
+    stem = os.environ.get("BENCH_STEM", "s2d")
+    sym = get_resnet(num_classes=1000, num_layers=50, stem=stem, image=image)
     step, state = make_symbol_train_step(
         sym,
         input_shapes={"data": (batch_size, 3, image, image),

@@ -386,8 +386,16 @@ def _bn_fwd(params, inputs, aux, is_train, rng):
     bshape = (1, -1) + (1,) * (data.ndim - 2)
     x32 = data.astype(jnp.float32)
     if is_train and not params["use_global_stats"]:
+        # E[x^2]-E[x]^2 instead of jnp.var's E[(x-E[x])^2]: the two-pass
+        # form must finish the mean reduction before it can START the
+        # variance pass (two full HBM reads of the activation, serialized);
+        # sum and sum-of-squares reduce in ONE fused read. f32 accumulation
+        # keeps the cancellation benign for activation-scale data (the
+        # cuDNN BN fast path makes the same trade). Clamp: cancellation
+        # can produce a small negative where true var ~ 0.
         mean = jnp.mean(x32, axis=axes)
-        var = jnp.var(x32, axis=axes)
+        sqmean = jnp.mean(jnp.square(x32), axis=axes)
+        var = jnp.maximum(sqmean - jnp.square(mean), 0.0)
         new_mm = moving_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum)
         new_mv = moving_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum)
         new_aux = [new_mm, new_mv]

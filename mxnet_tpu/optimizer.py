@@ -248,7 +248,10 @@ class Adam(Optimizer):
         var._set_data(v)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
+        # t may be a traced step index (scanned fit fast path,
+        # parallel/fit_trainer.py) — sqrt must then be jnp, not math
+        _sqrt = math.sqrt if isinstance(t, (int, _np.integer)) else jnp.sqrt
+        lr_t = lr * _sqrt(coef2) / coef1
         weight._set_data(weight._data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
 
 

@@ -1,0 +1,43 @@
+"""Model-zoo structural tests: the space-to-depth ResNet stem must be
+arithmetically equivalent to the reference 7x7/s2/p3 stem under the
+weight fold (models/resnet.py fold_stem_weights)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.resnet import _s2d_stem, fold_stem_weights, get_resnet
+from mxnet_tpu import symbol as sym
+
+
+def test_s2d_stem_matches_conv7():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 224, 224).astype(np.float32)
+    w7 = (rng.randn(64, 3, 7, 7) * 0.1).astype(np.float32)
+
+    data = sym.Variable("data")
+    ref = sym.Convolution(data=data, num_filter=64, kernel=(7, 7),
+                          stride=(2, 2), pad=(3, 3), no_bias=True,
+                          name="conv0_conv")
+    exe = ref.simple_bind(mx.cpu(0), data=(2, 3, 224, 224), grad_req="null")
+    exe.arg_dict["conv0_conv_weight"][:] = w7
+    exe.arg_dict["data"][:] = x
+    y_ref = exe.forward(is_train=False)[0].asnumpy()
+
+    s2d = _s2d_stem(sym.Variable("data"), "conv0")
+    exe2 = s2d.simple_bind(mx.cpu(0), data=(2, 3, 224, 224), grad_req="null")
+    assert exe2.arg_dict["conv0_conv_weight"].shape == (64, 12, 4, 4)
+    exe2.arg_dict["conv0_conv_weight"][:] = fold_stem_weights(w7)
+    exe2.arg_dict["data"][:] = x
+    y = exe2.forward(is_train=False)[0].asnumpy()
+
+    assert y.shape == y_ref.shape == (2, 64, 112, 112)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_s2d_variant_builds_and_infers():
+    s = get_resnet(num_classes=10, num_layers=50, stem="s2d")
+    args, outs, _ = s.infer_shape(data=(4, 3, 224, 224),
+                                  softmax_label=(4,))
+    assert outs == [(4, 10)]
+    names = s.list_arguments()
+    i = names.index("conv0_conv_weight")
+    assert args[i] == (64, 12, 4, 4)
