@@ -13,41 +13,17 @@ import os
 
 import numpy as _np
 
-# The CPU backend's async dispatch intermittently deadlocks programs
-# containing multiple host-callback nodes (pure_callback: TorchModule,
-# CustomOp/NumpyOp): a callback thread wedges materializing its own
-# argument while the main thread waits on the computation. Synchronous
-# dispatch sharply reduces (not fully eliminates — the race lives in
-# the runtime) the incidence: ~1-in-3 hangs for a two-TorchModule
-# training loop without it, ~1-in-8 with. Must be set before the CPU
-# client exists, hence package import time. Gate:
-# MXNET_CPU_ASYNC_DISPATCH=1 restores async dispatch for callback-free
-# workloads. Only the CPU backend (the test/dev rig) is affected; TPU
-# execution is untouched.
-if os.environ.get("MXNET_CPU_ASYNC_DISPATCH", "0") != "1":
-    try:
-        import jax as _jax_cfg
-
-        _jax_cfg.config.update("jax_cpu_enable_async_dispatch", False)
-        try:  # the flag is read at client creation: warn if too late
-            from jax._src import xla_bridge as _xb
-
-            if getattr(_xb, "_backends", None):
-                import warnings as _warnings
-
-                _warnings.warn(
-                    "mxnet_tpu imported after a jax backend was already "
-                    "initialized: the CPU async-dispatch mitigation for "
-                    "host-callback deadlocks cannot take effect; import "
-                    "mxnet_tpu before running jax computations.",
-                    stacklevel=2)
-        except ImportError:  # pragma: no cover - jax internals moved
-            pass
-    except Exception as _e:  # pragma: no cover - option renamed/removed
-        import logging as _logging
-
-        _logging.getLogger(__name__).debug(
-            "cpu async-dispatch mitigation unavailable: %s", _e)
+# Host-callback note: graphs containing host ops (CustomOp/NumpyOp,
+# TorchModule) are executed by the Executor's hybrid mode — jitted
+# segments with the host ops run eagerly between them (executor.py) —
+# so NO jax.pure_callback enters a compiled program on any framework
+# training/inference path. This is the structural replacement for the
+# round-2 import-time `jax_cpu_enable_async_dispatch=False` mitigation
+# (the CPU callback runtime could deadlock a program with several
+# pure_callback nodes); with no callbacks in compiled programs the
+# mitigation and its import-order sensitivity are gone. The
+# pure_callback fallback still exists for user code that jit-traces a
+# Custom op itself (mxnet_tpu/operator.py _custom_fwd).
 
 __all__ = [
     "MXNetError", "MXTPUError", "string_types", "numeric_types",

@@ -147,11 +147,40 @@ class Executor:
         # MXNET_BACKWARD_DO_MIRROR env; demo example/memcost/)
         self._plan = self._build_mirror_plan()
 
+        # hybrid (host-segmented) execution: graphs containing host ops
+        # (Custom/NumpyOp/torch bridge) run as jitted segments with the
+        # host ops executed EAGERLY between them — the reference's engine
+        # model (custom ops are host functions between device kernels,
+        # ref custom-inl.h) and the structural fix for the jax CPU
+        # host-callback deadlock: no pure_callback ever enters a
+        # compiled program on this path.
+        self._host_serials = {
+            i for i, n in enumerate(self._nodes)
+            if not n.is_variable and n.op.is_host_op
+        }
+        self._hybrid = bool(self._host_serials) and not self._multi_device
+        if self._hybrid:
+            self._hyb_plan = self._build_hybrid_plan()
+            self._seg_jit = {}      # (plan_idx, is_train) -> jitted fwd
+            self._seg_bwd_jit = {}  # plan_idx -> jitted bwd
+            self._hyb_saved = None
+            # host-op instances live exactly as long as their executor
+            # (the reference creates the operator once per binding,
+            # custom-inl.h); a module-level cache would leak operators
+            # across rebinds
+            self._host_op_cache = {}
+
         # jitted entry points (skip jit under multi-device eager pipeline)
         if self._multi_device:
             self._fwd_infer = functools.partial(self._run, is_train=False)
             self._fwd_train = functools.partial(self._run, is_train=True)
             self._fwd_bwd = self._fwd_bwd_impl
+        elif self._hybrid:
+            self._fwd_infer = functools.partial(
+                self._hybrid_run, is_train=False)
+            self._fwd_train = functools.partial(
+                self._hybrid_run, is_train=True)
+            self._fwd_bwd = None  # hybrid backward walks saved segments
         else:
             self._fwd_infer = jax.jit(functools.partial(self._run, is_train=False))
             self._fwd_train = jax.jit(functools.partial(self._run, is_train=True))
@@ -159,6 +188,261 @@ class Executor:
 
         self._outputs_nd = None
         self._grad_cache = None  # (arg_versions, grads)
+
+    # -- hybrid (host-segmented) engine ----------------------------------------
+    def _graph_meta(self):
+        head_keys = {(id(self._nodes[i]), j) for i, j in self._heads}
+        consumers = {}
+        for serial, n in enumerate(self._nodes):
+            if n.is_variable:
+                continue
+            for s, i in n.inputs:
+                consumers.setdefault((id(s), i), set()).add(serial)
+        return head_keys, consumers
+
+    def _segment_item(self, chunk, head_keys, consumers):
+        """Describe a jit segment: external inputs, live outputs, aux
+        window, rng-needing serials (same bookkeeping as the mirror
+        plan's emit)."""
+        seg_set = set(chunk)
+        produced = []
+        for s in chunk:
+            n = self._nodes[s]
+            for i in range(len(n.op.list_outputs(n.params))):
+                produced.append((id(n), i))
+        produced_set = set(produced)
+        ext, seen = [], set()
+        for s in chunk:
+            for src, i in self._nodes[s].inputs:
+                k = (id(src), i)
+                if k not in produced_set and k not in seen:
+                    seen.add(k)
+                    ext.append(k)
+        outs = [
+            k for k in produced
+            if k in head_keys or (consumers.get(k, set()) - seg_set)
+        ]
+        aux_slices = [
+            self._node_aux[id(self._nodes[s])]
+            for s in chunk if id(self._nodes[s]) in self._node_aux
+        ]
+        aux_ids = [j for lo, hi in aux_slices for j in range(lo, hi)]
+        rng_serials = [s for s in chunk if self._nodes[s].op.need_rng]
+        return ("seg", tuple(chunk), tuple(ext), tuple(outs),
+                tuple(aux_ids), tuple(rng_serials))
+
+    def _build_hybrid_plan(self):
+        """Topo plan of ("var", serial) | ("host", serial, in_keys) |
+        segment items. Host ops split the graph into maximal jittable
+        segments; variables are env loads emitted in place."""
+        head_keys, consumers = self._graph_meta()
+        plan, run = [], []
+
+        def flush():
+            if run:
+                plan.append(self._segment_item(tuple(run), head_keys,
+                                               consumers))
+                run.clear()
+
+        for serial, n in enumerate(self._nodes):
+            if n.is_variable:
+                plan.append(("var", serial))
+            elif serial in self._host_serials:
+                flush()
+                in_keys = tuple((id(s), i) for s, i in n.inputs)
+                plan.append(("host", serial, in_keys))
+            else:
+                run.append(serial)
+        flush()
+        return plan
+
+    def _seg_fn(self, item, is_train):
+        """The pure function for one segment (ext, aux, rngs) ->
+        (outs, new_aux)."""
+        _, serials, ext_keys, out_keys, aux_ids, rng_serials = item
+
+        def seg_fn(ext_vals, aux_in, rngs_in):
+            local = dict(zip(ext_keys, ext_vals))
+            laux = dict(zip(aux_ids, aux_in))
+            rmap = dict(zip(rng_serials, rngs_in))
+            for s in serials:
+                self._apply_node(s, local, laux, rmap.get(s), is_train)
+            return ([local[k] for k in out_keys],
+                    [laux[j] for j in aux_ids])
+
+        return seg_fn
+
+    def _hybrid_run(self, arg_vals, aux_vals, rng, is_train, save=False):
+        import jax
+
+        dev = self._ctx.jax_device
+        env = {}
+        new_aux = list(aux_vals)
+        saved = [] if save else None
+        # any forward invalidates previously saved backward state: a
+        # backward() after an inference forward must fail loudly, not
+        # silently replay an older train batch's residuals (the jit
+        # engine recomputes from current args; same observable contract)
+        self._hyb_saved = None
+        for idx, item in enumerate(self._hyb_plan):
+            kind = item[0]
+            if kind == "var":
+                n = self._nodes[item[1]]
+                env[(id(n), 0)] = arg_vals[self._var_argidx[id(n)]]
+            elif kind == "host":
+                _, serial, in_keys = item
+                n = self._nodes[serial]
+                ins_np = [_np.asarray(env[k]) for k in in_keys]  # D2H sync
+                outs_np, bctx = n.op.host_apply(
+                    n.params, ins_np, is_train, cache=self._host_op_cache)
+                out_avals = []
+                for i, o in enumerate(outs_np):
+                    v = jax.device_put(_np.asarray(o), dev)
+                    env[(id(n), i)] = v
+                    out_avals.append((v.shape, v.dtype))
+                if save:
+                    saved.append(("host", idx, bctx, out_avals))
+            else:
+                _, serials, ext_keys, out_keys, aux_ids, rng_serials = item
+                key = (idx, is_train)
+                if key not in self._seg_jit:
+                    self._seg_jit[key] = jax.jit(self._seg_fn(item, is_train))
+                ext_vals = [env[k] for k in ext_keys]
+                aux_in = [new_aux[j] for j in aux_ids]
+                rngs = ([jax.random.fold_in(rng, s) for s in rng_serials]
+                        if rng is not None else [])
+                outs, aux_out = self._seg_jit[key](ext_vals, aux_in, rngs)
+                env.update(zip(out_keys, outs))
+                for j, v in zip(aux_ids, aux_out):
+                    new_aux[j] = v
+                if save:
+                    saved.append(("seg", idx, ext_vals, aux_in, rngs,
+                                  [(o.shape, o.dtype) for o in outs]))
+        if save:
+            self._hyb_saved = saved
+        outputs = [env[(id(self._nodes[i]), j)] for i, j in self._heads]
+        return outputs, new_aux
+
+    def _seg_bwd(self, idx):
+        """Jitted segment backward: re-runs the segment forward under
+        jax.vjp with the saved inputs (rematerialization — the memory
+        schedule mirror nodes buy on the jit path comes free here) and
+        pulls cotangents back to the segment's external inputs. aux
+        updates are state, not differentiable outputs."""
+        if idx in self._seg_bwd_jit:
+            return self._seg_bwd_jit[idx]
+        import jax
+
+        item = self._hyb_plan[idx]
+        seg_fn = self._seg_fn(item, True)
+        import jax.numpy as jnp
+
+        def bwd(ext_vals, aux_in, rngs, out_cts):
+            # out_cts covers only the inexact (differentiable) outputs;
+            # integer outputs are filtered out of the vjp so no float0
+            # cotangents cross the jit boundary (dtype mask is static
+            # at trace time)
+            def f(ev):
+                outs, _ = seg_fn(ev, aux_in, rngs)
+                return [o for o in outs
+                        if jnp.issubdtype(o.dtype, jnp.inexact)]
+
+            _, vjp_fn = jax.vjp(f, ext_vals)
+            (ext_cts,) = vjp_fn(out_cts)
+            return ext_cts
+
+        self._seg_bwd_jit[idx] = jax.jit(bwd)
+        return self._seg_bwd_jit[idx]
+
+    def _hybrid_backward(self, head_grads):
+        """Reverse-mode over the hybrid plan: cotangents flow backward
+        through jitted segment vjps and eager host-op backwards, then
+        accumulate into grad_arrays per grad_req."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._hyb_saved is None:
+            raise MXNetError("backward before forward(is_train=True)")
+        dev = self._ctx.jax_device
+        float0 = jax.dtypes.float0
+        cot = {}
+        for (nidx, oidx), hg in zip(self._heads, head_grads):
+            if hg is None:  # integer-dtype head: no cotangent exists
+                continue
+            k = (id(self._nodes[nidx]), oidx)
+            cot[k] = cot.get(k, 0) + hg
+
+        def _accum(key, g):
+            if g is None or getattr(g, "dtype", None) == float0:
+                return
+            cot[key] = cot.get(key, 0) + g
+
+        for entry in reversed(self._hyb_saved):
+            if entry[0] == "host":
+                _, idx, bctx, out_avals = entry
+                item = self._hyb_plan[idx]
+                _, serial, in_keys = item
+                n = self._nodes[serial]
+                # no cotangent reached any output -> skip the eager host
+                # backward, UNLESS this is a loss-semantics op
+                # (head_no_grad): those produce real input grads while
+                # IGNORING out_grads, so absence of cotangents does not
+                # mean zero gradients for them
+                if (not n.op.head_no_grad(n.params)
+                        and all(cot.get((id(n), i)) is None
+                                for i in range(len(out_avals)))):
+                    continue
+                ogs = []
+                for i, (shape, dtype) in enumerate(out_avals):
+                    c = cot.get((id(n), i))
+                    ogs.append(_np.zeros(shape, dtype) if c is None
+                               else _np.asarray(c))
+                in_grads = n.op.host_grad(n.params, bctx, ogs)
+                for k, g in zip(in_keys, in_grads):
+                    _accum(k, jax.device_put(_np.asarray(g), dev))
+            else:
+                _, idx, ext_vals, aux_in, rngs, out_avals = entry
+                item = self._hyb_plan[idx]
+                out_keys = item[3]
+                # only inexact outputs participate in the vjp (same
+                # static mask as _seg_bwd's filtered forward)
+                pairs = [
+                    (cot.get(k), av) for k, av in zip(out_keys, out_avals)
+                    if jnp.issubdtype(jnp.dtype(av[1]), jnp.inexact)
+                ]
+                # all-zero cotangents still cost a backward pass; skip
+                # segments nothing flowed into (e.g. past a BlockGrad)
+                if all(c is None or getattr(c, "dtype", None) == float0
+                       for c, _ in pairs):
+                    continue
+                out_cts = [
+                    jnp.zeros(av[0], jnp.dtype(av[1])) if c is None
+                    else (c.astype(av[1])
+                          if getattr(c, "dtype", None) != jnp.dtype(av[1])
+                          else c)
+                    for c, av in pairs
+                ]
+                ext_cts = self._seg_bwd(idx)(ext_vals, aux_in, rngs, out_cts)
+                for k, g in zip(item[2], ext_cts):
+                    _accum(k, g)
+
+        argidx_key = getattr(self, "_argidx_key", None)
+        if argidx_key is None:
+            argidx_key = self._argidx_key = {
+                self._var_argidx[id(n)]: (id(n), 0)
+                for n in self._nodes if n.is_variable
+            }
+        grads = []
+        for i in self._grad_idx:
+            g = cot.get(argidx_key.get(i))
+            if g is None or getattr(g, "dtype", None) == float0:
+                g = jnp.zeros(self.arg_arrays[i].shape,
+                              self.arg_arrays[i]._data.dtype)
+            grads.append(g)
+        self._apply_grads(grads)
+        # release the saved activations/residuals: a full per-batch
+        # activation set must not stay pinned between optimizer steps
+        self._hyb_saved = None
 
     # -- mirror (gradient checkpointing) planning ------------------------------
     def _build_mirror_plan(self):
@@ -192,36 +476,13 @@ class Executor:
         if self._multi_device or not any(mirrored(n) for n in self._nodes):
             return [("node", i) for i in range(len(self._nodes))]
 
-        head_keys = {(id(self._nodes[i]), j) for i, j in self._heads}
-        consumers = {}  # key -> set of consumer serials
-        for serial, n in enumerate(self._nodes):
-            if n.is_variable:
-                continue
-            for s, i in n.inputs:
-                consumers.setdefault((id(s), i), set()).add(serial)
+        head_keys, consumers = self._graph_meta()
 
         plan, run = [], []
 
         def emit(chunk):
-            seg_set = set(chunk)
-            produced = []
-            for s in chunk:
-                n = self._nodes[s]
-                for i in range(len(n.op.list_outputs(n.params))):
-                    produced.append((id(n), i))
-            produced_set = set(produced)
-            ext, seen = [], set()
-            for s in chunk:
-                for src, i in self._nodes[s].inputs:
-                    k = (id(src), i)
-                    if k not in produced_set and k not in seen:
-                        seen.add(k)
-                        ext.append(k)
-            outs = [
-                k for k in produced
-                if k in head_keys or (consumers.get(k, set()) - seg_set)
-            ]
-            plan.append(("seg", tuple(chunk), tuple(ext), tuple(outs)))
+            plan.append(self._segment_item(tuple(chunk), head_keys,
+                                           consumers))
 
         def flush():
             if not run:
@@ -290,34 +551,15 @@ class Executor:
                 self._apply_node(serial, env, new_aux, node_rng, is_train)
                 continue
 
-            # remat segment: recompute these nodes' activations in backward
-            _, serials, ext_keys, out_keys = item
-            # gather the segment's aux window (contiguous per node)
-            aux_slices = [
-                self._node_aux[id(self._nodes[s])]
-                for s in serials if id(self._nodes[s]) in self._node_aux
-            ]
-            aux_ids = [j for lo, hi in aux_slices for j in range(lo, hi)]
-            rng_serials = [
-                s for s in serials
-                if self._nodes[s].op.need_rng and rng is not None
-            ]
-            rngs = [jax.random.fold_in(rng, s) for s in rng_serials]
-
-            def seg_fn(ext_vals, aux_in, rngs_in, _serials=serials,
-                       _ext_keys=ext_keys, _out_keys=out_keys,
-                       _aux_ids=aux_ids, _rng_serials=rng_serials):
-                local = dict(zip(_ext_keys, ext_vals))
-                laux = dict(zip(_aux_ids, aux_in))
-                rmap = dict(zip(_rng_serials, rngs_in))
-                for s in _serials:
-                    self._apply_node(s, local, laux, rmap.get(s), is_train)
-                return ([local[k] for k in _out_keys],
-                        [laux[j] for j in _aux_ids])
-
+            # remat segment: recompute these nodes' activations in
+            # backward (same segment closure as the hybrid engine)
+            _, serials, ext_keys, out_keys, aux_ids, rng_serials = item
+            seg_fn = self._seg_fn(item, is_train)
             fn = jax.checkpoint(seg_fn) if is_train else seg_fn
             ext_vals = [env[k] for k in ext_keys]
             aux_in = [new_aux[j] for j in aux_ids]
+            rngs = ([jax.random.fold_in(rng, s) for s in rng_serials]
+                    if rng is not None else [])
             outs, aux_out = fn(ext_vals, aux_in, rngs)
             env.update(zip(out_keys, outs))
             for j, v in zip(aux_ids, aux_out):
@@ -326,6 +568,11 @@ class Executor:
         return outputs, new_aux
 
     def _fwd_bwd_impl(self, arg_vals, aux_vals, rng, head_grads):
+        """head_grads: cotangents for the INEXACT-dtype heads only, in
+        head order — integer heads (e.g. a BlockGrad'd id tensor riding
+        along for metrics) are excluded from the vjp entirely, since
+        jax.vjp demands float0 cotangents for them. aux states travel
+        through has_aux (state, not differentiable outputs)."""
         import jax
         import jax.numpy as jnp
 
@@ -335,15 +582,25 @@ class Executor:
             vals = list(arg_vals)
             for i, g in zip(gidx, ga):
                 vals[i] = g
-            return self._run(vals, aux_vals, rng, is_train=True)
+            outs, new_aux = self._run(vals, aux_vals, rng, is_train=True)
+            flt = [o for o in outs if jnp.issubdtype(o.dtype, jnp.inexact)]
+            return flt, (outs, new_aux)
 
         ga0 = [arg_vals[i] for i in gidx]
-        (outs, new_aux), vjp_fn = jax.vjp(f, ga0)
-        zero_aux = [jnp.zeros_like(a) for a in new_aux]
-        (grads,) = vjp_fn((list(head_grads), zero_aux))
+        _, vjp_fn, (outs, new_aux) = jax.vjp(f, ga0, has_aux=True)
+        (grads,) = vjp_fn(list(head_grads))
         return outs, new_aux, grads
 
     # -- helpers ---------------------------------------------------------------
+    def _release_device_arrays(self):
+        """Free this executor's device arg/grad/aux arrays while keeping
+        the traced program (`_run`) usable as a pure function. Trainers
+        that only borrow `_run` (fit_trainer, symbol_trainer) call this
+        so the bound method doesn't pin a second parameter set in HBM.
+        The executor is unusable for forward/backward afterwards."""
+        self.arg_arrays = self.grad_arrays = self.aux_arrays = None
+        self._outputs_nd = None
+
     def _arg_vals(self):
         return [a._data for a in self.arg_arrays]
 
@@ -351,16 +608,21 @@ class Executor:
         return [a._data for a in self.aux_arrays]
 
     def _default_head_grads(self):
+        """Default cotangents per head: ones for loss ops, zeros
+        otherwise, None for integer-dtype heads (no cotangent exists —
+        the vjp paths exclude them)."""
         import jax.numpy as jnp
 
+        if self._outputs_nd is None or len(self._outputs_nd) != len(self._heads):
+            raise MXNetError("backward before forward")
         hg = []
-        for (nidx, oidx), no_grad in zip(self._heads, self._head_no_grad):
-            # shapes come from last outputs; ones for loss ops, zeros otherwise
-            shape_src = self._outputs_nd[len(hg)] if self._outputs_nd else None
-            if shape_src is None:
-                raise MXNetError("backward before forward")
+        for out_nd, no_grad in zip(self._outputs_nd, self._head_no_grad):
+            d = out_nd._data.dtype
+            if not jnp.issubdtype(d, jnp.inexact):
+                hg.append(None)
+                continue
             fill = 1.0 if no_grad else 0.0
-            hg.append(jnp.full(shape_src.shape, fill, dtype=shape_src.dtype))
+            hg.append(jnp.full(out_nd.shape, fill, dtype=d))
         return hg
 
     def _versions(self):
@@ -443,6 +705,15 @@ class Executor:
             self._monitor_replay(is_train)
 
         rng = _random.next_key() if is_train else None
+        if self._hybrid:
+            outs, new_aux = self._hybrid_run(
+                self._arg_vals(), self._aux_vals(), rng, is_train,
+                save=is_train and bool(self._grad_idx))
+            self._write_outputs(outs)
+            if is_train:
+                self._write_aux(new_aux)
+            self._grad_cache = None
+            return self.outputs
         if is_train and self._grad_idx and all(self._head_no_grad):
             # fused fwd+bwd program; gradients cached for backward().
             # Only worth it when EVERY head is a loss op: with any
@@ -451,7 +722,7 @@ class Executor:
             # compute a full backward only to discard it (same predicate
             # as parallel/symbol_trainer.py).
             self._outputs_shape_probe()
-            hg = self._default_head_grads()
+            hg = [g for g in self._default_head_grads() if g is not None]
             outs, new_aux, grads = self._fwd_bwd(
                 self._arg_vals(), self._aux_vals(), rng, hg
             )
@@ -495,6 +766,9 @@ class Executor:
                 grads = self._grad_cache[1]
                 self._apply_grads(grads)
                 return
+            if self._hybrid:
+                self._hybrid_backward(self._default_head_grads())
+                return
             hg = self._default_head_grads()
         else:
             if isinstance(out_grads, NDArray):
@@ -505,9 +779,26 @@ class Executor:
                 (g._data if isinstance(g, NDArray) else jnp.asarray(g))
                 for g in out_grads
             ]
+            # cotangents for integer-dtype heads do not exist; drop any
+            # the caller supplied (mirrors _default_head_grads). Output
+            # dtypes come from a shape probe ONLY when no forward ran
+            # yet (the probe is itself a forward: in hybrid mode it
+            # invalidates saved backward state) — without the mask an
+            # integer head would feed the vjp one cotangent too many
+            if self._outputs_nd is None:
+                self._outputs_shape_probe()
+            hg = [
+                None if not jnp.issubdtype(o._data.dtype, jnp.inexact)
+                else g
+                for g, o in zip(hg, self._outputs_nd)
+            ]
+        if self._hybrid:
+            self._hybrid_backward(hg)
+            return
         rng = _random.next_key()
         outs, new_aux, grads = self._fwd_bwd(
-            self._arg_vals(), self._aux_vals(), rng, hg
+            self._arg_vals(), self._aux_vals(), rng,
+            [g for g in hg if g is not None]
         )
         self._write_outputs(outs)
         self._apply_grads(grads)

@@ -287,6 +287,55 @@ def _custom_outputs(params):
     return ["output"]
 
 
+def _custom_host_apply(params, ins_np, is_train, cache=None):
+    """Eager host execution for the Executor's hybrid mode: the user
+    CustomOp runs directly on host numpy — no pure_callback, no compiled
+    program involved (the reference likewise runs Custom as a plain host
+    function pushed to the engine, ref: custom-inl.h:1-211).
+
+    `cache` is the owning Executor's per-binding dict: one operator
+    instance per (node params, input signature), created once per bind
+    like the reference, so stateful user CustomOps keep their state
+    across batches and die with their executor."""
+    op_type = params["op_type"]
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError("Custom op %s not registered" % op_type)
+    in_shapes = tuple(tuple(a.shape) for a in ins_np)
+    in_dtypes = tuple(_np.dtype(a.dtype).str for a in ins_np)
+    key = (id(params), in_shapes, in_dtypes)
+    cached = cache.get(key) if cache is not None else None
+    if cached is None:
+        prop = _CUSTOM_REGISTRY[op_type](**(params.get("__kwargs__") or {}))
+        n_out = len(prop.list_outputs())
+        _, out_shapes, _ = _norm_infer_shape(
+            prop.infer_shape(list(map(list, in_shapes))))
+        _, out_dtypes, _ = prop.infer_type([a.dtype for a in ins_np])
+        op = prop.create_operator(None, in_shapes, [a.dtype for a in ins_np])
+        cached = (op, n_out, out_shapes, out_dtypes)
+        if cache is not None:
+            cache[key] = cached
+    op, n_out, out_shapes, out_dtypes = cached
+    outs = [_np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+    in_nd = [_HostND(_np.asarray(a)) for a in ins_np]
+    out_nd = [_HostND(a) for a in outs]
+    op.forward(bool(is_train), ["write"] * n_out, in_nd, out_nd, [])
+    outs = [o._arr for o in out_nd]
+    return outs, (op, in_nd, out_nd)
+
+
+def _custom_host_grad(params, bwd_ctx, out_grads_np):
+    """in_grads from the user CustomOp.backward, reusing the saved
+    forward arrays (the pure_callback path must recompute forward in
+    backward; here the residuals persist — strictly cheaper)."""
+    op, in_nd, out_nd = bwd_ctx
+    grads = [_np.zeros_like(a._arr) for a in in_nd]
+    grad_nd = [_HostND(g) for g in grads]
+    op.backward(["write"] * len(in_nd),
+                [_HostND(_np.asarray(g)) for g in out_grads_np],
+                in_nd, out_nd, grad_nd, [])
+    return [g._arr for g in grad_nd]
+
+
 _register_opdef(
     OpDef(
         "Custom",
@@ -306,6 +355,8 @@ _register_opdef(
                 **(params.get("__kwargs__") or {})
             ).need_top_grad_
         ),
+        host_apply=_custom_host_apply,
+        host_grad=_custom_host_grad,
     )
 )
 

@@ -104,6 +104,8 @@ class OpDef:
         key_var_num_args=None,
         imperative=True,
         init_aux=None,
+        host_apply=None,
+        host_grad=None,
         doc="",
     ):
         self.name = name
@@ -123,6 +125,19 @@ class OpDef:
         self.key_var_num_args = key_var_num_args
         self.imperative = imperative
         self.init_aux = init_aux  # fn(params, aux_shapes)->list of np arrays
+        # host-op contract: ops whose kernels are host Python/numpy
+        # (Custom, NumpyOp, torch bridge). When set, the Executor runs
+        # them EAGERLY between jitted graph segments — host values in,
+        # host values out, no jax.pure_callback inside a compiled
+        # program (the callback runtime deadlocks are structural; see
+        # executor.py hybrid mode).
+        #   host_apply(params, ins_np, is_train, cache=None)
+        #       -> (outs_np, bwd_ctx)   (cache: executor-owned dict for
+        #          per-binding operator instances)
+        #   host_grad(params, bwd_ctx, out_grads_np) -> in_grads_np
+        self.host_apply = host_apply
+        self.host_grad = host_grad
+        self.is_host_op = host_apply is not None
         self.doc = doc
 
     def head_no_grad(self, params=None):

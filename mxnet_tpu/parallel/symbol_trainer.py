@@ -50,10 +50,20 @@ def make_symbol_train_step(symbol, input_shapes, optimizer=None,
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**input_shapes)
     param_names = [n for n in arg_names if n not in input_shapes]
 
-    # one throwaway bind to reuse the Executor's traced program & plan
+    if any((not n.is_variable) and n.op.is_host_op for n in symbol.nodes):
+        # host ops would have to trace as pure_callback inside this jit —
+        # the compiled-program host-callback path the hybrid executor
+        # exists to avoid (see executor.py); Module/FeedForward handle
+        # these graphs through the hybrid engine instead
+        raise MXNetError("make_symbol_train_step does not support host "
+                         "ops (Custom/NumpyOp/torch bridge)")
+    # one throwaway bind to reuse the Executor's traced program & plan;
+    # release its device arrays — `run` is a bound method and would
+    # otherwise pin a second full parameter set in HBM
     exe = symbol.simple_bind(ctx, grad_req="null", **input_shapes)
     run = exe._run
     no_head_grad = exe._head_no_grad
+    exe._release_device_arrays()
     if not all(no_head_grad):
         raise MXNetError("make_symbol_train_step requires loss-op heads")
 
@@ -91,16 +101,16 @@ def make_symbol_train_step(symbol, input_shapes, optimizer=None,
                 (batch[n] if n in batch else pc[n]) for n in arg_names
             ]
             outs, new_aux = run(vals, aux, rng, is_train=True)
-            # moving stats are state, not a differentiable output: cut
-            # their cotangent path at trace time so the vjp never builds
-            # a backward graph for them (the zero cotangents below would
-            # otherwise rely on XLA zero-propagation to DCE it)
-            return outs, [jax.lax.stop_gradient(a) for a in new_aux]
+            # only inexact heads get cotangents (integer heads, e.g. a
+            # BlockGrad'd id tensor, have none); moving stats are state,
+            # not differentiable outputs — both ride through has_aux so
+            # the vjp never builds a backward graph for them
+            flt = [o for o in outs if jnp.issubdtype(o.dtype, jnp.inexact)]
+            return flt, (outs, new_aux)
 
-        (outs, new_aux), vjp_fn = jax.vjp(f, params)
-        head_grads = [jnp.ones(o.shape, o.dtype) for o in outs]
-        zero_aux = [jnp.zeros_like(a) for a in new_aux]
-        (grads,) = vjp_fn((head_grads, zero_aux))
+        flt, vjp_fn, (outs, new_aux) = jax.vjp(f, params, has_aux=True)
+        head_grads = [jnp.ones(o.shape, o.dtype) for o in flt]
+        (grads,) = vjp_fn(head_grads)
         grads = {k: v.astype(jnp.float32) for k, v in grads.items()}
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

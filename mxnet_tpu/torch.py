@@ -129,10 +129,62 @@ def _load_params(mod, values):
 # TorchModule op
 # ---------------------------------------------------------------------------
 
+def _torch_module_run(params, host_args, with_grad, out_grads=None):
+    """One torch module execution on host numpy values — shared by the
+    pure_callback path (compiled traces) and the Executor's eager host-op
+    path (hybrid mode, executor.py)."""
+    torch = import_torch()
+    mstr = _resolve_module_string(params)
+    num_data = int(params["num_data"])
+    mod = module_creator(mstr)
+    datas = [torch.from_numpy(_np.array(a, _np.float32)) for a in
+             host_args[:num_data]]
+    with _torch_lock:
+        pvals = host_args[num_data:]
+        _load_params(mod, pvals)
+        tensors = datas + _param_tensors(mod)
+        if with_grad:
+            for t in tensors:
+                t.requires_grad_(True)
+        outs = mod(*datas)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        if not with_grad:
+            return tuple(o.detach().numpy() for o in outs)
+        ogs = [torch.from_numpy(_np.array(g, _np.float32))
+               for g in out_grads]
+        grads = torch.autograd.grad(
+            outs, tensors, grad_outputs=ogs, allow_unused=True
+        )
+        return tuple(
+            _np.zeros(t.shape, _np.float32) if g is None
+            else g.detach().numpy()
+            for g, t in zip(grads, tensors)
+        )
+
+
+def _torch_module_host_apply(params, ins_np, is_train, cache=None):
+    # bwd_ctx deliberately holds INPUTS, not a live autograd graph, so
+    # host_grad re-runs the forward: the module object is shared through
+    # _module_cache across all ops with the same module_string, and
+    # another op's in-place _load_params between this forward and its
+    # backward would corrupt a retained graph (autograd forbids in-place
+    # mutation of captured leaves). Reload-and-recompute under _torch_lock
+    # is the race-free contract.
+    ins = tuple(_np.asarray(a, _np.float32) for a in ins_np)
+    outs = _torch_module_run(params, ins, with_grad=False)
+    return list(outs), ins
+
+
+def _torch_module_host_grad(params, bwd_ctx, out_grads_np):
+    return list(_torch_module_run(params, bwd_ctx, with_grad=True,
+                                  out_grads=out_grads_np))
+
+
 def _torch_module_fwd(params, inputs, aux, is_train, rng):
     import jax
 
-    torch = import_torch()
+    import_torch()
     mstr = _resolve_module_string(params)
     num_data = int(params["num_data"])
     num_outputs = int(params["num_outputs"])
@@ -153,38 +205,13 @@ def _torch_module_fwd(params, inputs, aux, is_train, rng):
         jax.ShapeDtypeStruct(tuple(x.shape), _np.dtype(_np.float32)) for x in inputs
     )
 
-    def run(host_args, with_grad, out_grads=None):
-        datas = [torch.from_numpy(_np.array(a, _np.float32)) for a in
-                 host_args[:num_data]]
-        with _torch_lock:
-            pvals = host_args[num_data:]
-            _load_params(mod, pvals)
-            tensors = datas + _param_tensors(mod)
-            if with_grad:
-                for t in tensors:
-                    t.requires_grad_(True)
-            outs = mod(*datas)
-            if not isinstance(outs, (tuple, list)):
-                outs = (outs,)
-            if not with_grad:
-                return tuple(o.detach().numpy() for o in outs)
-            ogs = [torch.from_numpy(_np.array(g, _np.float32))
-                   for g in out_grads]
-            grads = torch.autograd.grad(
-                outs, tensors, grad_outputs=ogs, allow_unused=True
-            )
-            return tuple(
-                _np.zeros(t.shape, _np.float32) if g is None
-                else g.detach().numpy()
-                for g, t in zip(grads, tensors)
-            )
-
     def host_forward(*host_args):
-        return run(host_args, with_grad=False)
+        return _torch_module_run(params, host_args, with_grad=False)
 
     def host_backward(*args):
         ogs = args[:num_outputs]
-        return run(args[num_outputs:], with_grad=True, out_grads=ogs)
+        return _torch_module_run(params, args[num_outputs:], with_grad=True,
+                                 out_grads=ogs)
 
     @jax.custom_vjp
     def f(*xs):
@@ -266,6 +293,8 @@ _register_opdef(
         outputs=_torch_module_outputs,
         infer_shape=_torch_module_infer_shape,
         imperative=False,
+        host_apply=_torch_module_host_apply,
+        host_grad=_torch_module_host_grad,
         doc="Run a torch.nn.Module as an operator (ref: plugin/torch/"
             "torch_module-inl.h).",
     )
@@ -276,13 +305,48 @@ _register_opdef(
 # TorchCriterion op
 # ---------------------------------------------------------------------------
 
+def _torch_criterion_host_fwd(params, d, l):
+    torch = import_torch()
+    crit = module_creator(_resolve_module_string(params))
+    batch = int(_np.shape(d)[0]) if _np.ndim(d) > 0 else 1
+    with _torch_lock, torch.no_grad():
+        loss = crit(
+            torch.from_numpy(_np.array(d, _np.float32)),
+            torch.from_numpy(_np.array(l, _np.float32)),
+        )
+    # per-sample broadcast of the (scalar) criterion value, matching the
+    # reference's outputs[0] shape Shape1(1) semantics batched for metric
+    return _np.full((batch,), float(loss), _np.float32)
+
+
+def _torch_criterion_host_bwd(params, d, l):
+    torch = import_torch()
+    crit = module_creator(_resolve_module_string(params))
+    grad_scale = float(params.get("grad_scale", 1.0))
+    dt = torch.from_numpy(_np.array(d, _np.float32)).requires_grad_(True)
+    lt = torch.from_numpy(_np.array(l, _np.float32))
+    with _torch_lock:
+        loss = crit(dt, lt)
+        (g,) = torch.autograd.grad(loss, (dt,))
+    return g.detach().numpy() * grad_scale
+
+
+def _torch_criterion_host_apply(params, ins_np, is_train, cache=None):
+    d = _np.asarray(ins_np[0], _np.float32)
+    l = _np.asarray(ins_np[1], _np.float32)
+    return [_torch_criterion_host_fwd(params, d, l)], (d, l)
+
+
+def _torch_criterion_host_grad(params, bwd_ctx, out_grads_np):
+    d, l = bwd_ctx
+    # loss head: out_grad ignored (ref: torch_criterion-inl.h Backward)
+    return [_torch_criterion_host_bwd(params, d, l), _np.zeros_like(l)]
+
+
 def _torch_criterion_fwd(params, inputs, aux, is_train, rng):
     import jax
 
-    torch = import_torch()
-    mstr = _resolve_module_string(params)
-    grad_scale = float(params.get("grad_scale", 1.0))
-    crit = module_creator(mstr)
+    import_torch()
     data, label = inputs[0], inputs[1]
     batch = int(data.shape[0]) if getattr(data, "ndim", 1) > 0 else 1
 
@@ -290,22 +354,10 @@ def _torch_criterion_fwd(params, inputs, aux, is_train, rng):
     grad_spec = jax.ShapeDtypeStruct(tuple(data.shape), _np.dtype(_np.float32))
 
     def host_forward(d, l):
-        with _torch_lock, torch.no_grad():
-            loss = crit(
-                torch.from_numpy(_np.array(d, _np.float32)),
-                torch.from_numpy(_np.array(l, _np.float32)),
-            )
-        # per-sample broadcast of the (scalar) criterion value, matching the
-        # reference's outputs[0] shape Shape1(1) semantics batched for metric
-        return _np.full((batch,), float(loss), _np.float32)
+        return _torch_criterion_host_fwd(params, d, l)
 
     def host_backward(d, l):
-        dt = torch.from_numpy(_np.array(d, _np.float32)).requires_grad_(True)
-        lt = torch.from_numpy(_np.array(l, _np.float32))
-        with _torch_lock:
-            loss = crit(dt, lt)
-            (g,) = torch.autograd.grad(loss, (dt,))
-        return g.detach().numpy() * grad_scale
+        return _torch_criterion_host_bwd(params, d, l)
 
     @jax.custom_vjp
     def f(d, l):
@@ -347,6 +399,8 @@ _register_opdef(
         infer_shape=_torch_criterion_infer_shape,
         imperative=False,
         no_head_grad=True,
+        host_apply=_torch_criterion_host_apply,
+        host_grad=_torch_criterion_host_grad,
         doc="Run a torch criterion as a loss op (ref: plugin/torch/"
             "torch_criterion-inl.h).",
     )

@@ -186,3 +186,26 @@ def test_mirror_with_aux_and_dropout(monkeypatch):
     exe.backward()
     # aux state still mutates through the remat segment
     assert not np.allclose(exe.aux_dict["bn1_moving_mean"].asnumpy(), mm0)
+
+
+def test_int_blockgrad_head_rides_with_loss():
+    """An integer-dtype BlockGrad head (metrics side-channel) must not
+    break the fused fwd+bwd path: integer heads have no cotangent and
+    are excluded from the vjp (advisor r3)."""
+    import numpy as np
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    loss = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    ids = mx.sym.BlockGrad(data=mx.sym.Cast(data=mx.sym.argmax_channel(fc),
+                                            dtype="int32"), name="ids")
+    sym = mx.sym.Group([loss, ids])
+    exe = sym.simple_bind(mx.cpu(0), data=(8, 6), grad_req="write",
+                          softmax_label=(8,))
+    exe.arg_dict["data"][:] = np.random.RandomState(0).randn(8, 6)
+    exe.arg_dict["softmax_label"][:] = np.arange(8) % 4
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["fc_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    assert exe.outputs[1].asnumpy().dtype == np.int32
