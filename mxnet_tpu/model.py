@@ -87,16 +87,210 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
             updater(index * num_device + k, g, w)
 
 
+def _desc_name(d):
+    """provide_data/provide_label entries are (name, shape) tuples or
+    DataDesc namedtuples."""
+    return d.name if isinstance(d, io.DataDesc) else d[0]
+
+
+def _desc_shape(d):
+    return tuple(d.shape if isinstance(d, io.DataDesc) else d[1])
+
+
+def _scan_k():
+    """Steps fused per dispatch in the scanned fit path; 0 disables."""
+    import os
+
+    if os.environ.get("MXNET_SCAN_TRAIN", "1") in ("0", "false", "off"):
+        return 0
+    return int(os.environ.get("MXNET_TRAIN_SCAN_K", "8"))
+
+
+def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
+                   aux_params, begin_epoch, end_epoch, epoch_size, optimizer,
+                   train_data, eval_data, eval_metric, epoch_end_callback,
+                   batch_end_callback, logger, eval_batch_end_callback, K):
+    """K-step-scanned single-device training loop: same observable
+    semantics as _train_multi_device's per-batch loop (metrics, per-batch
+    callbacks, epoch checkpointing), but the step itself is a compiled
+    K-step lax.scan through parallel/fit_trainer.py — one dispatch per K
+    batches, so the tunnel round-trip and the metric fence amortize.
+    Per-batch callbacks fire after their chunk completes (they lag the
+    device by up to K batches, exactly like the reference's async engine
+    lag between push and metric sync; ref model.py:244)."""
+    input_names = trainer.input_names
+
+    eval_exe = None
+
+    def _flush(buf, epoch, nbatch0):
+        staged = trainer.stage_chunk(buf)
+        outs = trainer.run_chunk(staged)
+        return (outs, buf, epoch, nbatch0)
+
+    def _drain(pending, eval_metric):
+        if pending is None:
+            return 0
+        outs, bufs, epoch, nbatch0 = pending
+        # D2H minimisation: Accuracy only needs the argmax class id per
+        # sample — reduce [K,N,C] probabilities to [K,N] ids ON DEVICE
+        # before pulling to host (the tunnel's D2H bandwidth would
+        # otherwise eat ~30% of a ResNet chunk's wall time). Accuracy
+        # already accepts 1-D predicted labels.
+        if (type(eval_metric) is metric_mod.Accuracy and len(outs) == 1
+                and getattr(outs[0], "ndim", 0) == 3):
+            import jax.numpy as jnp
+
+            host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
+        else:
+            host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
+        for k, b in enumerate(bufs):
+            labels = [NDArray(_np.asarray(
+                b[n].asnumpy() if isinstance(b[n], NDArray) else b[n]),
+                cpu(0)) for n in label_names]
+            preds = [NDArray(h[k], cpu(0)) for h in host_outs]
+            eval_metric.update(labels, preds)
+            if batch_end_callback is not None:
+                _multiple_callbacks(batch_end_callback, BatchEndParam(
+                    epoch=epoch, nbatch=nbatch0 + k + 1,
+                    eval_metric=eval_metric, locals=locals()))
+        return len(bufs)
+
+    label_names = [_desc_name(d) for d in train_data.provide_label]
+
+    train_data.reset()
+    for epoch in range(begin_epoch, end_epoch):
+        tic = time.time()
+        eval_metric.reset()
+        nbatch = 0
+        pending = None
+        buf = []
+        while True:
+            do_reset = True
+            for data_batch in train_data:
+                arrs = list(data_batch.data) + list(data_batch.label)
+                # hold the NDArray refs — stage_chunk stacks on device
+                # when they are already device-resident (no host trip)
+                buf.append(dict(zip(input_names, arrs)))
+                nbatch += 1
+                if len(buf) == K:
+                    new_pending = _flush(buf, epoch, nbatch - K)
+                    _drain(pending, eval_metric)
+                    pending = new_pending
+                    buf = []
+                if epoch_size is not None and nbatch >= epoch_size:
+                    do_reset = False
+                    break
+            if do_reset:
+                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+                train_data.reset()
+            if epoch_size is None or nbatch >= epoch_size:
+                break
+        if buf:  # epoch tail: smaller scan, compiled once per tail size
+            new_pending = _flush(buf, epoch, nbatch - len(buf))
+            _drain(pending, eval_metric)
+            pending = new_pending
+            buf = []
+        _drain(pending, eval_metric)
+        toc = time.time()
+        logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+
+        trainer.write_back(arg_params, aux_params, aux_names)
+        _multiple_callbacks(epoch_end_callback, epoch, symbol, arg_params,
+                            aux_params)
+
+        if eval_data:
+            if eval_exe is None:
+                eval_shapes = {
+                    _desc_name(d): _desc_shape(d)
+                    for d in list(eval_data.provide_data)
+                    + list(eval_data.provide_label)
+                }
+                eval_exe = symbol.simple_bind(ctx0, grad_req="null",
+                                              **eval_shapes)
+            eval_exe.copy_params_from(arg_params, aux_params)
+            eval_metric.reset()
+            eval_data.reset()
+            eval_label_names = [_desc_name(d)
+                                for d in eval_data.provide_label]
+            eval_data_names = [_desc_name(d)
+                               for d in eval_data.provide_data]
+            for i, eval_batch in enumerate(eval_data):
+                for n, a in zip(eval_data_names, eval_batch.data):
+                    a.copyto(eval_exe.arg_dict[n])
+                # labels too: loss-style heads (MakeLoss/criterions) read
+                # them; leaving bind-time zeros would silently score the
+                # loss against zeros
+                for n, a in zip(eval_label_names, eval_batch.label):
+                    if n in eval_exe.arg_dict:
+                        a.copyto(eval_exe.arg_dict[n])
+                eval_exe.forward(is_train=False)
+                eval_metric.update(eval_batch.label, eval_exe.outputs)
+                if eval_batch_end_callback is not None:
+                    _multiple_callbacks(eval_batch_end_callback, BatchEndParam(
+                        epoch=epoch, nbatch=i, eval_metric=eval_metric,
+                        locals=locals()))
+            for name, value in eval_metric.get_name_value():
+                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+            eval_data.reset()
+
+    from . import engine as _engine
+
+    if _engine.Engine._instance is not None:
+        _engine.Engine._instance.wait_for_all()
+
+
 def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_params,
                         aux_params, begin_epoch, end_epoch, epoch_size, optimizer,
                         kvstore, update_on_kvstore, train_data, eval_data=None,
                         eval_metric=None, epoch_end_callback=None,
                         batch_end_callback=None, logger=None, work_load_list=None,
                         monitor=None, eval_batch_end_callback=None,
-                        sym_gen=None):
+                        sym_gen=None, compute_dtype=None):
     """Core DP training loop (ref: python/mxnet/model.py:117-310)."""
     if logger is None:
         logger = logging
+    K = _scan_k()
+    _scan_attempted = False
+    if (K > 1 and len(ctx) == 1 and kvstore is None and not update_on_kvstore
+            and monitor is None and sym_gen is None
+            and work_load_list is None):
+        from .parallel.fit_trainer import make_fit_trainer, supports_optimizer
+
+        if supports_optimizer(optimizer):
+            input_shapes = {
+                _desc_name(d): _desc_shape(d)
+                for d in (list(train_data.provide_data)
+                          + list(train_data.provide_label))
+            }
+            # only CONSTRUCTION falls back (host ops / non-loss heads);
+            # once training starts, errors must surface — a silent
+            # restart on the per-batch path would retrain from epoch 0
+            # with already-mutated params and a shifted lr schedule
+            trainer = None
+            try:
+                trainer = make_fit_trainer(
+                    symbol, ctx[0], input_shapes, optimizer, arg_params,
+                    aux_params, param_names, compute_dtype=compute_dtype)
+            except MXNetError as e:
+                logger.debug("scanned fit unavailable (%s); using the "
+                             "per-batch loop", e)
+            if trainer is not None:
+                return _train_scanned(
+                    trainer, symbol, ctx[0], param_names, aux_names,
+                    arg_params, aux_params, begin_epoch, end_epoch,
+                    epoch_size, optimizer, train_data, eval_data,
+                    eval_metric, epoch_end_callback, batch_end_callback,
+                    logger, eval_batch_end_callback, K)
+            _scan_attempted = True
+    if compute_dtype is not None:
+        # mixed precision rides the scanned trainer; the per-batch loop
+        # trains in the arrays' dtype (f32) — a silent precision change
+        # must not look like it took effect
+        logger.warning(
+            "compute_dtype=%s requested but the scanned fit fast path is "
+            "unavailable (%s); training proceeds in the parameter dtype",
+            compute_dtype,
+            "construction failed" if _scan_attempted else "eligibility")
     executor_manager = DataParallelExecutorManager(
         symbol=symbol, sym_gen=sym_gen, ctx=ctx, train_data=train_data,
         param_names=param_names, arg_names=arg_names, aux_names=aux_names,
@@ -271,7 +465,7 @@ class FeedForward(BASE_ESTIMATOR):
     def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
                  optimizer="sgd", initializer=Uniform(0.01), numpy_batch_size=128,
                  arg_params=None, aux_params=None, allow_extra_params=False,
-                 begin_epoch=0, **kwargs):
+                 begin_epoch=0, compute_dtype=None, **kwargs):
         if isinstance(symbol, Symbol):
             self.symbol = symbol
             self.sym_gen = None
@@ -298,6 +492,15 @@ class FeedForward(BASE_ESTIMATOR):
         self.numpy_batch_size = numpy_batch_size
         self._pred_exec = None
         self.begin_epoch = begin_epoch
+        # TPU extension: mixed-precision training through the scanned fit
+        # path (f32 master weights, `compute_dtype` activations/matmuls;
+        # same scheme as parallel/symbol_trainer.py). None = f32, or set
+        # MXNET_COMPUTE_DTYPE=bfloat16 process-wide.
+        import os
+
+        self.compute_dtype = (
+            compute_dtype if compute_dtype is not None
+            else os.environ.get("MXNET_COMPUTE_DTYPE") or None)
 
     def _check_arguments(self):
         if self.argument_checked:
@@ -562,7 +765,7 @@ class FeedForward(BASE_ESTIMATOR):
             kvstore=kvstore, update_on_kvstore=update_on_kvstore,
             logger=logger, work_load_list=work_load_list, monitor=monitor,
             eval_batch_end_callback=eval_batch_end_callback,
-            sym_gen=self.sym_gen,
+            sym_gen=self.sym_gen, compute_dtype=self.compute_dtype,
         )
 
     def save(self, prefix, epoch=None):

@@ -1,0 +1,86 @@
+"""Scanned fit() fast path (parallel/fit_trainer.py): must preserve the
+per-batch loop's semantics — same convergence, same metric/callback
+counts, real Optimizer state advancement — while running K steps per
+dispatch."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _fit(scan, optimizer="sgd", opt_kwargs=None, seed=7, num_epoch=2,
+         lr_scheduler=None, batch_cb=None):
+    os.environ["MXNET_SCAN_TRAIN"] = "1" if scan else "0"
+    try:
+        np.random.seed(seed)
+        mx.random.seed(seed)  # initializers draw from the mx.random chain
+        train = mx.io.MNISTIter(batch_size=32, num_synthetic=512, seed=1)
+        val = mx.io.MNISTIter(batch_size=32, num_synthetic=256, seed=2,
+                              shuffle=False)
+        kw = dict(opt_kwargs or {})
+        if lr_scheduler is not None:
+            kw["lr_scheduler"] = lr_scheduler
+        model = mx.FeedForward(
+            mx.models.get_mlp(), ctx=mx.cpu(0), num_epoch=num_epoch,
+            optimizer=optimizer, initializer=mx.initializer.Xavier(), **kw)
+        model.fit(X=train, eval_data=val, batch_end_callback=batch_cb)
+        return model
+    finally:
+        os.environ.pop("MXNET_SCAN_TRAIN", None)
+
+
+def test_scanned_matches_perbatch_sgd():
+    m1 = _fit(scan=True, opt_kwargs={"learning_rate": 0.1, "momentum": 0.9})
+    m2 = _fit(scan=False, opt_kwargs={"learning_rate": 0.1, "momentum": 0.9})
+    a1 = m1.score(mx.io.MNISTIter(batch_size=32, num_synthetic=256, seed=2,
+                                  shuffle=False))
+    a2 = m2.score(mx.io.MNISTIter(batch_size=32, num_synthetic=256, seed=2,
+                                  shuffle=False))
+    assert a1 > 0.9 and a2 > 0.9
+    # same seeds, same arithmetic -> near-identical weights (fp drift only)
+    for k in m1.arg_params:
+        np.testing.assert_allclose(
+            m1.arg_params[k].asnumpy(), m2.arg_params[k].asnumpy(),
+            rtol=2e-2, atol=2e-3, err_msg=k)
+
+
+def test_scanned_adam_with_scheduler_converges():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.9)
+    m = _fit(scan=True, optimizer="adam",
+             opt_kwargs={"learning_rate": 0.002}, lr_scheduler=sched)
+    acc = m.score(mx.io.MNISTIter(batch_size=32, num_synthetic=256, seed=2,
+                                  shuffle=False))
+    assert acc > 0.9
+
+
+def test_scanned_callback_counts_and_tail_chunks():
+    """Per-batch callbacks must fire once per batch even when the epoch
+    length is not a multiple of K (tail chunk takes a smaller scan)."""
+    os.environ["MXNET_TRAIN_SCAN_K"] = "5"  # 512/32 = 16 batches: 5,5,5,1
+    seen = []
+    try:
+        _fit(scan=True, opt_kwargs={"learning_rate": 0.1},
+             num_epoch=1, batch_cb=lambda p: seen.append(p.nbatch))
+    finally:
+        os.environ.pop("MXNET_TRAIN_SCAN_K", None)
+    assert seen == list(range(1, 17))
+
+
+def test_scanned_optimizer_counts_advance():
+    """lr schedulers key off num_update; the host-side counts must
+    advance by exactly the number of applied batches."""
+    os.environ["MXNET_SCAN_TRAIN"] = "1"
+    try:
+        np.random.seed(0)
+        train = mx.io.MNISTIter(batch_size=32, num_synthetic=320, seed=1)
+        opt = mx.optimizer.create("sgd", learning_rate=0.05,
+                                  rescale_grad=1.0 / 32)
+        model = mx.FeedForward(mx.models.get_mlp(), ctx=mx.cpu(0),
+                               num_epoch=2, optimizer=opt,
+                               initializer=mx.initializer.Xavier())
+        model.fit(X=train)
+        assert opt.num_update == 2 * (320 // 32)
+    finally:
+        os.environ.pop("MXNET_SCAN_TRAIN", None)
