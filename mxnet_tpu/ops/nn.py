@@ -766,7 +766,10 @@ def _blockgrad_fwd(params, inputs, aux, is_train, rng):
     return [jax.lax.stop_gradient(inputs[0])], []
 
 
-register(OpDef("BlockGrad", _blockgrad_fwd))
+# no_head_grad: a BlockGrad head never propagates a cotangent, so
+# backward() must not demand an out_grad for it (lets metrics-only heads
+# ride alongside loss heads, e.g. the rcnn example's sampled-label head)
+register(OpDef("BlockGrad", _blockgrad_fwd, no_head_grad=True))
 
 
 # -- SwapAxis (ref: src/operator/swapaxis-inl.h) -------------------------------
