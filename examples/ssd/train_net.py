@@ -8,11 +8,13 @@ expects (example/ssd/dataset/iterator.py).
 """
 import argparse
 import logging
+import os
 
 import numpy as np
 
 import mxnet_tpu as mx
 from symbol_ssd import get_symbol_train, get_symbol
+from evaluate import evaluate_detections
 
 
 def synthetic_detection_set(n, image=64, num_classes=3, max_obj=3, seed=0):
@@ -69,8 +71,8 @@ def parse_args():
     p.add_argument('--image', type=int, default=64)
     p.add_argument('--num-examples', type=int, default=512)
     p.add_argument('--batch-size', type=int, default=32)
-    p.add_argument('--num-epochs', type=int, default=5)
-    p.add_argument('--lr', type=float, default=0.01)
+    p.add_argument('--num-epochs', type=int, default=25)
+    p.add_argument('--lr', type=float, default=0.1)
     p.add_argument('--ctx', type=str, default='auto', choices=['auto', 'cpu', 'tpu'])
     return p.parse_args()
 
@@ -78,6 +80,9 @@ def parse_args():
 def main():
     args = parse_args()
     logging.basicConfig(level=logging.INFO)
+    smoke = os.environ.get("MXNET_EXAMPLE_SMOKE") == "1"
+    if smoke:
+        args.num_examples, args.num_epochs = 256, 15
     if args.ctx == 'cpu' or (args.ctx == 'auto' and mx.context.num_devices('tpu') == 0):
         ctx = mx.cpu()
     else:
@@ -114,6 +119,16 @@ def main():
     out = det.get_outputs()[0].asnumpy()
     kept = (out[:, :, 0] >= 0).sum(axis=1)
     logging.info('detections per image (first 8): %s', kept[:8].tolist())
+
+    # evaluate: mAP on a held-out synthetic set through the SAME decode
+    # pipeline (ref: example/ssd/evaluate/evaluate_net.py role)
+    Xe, Ye = synthetic_detection_set(
+        max(args.batch_size * 4, 64), args.image, args.num_classes, seed=99)
+    mean_ap = evaluate_detections(det, Xe, Ye, args.batch_size,
+                                  args.num_classes)
+    logging.info('held-out mAP@0.5 = %.3f', mean_ap)
+    assert mean_ap > 0.25, "SSD stopped converging: mAP=%.3f" % mean_ap
+    print('ok: ssd train->detect->eval mAP=%.3f' % mean_ap)
 
 
 if __name__ == '__main__':

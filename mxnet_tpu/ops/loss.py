@@ -189,17 +189,32 @@ _make_regression(
 # -- MakeLoss (ref: src/operator/make_loss-inl.h) ------------------------------
 def _make_loss_fwd(params, inputs, aux, is_train, rng):
     grad_scale = params["grad_scale"]
+    normalization = params["normalization"]
+    valid_thresh = params["valid_thresh"]
 
     @jax.custom_vjp
     def f(x):
         return x
 
     def fwd(x):
-        return x, x  # residual only to carry shape+dtype for the cotangent
+        return x, x  # residual carries shape+dtype AND the normalizer data
 
     def bwd(res, g):
         del g
-        return (jnp.full_like(res, grad_scale),)
+        # normalization (ref: make_loss-inl.h Backward): "valid" divides
+        # by the count of loss elements above valid_thresh (for masked
+        # losses like SSD's smooth_l1 that is the number of live
+        # coordinates — without it the summed gradient scales with the
+        # anchor count and drowns every other loss sharing the trunk);
+        # "batch" divides by batch size
+        if normalization == "valid":
+            denom = jnp.maximum(
+                jnp.sum((res > valid_thresh).astype(res.dtype)), 1.0)
+        elif normalization == "batch":
+            denom = float(res.shape[0])
+        else:
+            denom = 1.0
+        return (jnp.full_like(res, grad_scale) / denom,)
 
     f.defvjp(fwd, bwd)
     return [f(inputs[0])], []

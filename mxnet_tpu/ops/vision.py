@@ -478,7 +478,14 @@ def _multibox_target_fwd(params, inputs, aux, is_train, rng):
         params["minimum_negative_samples"], variances)
     loc_t, loc_m, cls_t = jax.vmap(f)(labels, cls_preds)
     dt = anchors.dtype
-    return [loc_t.astype(dt), loc_m.astype(dt), cls_t.astype(dt)], []
+    # targets are labels, not differentiable outputs: the reference op's
+    # Backward writes zeros (multibox_target.cc). Without the cut, the
+    # loc loss backprops THROUGH the negative-mining sort into
+    # cls_preds with nonsense cotangents — observed as the SSD
+    # classifier collapsing to background while localization converges.
+    return [jax.lax.stop_gradient(loc_t).astype(dt),
+            jax.lax.stop_gradient(loc_m).astype(dt),
+            jax.lax.stop_gradient(cls_t).astype(dt)], []
 
 
 def _multibox_target_shape(params, in_shapes):

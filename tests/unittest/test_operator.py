@@ -751,3 +751,23 @@ def test_batchnorm_use_global_stats():
         mv.reshape(1, 3, 1, 1) + 1e-3)
     assert reldiff(out, ref) < 1e-4
     assert np.allclose(exe.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_make_loss_normalization():
+    """MakeLoss normalization (ref: make_loss-inl.h Backward): 'valid'
+    divides the gradient by the count of loss elements > valid_thresh;
+    'batch' by batch size (advisor r3: an un-normalized masked loc loss
+    drowned every other loss sharing the trunk in the SSD example)."""
+    import numpy as np
+
+    x = np.zeros((2, 8), np.float32)
+    x[0, :3] = 5.0  # 3 'valid' loss elements
+    for norm, expect in (("null", 2.0), ("batch", 1.0), ("valid", 2.0 / 3)):
+        d = mx.sym.Variable("d")
+        l = mx.sym.MakeLoss(data=d, grad_scale=2.0, normalization=norm)
+        g = mx.nd.zeros((2, 8))
+        exe = l.bind(mx.cpu(), {"d": mx.nd.array(x)}, args_grad={"d": g})
+        exe.forward(is_train=True)
+        exe.backward()
+        np.testing.assert_allclose(g.asnumpy(), np.full((2, 8), expect),
+                                   rtol=1e-6, err_msg=norm)
