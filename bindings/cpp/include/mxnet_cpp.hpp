@@ -1,0 +1,599 @@
+// mxnet_cpp.hpp — the C++ language binding for mxnet_tpu.
+//
+// A real API package over the flat C ABI (include/c_api.h /
+// libc_api.so), playing the role the reference's R and Scala packages
+// play over libmxnet.so (ref: R-package/R/model.R mx.model.FeedForward
+// .create, scala-package core ml.dmlc.mxnet.FeedForward): RAII handles,
+// an operator factory, executor management, optimizers, metrics,
+// data iterators, and a FeedForward estimator with fit / score /
+// checkpoint save+load. Header-only; link only against libc_api.so.
+//
+//   using namespace mxnet::cpp;
+//   Symbol net = ...;                      // operator factory
+//   FeedForward model(net, FeedForward::Config().Epochs(6).LR(0.1f));
+//   model.Fit(train_iter);                 // optimizer + metric inside
+//   model.Save("lenet");                   // -symbol.json + -0000.params
+//   FeedForward back = FeedForward::Load("lenet", 0);
+//   float acc = back.Score(val_iter);
+#ifndef MXNET_CPP_HPP_
+#define MXNET_CPP_HPP_
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../include/c_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc, const char *what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+  }
+}
+#define MXCPP_CHECK(call) ::mxnet::cpp::Check((call), #call)
+
+// ---------------------------------------------------------------------------
+// NDArray — RAII over NDArrayHandle (ref: R-package/src/ndarray.cc role)
+// ---------------------------------------------------------------------------
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(NDArrayHandle h) : h_(std::make_shared<Owner>(h)) {}
+  NDArray(const std::vector<mx_uint> &shape, float fill = 0.f) {
+    NDArrayHandle h = nullptr;
+    MXCPP_CHECK(MXNDArrayCreate(shape.data(), shape.size(), 1, 0, 0, &h));
+    h_ = std::make_shared<Owner>(h);
+    std::vector<float> init(Size(shape), fill);
+    SyncCopyFromCPU(init);
+  }
+  NDArray(const std::vector<mx_uint> &shape, const std::vector<float> &data) {
+    NDArrayHandle h = nullptr;
+    MXCPP_CHECK(MXNDArrayCreate(shape.data(), shape.size(), 1, 0, 0, &h));
+    h_ = std::make_shared<Owner>(h);
+    SyncCopyFromCPU(data);
+  }
+
+  static size_t Size(const std::vector<mx_uint> &shape) {
+    size_t n = 1;
+    for (mx_uint d : shape) n *= d;
+    return n;
+  }
+
+  NDArrayHandle handle() const { return h_ ? h_->h : nullptr; }
+  bool defined() const { return handle() != nullptr; }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint dim = 0;
+    const mx_uint *pdata = nullptr;
+    MXCPP_CHECK(MXNDArrayGetShape(handle(), &dim, &pdata));
+    return std::vector<mx_uint>(pdata, pdata + dim);
+  }
+  size_t Size() const { return Size(Shape()); }
+
+  void SyncCopyFromCPU(const std::vector<float> &src) {
+    MXCPP_CHECK(MXNDArraySyncCopyFromCPU(handle(), src.data(), src.size()));
+  }
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    MXCPP_CHECK(MXNDArraySyncCopyToCPU(handle(), out.data(), out.size()));
+    return out;
+  }
+
+  // dict-style save/load — the checkpoint format (ref: c_api.h
+  // MXNDArraySave/Load; python save_checkpoint's arg:/aux: keys)
+  static void Save(const std::string &fname,
+                   const std::map<std::string, NDArray> &dict) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char *> keys;
+    for (const auto &kv : dict) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second.handle());
+    }
+    MXCPP_CHECK(MXNDArraySave(fname.c_str(), handles.size(), handles.data(),
+                              keys.data()));
+  }
+  static std::map<std::string, NDArray> Load(const std::string &fname) {
+    mx_uint n = 0, nk = 0;
+    NDArrayHandle *arrs = nullptr;
+    const char **keys = nullptr;
+    MXCPP_CHECK(MXNDArrayLoad(fname.c_str(), &n, &arrs, &nk, &keys));
+    std::map<std::string, NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) {
+      std::string k = (nk == n) ? keys[i] : ("arg:" + std::to_string(i));
+      out.emplace(k, NDArray(arrs[i]));
+    }
+    return out;
+  }
+
+ private:
+  struct Owner {
+    explicit Owner(NDArrayHandle hh) : h(hh) {}
+    ~Owner() {
+      if (h) MXNDArrayFree(h);
+    }
+    NDArrayHandle h;
+  };
+  std::shared_ptr<Owner> h_;
+};
+
+// ---------------------------------------------------------------------------
+// Symbol + Operator factory (ref: scala-package Symbol.scala creators;
+// cpp-package op.h style fluent builder)
+// ---------------------------------------------------------------------------
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : h_(std::make_shared<Owner>(h)) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    MXCPP_CHECK(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol Group(const std::vector<Symbol> &parts) {
+    std::vector<SymbolHandle> hs;
+    for (const auto &s : parts) hs.push_back(s.handle());
+    SymbolHandle out = nullptr;
+    MXCPP_CHECK(MXSymbolCreateGroup(hs.size(), hs.data(), &out));
+    return Symbol(out);
+  }
+  static Symbol FromJSONFile(const std::string &fname) {
+    SymbolHandle h = nullptr;
+    MXCPP_CHECK(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+  void SaveToFile(const std::string &fname) const {
+    MXCPP_CHECK(MXSymbolSaveToFile(handle(), fname.c_str()));
+  }
+
+  SymbolHandle handle() const { return h_ ? h_->h : nullptr; }
+  bool defined() const { return handle() != nullptr; }
+
+  std::vector<std::string> ListArguments() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    MXCPP_CHECK(MXSymbolListArguments(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    MXCPP_CHECK(MXSymbolListAuxiliaryStates(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  std::vector<std::string> ListOutputs() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    MXCPP_CHECK(MXSymbolListOutputs(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+  // shape inference over named input shapes; returns (arg, out, aux)
+  struct InferredShapes {
+    std::vector<std::vector<mx_uint>> arg, out, aux;
+    bool complete = false;
+  };
+  InferredShapes InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &known) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0}, cdata;
+    for (const auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      cdata.insert(cdata.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(cdata.size());
+    }
+    mx_uint in_n = 0, out_n = 0, aux_n = 0;
+    const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+    const mx_uint **in_sh = nullptr, **out_sh = nullptr, **aux_sh = nullptr;
+    int complete = 0;
+    MXCPP_CHECK(MXSymbolInferShape(
+        handle(), keys.size(), keys.data(), indptr.data(), cdata.data(),
+        &in_n, &in_nd, &in_sh, &out_n, &out_nd, &out_sh, &aux_n, &aux_nd,
+        &aux_sh, &complete));
+    InferredShapes r;
+    r.complete = complete != 0;
+    for (mx_uint i = 0; i < in_n; ++i)
+      r.arg.emplace_back(in_sh[i], in_sh[i] + in_nd[i]);
+    for (mx_uint i = 0; i < out_n; ++i)
+      r.out.emplace_back(out_sh[i], out_sh[i] + out_nd[i]);
+    for (mx_uint i = 0; i < aux_n; ++i)
+      r.aux.emplace_back(aux_sh[i], aux_sh[i] + aux_nd[i]);
+    return r;
+  }
+
+ private:
+  struct Owner {
+    explicit Owner(SymbolHandle hh) : h(hh) {}
+    ~Owner() {
+      if (h) MXSymbolFree(h);
+    }
+    SymbolHandle h;
+  };
+  std::shared_ptr<Owner> h_;
+};
+
+// Fluent operator factory: Operator("Convolution").SetParam("kernel",
+// "(5, 5)").SetInput("data", x).CreateSymbol("conv1")
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_(op_name) {}
+
+  Operator &SetParam(const std::string &key, const std::string &value) {
+    pkeys_.push_back(key);
+    pvals_.push_back(value);
+    return *this;
+  }
+  Operator &SetParam(const std::string &key, const char *value) {
+    return SetParam(key, std::string(value));
+  }
+  template <typename T>
+  Operator &SetParam(const std::string &key, T value) {
+    return SetParam(key, std::to_string(value));
+  }
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    ikeys_.push_back(name);
+    inputs_.push_back(sym);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name = "") {
+    std::vector<const char *> pk, pv;
+    for (size_t i = 0; i < pkeys_.size(); ++i) {
+      pk.push_back(pkeys_[i].c_str());
+      pv.push_back(pvals_[i].c_str());
+    }
+    AtomicSymbolHandle atom = nullptr;
+    MXCPP_CHECK(MXSymbolCreateAtomicSymbol(op_.c_str(), pk.size(), pk.data(),
+                                           pv.data(), &atom));
+    std::vector<const char *> ik;
+    std::vector<SymbolHandle> ih;
+    for (size_t i = 0; i < ikeys_.size(); ++i) {
+      ik.push_back(ikeys_[i].c_str());
+      ih.push_back(inputs_[i].handle());
+    }
+    SymbolHandle out = nullptr;
+    MXCPP_CHECK(MXSymbolCompose(atom, name.empty() ? nullptr : name.c_str(),
+                                ik.size(), ik.data(), ih.data(), &out));
+    return Symbol(out);
+  }
+
+ private:
+  std::string op_;
+  std::vector<std::string> pkeys_, pvals_, ikeys_;
+  std::vector<Symbol> inputs_;
+};
+
+// ---------------------------------------------------------------------------
+// Executor (ref: R-package/src/executor.cc role)
+// ---------------------------------------------------------------------------
+class Executor {
+ public:
+  Executor() = default;
+  Executor(const Symbol &sym, const std::vector<NDArray> &args,
+           const std::vector<NDArray> &grads, const std::vector<mx_uint> &reqs)
+      : sym_(sym), args_(args), grads_(grads) {
+    std::vector<NDArrayHandle> ah, gh;
+    for (const auto &a : args_) ah.push_back(a.handle());
+    for (const auto &g : grads_) gh.push_back(g.handle());
+    std::vector<mx_uint> req_copy(reqs);  // ABI takes non-const mx_uint*
+    ExecutorHandle h = nullptr;
+    MXCPP_CHECK(MXExecutorBind(sym.handle(), 1, 0, ah.size(), ah.data(),
+                               gh.data(), req_copy.data(), 0, nullptr, &h));
+    h_ = std::make_shared<Owner>(h);
+  }
+
+  void Forward(bool is_train) {
+    MXCPP_CHECK(MXExecutorForward(h_->h, is_train ? 1 : 0));
+  }
+  void Backward() { MXCPP_CHECK(MXExecutorBackward(h_->h, 0, nullptr)); }
+
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    MXCPP_CHECK(MXExecutorOutputs(h_->h, &n, &outs));
+    std::vector<NDArray> res;
+    for (mx_uint i = 0; i < n; ++i) res.emplace_back(outs[i]);
+    return res;
+  }
+
+  const std::vector<NDArray> &args() const { return args_; }
+  const std::vector<NDArray> &grads() const { return grads_; }
+
+ private:
+  struct Owner {
+    explicit Owner(ExecutorHandle hh) : h(hh) {}
+    ~Owner() {
+      if (h) MXExecutorFree(h);
+    }
+    ExecutorHandle h;
+  };
+  Symbol sym_;
+  std::vector<NDArray> args_, grads_;
+  std::shared_ptr<Owner> h_;
+};
+
+// ---------------------------------------------------------------------------
+// Optimizer (ref: python/mxnet/optimizer.py via MXOptimizer* C ABI)
+// ---------------------------------------------------------------------------
+class Optimizer {
+ public:
+  explicit Optimizer(const std::string &name,
+                     const std::map<std::string, std::string> &params = {}) {
+    std::vector<const char *> k, v;
+    for (const auto &kv : params) {
+      k.push_back(kv.first.c_str());
+      v.push_back(kv.second.c_str());
+    }
+    OptimizerHandle h = nullptr;
+    MXCPP_CHECK(MXOptimizerCreateOptimizer(name.c_str(), k.size(), k.data(),
+                                           v.data(), &h));
+    h_ = std::make_shared<Owner>(h);
+  }
+  void Update(int index, const NDArray &weight, const NDArray &grad, float lr,
+              float wd = 0.f) {
+    MXCPP_CHECK(
+        MXOptimizerUpdate(h_->h, index, weight.handle(), grad.handle(), lr, wd));
+  }
+
+ private:
+  struct Owner {
+    explicit Owner(OptimizerHandle hh) : h(hh) {}
+    ~Owner() {
+      if (h) MXOptimizerFree(h);
+    }
+    OptimizerHandle h;
+  };
+  std::shared_ptr<Owner> h_;
+};
+
+// ---------------------------------------------------------------------------
+// DataIter (ref: python/mxnet/io.py C-iter wrappers)
+// ---------------------------------------------------------------------------
+class DataIter {
+ public:
+  DataIter(const std::string &name,
+           const std::map<std::string, std::string> &params) {
+    std::vector<const char *> k, v;
+    for (const auto &kv : params) {
+      k.push_back(kv.first.c_str());
+      v.push_back(kv.second.c_str());
+    }
+    DataIterHandle h = nullptr;
+    MXCPP_CHECK(MXDataIterCreateIter(name.c_str(), k.size(), k.data(),
+                                     v.data(), &h));
+    h_ = std::make_shared<Owner>(h);
+  }
+  void Reset() { MXCPP_CHECK(MXDataIterBeforeFirst(h_->h)); }
+  bool Next() {
+    int more = 0;
+    MXCPP_CHECK(MXDataIterNext(h_->h, &more));
+    return more != 0;
+  }
+  NDArray Data() const {
+    NDArrayHandle d = nullptr;
+    MXCPP_CHECK(MXDataIterGetData(h_->h, &d));
+    return NDArray(d);
+  }
+  NDArray Label() const {
+    NDArrayHandle l = nullptr;
+    MXCPP_CHECK(MXDataIterGetLabel(h_->h, &l));
+    return NDArray(l);
+  }
+
+ private:
+  struct Owner {
+    explicit Owner(DataIterHandle hh) : h(hh) {}
+    ~Owner() {
+      if (h) MXDataIterFree(h);
+    }
+    DataIterHandle h;
+  };
+  std::shared_ptr<Owner> h_;
+};
+
+// ---------------------------------------------------------------------------
+// Metrics (ref: python/mxnet/metric.py Accuracy)
+// ---------------------------------------------------------------------------
+class Accuracy {
+ public:
+  void Reset() { sum_ = 0, n_ = 0; }
+  void Update(const std::vector<float> &labels,
+              const std::vector<float> &probs, size_t batch, size_t classes) {
+    for (size_t i = 0; i < batch; ++i) {
+      size_t am = 0;
+      for (size_t c = 1; c < classes; ++c)
+        if (probs[i * classes + c] > probs[i * classes + am]) am = c;
+      sum_ += (static_cast<int>(am) == static_cast<int>(labels[i]));
+      ++n_;
+    }
+  }
+  float Get() const { return n_ ? static_cast<float>(sum_) / n_ : 0.f; }
+
+ private:
+  long sum_ = 0, n_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// FeedForward estimator (ref: R-package/R/model.R:391
+// mx.model.FeedForward.create; scala FeedForward.scala)
+// ---------------------------------------------------------------------------
+class FeedForward {
+ public:
+  struct Config {
+    int epochs = 10;
+    float lr = 0.1f;
+    float momentum = 0.9f;
+    float wd = 0.f;
+    std::string optimizer = "sgd";
+    unsigned seed = 0;
+    bool verbose = true;
+    Config &Epochs(int e) { epochs = e; return *this; }
+    Config &LR(float v) { lr = v; return *this; }
+    Config &Momentum(float v) { momentum = v; return *this; }
+    Config &WD(float v) { wd = v; return *this; }
+    Config &Opt(const std::string &n) { optimizer = n; return *this; }
+    Config &Seed(unsigned s) { seed = s; return *this; }
+    Config &Verbose(bool v) { verbose = v; return *this; }
+  };
+
+  FeedForward(const Symbol &net, const Config &cfg)
+      : net_(net), cfg_(cfg) {}
+  explicit FeedForward(const Symbol &net) : net_(net) {}
+
+  // Fit with optimizer + per-epoch train metric; the R/Scala
+  // FeedForward.create training loop (slice-free single device).
+  void Fit(DataIter &train,
+           const std::map<std::string, std::vector<mx_uint>> &input_shapes) {
+    BindIfNeeded(input_shapes);
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%g",
+                  1.0 / static_cast<double>(batch_size_));
+    Optimizer opt(cfg_.optimizer,
+                  {{"momentum", std::to_string(cfg_.momentum)},
+                   {"rescale_grad", buf}});
+    for (int epoch = 0; epoch < cfg_.epochs; ++epoch) {
+      train.Reset();
+      metric_.Reset();
+      while (train.Next()) {
+        NDArray d = train.Data(), l = train.Label();
+        arg_store_[data_idx_].SyncCopyFromCPU(d.SyncCopyToCPU());
+        arg_store_[label_idx_].SyncCopyFromCPU(l.SyncCopyToCPU());
+        exec_.Forward(true);
+        auto outs = exec_.Outputs();
+        auto probs = outs[0].SyncCopyToCPU();
+        auto labels = l.SyncCopyToCPU();
+        metric_.Update(labels, probs, batch_size_,
+                       probs.size() / batch_size_);
+        exec_.Backward();
+        for (size_t i = 0; i < arg_store_.size(); ++i)
+          if (reqs_[i])
+            opt.Update(static_cast<int>(i), arg_store_[i], grad_store_[i],
+                       cfg_.lr, cfg_.wd);
+      }
+      if (cfg_.verbose)
+        std::printf("Epoch[%d] Train-accuracy=%.4f\n", epoch, metric_.Get());
+    }
+  }
+
+  float Score(DataIter &it,
+              const std::map<std::string, std::vector<mx_uint>> &input_shapes) {
+    BindIfNeeded(input_shapes);
+    Accuracy m;
+    it.Reset();
+    while (it.Next()) {
+      NDArray d = it.Data(), l = it.Label();
+      arg_store_[data_idx_].SyncCopyFromCPU(d.SyncCopyToCPU());
+      arg_store_[label_idx_].SyncCopyFromCPU(l.SyncCopyToCPU());
+      exec_.Forward(false);
+      auto probs = exec_.Outputs()[0].SyncCopyToCPU();
+      auto labels = l.SyncCopyToCPU();
+      m.Update(labels, probs, batch_size_, probs.size() / batch_size_);
+    }
+    return m.Get();
+  }
+
+  // checkpoint: prefix-symbol.json + prefix-%04d.params with arg:/aux:
+  // key prefixes — byte-compatible with the Python frontend's
+  // save_checkpoint/load_checkpoint (model.py)
+  void Save(const std::string &prefix, int epoch = 0) const {
+    net_.SaveToFile(prefix + "-symbol.json");
+    std::map<std::string, NDArray> dict;
+    auto names = net_.ListArguments();
+    for (size_t i = 0; i < names.size(); ++i)
+      if (reqs_[i]) dict.emplace("arg:" + names[i], arg_store_[i]);
+    char fname[512];
+    std::snprintf(fname, sizeof(fname), "%s-%04d.params", prefix.c_str(),
+                  epoch);
+    NDArray::Save(fname, dict);
+  }
+
+  static FeedForward Load(const std::string &prefix, int epoch) {
+    return Load(prefix, epoch, Config());
+  }
+  static FeedForward Load(const std::string &prefix, int epoch,
+                          const Config &cfg) {
+    FeedForward model(Symbol::FromJSONFile(prefix + "-symbol.json"), cfg);
+    char fname[512];
+    std::snprintf(fname, sizeof(fname), "%s-%04d.params", prefix.c_str(),
+                  epoch);
+    model.loaded_params_ = NDArray::Load(fname);
+    return model;
+  }
+
+  const Symbol &net() const { return net_; }
+
+ private:
+  void BindIfNeeded(
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes) {
+    if (bound_) return;
+    auto names = net_.ListArguments();
+    auto shapes = net_.InferShape(input_shapes);
+    if (!shapes.complete)
+      throw std::runtime_error("FeedForward: shape inference incomplete");
+    std::mt19937 rng(cfg_.seed);
+    data_idx_ = label_idx_ = -1;
+    for (size_t i = 0; i < names.size(); ++i) {
+      const auto &shp = shapes.arg[i];
+      size_t total = NDArray::Size(shp);
+      bool is_input = input_shapes.count(names[i]) > 0;
+      if (is_input) {
+        if (names[i].find("label") != std::string::npos)
+          label_idx_ = static_cast<int>(i);
+        else
+          data_idx_ = static_cast<int>(i);
+        arg_store_.emplace_back(shp, 0.f);
+        grad_store_.emplace_back(NDArray());
+        reqs_.push_back(0);
+        continue;
+      }
+      auto it = loaded_params_.find("arg:" + names[i]);
+      if (it != loaded_params_.end()) {
+        arg_store_.push_back(it->second);
+      } else {
+        // uniform Xavier (ref: initializer.py Xavier default)
+        size_t fan_in = shp.size() > 1 ? total / shp[0] : total;
+        float scale = std::sqrt(3.0f / static_cast<float>(fan_in));
+        std::uniform_real_distribution<float> dist(-scale, scale);
+        std::vector<float> w(total, 0.f);
+        bool is_bias = names[i].size() > 4 &&
+                       names[i].rfind("bias") == names[i].size() - 4;
+        if (!is_bias)
+          for (auto &x : w) x = dist(rng);
+        arg_store_.emplace_back(shp, w);
+      }
+      grad_store_.emplace_back(shp, 0.f);
+      reqs_.push_back(1);
+    }
+    if (data_idx_ < 0 || label_idx_ < 0)
+      throw std::runtime_error("FeedForward: data/label inputs not found");
+    batch_size_ = shapes.arg[data_idx_][0];
+    exec_ = Executor(net_, arg_store_, grad_store_, reqs_);
+    bound_ = true;
+  }
+
+  Symbol net_;
+  Config cfg_;
+  Executor exec_;
+  Accuracy metric_;
+  std::vector<NDArray> arg_store_, grad_store_;
+  std::vector<mx_uint> reqs_;
+  std::map<std::string, NDArray> loaded_params_;
+  int data_idx_ = -1, label_idx_ = -1;
+  mx_uint batch_size_ = 0;
+  bool bound_ = false;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_HPP_
