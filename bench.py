@@ -30,7 +30,7 @@ BASELINE_IMG_S_PER_GPU = 513.0 / 4.0  # ref README.md:255, see docstring
 def main():
     batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
-    steps = int(os.environ.get("BENCH_STEPS", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "64"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     # steps per dispatch: lax.scan inside one jitted call amortizes the
     # ~20 ms/dispatch host round-trip of the tunneled backend

@@ -39,7 +39,11 @@ def _extra_flags(name):
         return ["-I" + inc, "-L" + libdir, "-lpython" + ldver,
                 "-Wl,-rpath," + libdir]
     if name == "imagedec":
-        return ["-ljpeg"]
+        # the per-pixel augment loop is the single-core bottleneck of the
+        # data pipeline (docs/perf_analysis.md); -O3 + unrolling buys real
+        # throughput there (-march is deliberately NOT set: the cached .so
+        # must stay portable across the fleet's cpu steppings)
+        return ["-ljpeg", "-O3", "-funroll-loops"]
     return []
 
 
@@ -48,7 +52,16 @@ def _build(name):
     out = os.path.join(_PKG_DIR, "lib%s.so" % name)
     if not os.path.isfile(src):
         return None
-    if os.path.isfile(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    # cache key = source mtime AND the compile flags: flags are
+    # performance-load-bearing (-O3 for imagedec), and a restored tree
+    # with preserved timestamps must not keep serving a stale binary
+    # built under different flags
+    stamp = out + ".flags"
+    flags_sig = " ".join(_extra_flags(name))
+    stamp_ok = (os.path.isfile(stamp)
+                and open(stamp).read() == flags_sig)
+    if (os.path.isfile(out) and stamp_ok
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
         return out
     # build to a per-pid temp and atomically rename: concurrent launcher
     # workers may race to build, and a half-written .so must never be
@@ -61,6 +74,8 @@ def _build(name):
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
+        with open(stamp, "w") as f:
+            f.write(flags_sig)
     except Exception:
         try:
             os.unlink(tmp)

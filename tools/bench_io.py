@@ -54,12 +54,18 @@ def bench(rec_path, native, threads, **aug):
 
             it._pool = ThreadPoolExecutor(max_workers=threads)
     next(iter(it))  # warmup: jax backend init + native lib load
-    it.reset()
-    n = 0
-    t0 = time.perf_counter()
-    for _ in it:
-        n += 64
-    return n / (time.perf_counter() - t0)
+    # several timed passes, best-of: a single ~1s pass is hostage to
+    # scheduler noise on the shared 1-core dev box (observed +-20%)
+    passes = int(os.environ.get("BENCH_IO_PASSES", "3"))
+    best = 0.0
+    for _ in range(passes):
+        it.reset()
+        n = 0
+        t0 = time.perf_counter()
+        for _ in it:
+            n += 64
+        best = max(best, n / (time.perf_counter() - t0))
+    return best
 
 
 def main():
