@@ -18,7 +18,11 @@ _lock = threading.Lock()
 _cache = {}
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+# repo layout first (editable install / source tree); wheel installs
+# ship the sources INSIDE the package (setup.py stages them)
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "src")
+if not os.path.isdir(_SRC_DIR):
+    _SRC_DIR = os.path.join(_PKG_DIR, "src")  # wheel: staged by setup.py
 
 
 def native_disabled():
@@ -98,7 +102,20 @@ def load(name):
             try:
                 lib = ctypes.CDLL(path)
             except OSError:
-                lib = None
+                # a wheel may ship a prebuilt .so that doesn't dlopen on
+                # this target (glibc/arch mismatch); the staged sources
+                # and local toolchain are the designed fallback — force
+                # one rebuild before giving up on native
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                path = _build(name)
+                if path is not None:
+                    try:
+                        lib = ctypes.CDLL(path)
+                    except OSError:
+                        lib = None
         _cache[name] = lib
         return lib
 

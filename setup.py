@@ -1,0 +1,74 @@
+"""Build hooks for mxnet-tpu (metadata lives in pyproject.toml).
+
+The native runtime components (src/*.cc: dependency engine, recordio,
+image pipeline, C ABI) are compiled here at wheel-build time when a
+toolchain is available — the role of the reference's Makefile
+(ref: make/config.mk) — and the sources are ALSO packaged so the
+JIT g++-on-first-use loader (mxnet_tpu/_native) can rebuild on the
+target machine when no prebuilt .so matches. Every failure degrades
+gracefully: the pure-Python/JAX core never requires the native bits
+(MXNET_NATIVE=0 disables them outright).
+"""
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        super().run()
+        self._stage_sources()
+        self._try_prebuild()
+
+    def _native_dir(self):
+        return os.path.join(self.build_lib, "mxnet_tpu", "_native")
+
+    def _stage_sources(self):
+        """Ship src/*.cc + include/*.h inside the package so the lazy
+        loader can compile on the target machine."""
+        # keep the src/ + include/ sibling layout: c_api.cc includes
+        # "../include/c_api.h"
+        base = self._native_dir()
+        for d in ("src", "include"):
+            sdir = os.path.join(ROOT, d)
+            if not os.path.isdir(sdir):
+                continue
+            dst = os.path.join(base, d)
+            os.makedirs(dst, exist_ok=True)
+            for f in os.listdir(sdir):
+                if f.endswith((".cc", ".h")):
+                    shutil.copy2(os.path.join(sdir, f), os.path.join(dst, f))
+
+    def _try_prebuild(self):
+        """Best-effort eager compile (c_api is skipped: it links the
+        exact CPython of the TARGET interpreter, so it stays lazy)."""
+        import subprocess
+        import sys
+
+        sys.path.insert(0, ROOT)
+        try:
+            from mxnet_tpu._native import _extra_flags
+        except Exception:
+            return
+        for name in ("engine", "recordio", "imagedec"):
+            src = os.path.join(ROOT, "src", name + ".cc")
+            if not os.path.isfile(src):
+                continue
+            out = os.path.join(self._native_dir(), "lib%s.so" % name)
+            flags = _extra_flags(name)
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", out] + flags
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=300)
+                with open(out + ".flags", "w") as f:
+                    f.write(" ".join(flags))
+            except Exception:
+                pass  # lazy loader handles it on first use
+
+
+setup(cmdclass={"build_py": BuildWithNative})
