@@ -32,9 +32,12 @@ def caffe_available():
 
 _MSG = (
     "%s requires the caffe python package, which is not available in this "
-    "build (ref: plugin/caffe, gated on CAFFE_PATH). Port the layer to a "
-    "native op, a CustomOp (mxnet_tpu.operator), or a TorchModule "
-    "(mxnet_tpu.torch)."
+    "build (ref: plugin/caffe, gated on CAFFE_PATH). For whole caffe "
+    "NETWORKS use tools/caffe_converter.py: convert_model() reads "
+    ".prototxt AND .caffemodel (self-contained wire-format reader, no "
+    "pycaffe) and runs the graph through native ops. For single layers, "
+    "port to a native op, a CustomOp (mxnet_tpu.operator), or a "
+    "TorchModule (mxnet_tpu.torch)."
 )
 
 

@@ -270,38 +270,162 @@ def convert_symbol(prototxt_text):
     return sym, input_name, input_dim
 
 
+# -- minimal protobuf WIRE-format reader for .caffemodel ----------------------
+# The reference's convert_model.py needs pycaffe to deserialize
+# NetParameter; caffe isn't installable here, and the binary format is
+# plain protobuf wire encoding — a ~60-line reader covers the fields
+# that carry weights (NetParameter.layer[100] -> LayerParameter{name=1,
+# blobs=7} -> BlobProto{data=5 packed floats, shape=7{dim=1},
+# legacy num/channels/height/width=1..4}). V1 graphs (NetParameter.
+# layers[2], V1LayerParameter{name=4, blobs=6}) are read too.
+
+def _varint(buf, pos):
+    result = shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated/corrupt caffemodel (varint past EOF)")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_no, wire_type, value|bytes) over one message."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _varint(buf, pos)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _varint(buf, pos)
+        elif wt == 1:
+            end = pos + 8
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            end = pos + ln
+        elif wt == 5:
+            end = pos + 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        if wt != 0:
+            if end > n:
+                raise ValueError(
+                    "truncated/corrupt caffemodel (field %d runs past "
+                    "EOF)" % fno)
+            v, pos = buf[pos:end], end
+        yield fno, wt, v
+
+
+def _read_blob(buf):
+    import numpy as np
+
+    data, shape, legacy = [], [], {}
+    for fno, wt, v in _fields(buf):
+        if fno == 5:  # data: packed floats (wt 2) or repeated f32 (wt 5)
+            if wt == 2:
+                data.append(np.frombuffer(v, "<f4"))
+            else:
+                data.append(np.frombuffer(bytes(v), "<f4"))
+        elif fno == 7 and wt == 2:  # BlobShape
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    p = 0
+                    while p < len(v2):
+                        d, p = _varint(v2, p)
+                        shape.append(d)
+        elif fno in (1, 2, 3, 4) and wt == 0:  # legacy num/c/h/w
+            legacy[fno] = v
+    arr = (np.concatenate(data) if data
+           else np.zeros((0,), np.float32)).astype(np.float32)
+    if not shape and legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    if shape and int(np.prod(shape)) == arr.size:
+        arr = arr.reshape(shape)
+    return arr
+
+
+def read_caffemodel(path):
+    """Parse a .caffemodel (binary NetParameter) into
+    {layer_name: [blob arrays]} with no caffe/protobuf dependency."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out = {}
+    for fno, wt, v in _fields(buf):
+        if wt != 2 or fno not in (100, 2):  # layer (new) / layers (V1)
+            continue
+        name_field = 1 if fno == 100 else 4
+        blob_field = 7 if fno == 100 else 6
+        name, blobs = None, []
+        for f2, wt2, v2 in _fields(v):
+            if f2 == name_field and wt2 == 2:
+                name = v2.decode("utf-8", "replace")
+            elif f2 == blob_field and wt2 == 2:
+                blobs.append(_read_blob(v2))
+        if name and blobs:
+            out[name] = blobs
+    return out
+
+
 def convert_model(prototxt_path, caffemodel_path, output_prefix):
-    """Convert weights too (ref: convert_model.py). Reading .caffemodel
-    needs pycaffe — gated the same way the caffe plugin is. Writes
+    """Convert weights too (ref: convert_model.py role) — executable
+    WITHOUT pycaffe via the wire-format reader above. Writes
     <output_prefix>-symbol.json and <output_prefix>-0001.params; returns
     (symbol, arg_params)."""
-    try:
-        import caffe
-    except ImportError as e:
-        from mxnet_tpu.base import MXNetError
-
-        raise MXNetError(
-            "convert_model requires pycaffe to read .caffemodel (not in "
-            "this build). convert_symbol works without it.") from e
     import numpy as np
 
     import mxnet_tpu as mx
 
-    sym, _, _ = convert_symbol(open(prototxt_path).read())
-    net = caffe.Net(prototxt_path, caffemodel_path, caffe.TEST)
+    sym, input_name, input_dim = convert_symbol(open(prototxt_path).read())
+    net_params = read_caffemodel(caffemodel_path)
+    # arg shapes from the prototxt's input declaration: caffe stores IP
+    # weights 2-D (out, in) or legacy 4-D (out, in, 1, 1)/(o, i, h, w);
+    # reshape each blob onto the symbol's inferred parameter shape
+    arg_shapes = {}
+    if input_dim:
+        names = sym.list_arguments()
+        shapes, _, _ = sym.infer_shape_partial(**{input_name: input_dim})
+        arg_shapes = {n: s for n, s in zip(names, shapes) if s is not None}
     arg_params = {}
     args = set(sym.list_arguments())
-    for lname, blobs in net.params.items():
+
+    # layer types from the prototxt: legacy caffemodels store
+    # InnerProduct weights 4-D (out, in, 1, 1); those must flatten to
+    # 2-D even when no input dims were declared (deploy files with a
+    # bare Input layer leave arg_shapes empty)
+    ip_layers = {
+        str(l.get("name", "")).replace("/", "_")
+        for l in _aslist(parse_prototxt(open(prototxt_path).read())
+                         .get("layer"))
+        if isinstance(l, dict) and l.get("type") == "InnerProduct"
+    }
+
+    def _fit(arr, key):
+        want = arg_shapes.get(key)
+        arr = np.asarray(arr, np.float32)
+        if want is not None and tuple(arr.shape) != tuple(want):
+            if int(np.prod(arr.shape)) != int(np.prod(want)):
+                raise ValueError(
+                    "caffemodel blob for %s has %s elements; symbol "
+                    "expects shape %s" % (key, arr.shape, want))
+            arr = arr.reshape(want)
+        elif (want is None and arr.ndim == 4
+              and key.rsplit("_", 1)[0] in ip_layers):
+            arr = arr.reshape(arr.shape[0], -1)
+        return arr
+
+    for lname, blobs in net_params.items():
         name = lname.replace("/", "_")
         wkey, bkey = name + "_weight", name + "_bias"
         if wkey in args:
-            # caffe conv weights are (N, C, kh, kw) and IP weights
-            # (out, in) — both match this framework's layout directly
-            arg_params[wkey] = mx.nd.array(
-                np.asarray(blobs[0].data, np.float32))
+            # caffe conv weights are (N, C, kh, kw) — this framework's
+            # layout directly
+            arg_params[wkey] = mx.nd.array(_fit(blobs[0], wkey))
             if len(blobs) > 1 and bkey in args:
                 arg_params[bkey] = mx.nd.array(
-                    np.asarray(blobs[1].data, np.float32))
+                    _fit(np.asarray(blobs[1]).reshape(-1), bkey))
     sym.save(output_prefix + "-symbol.json")
     mx.nd.save(output_prefix + "-0001.params",
                {"arg:" + k: v for k, v in arg_params.items()})
