@@ -76,6 +76,9 @@ def _synthetic_iter_cls():
 
 
 def main():
+    # 16 steps per dispatch amortizes the tunnel round trip like
+    # bench.py's scan does (docs/perf_analysis.md); overridable
+    os.environ.setdefault("MXNET_TRAIN_SCAN_K", "16")
     batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     steps = int(os.environ.get("BENCH_STEPS", "96"))

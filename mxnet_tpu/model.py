@@ -106,6 +106,46 @@ def _scan_k():
     return int(os.environ.get("MXNET_TRAIN_SCAN_K", "8"))
 
 
+def _scan_flush(trainer, buf, epoch, nbatch0):
+    """Dispatch one K-batch chunk; returns the pending record drained
+    after the NEXT chunk is in flight (shared by FeedForward's
+    _train_scanned and Module._try_scanned_fit)."""
+    staged = trainer.stage_chunk(buf)
+    return (trainer.run_chunk(staged), buf, epoch, nbatch0)
+
+
+def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
+                nbatch_base):
+    """Metric updates + per-batch callbacks for a completed chunk.
+    nbatch_base: FeedForward numbers batches from 1, Module from 0.
+
+    D2H minimisation: Accuracy only needs the argmax class id per
+    sample — reduce [K,N,C] probabilities to [K,N] ids ON DEVICE before
+    pulling to host (the tunnel's D2H bandwidth would otherwise eat
+    ~30% of a ResNet chunk's wall time). Accuracy already accepts 1-D
+    predicted labels."""
+    if pending is None:
+        return
+    outs, bufs, epoch, nbatch0 = pending
+    if (type(eval_metric) is metric_mod.Accuracy and len(outs) == 1
+            and getattr(outs[0], "ndim", 0) == 3):
+        import jax.numpy as jnp
+
+        host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
+    else:
+        host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
+    for k, b in enumerate(bufs):
+        labels = [NDArray(_np.asarray(
+            b[n].asnumpy() if isinstance(b[n], NDArray) else b[n]),
+            cpu(0)) for n in label_names]
+        preds = [NDArray(h[k], cpu(0)) for h in host_outs]
+        eval_metric.update(labels, preds)
+        if batch_end_callback is not None:
+            _multiple_callbacks(batch_end_callback, BatchEndParam(
+                epoch=epoch, nbatch=nbatch0 + k + nbatch_base,
+                eval_metric=eval_metric, locals=locals()))
+
+
 def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
                    aux_params, begin_epoch, end_epoch, epoch_size, optimizer,
                    train_data, eval_data, eval_metric, epoch_end_callback,
@@ -123,37 +163,11 @@ def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
     eval_exe = None
 
     def _flush(buf, epoch, nbatch0):
-        staged = trainer.stage_chunk(buf)
-        outs = trainer.run_chunk(staged)
-        return (outs, buf, epoch, nbatch0)
+        return _scan_flush(trainer, buf, epoch, nbatch0)
 
     def _drain(pending, eval_metric):
-        if pending is None:
-            return 0
-        outs, bufs, epoch, nbatch0 = pending
-        # D2H minimisation: Accuracy only needs the argmax class id per
-        # sample — reduce [K,N,C] probabilities to [K,N] ids ON DEVICE
-        # before pulling to host (the tunnel's D2H bandwidth would
-        # otherwise eat ~30% of a ResNet chunk's wall time). Accuracy
-        # already accepts 1-D predicted labels.
-        if (type(eval_metric) is metric_mod.Accuracy and len(outs) == 1
-                and getattr(outs[0], "ndim", 0) == 3):
-            import jax.numpy as jnp
-
-            host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
-        else:
-            host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
-        for k, b in enumerate(bufs):
-            labels = [NDArray(_np.asarray(
-                b[n].asnumpy() if isinstance(b[n], NDArray) else b[n]),
-                cpu(0)) for n in label_names]
-            preds = [NDArray(h[k], cpu(0)) for h in host_outs]
-            eval_metric.update(labels, preds)
-            if batch_end_callback is not None:
-                _multiple_callbacks(batch_end_callback, BatchEndParam(
-                    epoch=epoch, nbatch=nbatch0 + k + 1,
-                    eval_metric=eval_metric, locals=locals()))
-        return len(bufs)
+        _scan_drain(pending, eval_metric, label_names, batch_end_callback,
+                    nbatch_base=1)
 
     label_names = [_desc_name(d) for d in train_data.provide_label]
 

@@ -198,6 +198,11 @@ class BaseModule:
             return output_list2
         return output_list
 
+    def _try_scanned_fit(self, *args, **kwargs):
+        """Overridden by Module; other module kinds use the per-batch
+        loop unconditionally."""
+        return False
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -228,6 +233,15 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+
+        # K-step-scanned fast path (parallel/fit_trainer.py) — plain
+        # single-device Module only; returns False and falls through to
+        # the per-batch loop otherwise
+        if self._try_scanned_fit(
+                train_data, eval_data, eval_metric, validation_metric,
+                epoch_end_callback, batch_end_callback, eval_end_callback,
+                eval_batch_end_callback, begin_epoch, num_epoch, monitor):
+            return
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()

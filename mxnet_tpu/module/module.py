@@ -115,6 +115,14 @@ class Module(BaseModule):
 
     def get_params(self):
         """ref: module.py:175."""
+        live = getattr(self, "_scan_live", None)
+        if live is not None:
+            # scanned fit in progress: the freshest weights live in the
+            # trainer's device state, not the executor — sync so a
+            # mid-epoch checkpoint callback never reads stale params
+            trainer, ap, xp = live
+            trainer.write_back(ap, xp, self._aux_names)
+            return (ap, xp)
         assert self.binded or self._arg_params is not None
         if self.binded and self._params_dirty:
             self._sync_params_from_devices()
@@ -398,3 +406,103 @@ class Module(BaseModule):
         assert self.binded
         for exec_ in self._execs:
             mon.install(exec_)
+
+    # -- scanned fast path (parallel/fit_trainer.py) ---------------------------
+    def _try_scanned_fit(self, train_data, eval_data, eval_metric,
+                         validation_metric, epoch_end_callback,
+                         batch_end_callback, eval_end_callback,
+                         eval_batch_end_callback, begin_epoch, num_epoch,
+                         monitor):
+        """Run fit() as K-step compiled scans when eligible (the same
+        fast path FeedForward uses, model._train_scanned): single
+        device, local updates (no kvstore), scannable optimizer, no
+        monitor. Observable semantics preserved: per-batch metrics and
+        callbacks (Module numbers batches from 0), per-epoch Train-*
+        logging, epoch_end callbacks with synced params, eval via
+        score(). Returns False to fall back."""
+        import os as _os
+        import time as _time
+
+        from ..base import MXNetError
+        from ..model import (_desc_name, _desc_shape, _multiple_callbacks,
+                             _scan_drain, _scan_flush, _scan_k)
+        from ..parallel.fit_trainer import make_fit_trainer, supports_optimizer
+
+        K = _scan_k()
+        if (K <= 1 or len(self._context) != 1 or monitor is not None
+                or self._kvstore is not None or self._update_on_kvstore
+                or not train_data.provide_label
+                or not supports_optimizer(self._optimizer)):
+            return False
+        input_shapes = {
+            _desc_name(d): _desc_shape(d)
+            for d in (list(train_data.provide_data)
+                      + list(train_data.provide_label))
+        }
+        arg_params, aux_params = self.get_params()
+        try:
+            trainer = make_fit_trainer(
+                self._symbol, self._context[0], input_shapes,
+                self._optimizer, arg_params, aux_params, self._param_names,
+                compute_dtype=_os.environ.get("MXNET_COMPUTE_DTYPE") or None)
+        except MXNetError as e:
+            self.logger.debug("scanned fit unavailable (%s); per-batch "
+                              "loop", e)
+            return False
+        input_names = trainer.input_names
+        label_names = [_desc_name(d) for d in train_data.provide_label]
+
+        def _drain(pending):
+            _scan_drain(pending, eval_metric, label_names,
+                        batch_end_callback, nbatch_base=0)
+
+        # while the scanned loop is live, get_params() syncs from the
+        # trainer (a batch_end_callback that checkpoints mid-epoch must
+        # not read epoch-start weights)
+        self._scan_live = (trainer, arg_params, aux_params)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = _time.time()
+                eval_metric.reset()
+                pending = None
+                buf = []
+                nbatch = 0
+                for data_batch in train_data:
+                    arrs = list(data_batch.data) + list(data_batch.label)
+                    buf.append(dict(zip(input_names, arrs)))
+                    nbatch += 1
+                    if len(buf) == K:
+                        new_pending = _scan_flush(trainer, buf, epoch,
+                                                  nbatch - K)
+                        _drain(pending)
+                        pending = new_pending
+                        buf = []
+                if buf:
+                    new_pending = _scan_flush(trainer, buf, epoch,
+                                              nbatch - len(buf))
+                    _drain(pending)
+                    pending = new_pending
+                    buf = []
+                _drain(pending)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 _time.time() - tic)
+                trainer.write_back(arg_params, aux_params, self._aux_names)
+                self.set_params(arg_params, aux_params)
+                if epoch_end_callback is not None:
+                    _multiple_callbacks(epoch_end_callback, epoch,
+                                        self.symbol, arg_params, aux_params)
+                if eval_data:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        finally:
+            self._scan_live = None
+        return True

@@ -84,3 +84,37 @@ def test_scanned_optimizer_counts_advance():
         assert opt.num_update == 2 * (320 // 32)
     finally:
         os.environ.pop("MXNET_SCAN_TRAIN", None)
+
+
+def test_module_scanned_get_params_fresh_mid_epoch():
+    """A batch_end_callback that checkpoints mid-epoch must see the
+    trainer's CURRENT weights, not epoch-start values (advisor r3)."""
+    os.environ["MXNET_SCAN_TRAIN"] = "1"
+    os.environ["MXNET_TRAIN_SCAN_K"] = "4"
+    try:
+        np.random.seed(3)
+        mx.random.seed(3)
+        train = mx.io.MNISTIter(batch_size=32, num_synthetic=512, seed=1)
+        mod = mx.module.Module(mx.models.get_mlp(), context=mx.cpu(0))
+        snaps = []
+
+        def cb(param):
+            if param.nbatch == 7:  # mid-epoch (16 batches/epoch)
+                ap, _ = mod.get_params()
+                snaps.append(ap["fc1_weight"].asnumpy().copy())
+
+        mod.fit(train, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.initializer.Xavier(),
+                batch_end_callback=cb)
+        assert len(snaps) == 2
+        # epoch-1's mid-epoch snapshot must differ from epoch-0's (the
+        # stale-params bug returned identical epoch-start values only
+        # when nothing had trained yet; here both are mid-training and
+        # must reflect progress)
+        assert not np.allclose(snaps[0], snaps[1])
+        final, _ = mod.get_params()
+        assert not np.allclose(snaps[1], final["fc1_weight"].asnumpy())
+    finally:
+        os.environ.pop("MXNET_SCAN_TRAIN", None)
+        os.environ.pop("MXNET_TRAIN_SCAN_K", None)

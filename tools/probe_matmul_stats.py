@@ -95,6 +95,9 @@ def main():
         y1, s1, q1 = pkj(x, w)
         np.testing.assert_allclose(np.asarray(s1).ravel(),
                                    np.asarray(s0), rtol=2e-2, atol=2e2)
+        np.testing.assert_allclose(np.asarray(q1).ravel(),
+                                   np.asarray(q0), rtol=2e-2,
+                                   atol=np.abs(np.asarray(q0)).max() * 2e-2)
         np.testing.assert_allclose(np.asarray(y1, np.float32),
                                    np.asarray(y0, np.float32),
                                    rtol=2e-2, atol=1e-1)
