@@ -1,0 +1,68 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new implementation on JAX/XLA/Pallas (reference: jankim/mxnet,
+surveyed in SURVEY.md). Public API mirrors python/mxnet/__init__.py so
+reference-era user code runs with ``import mxnet_tpu as mx``:
+NDArray + Symbol/Executor + Module/FeedForward + KVStore + DataIter,
+with ``mx.tpu()`` as a first-class context.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, MXTPUError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
+from . import engine
+from . import storage
+from . import resource
+from . import opencv as cv
+from . import sframe_plugin
+from . import ndarray
+from . import ndarray as nd
+from . import stream
+from . import runtime
+from . import random
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Variable, Group
+from . import ops as _ops
+
+_ops.install(ndarray_module=ndarray, symbol_module=symbol)
+
+from .ndarray import NDArray, load, save, load_frombuffer, zeros, ones, array, empty, full, arange, concatenate, waitall  # noqa: E402
+from .executor import Executor  # noqa: E402
+from . import initializer  # noqa: E402
+from .initializer import init  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import lr_scheduler  # noqa: E402
+from . import metric  # noqa: E402
+from . import callback  # noqa: E402
+from . import io  # noqa: E402
+from . import recordio  # noqa: E402
+from . import kvstore  # noqa: E402
+from .kvstore import create as kvstore_create  # noqa: E402
+from . import kvstore_server as _kvstore_server  # noqa: E402
+
+# legacy DMLC_ROLE=server launches must fail loudly at import, as the
+# reference boots its server loop from package init (kvstore_server.py:58)
+_kvstore_server._init_kvstore_server_module()
+from . import monitor  # noqa: E402
+from .monitor import Monitor  # noqa: E402
+from . import model  # noqa: E402
+from .model import FeedForward  # noqa: E402
+from . import module  # noqa: E402
+from . import visualization  # noqa: E402
+from . import visualization as viz  # noqa: E402
+from . import test_utils  # noqa: E402
+from . import operator  # noqa: E402
+from . import rtc  # noqa: E402
+from . import predictor  # noqa: E402
+from . import profiler  # noqa: E402
+from . import caffe_plugin  # noqa: E402
+from .predictor import Predictor  # noqa: E402
+from . import torch as torch_plugin  # noqa: E402
+from .torch import th  # noqa: E402
+from . import parallel  # noqa: E402
+from . import models  # noqa: E402

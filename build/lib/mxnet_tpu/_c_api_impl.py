@@ -1,0 +1,698 @@
+"""Python side of the flat C API (ref: src/c_api/c_api.cc, SURVEY §2.10).
+
+The reference exposes ~110 flat C functions over its C++ core; every
+language binding (Python/R/Scala/MATLAB/amalgamation) sits on that ABI.
+In this framework the core is the Python/JAX layer, so the C ABI
+(src/c_api.cc) embeds CPython and marshals into the plain functions here.
+Each function takes/returns only simple types (ints, strings, bytes,
+tuples, handles-as-objects) so the C side stays a dumb marshaller.
+
+Device-type codes follow the reference (include/mxnet/base.h:85-118):
+1 = cpu, 2 = gpu (alias of tpu here), 3 = cpu_pinned, 6 = tpu.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+_DEV = {}
+
+
+def _ctx(dev_type, dev_id):
+    from . import context
+
+    if not _DEV:
+        _DEV.update({1: context.cpu, 2: context.tpu, 3: context.cpu_pinned,
+                     6: context.tpu})
+    return _DEV[int(dev_type)](int(dev_id))
+
+
+def _dev_code(ctx):
+    return {"cpu": 1, "tpu": 6, "gpu": 6, "cpu_pinned": 3}[ctx.device_type], ctx.device_id
+
+
+# -- NDArray ------------------------------------------------------------------
+
+def ndarray_create(shape, dev_type, dev_id):
+    from . import ndarray as nd
+
+    return nd.empty(tuple(int(s) for s in shape), ctx=_ctx(dev_type, dev_id))
+
+
+def ndarray_create_none():
+    from . import ndarray as nd
+
+    return nd.empty((0,))
+
+
+def ndarray_sync_copy_from(arr, data):
+    """data: bytes of float32, length must equal arr.size*4."""
+    src = _np.frombuffer(data, dtype=_np.float32).reshape(arr.shape)
+    arr[:] = src.astype(arr.dtype, copy=False)
+    return 0
+
+
+def ndarray_sync_copy_to(arr):
+    return _np.ascontiguousarray(arr.asnumpy().astype(_np.float32)).tobytes()
+
+
+def ndarray_shape(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def ndarray_dtype_code(arr):
+    from .base import _DTYPE_NP_TO_MX
+
+    return int(_DTYPE_NP_TO_MX[_np.dtype(arr.dtype)])
+
+
+def ndarray_context(arr):
+    return _dev_code(arr.context)
+
+
+def ndarray_slice(arr, start, stop):
+    return arr[int(start):int(stop)]
+
+def ndarray_at(arr, idx):
+    return arr[int(idx)]
+
+
+def ndarray_save(fname, handles, keys):
+    from . import ndarray as nd
+
+    if keys:
+        nd.save(fname, dict(zip(keys, handles)))
+    else:
+        nd.save(fname, list(handles))
+    return 0
+
+
+def ndarray_load(fname):
+    """Returns (list_of_arrays, list_of_names) — names empty for a list."""
+    from . import ndarray as nd
+
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [data[k] for k in names], names
+    return list(data), []
+
+
+def ndarray_wait_to_read(arr):
+    arr.wait_to_read()
+    return 0
+
+
+def wait_all():
+    from . import ndarray as nd
+
+    nd.waitall()
+    return 0
+
+
+def random_seed(seed):
+    from . import random
+
+    random.seed(int(seed))
+    return 0
+
+
+# -- imperative function registry --------------------------------------------
+
+def list_all_op_names():
+    """Registered operators only — the set a binding generator should wrap
+    (ref: MXListFunctions lists the op registry, not module helpers)."""
+    from .ops.registry import REGISTRY
+
+    return sorted(n for n, op in REGISTRY.items() if op.imperative)
+
+
+def _parse_literal(s):
+    """Best-effort string→value for kwargs crossing the C ABI, mirroring
+    the reference's dmlc::Parameter string protocol (registry Field.convert
+    handles op params; this covers plain jnp-wrapper functions)."""
+    import ast
+
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def func_invoke(name, inputs, keys, vals):
+    """Generic imperative invoke (ref: MXFuncInvoke, c_api.h:447).
+    kwargs arrive as strings, as in the reference C API."""
+    from . import ndarray as nd
+    from .ops.registry import REGISTRY
+
+    op = REGISTRY.get(name)
+    if op is None or not op.imperative:
+        raise ValueError("unknown NDArray function: %s" % name)
+    fn = getattr(nd, name)
+    kwargs = {k: _parse_literal(v) for k, v in zip(keys, vals)}
+    out = fn(*inputs, **kwargs)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+# -- Symbol -------------------------------------------------------------------
+
+def symbol_create_from_json(json_str):
+    from . import symbol
+
+    return symbol.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_create_variable(name):
+    from . import symbol
+
+    return symbol.Variable(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    """Create an un-composed op symbol; compose() wires its inputs
+    (ref: MXSymbolCreateAtomicSymbol + MXSymbolCompose, c_api.h:600-668)."""
+    from . import symbol
+
+    op = getattr(symbol, op_name, None)
+    if op is None:
+        raise ValueError("unknown operator: %s" % op_name)
+    # registry ops convert string params themselves (Field.convert — the
+    # dmlc::Parameter protocol), so kwargs stay as strings here
+    return ("_atomic", op, dict(zip(keys, vals)))
+
+
+def symbol_compose(atom, name, keys, args):
+    if not (isinstance(atom, tuple) and atom and atom[0] == "_atomic"):
+        raise ValueError("handle is not an atomic symbol")
+    _, op, base_kwargs = atom
+    kwargs = dict(base_kwargs)  # the atomic handle may be composed repeatedly
+    if name:
+        kwargs.setdefault("name", name)
+    if keys:
+        kwargs.update(dict(zip(keys, args)))
+        return op(**kwargs)
+    return op(*args, **kwargs)
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def symbol_infer_shape(sym, keys, shapes):
+    """shapes: list of int tuples aligned with keys. Returns
+    (arg_shapes, out_shapes, aux_shapes) or None on incomplete info."""
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    arg, out, aux = sym.infer_shape(**kwargs)
+    if arg is None:
+        return None
+    return ([tuple(map(int, s)) for s in arg],
+            [tuple(map(int, s)) for s in out],
+            [tuple(map(int, s)) for s in aux])
+
+
+# -- Predict API (ref: include/mxnet/c_predict_api.h) -------------------------
+
+def pred_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
+                input_shapes):
+    from .predictor import Predictor
+
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    return Predictor(symbol_json, param_bytes, ctx=_ctx(dev_type, dev_id),
+                     input_shapes=shapes)
+
+
+def pred_set_input(pred, key, data):
+    if key not in pred._args:
+        raise ValueError("unknown input %r" % key)
+    shape = pred._args[key].shape
+    arr = _np.frombuffer(data, dtype=_np.float32).reshape(shape)
+    pred.set_input(key, arr)
+    return 0
+
+
+def pred_forward(pred):
+    pred.forward()
+    return 0
+
+
+def pred_get_output_shape(pred, index):
+    return tuple(int(s) for s in pred.get_output_shape(int(index)))
+
+
+def pred_get_output(pred, index):
+    out = pred.get_output(int(index))
+    return _np.ascontiguousarray(
+        _np.asarray(out, dtype=_np.float32)).tobytes()
+
+
+def pred_reshape(pred, input_keys, input_shapes):
+    """Returns a NEW predictor at the new shapes; the original handle
+    stays valid at its old shapes (ref: MXPredReshape contract)."""
+    import copy
+
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    newp = copy.copy(pred)
+    newp.reshape(shapes)
+    return newp
+
+
+# -- Symbol attributes / info / grad / type (ref: c_api.h:528-860) ------------
+
+def symbol_copy(sym):
+    import copy
+
+    return copy.deepcopy(sym)
+
+
+def symbol_print(sym):
+    return sym.debug_str() if hasattr(sym, "debug_str") else repr(sym)
+
+
+def symbol_get_name(sym):
+    """Returns (name, success) — heads of multi-output groups have none."""
+    n = sym.name
+    return ("", 0) if n is None else (str(n), 1)
+
+
+def symbol_get_attr(sym, key):
+    v = sym.attr(key)
+    return ("", 0) if v is None else (str(v), 1)
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+    return 0
+
+
+def symbol_list_attr(sym, recursive):
+    """Flat key/value list [k0, v0, k1, v1, ...] (ref: MXSymbolListAttr)."""
+    d = sym.attr_dict() if recursive else sym.list_attr()
+    flat = []
+    if recursive:
+        for name, attrs in d.items():
+            for k, v in attrs.items():
+                flat += ["%s$%s" % (name, k), str(v)]
+    else:
+        for k, v in d.items():
+            flat += [str(k), str(v)]
+    return flat
+
+
+def symbol_create_group(syms):
+    from . import symbol
+
+    return symbol.Group(syms)
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def symbol_grad(sym, wrt):
+    return sym.grad(list(wrt))
+
+
+def symbol_infer_shape_partial(sym, keys, shapes):
+    """Returns (arg, out, aux, complete) — unknown shapes become () rows
+    and complete is 0 when any remain (matching the reference's
+    MXSymbolInferShapePartial complete flag)."""
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    arg, out, aux = sym.infer_shape_partial(**kwargs)
+    if arg is None:
+        return None
+    complete = int(all(
+        s is not None for grp in (arg, out, aux) for s in grp))
+    fix = lambda ss: [tuple(map(int, s)) if s is not None else () for s in ss]
+    return (fix(arg), fix(out), fix(aux), complete)
+
+
+def symbol_infer_type(sym, keys, type_codes):
+    """type codes per reference: 0=f32 1=f64 2=f16 3=u8 4=i32 (+6=bf16)."""
+    from .base import _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX
+
+    kwargs = {k: _DTYPE_MX_TO_NP[int(t)] for k, t in zip(keys, type_codes)}
+    arg, out, aux = sym.infer_type(**kwargs)
+    if arg is None:
+        return None
+    code = lambda ts: [int(_DTYPE_NP_TO_MX[_np.dtype(t)]) for t in ts]
+    return (code(arg), code(out), code(aux))
+
+
+def symbol_get_atomic_symbol_info(op_name):
+    """(name, description, arg_names, arg_types, arg_descriptions,
+    key_var_num_args, return_type) — from the op registry Field schema
+    (ref: MXSymbolGetAtomicSymbolInfo)."""
+    from .ops.registry import REGISTRY
+
+    op = REGISTRY.get(op_name)
+    if op is None:
+        raise ValueError("unknown operator: %s" % op_name)
+    names, types, descs = [], [], []
+    for pname, field in op.param_fields.items():
+        names.append(pname)
+        t = str(field.type)
+        if field.required:
+            t += ", required"
+        else:
+            t += ", optional, default=%r" % (field.default,)
+        types.append(t)
+        descs.append(field.doc or "")
+    doc = op.doc or (op.forward.__doc__ or "").strip()
+    return (op_name, doc, names, types, descs,
+            op.key_var_num_args or "", "Symbol")
+
+
+# -- Executor (ref: c_api.h:861-991) ------------------------------------------
+
+def executor_bind(sym, dev_type, dev_id, g2c_keys, g2c_dev_types, g2c_dev_ids,
+                  in_args, arg_grads, grad_reqs, aux_states, shared_exec):
+    """grad_reqs: per-arg code 0=null 1=write 2=inplace 3=add (ref
+    graph_executor OpReqType); arg_grads entries may be None."""
+    req_map = {0: "null", 1: "write", 2: "write", 3: "add"}
+    group2ctx = {
+        k: _ctx(t, i) for k, t, i in zip(g2c_keys, g2c_dev_types, g2c_dev_ids)
+    }
+    reqs = [req_map[int(r)] for r in grad_reqs]
+    exe = sym.bind(
+        _ctx(dev_type, dev_id),
+        list(in_args),
+        args_grad=[g for g in arg_grads],
+        grad_req=reqs,
+        aux_states=list(aux_states) if aux_states else None,
+        group2ctx=group2ctx or None,
+        shared_exec=shared_exec,
+    )
+    return exe
+
+
+def executor_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+    return 0
+
+
+def executor_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+    return 0
+
+
+def executor_outputs(exe):
+    return list(exe.outputs)
+
+
+def executor_print(exe):
+    """Memory/plan report (ref: MXExecutorPrint → Executor::Print)."""
+    lines = ["Symbol outputs: %s" % ", ".join(exe._output_names)]
+    total = 0
+    for n, a in zip(exe._arg_names, exe.arg_arrays):
+        nbytes = int(_np.prod(a.shape)) * _np.dtype(a.dtype).itemsize
+        total += nbytes
+        lines.append("arg %s: %s %s (%d bytes)" % (n, a.shape, a.dtype, nbytes))
+    lines.append("Total argument memory: %.2f MB" % (total / 1e6))
+    return "\n".join(lines)
+
+
+def executor_set_monitor_callback(exe, pyfn):
+    exe.set_monitor_callback(pyfn)
+    return 0
+
+
+# -- DataIter (ref: c_api.h:1004-1090) ----------------------------------------
+
+_ITER_REGISTRY = None
+
+
+def _iters():
+    global _ITER_REGISTRY
+    if _ITER_REGISTRY is None:
+        from . import io
+
+        _ITER_REGISTRY = {
+            "MNISTIter": io.MNISTIter,
+            "CSVIter": io.CSVIter,
+            "NDArrayIter": io.NDArrayIter,
+            "ImageRecordIter": io.ImageRecordIter,
+        }
+    return _ITER_REGISTRY
+
+
+def list_data_iters():
+    return sorted(_iters().keys())
+
+
+def data_iter_get_info(name):
+    cls = _iters().get(name)
+    if cls is None:
+        raise ValueError("unknown iterator: %s" % name)
+    return (name, (cls.__doc__ or "").strip().splitlines()[0] if cls.__doc__ else "")
+
+
+def data_iter_create(name, keys, vals):
+    cls = _iters().get(name)
+    if cls is None:
+        raise ValueError("unknown iterator: %s" % name)
+    kwargs = {k: _parse_literal(v) for k, v in zip(keys, vals)}
+    return cls(**kwargs)
+
+
+def data_iter_next(it):
+    """Returns 1 and stashes the batch, or 0 at end of epoch."""
+    try:
+        batch = next(it)
+    except StopIteration:
+        it._c_batch = None
+        return 0
+    it._c_batch = batch
+    return 1
+
+
+def data_iter_before_first(it):
+    it.reset()
+    it._c_batch = None
+    return 0
+
+
+def _c_batch(it):
+    b = getattr(it, "_c_batch", None)
+    if b is None:
+        raise ValueError("no current batch: call MXDataIterNext first")
+    return b
+
+
+def data_iter_get_data(it):
+    return _c_batch(it).data[0]
+
+
+def data_iter_get_label(it):
+    return _c_batch(it).label[0]
+
+
+def data_iter_get_index(it):
+    b = _c_batch(it)
+    idx = getattr(b, "index", None)
+    if idx is None:
+        return []
+    return [int(i) for i in idx]
+
+
+def data_iter_get_pad_num(it):
+    return int(getattr(_c_batch(it), "pad", 0) or 0)
+
+
+# -- KVStore (ref: c_api.h:1095-1298) -----------------------------------------
+
+def init_ps_env(keys, vals):
+    import os
+
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+    return 0
+
+
+def kvstore_create(type_str):
+    from . import kvstore
+
+    return kvstore.create(type_str)
+
+
+def kvstore_init(kv, keys, values):
+    kv.init(list(keys), list(values))
+    return 0
+
+
+def kvstore_push(kv, keys, values, priority):
+    kv.push(list(keys), list(values), priority=int(priority))
+    return 0
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return 0
+
+
+def kvstore_set_updater(kv, pyfn):
+    kv.set_updater(pyfn)
+    return 0
+
+
+def kvstore_get_type(kv):
+    return str(kv.type)
+
+
+def kvstore_get_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_get_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_role(which):
+    import os
+
+    role = os.environ.get("DMLC_ROLE", "worker")
+    return 1 if role == which else 0
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+    return 0
+
+
+def kvstore_set_barrier_before_exit(kv, flag):
+    kv._barrier_before_exit = bool(flag)
+    return 0
+
+
+def kvstore_run_server(kv, pyfn):
+    """ref: MXKVStoreRunServer → KVStore::RunServer. With no server role
+    (SURVEY §5.8 redesign) there is no event loop to block in; the call
+    installs the controller so subsequent SendCommandToServers calls
+    reach it, then returns — matching KVStoreServer.run()'s no-op."""
+    if pyfn is not None:
+        kv._server_controller = pyfn
+    return 0
+
+
+def kvstore_send_command(kv, head, body):
+    kv.send_command_to_servers(int(head), body)
+    return 0
+
+
+def kvstore_get_num_dead_node(kv, node_id, timeout):
+    return int(kv.get_num_dead_node(int(node_id), timeout=int(timeout)))
+
+
+# -- RecordIO (ref: c_api.h:1302-1360) ----------------------------------------
+
+def recordio_writer_create(uri):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(uri, "w")
+
+
+def recordio_reader_create(uri):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(uri, "r")
+
+
+def recordio_close(rec):
+    rec.close()
+    return 0
+
+
+def recordio_write(rec, buf):
+    rec.write(bytes(buf))
+    return 0
+
+
+def recordio_read(rec):
+    """Returns record bytes or None at EOF."""
+    return rec.read()
+
+
+def recordio_tell(rec):
+    return int(rec.tell())
+
+
+def recordio_seek(rec, pos):
+    rec._seek(int(pos))
+    return 0
+
+
+# -- Rtc (ref: c_api.h:1365-1390, mxrtc.h) ------------------------------------
+
+def rtc_create(name, input_names, output_names, inputs, outputs, kernel):
+    from .rtc import Rtc
+
+    return Rtc(name, list(zip(input_names, inputs)),
+               list(zip(output_names, outputs)), kernel)
+
+
+def rtc_push(rtc, inputs, outputs, gridx, gridy, gridz):
+    rtc.push(list(inputs), list(outputs), grid_dims=(int(gridx), int(gridy), int(gridz)))
+    return 0
+
+
+# -- Optimizer (ref: c_api.h:1394-1414) ---------------------------------------
+
+def optimizer_find_creator(key):
+    """Returns the name if registered (creator handle == its name).
+    Case-insensitive, same as Optimizer.create_optimizer's lookup."""
+    from .optimizer import Optimizer
+
+    if str(key).lower() not in Optimizer.opt_registry:
+        raise ValueError("unknown optimizer: %s" % key)
+    return str(key)
+
+
+def optimizer_create(name, keys, vals):
+    from .optimizer import Optimizer
+
+    kwargs = {k: _parse_literal(v) for k, v in zip(keys, vals)}
+    opt = Optimizer.create_optimizer(name, **kwargs)
+    opt._c_states = {}
+    return opt
+
+
+def optimizer_update(opt, index, weight, grad, lr, wd):
+    index = int(index)
+    opt.lr = float(lr)
+    opt.wd = float(wd)
+    if index not in opt._c_states:
+        opt._c_states[index] = opt.create_state(index, weight)
+    opt.update(index, weight, grad, opt._c_states[index])
+    return 0
+
+
+# -- CustomOp (ref: c_api.h:1418, operator.py CustomOp) -----------------------
+
+def custom_op_register(op_type, pyfns):
+    """Register a custom op whose fwd/bwd/infer-shape are host callbacks.
+
+    pyfns: dict with 'forward', 'backward' (optional), 'infer_shape'
+    (optional), 'list_arguments', 'list_outputs' — Python callables the C
+    side builds from the caller's function pointers. The op becomes
+    available as symbol.<op_type> / MXSymbolCreateAtomicSymbol like the
+    reference's MXCustomOpRegister-created ops."""
+    from .operator import register_custom_c_op
+
+    register_custom_c_op(op_type, pyfns)
+    return 0

@@ -1,0 +1,349 @@
+/*
+ * Flat C API of the TPU-native framework (parity target:
+ * include/mxnet/c_api.h in the reference — SURVEY §2.10).
+ *
+ * Architecture: the reference's C API sits above a C++ core; here the
+ * core is the Python/JAX layer, so this ABI embeds CPython (linked
+ * against libpython3) and marshals into mxnet_tpu._c_api_impl. Language
+ * bindings (R/Scala/MATLAB/C++ deployments) link this library exactly as
+ * they link the reference's libmxnet.so.
+ *
+ * Conventions (same as reference):
+ *  - every function returns 0 on success, nonzero on failure;
+ *  - MXGetLastError() returns the failure message for the calling thread;
+ *  - handles are opaque pointers owned by the library; free with the
+ *    matching *Free call;
+ *  - output string/array pointers are valid until the next call on the
+ *    same thread.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *AtomicSymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *DataIterHandle;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
+typedef void *RtcHandle;
+typedef void *OptimizerHandle;
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+/* Callback handle ownership: NDArrayHandles passed INTO a callback
+ * (monitor arr, updater recv/local) are BORROWED for the duration of the
+ * call — read/copy/mutate through MX* functions, but do NOT call
+ * MXNDArrayFree on them and do not retain them past the callback's
+ * return. (Divergence from the reference, where the monitor callee frees
+ * its handle — here the library owns callback-visible handles.) */
+/* ref: c_api.h:991 ExecutorMonitorCallback */
+typedef void (*ExecutorMonitorCallback)(const char *name, NDArrayHandle arr,
+                                        void *callback_handle);
+/* ref: c_api.h:1194 MXKVStoreUpdater */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+/* ref: c_api.h:1257 MXKVStoreServerController */
+typedef void (*MXKVStoreServerController)(int head, const char *body,
+                                          void *controller_handle);
+
+/* ref: c_api.h:144 MXGetLastError */
+const char *MXGetLastError();
+/* ref: c_api.h MXGetVersion */
+int MXGetVersion(int *out);
+/* ref: c_api.h MXNotifyShutdown */
+int MXNotifyShutdown();
+/* ref: c_api.h MXRandomSeed */
+int MXRandomSeed(int seed);
+
+/* ---- NDArray ---- */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+/* dev_type: 1=cpu, 2=gpu(alias tpu), 3=cpu_pinned, 6=tpu */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* ---- imperative function registry ---- */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+/* Generic invoke by name (ref: MXFuncInvoke c_api.h:447); kwargs as
+ * key/value strings, outputs appended to out_handles (caller provides
+ * capacity >= *num_outputs; actual count written back). */
+int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
+                       mx_uint num_inputs, mx_uint num_params,
+                       const char **keys, const char **vals,
+                       mx_uint *num_outputs, NDArrayHandle *out_handles);
+
+/* ---- Symbol ---- */
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle handle, const char *fname);
+int MXSymbolFree(SymbolHandle handle);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* Atomic symbol creation + composition (ref: c_api.h:600-668). */
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               AtomicSymbolHandle *out);
+int MXSymbolCompose(AtomicSymbolHandle handle, const char *name,
+                    mx_uint num_args, const char **keys,
+                    SymbolHandle *args, SymbolHandle *out);
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array);
+/* CSR-style shape args, as in the reference (c_api.h:714):
+ * arg_ind_ptr has num_args+1 entries delimiting arg_shape_data. */
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+
+/* CSR-style partial-shape inference: unknown entries may be omitted
+ * (ref: c_api.h:760 MXSymbolInferShapePartial). */
+int MXSymbolInferShapePartial(SymbolHandle handle, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data, int *complete);
+/* dtype codes (base.py _DTYPE_NP_TO_MX, reference-compatible 0-4):
+ * 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64 7=bf16
+ * (ref: c_api.h:800 MXSymbolInferType). */
+int MXSymbolInferType(SymbolHandle handle, mx_uint num_args,
+                      const char **keys, const int *arg_type_data,
+                      mx_uint *in_type_size, const int **in_type_data,
+                      mx_uint *out_type_size, const int **out_type_data,
+                      mx_uint *aux_type_size, const int **aux_type_data,
+                      int *complete);
+
+/* ---- Symbol attributes / structure (ref: c_api.h:528-860) ---- */
+int MXSymbolCopy(SymbolHandle handle, SymbolHandle *out);
+int MXSymbolPrint(SymbolHandle handle, const char **out_str);
+int MXSymbolGetName(SymbolHandle handle, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle handle, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle handle, const char *key, const char *value);
+/* out_size pairs: [key0, val0, key1, val1, ...]; recursive form prefixes
+ * keys with "<node>$" (ref: MXSymbolListAttr vs MXSymbolListAttrShallow). */
+int MXSymbolListAttr(SymbolHandle handle, mx_uint *out_size,
+                     const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle handle, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle handle, mx_uint index, SymbolHandle *out);
+/* ABI-parity stub (ref: c_api.h:700 MXSymbolGrad). Like the reference's
+ * comment warns ("this is not applied to the symbol"), symbol-level grad
+ * graphs are superseded by Executor backward; this entry always returns
+ * an error directing callers to MXExecutorBackward. */
+int MXSymbolGrad(SymbolHandle handle, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+/* op registry introspection (ref: c_api.h:562-600). Creators are op-name
+ * strings here (AtomicSymbolCreator == const char* op name). */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     const char ***out_array);
+int MXSymbolGetAtomicSymbolInfo(const char *creator, const char **name,
+                                const char **description, mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
+
+/* ---- Executor (ref: c_api.h:861-991) ---- */
+int MXExecutorFree(ExecutorHandle handle);
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+/* grad_req_type codes: 0=null 1=write 2=inplace 3=add (OpReqType) */
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
+
+/* ---- DataIter (ref: c_api.h:1004-1090) ---- */
+/* Creators are iterator-name strings (DataIterCreator == const char*). */
+int MXListDataIters(mx_uint *out_size, const char ***out_array);
+int MXDataIterCreateIter(const char *creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterGetIterInfo(const char *creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterFree(DataIterHandle handle);
+/* *out = 1 while data remains, 0 at epoch end */
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ---- KVStore (ref: c_api.h:1095-1298) ---- */
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit);
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle);
+/* (sic) three m's, matching the reference ABI (c_api.h:1270) */
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
+                            int *number, int timeout_sec);
+
+/* ---- RecordIO (ref: c_api.h:1302-1360) ---- */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle *handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle *handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle *handle);
+/* *size = 0 and *buf = NULL at end of file */
+int MXRecordIOReaderReadRecord(RecordIOHandle *handle, char const **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle *handle, size_t pos);
+
+/* ---- Rtc (ref: c_api.h:1365-1390; kernel body compiles to Pallas) ---- */
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs,
+                char *kernel, RtcHandle *out);
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs, mx_uint gridDimX,
+              mx_uint gridDimY, mx_uint gridDimZ, mx_uint blockDimX,
+              mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(RtcHandle handle);
+
+/* ---- Optimizer (ref: c_api.h:1394-1414) ---- */
+/* Creators are optimizer-name strings (OptimizerCreator == const char*). */
+int MXOptimizerFindCreator(const char *key, const char **out);
+int MXOptimizerCreateOptimizer(const char *creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               OptimizerHandle *out);
+int MXOptimizerFree(OptimizerHandle handle);
+int MXOptimizerUpdate(OptimizerHandle handle, int index,
+                      NDArrayHandle weight, NDArrayHandle grad,
+                      mx_float lr, mx_float wd);
+
+/* ---- CustomOp (ref: c_api.h:1418 MXCustomOpRegister) ----
+ * Simplified vtable: f32 host buffers, shapes flattened with per-tensor
+ * ndims. infer_shape may be NULL (outputs take input[0]'s shape);
+ * backward may be NULL (op declares no gradient). The registered type
+ * becomes Custom(op_type=...) exactly like Python-registered ops. */
+typedef int (*MXCustomOpForwardFunc)(int num_in, const mx_float **in_data,
+                                     int num_out, mx_float **out_data,
+                                     const mx_uint *shapes_flat,
+                                     const mx_uint *ndims, void *user);
+typedef int (*MXCustomOpBackwardFunc)(int num_in, const mx_float **in_data,
+                                      const mx_float **out_grad,
+                                      mx_float **in_grad,
+                                      const mx_uint *shapes_flat,
+                                      const mx_uint *ndims, void *user);
+/* infer_shape output packing: out_shapes_flat has exactly
+ * MX_CUSTOM_OP_MAX_NDIM slots PER OUTPUT (fixed stride, NOT contiguous):
+ * write output i's dims at out_shapes_flat[i * MX_CUSTOM_OP_MAX_NDIM]
+ * and its rank (<= MX_CUSTOM_OP_MAX_NDIM) into out_ndims[i]. Input
+ * shapes arrive contiguously packed with per-tensor in_ndims, like the
+ * forward/backward shape arrays. */
+#define MX_CUSTOM_OP_MAX_NDIM 8
+typedef int (*MXCustomOpInferShapeFunc)(int num_in,
+                                        const mx_uint *in_shapes_flat,
+                                        const mx_uint *in_ndims, int num_out,
+                                        mx_uint *out_shapes_flat,
+                                        mx_uint *out_ndims, void *user);
+typedef struct {
+  MXCustomOpForwardFunc forward;
+  MXCustomOpBackwardFunc backward;       /* nullable */
+  MXCustomOpInferShapeFunc infer_shape;  /* nullable */
+  int num_inputs;
+  int num_outputs;
+  void *user;
+} MXCustomOpInfo;
+int MXCustomOpRegister(const char *op_type, const MXCustomOpInfo *info);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_API_H_ */

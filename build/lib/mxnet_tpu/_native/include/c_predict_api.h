@@ -1,0 +1,52 @@
+/*
+ * Standalone C prediction API (parity target:
+ * include/mxnet/c_predict_api.h — the ABI behind the reference's MATLAB
+ * binding and amalgamation deployments, SURVEY §2.19-2.20).
+ *
+ * Same conventions as c_api.h: 0 = success, MXGetLastError() for
+ * messages, thread-local output buffers.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *PredictorHandle;
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+/* ref: c_predict_api.h:57 MXPredCreate. input_shape_indptr is CSR over
+ * input_shape_data, one row per input key. */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+/* ref: c_predict_api.h:113 */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+/* ref: c_predict_api.h:126 — data is float32, size in elements */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+/* ref: c_predict_api.h:135 */
+int MXPredForward(PredictorHandle handle);
+/* ref: c_predict_api.h:161 */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+/* ref: c_predict_api.h:178 */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out);
+/* ref: c_predict_api.h:169 */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

@@ -1,0 +1,1939 @@
+// Flat C ABI over the embedded Python/JAX core (ref: src/c_api/c_api.cc,
+// src/c_api/c_predict_api.cc — SURVEY §2.10). See include/c_api.h for the
+// architecture note. Every entry point:
+//   1. ensures the interpreter is alive and takes the GIL,
+//   2. calls a plain function in mxnet_tpu._c_api_impl,
+//   3. marshals results into thread-local buffers,
+//   4. converts Python exceptions into -1 + MXGetLastError().
+// Handles are strong PyObject* references.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+// the public ABI declarations — any signature drift between header and
+// implementation becomes a compile error
+#include "../include/c_api.h"
+#include "../include/c_predict_api.h"
+
+namespace {
+
+thread_local std::string tl_last_error;
+
+// Per-thread marshalling buffers; valid until the next call on the thread
+// (the reference uses the same thread-local ownership discipline via
+// MXAPIThreadLocalEntry, src/c_api/c_api.cc).
+struct TLBuffers {
+  std::vector<mx_uint> shape;
+  std::vector<std::string> strings;
+  std::vector<const char *> cstrs;
+  std::vector<void *> handles;
+  std::string json;
+  std::vector<std::vector<mx_uint>> shape_rows[3];
+  std::vector<mx_uint> shape_ndim[3];
+  std::vector<const mx_uint *> shape_ptrs[3];
+  std::vector<mx_uint> out_shape;
+};
+thread_local TLBuffers tl_buf;
+
+void EnsureInterpreter() {
+  // first calls may race from multiple foreign threads (JVM/C++ hosts)
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();  // drop the GIL so GILGuard below is uniform
+    }
+  });
+}
+
+struct GILGuard {
+  PyGILState_STATE st;
+  GILGuard() {
+    EnsureInterpreter();
+    st = PyGILState_Ensure();
+  }
+  ~GILGuard() { PyGILState_Release(st); }
+};
+
+// Record the active Python exception into tl_last_error and clear it.
+int HandleException() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  tl_last_error = "unknown error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) tl_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return -1;
+}
+
+PyObject *Impl() {
+  static PyObject *mod = nullptr;  // borrowed forever, created under GIL
+  if (mod == nullptr) mod = PyImport_ImportModule("mxnet_tpu._c_api_impl");
+  return mod;
+}
+
+// Call impl.<fn>(*args). STEALS the args reference (callers build the
+// tuple inline and must not touch it afterwards); returns new ref or null.
+PyObject *CallImpl(const char *fn, PyObject *args) {
+  PyObject *r = nullptr;
+  PyObject *mod = Impl();
+  if (mod != nullptr) {
+    PyObject *f = PyObject_GetAttrString(mod, fn);
+    if (f != nullptr) {
+      r = PyObject_CallObject(f, args);
+      Py_DECREF(f);
+    }
+  }
+  Py_XDECREF(args);
+  return r;
+}
+
+PyObject *UIntTuple(const mx_uint *data, mx_uint n) {
+  PyObject *t = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(data[i]));
+  return t;
+}
+
+PyObject *StrList(const char **strs, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs[i]));
+  return l;
+}
+
+PyObject *HandleList(void **handles, mx_uint n) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *o = static_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+// CSR shape args → list of int tuples (ref MXSymbolInferShape marshalling)
+PyObject *CSRShapes(mx_uint num, const mx_uint *indptr, const mx_uint *data) {
+  PyObject *l = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyList_SET_ITEM(l, i, UIntTuple(data + lo, hi - lo));
+  }
+  return l;
+}
+
+// Fill tl_buf.strings/cstrs from a Python list of str.
+int MarshalStrList(PyObject *list, mx_uint *out_size, const char ***out) {
+  tl_buf.strings.clear();
+  tl_buf.cstrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(list, i));
+    if (c == nullptr) return -1;
+    tl_buf.strings.emplace_back(c);
+  }
+  for (auto &s : tl_buf.strings) tl_buf.cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out = tl_buf.cstrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return tl_last_error.c_str(); }
+
+int MXGetVersion(int *out) {
+  *out = 10000;  // 1.0.0 of the TPU-native framework
+  return 0;
+}
+
+int MXNotifyShutdown() { return 0; }
+
+int MXRandomSeed(int seed) {
+  GILGuard g;
+  PyObject *r = CallImpl("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- NDArray ---- */
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *r = CallImpl("ndarray_create_none", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int /*delay_alloc*/, NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, UIntTuple(shape, ndim));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_id));
+  PyObject *r = CallImpl("ndarray_create", args);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  GILGuard g;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), static_cast<Py_ssize_t>(size * 4));
+  PyObject *args = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(args, 0, h);
+  PyTuple_SET_ITEM(args, 1, bytes);
+  PyObject *r = CallImpl("ndarray_sync_copy_from", args);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_sync_copy_to", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  if (static_cast<size_t>(len) != size * 4) {
+    Py_DECREF(r);
+    tl_last_error = "MXNDArraySyncCopyToCPU: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_wait_to_read", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  GILGuard g;
+  PyObject *r = CallImpl("wait_all", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_shape", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_ssize_t n = PyTuple_Size(r);
+  tl_buf.shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_buf.shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i))));
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = tl_buf.shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_dtype_code", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_context", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_slice", Py_BuildValue("(OII)", h, start, stop));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("ndarray_at", Py_BuildValue("(OI)", h, idx));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(fname));
+  PyTuple_SET_ITEM(t, 1, HandleList(args, num_args));
+  if (keys != nullptr) {
+    PyTuple_SET_ITEM(t, 2, StrList(keys, num_args));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(t, 2, Py_None);
+  }
+  PyObject *r = CallImpl("ndarray_save", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  GILGuard g;
+  PyObject *r = CallImpl("ndarray_load", Py_BuildValue("(s)", fname));
+  if (r == nullptr) return HandleException();
+  PyObject *arrs = PyTuple_GET_ITEM(r, 0);
+  PyObject *names = PyTuple_GET_ITEM(r, 1);
+  tl_buf.handles.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);  // caller owns; frees via MXNDArrayFree
+    tl_buf.handles.push_back(o);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = tl_buf.handles.data();
+  int rc = MarshalStrList(names, out_name_size, out_names);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+/* ---- function registry ---- */
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  GILGuard g;
+  PyObject *r = CallImpl("list_all_op_names", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  int rc = MarshalStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
+                       mx_uint num_inputs, mx_uint num_params,
+                       const char **keys, const char **vals,
+                       mx_uint *num_outputs, NDArrayHandle *out_handles) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(4);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(name));
+  PyTuple_SET_ITEM(t, 1, HandleList(inputs, num_inputs));
+  PyTuple_SET_ITEM(t, 2, StrList(keys, num_params));
+  PyTuple_SET_ITEM(t, 3, StrList(vals, num_params));
+  PyObject *r = CallImpl("func_invoke", t);
+  if (r == nullptr) return HandleException();
+  Py_ssize_t n = PyList_Size(r);
+  if (static_cast<mx_uint>(n) > *num_outputs) {
+    Py_DECREF(r);
+    tl_last_error = "MXFuncInvokeByName: output capacity too small";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);
+    out_handles[i] = o;
+  }
+  *num_outputs = static_cast<mx_uint>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Symbol ---- */
+
+static int SymCallStr(const char *fn, const char *arg, SymbolHandle *out) {
+  GILGuard g;
+  PyObject *r = CallImpl(fn, Py_BuildValue("(s)", arg));
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  return SymCallStr("symbol_create_from_json", json, out);
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  return SymCallStr("symbol_create_variable", name, out);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("symbol_to_json", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  const char *c = PyUnicode_AsUTF8(r);
+  if (c == nullptr) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  tl_buf.json = c;
+  Py_DECREF(r);
+  *out_json = tl_buf.json.c_str();
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  GILGuard g;
+  PyObject *io = PyImport_ImportModule("mxnet_tpu.symbol");
+  if (io == nullptr) return HandleException();
+  PyObject *r = PyObject_CallMethod(io, "load", "(s)", fname);
+  Py_DECREF(io);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle handle, const char *fname) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = PyObject_CallMethod(h, "save", "(s)", fname);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               AtomicSymbolHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(op_name));
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_param));
+  PyTuple_SET_ITEM(t, 2, StrList(vals, num_param));
+  PyObject *r = CallImpl("symbol_create_atomic", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolCompose(AtomicSymbolHandle handle, const char *name,
+                    mx_uint num_args, const char **keys, SymbolHandle *args,
+                    SymbolHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(4);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, PyUnicode_FromString(name == nullptr ? "" : name));
+  if (keys != nullptr) {
+    PyTuple_SET_ITEM(t, 2, StrList(keys, num_args));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(t, 2, Py_None);
+  }
+  PyTuple_SET_ITEM(t, 3, HandleList(args, num_args));
+  PyObject *r = CallImpl("symbol_compose", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+static int SymListCall(const char *fn, SymbolHandle handle, mx_uint *out_size,
+                       const char ***out_array) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  int rc = MarshalStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array) {
+  return SymListCall("symbol_list_arguments", handle, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array) {
+  return SymListCall("symbol_list_outputs", handle, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array) {
+  return SymListCall("symbol_list_aux", handle, out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_args));
+  PyTuple_SET_ITEM(t, 2, CSRShapes(num_args, arg_ind_ptr, arg_shape_data));
+  PyObject *r = CallImpl("symbol_infer_shape", t);
+  if (r == nullptr) return HandleException();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *complete = 0;
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    return 0;
+  }
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims[3] = {in_shape_ndim, out_shape_ndim, aux_shape_ndim};
+  const mx_uint ***datas[3] = {in_shape_data, out_shape_data, aux_shape_data};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject *lst = PyTuple_GET_ITEM(r, grp);
+    Py_ssize_t n = PyList_Size(lst);
+    auto &rows = tl_buf.shape_rows[grp];
+    auto &nd = tl_buf.shape_ndim[grp];
+    auto &ptrs = tl_buf.shape_ptrs[grp];
+    rows.clear();
+    nd.clear();
+    ptrs.clear();
+    rows.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *shp = PyList_GET_ITEM(lst, i);
+      Py_ssize_t d = PyTuple_Size(shp);
+      for (Py_ssize_t k = 0; k < d; ++k)
+        rows[i].push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, k))));
+      nd.push_back(static_cast<mx_uint>(d));
+    }
+    for (auto &row : rows) ptrs.push_back(row.data());
+    *sizes[grp] = static_cast<mx_uint>(n);
+    *ndims[grp] = nd.data();
+    *datas[grp] = ptrs.data();
+  }
+  Py_DECREF(r);
+  *complete = 1;
+  return 0;
+}
+
+/* ---- Predict API ---- */
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(6);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(symbol_json_str));
+  PyTuple_SET_ITEM(t, 1, PyBytes_FromStringAndSize(
+                             static_cast<const char *>(param_bytes),
+                             param_size));
+  PyTuple_SET_ITEM(t, 2, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(t, 3, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(t, 4, StrList(input_keys, num_input_nodes));
+  PyTuple_SET_ITEM(
+      t, 5, CSRShapes(num_input_nodes, input_shape_indptr, input_shape_data));
+  PyObject *r = CallImpl("pred_create", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("pred_get_output_shape",
+                         Py_BuildValue("(OI)", h, index));
+  if (r == nullptr) return HandleException();
+  Py_ssize_t n = PyTuple_Size(r);
+  tl_buf.out_shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_buf.out_shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i))));
+  Py_DECREF(r);
+  *shape_ndim = static_cast<mx_uint>(n);
+  *shape_data = tl_buf.out_shape.data();
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, PyUnicode_FromString(key));
+  PyTuple_SET_ITEM(t, 2, PyBytes_FromStringAndSize(
+                             reinterpret_cast<const char *>(data), size * 4));
+  PyObject *r = CallImpl("pred_set_input", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("pred_forward", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("pred_get_output", Py_BuildValue("(OI)", h, index));
+  if (r == nullptr) return HandleException();
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  if (static_cast<size_t>(len) != static_cast<size_t>(size) * 4) {
+    Py_DECREF(r);
+    tl_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(input_keys, num_input_nodes));
+  PyTuple_SET_ITEM(
+      t, 2, CSRShapes(num_input_nodes, input_shape_indptr, input_shape_data));
+  PyObject *r = CallImpl("pred_reshape", t);
+  if (r == nullptr) return HandleException();
+  *out = r;  // a NEW predictor; the input handle keeps its old shapes
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+}  // extern "C"
+
+/* ==== round-2 surface: Symbol attrs/info, Executor, DataIter, KVStore,
+ * RecordIO, Rtc, Optimizer, CustomOp (ref: c_api.h:528-1418) ====
+ * Types come from include/c_api.h. */
+
+namespace {
+
+// ---- C-function-pointer → Python-callable trampolines ----------------------
+// Each callable is a PyCFunction whose self is a PyCapsule owning a small
+// ctx struct (freed by the capsule destructor when the callable dies).
+
+template <typename Ctx>
+void CapsuleFree(PyObject *cap) {
+  delete static_cast<Ctx *>(
+      PyCapsule_GetPointer(cap, PyCapsule_GetName(cap)));
+}
+
+struct MonitorCtx {
+  ExecutorMonitorCallback fn;
+  void *handle;
+};
+
+PyObject *MonitorTramp(PyObject *self, PyObject *args) {
+  auto *c = static_cast<MonitorCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.monitor"));
+  const char *name = nullptr;
+  PyObject *arr = nullptr;
+  if (!PyArg_ParseTuple(args, "sO", &name, &arr)) return nullptr;
+  c->fn(name, arr, c->handle);  // arr is a borrowed NDArray handle
+  Py_RETURN_NONE;
+}
+PyMethodDef monitor_def = {"monitor", MonitorTramp, METH_VARARGS, nullptr};
+
+struct UpdaterCtx {
+  MXKVStoreUpdater fn;
+  void *handle;
+};
+
+PyObject *UpdaterTramp(PyObject *self, PyObject *args) {
+  auto *c = static_cast<UpdaterCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.updater"));
+  int key = 0;
+  PyObject *recv = nullptr, *local = nullptr;
+  if (!PyArg_ParseTuple(args, "iOO", &key, &recv, &local)) return nullptr;
+  c->fn(key, recv, local, c->handle);  // handles borrowed for the call
+  Py_RETURN_NONE;
+}
+PyMethodDef updater_def = {"updater", UpdaterTramp, METH_VARARGS, nullptr};
+
+struct ControllerCtx {
+  MXKVStoreServerController fn;
+  void *handle;
+};
+
+PyObject *ControllerTramp(PyObject *self, PyObject *args) {
+  auto *c = static_cast<ControllerCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.controller"));
+  int head = 0;
+  PyObject *body_obj = nullptr;
+  if (!PyArg_ParseTuple(args, "iO", &head, &body_obj)) return nullptr;
+  // the command body may be text (str) or a raw pickle (bytes)
+  const char *body = PyBytes_Check(body_obj)
+                         ? PyBytes_AsString(body_obj)
+                         : PyUnicode_AsUTF8(body_obj);
+  if (body == nullptr) return nullptr;
+  c->fn(head, body, c->handle);
+  Py_RETURN_NONE;
+}
+PyMethodDef controller_def = {"controller", ControllerTramp, METH_VARARGS,
+                              nullptr};
+
+template <typename Ctx>
+PyObject *MakeCallable(const char *capname, PyMethodDef *def, Ctx *ctx) {
+  PyObject *cap = PyCapsule_New(ctx, capname, CapsuleFree<Ctx>);
+  if (cap == nullptr) {
+    delete ctx;
+    return nullptr;
+  }
+  PyObject *fn = PyCFunction_New(def, cap);
+  Py_DECREF(cap);  // callable holds the only reference now
+  return fn;
+}
+
+// Buffer-protocol access to a contiguous f32 numpy array (no numpy headers
+// needed — the impl side guarantees float32 C-contiguous arrays).
+struct F32View {
+  Py_buffer view{};
+  bool ok = false;
+  F32View(PyObject *obj, bool writable) {
+    int flags = PyBUF_C_CONTIGUOUS | PyBUF_FORMAT;
+    if (writable) flags |= PyBUF_WRITABLE;
+    ok = PyObject_GetBuffer(obj, &view, flags) == 0;
+  }
+  ~F32View() {
+    if (ok) PyBuffer_Release(&view);
+  }
+  mx_float *data() const { return static_cast<mx_float *>(view.buf); }
+};
+
+struct CustomOpCtx {
+  MXCustomOpInfo info;
+};
+
+// Gather shapes of a list of buffer views into flat+ndims arrays.
+void AppendShapes(const Py_buffer &v, std::vector<mx_uint> *flat,
+                  std::vector<mx_uint> *ndims) {
+  ndims->push_back(static_cast<mx_uint>(v.ndim));
+  for (int d = 0; d < v.ndim; ++d)
+    flat->push_back(static_cast<mx_uint>(v.shape[d]));
+}
+
+PyObject *CustomFwdTramp(PyObject *self, PyObject *args) {
+  auto *c = static_cast<CustomOpCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.customop"));
+  PyObject *ins = nullptr, *outs = nullptr;
+  if (!PyArg_ParseTuple(args, "OO", &ins, &outs)) return nullptr;
+  Py_ssize_t ni = PyList_Size(ins), no = PyList_Size(outs);
+  std::vector<std::unique_ptr<F32View>> views;
+  std::vector<const mx_float *> in_ptrs;
+  std::vector<mx_float *> out_ptrs;
+  std::vector<mx_uint> flat, ndims;
+  for (Py_ssize_t i = 0; i < ni; ++i) {
+    views.emplace_back(new F32View(PyList_GET_ITEM(ins, i), false));
+    if (!views.back()->ok) return nullptr;
+    in_ptrs.push_back(views.back()->data());
+    AppendShapes(views.back()->view, &flat, &ndims);
+  }
+  for (Py_ssize_t i = 0; i < no; ++i) {
+    views.emplace_back(new F32View(PyList_GET_ITEM(outs, i), true));
+    if (!views.back()->ok) return nullptr;
+    out_ptrs.push_back(views.back()->data());
+    AppendShapes(views.back()->view, &flat, &ndims);
+  }
+  int rc = c->info.forward(static_cast<int>(ni), in_ptrs.data(),
+                           static_cast<int>(no), out_ptrs.data(), flat.data(),
+                           ndims.data(), c->info.user);
+  if (rc != 0) {
+    PyErr_SetString(PyExc_RuntimeError, "custom op forward callback failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+PyMethodDef custom_fwd_def = {"custom_forward", CustomFwdTramp, METH_VARARGS,
+                              nullptr};
+
+PyObject *CustomBwdTramp(PyObject *self, PyObject *args) {
+  auto *c = static_cast<CustomOpCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.customop"));
+  PyObject *ogs = nullptr, *ins = nullptr, *igs = nullptr;
+  if (!PyArg_ParseTuple(args, "OOO", &ogs, &ins, &igs)) return nullptr;
+  Py_ssize_t no = PyList_Size(ogs), ni = PyList_Size(ins);
+  std::vector<std::unique_ptr<F32View>> views;
+  std::vector<const mx_float *> og_ptrs, in_ptrs;
+  std::vector<mx_float *> ig_ptrs;
+  // shape order: in_data, out_grad, in_grad (impl contract)
+  std::vector<mx_uint> flat, ndims;
+  for (Py_ssize_t i = 0; i < ni; ++i) {
+    views.emplace_back(new F32View(PyList_GET_ITEM(ins, i), false));
+    if (!views.back()->ok) return nullptr;
+    in_ptrs.push_back(views.back()->data());
+    AppendShapes(views.back()->view, &flat, &ndims);
+  }
+  for (Py_ssize_t i = 0; i < no; ++i) {
+    views.emplace_back(new F32View(PyList_GET_ITEM(ogs, i), false));
+    if (!views.back()->ok) return nullptr;
+    og_ptrs.push_back(views.back()->data());
+    AppendShapes(views.back()->view, &flat, &ndims);
+  }
+  for (Py_ssize_t i = 0; i < ni; ++i) {
+    views.emplace_back(new F32View(PyList_GET_ITEM(igs, i), true));
+    if (!views.back()->ok) return nullptr;
+    ig_ptrs.push_back(views.back()->data());
+    AppendShapes(views.back()->view, &flat, &ndims);
+  }
+  int rc = c->info.backward(static_cast<int>(ni), in_ptrs.data(),
+                            og_ptrs.data(), ig_ptrs.data(), flat.data(),
+                            ndims.data(), c->info.user);
+  if (rc != 0) {
+    PyErr_SetString(PyExc_RuntimeError, "custom op backward callback failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+PyMethodDef custom_bwd_def = {"custom_backward", CustomBwdTramp, METH_VARARGS,
+                              nullptr};
+
+PyObject *CustomShapeTramp(PyObject *self, PyObject *args) {
+  auto *c = static_cast<CustomOpCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.customop"));
+  PyObject *in_shapes = nullptr;
+  if (!PyArg_ParseTuple(args, "O", &in_shapes)) return nullptr;
+  Py_ssize_t ni = PyList_Size(in_shapes);
+  std::vector<mx_uint> flat, ndims;
+  for (Py_ssize_t i = 0; i < ni; ++i) {
+    PyObject *s = PyList_GET_ITEM(in_shapes, i);
+    Py_ssize_t d = PyList_Size(s);
+    ndims.push_back(static_cast<mx_uint>(d));
+    for (Py_ssize_t k = 0; k < d; ++k)
+      flat.push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyList_GET_ITEM(s, k))));
+  }
+  int no = c->info.num_outputs;
+  constexpr mx_uint kMaxNdim = 8;  // MX_CUSTOM_OP_MAX_NDIM
+  std::vector<mx_uint> out_flat(static_cast<size_t>(no) * kMaxNdim, 0);
+  std::vector<mx_uint> out_ndims(no, 0);
+  int rc = c->info.infer_shape(static_cast<int>(ni), flat.data(),
+                               ndims.data(), no, out_flat.data(),
+                               out_ndims.data(), c->info.user);
+  if (rc != 0) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "custom op infer_shape callback failed");
+    return nullptr;
+  }
+  PyObject *outs = PyList_New(no);
+  size_t off = 0;
+  for (int i = 0; i < no; ++i) {
+    if (out_ndims[i] > kMaxNdim) {
+      Py_DECREF(outs);
+      PyErr_SetString(PyExc_ValueError,
+                      "custom op infer_shape: rank exceeds "
+                      "MX_CUSTOM_OP_MAX_NDIM");
+      return nullptr;
+    }
+    PyObject *shp = PyList_New(out_ndims[i]);
+    for (mx_uint d = 0; d < out_ndims[i]; ++d)
+      PyList_SET_ITEM(shp, d, PyLong_FromUnsignedLong(out_flat[off + d]));
+    off += kMaxNdim;  // fixed stride per output (see c_api.h)
+    PyList_SET_ITEM(outs, i, shp);
+  }
+  PyObject *ret = PyTuple_New(2);
+  Py_INCREF(in_shapes);
+  PyTuple_SET_ITEM(ret, 0, in_shapes);
+  PyTuple_SET_ITEM(ret, 1, outs);
+  return ret;
+}
+PyMethodDef custom_shape_def = {"custom_infer_shape", CustomShapeTramp,
+                                METH_VARARGS, nullptr};
+
+// Common pattern: call impl fn, hand the new reference to the caller as
+// an opaque handle. Caller must hold the GIL (GILGuard).
+int CallNewRef(const char *fn, PyObject *args, void **out) {
+  PyObject *r = CallImpl(fn, args);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+// Common pattern: call impl fn with (handle,) and discard result.
+int CallHandleNoRet(const char *fn, void *handle) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+// Common pattern: call impl fn with (handle,), marshal a string result.
+int CallHandleStr(const char *fn, void *handle, const char **out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  const char *c = PyUnicode_AsUTF8(r);
+  if (c == nullptr) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  tl_buf.json = c;
+  Py_DECREF(r);
+  *out = tl_buf.json.c_str();
+  return 0;
+}
+
+// Marshal (arg, out, aux) int-code tuple result for MXSymbolInferType.
+thread_local std::vector<int> tl_types[3];
+
+}  // namespace
+
+extern "C" {
+
+/* ---- Symbol attributes / structure ---- */
+
+int MXSymbolCopy(SymbolHandle handle, SymbolHandle *out) {
+  GILGuard g;
+  return CallNewRef("symbol_copy",
+                    Py_BuildValue("(O)", static_cast<PyObject *>(handle)),
+                    out);
+}
+
+int MXSymbolPrint(SymbolHandle handle, const char **out_str) {
+  return CallHandleStr("symbol_print", handle, out_str);
+}
+
+int MXSymbolGetName(SymbolHandle handle, const char **out, int *success) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("symbol_get_name", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  const char *c = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  *success = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  tl_buf.json = c == nullptr ? "" : c;
+  Py_DECREF(r);
+  *out = tl_buf.json.c_str();
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle handle, const char *key, const char **out,
+                    int *success) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("symbol_get_attr", Py_BuildValue("(Os)", h, key));
+  if (r == nullptr) return HandleException();
+  const char *c = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  *success = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  tl_buf.json = c == nullptr ? "" : c;
+  Py_DECREF(r);
+  *out = tl_buf.json.c_str();
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle handle, const char *key, const char *value) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("symbol_set_attr",
+                         Py_BuildValue("(Oss)", h, key, value));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int ListAttrCommon(SymbolHandle handle, int recursive,
+                          mx_uint *out_size, const char ***out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("symbol_list_attr",
+                         Py_BuildValue("(Oi)", h, recursive));
+  if (r == nullptr) return HandleException();
+  mx_uint n = 0;
+  int rc = MarshalStrList(r, &n, out);
+  Py_DECREF(r);
+  if (rc != 0) return HandleException();
+  *out_size = n / 2;  // reference counts PAIRS
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle handle, mx_uint *out_size,
+                     const char ***out) {
+  return ListAttrCommon(handle, 1, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle handle, mx_uint *out_size,
+                            const char ***out) {
+  return ListAttrCommon(handle, 0, out_size, out);
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(1);
+  PyTuple_SET_ITEM(t, 0, HandleList(symbols, num_symbols));
+  return CallNewRef("symbol_create_group", t, out);
+}
+
+int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle *out) {
+  GILGuard g;
+  return CallNewRef("symbol_get_internals",
+                    Py_BuildValue("(O)", static_cast<PyObject *>(handle)),
+                    out);
+}
+
+int MXSymbolGetOutput(SymbolHandle handle, mx_uint index, SymbolHandle *out) {
+  GILGuard g;
+  return CallNewRef(
+      "symbol_get_output",
+      Py_BuildValue("(OI)", static_cast<PyObject *>(handle), index), out);
+}
+
+int MXSymbolGrad(SymbolHandle handle, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(wrt, num_wrt));
+  PyObject *r = CallImpl("symbol_grad", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     const char ***out_array) {
+  GILGuard g;
+  PyObject *r = CallImpl("list_all_op_names", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  int rc = MarshalStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+int MXSymbolGetAtomicSymbolInfo(const char *creator, const char **name,
+                                const char **description, mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type) {
+  GILGuard g;
+  PyObject *r = CallImpl("symbol_get_atomic_symbol_info",
+                         Py_BuildValue("(s)", creator));
+  if (r == nullptr) return HandleException();
+  // pack everything into tl_buf.strings: [name, desc, kv, ret,
+  //   names..., types..., descs...]
+  tl_buf.strings.clear();
+  tl_buf.cstrs.clear();
+  auto utf = [&](PyObject *o) {
+    const char *c = PyUnicode_AsUTF8(o);
+    tl_buf.strings.emplace_back(c == nullptr ? "" : c);
+  };
+  utf(PyTuple_GET_ITEM(r, 0));
+  utf(PyTuple_GET_ITEM(r, 1));
+  utf(PyTuple_GET_ITEM(r, 5));
+  utf(PyTuple_GET_ITEM(r, 6));
+  PyObject *lists[3] = {PyTuple_GET_ITEM(r, 2), PyTuple_GET_ITEM(r, 3),
+                        PyTuple_GET_ITEM(r, 4)};
+  Py_ssize_t n = PyList_Size(lists[0]);
+  for (auto *lst : lists)
+    for (Py_ssize_t i = 0; i < n; ++i) utf(PyList_GET_ITEM(lst, i));
+  Py_DECREF(r);
+  for (auto &s : tl_buf.strings) tl_buf.cstrs.push_back(s.c_str());
+  *name = tl_buf.cstrs[0];
+  *description = tl_buf.cstrs[1];
+  *key_var_num_args = tl_buf.cstrs[2];
+  *return_type = tl_buf.cstrs[3];
+  *num_args = static_cast<mx_uint>(n);
+  *arg_names = tl_buf.cstrs.data() + 4;
+  *arg_type_infos = tl_buf.cstrs.data() + 4 + n;
+  *arg_descriptions = tl_buf.cstrs.data() + 4 + 2 * n;
+  return 0;
+}
+
+static int InferShapeCommon(const char *implfn, SymbolHandle handle,
+                            mx_uint num_args, const char **keys,
+                            const mx_uint *arg_ind_ptr,
+                            const mx_uint *arg_shape_data,
+                            mx_uint *in_shape_size,
+                            const mx_uint **in_shape_ndim,
+                            const mx_uint ***in_shape_data,
+                            mx_uint *out_shape_size,
+                            const mx_uint **out_shape_ndim,
+                            const mx_uint ***out_shape_data,
+                            mx_uint *aux_shape_size,
+                            const mx_uint **aux_shape_ndim,
+                            const mx_uint ***aux_shape_data, int *complete) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_args));
+  PyTuple_SET_ITEM(t, 2, CSRShapes(num_args, arg_ind_ptr, arg_shape_data));
+  PyObject *r = CallImpl(implfn, t);
+  if (r == nullptr) return HandleException();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *complete = 0;
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    return 0;
+  }
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims[3] = {in_shape_ndim, out_shape_ndim, aux_shape_ndim};
+  const mx_uint ***datas[3] = {in_shape_data, out_shape_data, aux_shape_data};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject *lst = PyTuple_GET_ITEM(r, grp);
+    Py_ssize_t nn = PyList_Size(lst);
+    auto &rows = tl_buf.shape_rows[grp];
+    auto &nd = tl_buf.shape_ndim[grp];
+    auto &ptrs = tl_buf.shape_ptrs[grp];
+    rows.clear();
+    nd.clear();
+    ptrs.clear();
+    rows.resize(nn);
+    for (Py_ssize_t i = 0; i < nn; ++i) {
+      PyObject *shp = PyList_GET_ITEM(lst, i);
+      Py_ssize_t d = PyTuple_Size(shp);
+      for (Py_ssize_t k = 0; k < d; ++k)
+        rows[i].push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, k))));
+      nd.push_back(static_cast<mx_uint>(d));
+    }
+    for (auto &row : rows) ptrs.push_back(row.data());
+    *sizes[grp] = static_cast<mx_uint>(nn);
+    *ndims[grp] = nd.data();
+    *datas[grp] = ptrs.data();
+  }
+  // partial inference returns a 4th element: the complete flag
+  // (unknown shapes are rank-0 rows); the full path's 3-tuple means done
+  *complete = PyTuple_Size(r) >= 4
+                  ? static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 3)))
+                  : 1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle handle, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return InferShapeCommon("symbol_infer_shape_partial", handle, num_args, keys,
+                          arg_ind_ptr, arg_shape_data, in_shape_size,
+                          in_shape_ndim, in_shape_data, out_shape_size,
+                          out_shape_ndim, out_shape_data, aux_shape_size,
+                          aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolInferType(SymbolHandle handle, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_args));
+  PyObject *codes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SET_ITEM(codes, i, PyLong_FromLong(arg_type_data[i]));
+  PyTuple_SET_ITEM(t, 2, codes);
+  PyObject *r = CallImpl("symbol_infer_type", t);
+  if (r == nullptr) return HandleException();
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *complete = 0;
+    *in_type_size = *out_type_size = *aux_type_size = 0;
+    return 0;
+  }
+  mx_uint *sizes[3] = {in_type_size, out_type_size, aux_type_size};
+  const int **outs[3] = {in_type_data, out_type_data, aux_type_data};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject *lst = PyTuple_GET_ITEM(r, grp);
+    Py_ssize_t nn = PyList_Size(lst);
+    tl_types[grp].clear();
+    for (Py_ssize_t i = 0; i < nn; ++i)
+      tl_types[grp].push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GET_ITEM(lst, i))));
+    *sizes[grp] = static_cast<mx_uint>(nn);
+    *outs[grp] = tl_types[grp].data();
+  }
+  Py_DECREF(r);
+  *complete = 1;
+  return 0;
+}
+
+/* ---- Executor ---- */
+
+int MXExecutorFree(ExecutorHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  return CallHandleStr("executor_print", handle, out_str);
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("executor_forward",
+                         Py_BuildValue("(Oi)", h, is_train));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  if (len == 0) {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(t, 1, Py_None);
+  } else {
+    PyTuple_SET_ITEM(t, 1, HandleList(head_grads, len));
+  }
+  PyObject *r = CallImpl("executor_backward", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("executor_outputs", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  tl_buf.handles.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(r, i);
+    Py_INCREF(o);  // caller frees via MXNDArrayFree
+    tl_buf.handles.push_back(o);
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out = tl_buf.handles.data();
+  return 0;
+}
+
+static int BindCommon(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                      mx_uint num_map_keys, const char **map_keys,
+                      const int *map_dev_types, const int *map_dev_ids,
+                      mx_uint len, NDArrayHandle *in_args,
+                      NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                      mx_uint aux_states_len, NDArrayHandle *aux_states,
+                      ExecutorHandle shared_exec, ExecutorHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(11);
+  PyObject *h = static_cast<PyObject *>(symbol_handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, PyLong_FromLong(dev_type));
+  PyTuple_SET_ITEM(t, 2, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(t, 3, StrList(map_keys, num_map_keys));
+  PyObject *mts = PyList_New(num_map_keys), *mis = PyList_New(num_map_keys);
+  for (mx_uint i = 0; i < num_map_keys; ++i) {
+    PyList_SET_ITEM(mts, i, PyLong_FromLong(map_dev_types[i]));
+    PyList_SET_ITEM(mis, i, PyLong_FromLong(map_dev_ids[i]));
+  }
+  PyTuple_SET_ITEM(t, 4, mts);
+  PyTuple_SET_ITEM(t, 5, mis);
+  PyTuple_SET_ITEM(t, 6, HandleList(in_args, len));
+  // arg_grad_store entries may be NULL → None
+  PyObject *grads = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    if (arg_grad_store[i] == nullptr) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(grads, i, Py_None);
+    } else {
+      PyObject *o = static_cast<PyObject *>(arg_grad_store[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(grads, i, o);
+    }
+  }
+  PyTuple_SET_ITEM(t, 7, grads);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  PyTuple_SET_ITEM(t, 8, reqs);
+  PyTuple_SET_ITEM(t, 9, HandleList(aux_states, aux_states_len));
+  if (shared_exec == nullptr) {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(t, 10, Py_None);
+  } else {
+    PyObject *se = static_cast<PyObject *>(shared_exec);
+    Py_INCREF(se);
+    PyTuple_SET_ITEM(t, 10, se);
+  }
+  PyObject *r = CallImpl("executor_bind", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  return BindCommon(symbol_handle, dev_type, dev_id, 0, nullptr, nullptr,
+                    nullptr, len, in_args, arg_grad_store, grad_req_type,
+                    aux_states_len, aux_states, nullptr, out);
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  return BindCommon(symbol_handle, dev_type, dev_id, num_map_keys, map_keys,
+                    map_dev_types, map_dev_ids, len, in_args, arg_grad_store,
+                    grad_req_type, aux_states_len, aux_states, nullptr, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  return BindCommon(symbol_handle, dev_type, dev_id, num_map_keys, map_keys,
+                    map_dev_types, map_dev_ids, len, in_args, arg_grad_store,
+                    grad_req_type, aux_states_len, aux_states, shared_exec,
+                    out);
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  GILGuard g;
+  PyObject *fn = MakeCallable("mxtpu.monitor", &monitor_def,
+                              new MonitorCtx{callback, callback_handle});
+  if (fn == nullptr) return HandleException();
+  PyObject *t = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, fn);
+  PyObject *r = CallImpl("executor_set_monitor_callback", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- DataIter ---- */
+
+int MXListDataIters(mx_uint *out_size, const char ***out_array) {
+  GILGuard g;
+  PyObject *r = CallImpl("list_data_iters", PyTuple_New(0));
+  if (r == nullptr) return HandleException();
+  int rc = MarshalStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  return rc == 0 ? 0 : HandleException();
+}
+
+int MXDataIterCreateIter(const char *creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(creator));
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_param));
+  PyTuple_SET_ITEM(t, 2, StrList(vals, num_param));
+  PyObject *r = CallImpl("data_iter_create", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXDataIterGetIterInfo(const char *creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  GILGuard g;
+  PyObject *r = CallImpl("data_iter_get_info", Py_BuildValue("(s)", creator));
+  if (r == nullptr) return HandleException();
+  tl_buf.strings.clear();
+  tl_buf.cstrs.clear();
+  const char *c0 = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  const char *c1 = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+  tl_buf.strings.emplace_back(c0 == nullptr ? "" : c0);
+  tl_buf.strings.emplace_back(c1 == nullptr ? "" : c1);
+  Py_DECREF(r);
+  for (auto &s : tl_buf.strings) tl_buf.cstrs.push_back(s.c_str());
+  *name = tl_buf.cstrs[0];
+  *description = tl_buf.cstrs[1];
+  *num_args = 0;
+  *arg_names = nullptr;
+  *arg_type_infos = nullptr;
+  *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("data_iter_next", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  return CallHandleNoRet("data_iter_before_first", handle);
+}
+
+static int IterGetArray(const char *fn, DataIterHandle handle,
+                        NDArrayHandle *out) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *out = r;  // new NDArray reference; caller frees
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return IterGetArray("data_iter_get_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return IterGetArray("data_iter_get_label", handle, out);
+}
+
+thread_local std::vector<uint64_t> tl_index;
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("data_iter_get_index", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  tl_index.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tl_index.push_back(PyLong_AsUnsignedLongLong(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  *out_size = static_cast<uint64_t>(n);
+  *out_index = tl_index.data();
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("data_iter_get_pad_num", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- KVStore ---- */
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(2);
+  PyTuple_SET_ITEM(t, 0, StrList(keys, num_vars));
+  PyTuple_SET_ITEM(t, 1, StrList(vals, num_vars));
+  PyObject *r = CallImpl("init_ps_env", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  GILGuard g;
+  return CallNewRef("kvstore_create", Py_BuildValue("(s)", type), out);
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+static int KVKeysVals(const char *fn, KVStoreHandle handle, mx_uint num,
+                      const int *keys, NDArrayHandle *vals, int priority,
+                      bool with_priority) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(with_priority ? 4 : 3);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyObject *ks = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
+  PyTuple_SET_ITEM(t, 1, ks);
+  PyTuple_SET_ITEM(t, 2, HandleList(vals, num));
+  if (with_priority) PyTuple_SET_ITEM(t, 3, PyLong_FromLong(priority));
+  PyObject *r = CallImpl(fn, t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  return KVKeysVals("kvstore_init", handle, num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return KVKeysVals("kvstore_push", handle, num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return KVKeysVals("kvstore_pull", handle, num, keys, vals, priority, true);
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  GILGuard g;
+  PyObject *fn = MakeCallable("mxtpu.updater", &updater_def,
+                              new UpdaterCtx{updater, updater_handle});
+  if (fn == nullptr) return HandleException();
+  PyObject *t = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, fn);
+  PyObject *r = CallImpl("kvstore_set_updater", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  return CallHandleStr("kvstore_get_type", handle, type);
+}
+
+static int KVGetInt(const char *fn, KVStoreHandle handle, int *ret) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl(fn, Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret) {
+  return KVGetInt("kvstore_get_rank", handle, ret);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret) {
+  return KVGetInt("kvstore_get_group_size", handle, ret);
+}
+
+static int KVRole(const char *which, int *ret) {
+  GILGuard g;
+  PyObject *r = CallImpl("kvstore_role", Py_BuildValue("(s)", which));
+  if (r == nullptr) return HandleException();
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) { return KVRole("worker", ret); }
+int MXKVStoreIsServerNode(int *ret) { return KVRole("server", ret); }
+int MXKVStoreIsSchedulerNode(int *ret) { return KVRole("scheduler", ret); }
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  return CallHandleNoRet("kvstore_barrier", handle);
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("kvstore_set_barrier_before_exit",
+                         Py_BuildValue("(Oi)", h, barrier_before_exit));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle) {
+  GILGuard g;
+  PyObject *fn = Py_None;
+  if (controller != nullptr) {
+    fn = MakeCallable("mxtpu.controller", &controller_def,
+                      new ControllerCtx{controller, controller_handle});
+    if (fn == nullptr) return HandleException();
+  } else {
+    Py_INCREF(Py_None);
+  }
+  PyObject *t = PyTuple_New(2);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, fn);
+  PyObject *r = CallImpl("kvstore_run_server", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  // "y": raw bytes — the kController protocol body is a pickle, not text
+  // (NUL-truncation at the char* boundary matches the reference ABI)
+  PyObject *r = CallImpl("kvstore_send_command",
+                         Py_BuildValue("(Oiy)", h, cmd_id, cmd_body));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number,
+                            int timeout_sec) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *r = CallImpl("kvstore_get_num_dead_node",
+                         Py_BuildValue("(Oii)", h, node_id, timeout_sec));
+  if (r == nullptr) return HandleException();
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- RecordIO ---- */
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  GILGuard g;
+  return CallNewRef("recordio_writer_create", Py_BuildValue("(s)", uri),
+                    out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  int rc = 0;
+  if (h != nullptr) {
+    PyObject *r = CallImpl("recordio_close", Py_BuildValue("(O)", h));
+    if (r == nullptr)
+      rc = HandleException();  // still release the handle below
+    else
+      Py_DECREF(r);
+  }
+  Py_XDECREF(h);
+  return rc;
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle *handle, const char *buf,
+                                size_t size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(*handle);
+  PyObject *t = PyTuple_New(2);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, PyBytes_FromStringAndSize(
+                             buf, static_cast<Py_ssize_t>(size)));
+  PyObject *r = CallImpl("recordio_write", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle *handle, size_t *pos) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(*handle);
+  PyObject *r = CallImpl("recordio_tell", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  *pos = static_cast<size_t>(PyLong_AsSize_t(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  GILGuard g;
+  return CallNewRef("recordio_reader_create", Py_BuildValue("(s)", uri),
+                    out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle *handle) {
+  return MXRecordIOWriterFree(*handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle *handle, char const **buf,
+                               size_t *size) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(*handle);
+  PyObject *r = CallImpl("recordio_read", Py_BuildValue("(O)", h));
+  if (r == nullptr) return HandleException();
+  if (r == Py_None) {  // EOF
+    Py_DECREF(r);
+    *buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char *b = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &b, &len) != 0) {
+    Py_DECREF(r);
+    return HandleException();
+  }
+  tl_buf.json.assign(b, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *buf = tl_buf.json.data();
+  *size = static_cast<size_t>(len);
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle *handle, size_t pos) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(*handle);
+  PyObject *r = CallImpl("recordio_seek",
+                         Py_BuildValue("(On)", h,
+                                       static_cast<Py_ssize_t>(pos)));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Rtc ---- */
+
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(6);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(name));
+  PyTuple_SET_ITEM(t, 1, StrList(const_cast<const char **>(input_names),
+                                 num_input));
+  PyTuple_SET_ITEM(t, 2, StrList(const_cast<const char **>(output_names),
+                                 num_output));
+  PyTuple_SET_ITEM(t, 3, HandleList(inputs, num_input));
+  PyTuple_SET_ITEM(t, 4, HandleList(outputs, num_output));
+  PyTuple_SET_ITEM(t, 5, PyUnicode_FromString(kernel));
+  PyObject *r = CallImpl("rtc_create", t);
+  if (r == nullptr) return HandleException();
+  *out = r;
+  return 0;
+}
+
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs, mx_uint gridDimX,
+              mx_uint gridDimY, mx_uint gridDimZ, mx_uint /*blockDimX*/,
+              mx_uint /*blockDimY*/, mx_uint /*blockDimZ*/) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(6);
+  PyObject *h = static_cast<PyObject *>(handle);
+  Py_INCREF(h);
+  PyTuple_SET_ITEM(t, 0, h);
+  PyTuple_SET_ITEM(t, 1, HandleList(inputs, num_input));
+  PyTuple_SET_ITEM(t, 2, HandleList(outputs, num_output));
+  PyTuple_SET_ITEM(t, 3, PyLong_FromUnsignedLong(gridDimX));
+  PyTuple_SET_ITEM(t, 4, PyLong_FromUnsignedLong(gridDimY));
+  PyTuple_SET_ITEM(t, 5, PyLong_FromUnsignedLong(gridDimZ));
+  PyObject *r = CallImpl("rtc_push", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRtcFree(RtcHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+/* ---- Optimizer ---- */
+
+int MXOptimizerFindCreator(const char *key, const char **out) {
+  GILGuard g;
+  PyObject *r = CallImpl("optimizer_find_creator", Py_BuildValue("(s)", key));
+  if (r == nullptr) return HandleException();
+  const char *c = PyUnicode_AsUTF8(r);
+  tl_buf.json = c == nullptr ? "" : c;
+  Py_DECREF(r);
+  *out = tl_buf.json.c_str();
+  return 0;
+}
+
+int MXOptimizerCreateOptimizer(const char *creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               OptimizerHandle *out) {
+  GILGuard g;
+  PyObject *t = PyTuple_New(3);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(creator));
+  PyTuple_SET_ITEM(t, 1, StrList(keys, num_param));
+  PyTuple_SET_ITEM(t, 2, StrList(vals, num_param));
+  return CallNewRef("optimizer_create", t, out);
+}
+
+int MXOptimizerFree(OptimizerHandle handle) {
+  GILGuard g;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+int MXOptimizerUpdate(OptimizerHandle handle, int index, NDArrayHandle weight,
+                      NDArrayHandle grad, mx_float lr, mx_float wd) {
+  GILGuard g;
+  PyObject *h = static_cast<PyObject *>(handle);
+  PyObject *w = static_cast<PyObject *>(weight);
+  PyObject *gr = static_cast<PyObject *>(grad);
+  PyObject *r = CallImpl("optimizer_update",
+                         Py_BuildValue("(OiOOff)", h, index, w, gr,
+                                       static_cast<double>(lr),
+                                       static_cast<double>(wd)));
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- CustomOp ---- */
+
+int MXCustomOpRegister(const char *op_type, const MXCustomOpInfo *info) {
+  GILGuard g;
+  if (info == nullptr || info->forward == nullptr) {
+    tl_last_error = "MXCustomOpRegister: forward callback required";
+    return -1;
+  }
+  auto *ctx = new CustomOpCtx{*info};
+  PyObject *cap = PyCapsule_New(ctx, "mxtpu.customop",
+                                CapsuleFree<CustomOpCtx>);
+  if (cap == nullptr) {
+    delete ctx;
+    return HandleException();
+  }
+  PyObject *fns = PyDict_New();
+  PyObject *ni = PyLong_FromLong(info->num_inputs);
+  PyObject *no = PyLong_FromLong(info->num_outputs);
+  PyDict_SetItemString(fns, "num_inputs", ni);
+  PyDict_SetItemString(fns, "num_outputs", no);
+  Py_DECREF(ni);
+  Py_DECREF(no);
+  PyObject *fwd = PyCFunction_New(&custom_fwd_def, cap);
+  PyDict_SetItemString(fns, "forward", fwd);
+  Py_DECREF(fwd);
+  if (info->backward != nullptr) {
+    PyObject *bwd = PyCFunction_New(&custom_bwd_def, cap);
+    PyDict_SetItemString(fns, "backward", bwd);
+    Py_DECREF(bwd);
+  }
+  if (info->infer_shape != nullptr) {
+    PyObject *shp = PyCFunction_New(&custom_shape_def, cap);
+    PyDict_SetItemString(fns, "infer_shape", shp);
+    Py_DECREF(shp);
+  }
+  Py_DECREF(cap);  // the PyCFunctions hold references now
+  PyObject *t = PyTuple_New(2);
+  PyTuple_SET_ITEM(t, 0, PyUnicode_FromString(op_type));
+  PyTuple_SET_ITEM(t, 1, fns);
+  PyObject *r = CallImpl("custom_op_register", t);
+  if (r == nullptr) return HandleException();
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,307 @@
+// Native JPEG decode + augmentation pipeline.
+//
+// Role: the reference's ImageRecordIOParser + DefaultImageAugmenter
+// (src/io/iter_image_recordio.cc:150, src/io/image_aug_default.cc) — an
+// OMP-parallel C++ stage that turns packed JPEG bytes into augmented
+// float CHW tensors at multi-thousand img/s, which a GIL-bound Python
+// thread pool cannot approach (measured: PIL threads plateau ~400 img/s;
+// this pipeline scales with cores).
+//
+// Exposed as a flat C ABI consumed by mxnet_tpu.io.ImageRecordIter via
+// ctypes. One call decodes a whole batch with an internal thread pool.
+//
+// Augmentations (flags bitmask), applied in the reference's order:
+//   bit 0: random crop (scale + aspect-ratio jitter, image_aug_default.cc
+//          max_random_scale/min_random_scale/max_aspect_ratio)
+//   bit 1: random horizontal mirror
+//   bit 2: HSL jitter (random_h/random_s/random_l, HLS color space)
+// Per-image randomness comes in from the caller (8 uniforms per image)
+// so decode is deterministic given the caller's RNG — same discipline as
+// the Python path.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr unsigned kRandCrop = 1u;
+constexpr unsigned kRandMirror = 2u;
+constexpr unsigned kHSL = 4u;
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jmp;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr *>(cinfo->err)->jmp, 1);
+}
+
+// Decode a JPEG into an RGB8 buffer; returns false on corrupt input.
+bool DecodeJpeg(const unsigned char *buf, size_t size,
+                std::vector<unsigned char> *rgb, int *iw, int *ih) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char *>(buf),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // training-pipeline decode: fast integer DCT + plain upsampling, the
+  // accuracy/speed point image pipelines use (augmentation noise dwarfs
+  // the DCT approximation error)
+  cinfo.dct_method = JDCT_IFAST;
+  cinfo.do_fancy_upsampling = FALSE;
+  jpeg_start_decompress(&cinfo);
+  *iw = static_cast<int>(cinfo.output_width);
+  *ih = static_cast<int>(cinfo.output_height);
+  rgb->resize(static_cast<size_t>(*iw) * (*ih) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char *row = rgb->data() +
+                         static_cast<size_t>(cinfo.output_scanline) * (*iw) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Integer HLS jitter (the cv::COLOR_BGR2HLS color space the reference
+// jitters in, image_aug_default.cc) — fixed point with reciprocal LUTs,
+// no divisions or fmod in the pixel loop. Units: h in [0, 360) scaled
+// Q6 (val = degrees * 64), l and s in [0, 255] byte range; all
+// intermediates Q15. This is the "LUT/integer HLS" rework: the float
+// path cost ~53 ns/pixel and halved pipeline throughput with jitter on.
+struct HlsTables {
+  // kRecip[x] = round((255 << 15) / x): d * kRecip[sum] >> 15 == d*255/sum
+  int recip[511];
+  // kRecipDeg[d] = round((60 << 6 << 15) / (255*...)): see HueQ6
+  int recip_d[256];
+  HlsTables() {
+    recip[0] = 0;
+    for (int x = 1; x <= 510; ++x)
+      recip[x] = static_cast<int>(((255ll << 15) + x / 2) / x);
+    recip_d[0] = 0;
+    for (int d = 1; d <= 255; ++d)
+      recip_d[d] = static_cast<int>((((60ll << 6) << 15) + d / 2) / d);
+  }
+};
+const HlsTables kHlsT;
+
+// RGB bytes -> (h Q6 degrees, l byte, s byte). Written with ternaries
+// on ints (cmov) — per-pixel hue sectors are branch-predictor poison.
+inline void RgbToHlsInt(int r, int g, int b, int *h, int *l, int *s) {
+  int mx = r > g ? (r > b ? r : b) : (g > b ? g : b);
+  int mn = r < g ? (r < b ? r : b) : (g < b ? g : b);
+  int sum = mx + mn, d = mx - mn;
+  int l8 = sum >> 1;
+  *l = l8;
+  int rec = kHlsT.recip[l8 < 128 ? sum : 510 - sum];
+  *s = d == 0 ? 0 : (d * rec) >> 15;
+  int num = mx == r ? g - b : (mx == g ? b - r : r - g);
+  int base = mx == r ? 0 : (mx == g ? 120 << 6 : 240 << 6);
+  int hq = ((num * kHlsT.recip_d[d]) >> 15) + base;
+  hq = hq < 0 ? hq + (360 << 6) : hq;
+  *h = d == 0 ? 0 : hq;
+}
+
+// (h Q6, l byte, s byte) -> RGB bytes, BRANCHLESS (the closed-form HSL
+// formula: f(n) = l - a*clamp(min(k-3, 9-k), -1, 1), k = (n + h/30)
+// mod 12, a = s*min(l, 1-l)), fixed point so the compiler can keep the
+// pixel loop free of unpredictable per-pixel branches.
+inline int HlsChan(int l, int a, int k /* Q6, [0, 12<<6) */) {
+  int m = std::min(k - (3 << 6), (9 << 6) - k);
+  m = std::max(-(1 << 6), std::min(m, 1 << 6));
+  return l - ((a * m) >> 6);
+}
+
+inline void HlsToRgbInt(int h, int l, int s, int *r, int *g, int *b) {
+  // h/30 in Q6: h * ((1<<21)/1920) >> 15 (h <= 360<<6 -> fits int)
+  constexpr int kInv30 = (1 << 21) / (30 << 6);  // 1092
+  int hk = (h * kInv30) >> 15;                   // [0, 12<<6)
+  int a = (s * std::min(l, 255 - l)) >> 8;
+  int k0 = hk;                                   // n = 0
+  int k1 = (8 << 6) + hk;                        // n = 8
+  int k2 = (4 << 6) + hk;                        // n = 4
+  if (k1 >= 12 << 6) k1 -= 12 << 6;
+  if (k2 >= 12 << 6) k2 -= 12 << 6;
+  *r = HlsChan(l, a, k0);
+  *g = HlsChan(l, a, k1);
+  *b = HlsChan(l, a, k2);
+}
+
+inline int ClampByte(int v) { return v < 0 ? 0 : (v > 255 ? 255 : v); }
+
+struct BatchArgs {
+  const unsigned char *const *bufs;
+  const size_t *sizes;
+  int n, oh, ow;
+  unsigned flags;
+  // n * 8 independent uniforms per image:
+  // [0]=crop_scale [1]=crop_aspect [2]=crop_x [3]=crop_y [4]=mirror
+  // [5]=dh [6]=ds [7]=dl
+  const float *rands;
+  const float *mean;   // nullptr | [3] | [3*oh*ow]
+  int mean_kind;       // 0 none, 1 per-channel, 2 full image
+  float scale;
+  float max_aspect, min_rscale, max_rscale;
+  float rand_h, rand_s, rand_l;  // jitter half-ranges (deg, frac, frac)
+  float *out;  // n * 3 * oh * ow, CHW
+};
+
+bool ProcessOne(const BatchArgs &a, int i, std::vector<unsigned char> *rgb) {
+  int iw = 0, ih = 0;
+  if (!DecodeJpeg(a.bufs[i], a.sizes[i], rgb, &iw, &ih)) return false;
+  const float *r8 = a.rands + static_cast<size_t>(i) * 8;
+  const int oh = a.oh, ow = a.ow;
+
+  // crop window (ref DefaultImageAugmenter: scale in [min,max], aspect
+  // jitter on the width; clamped to the source image). Every decision
+  // consumes its own uniform — correlated randomness biases training.
+  int cw = iw, ch = ih, x0 = 0, y0 = 0;
+  if (a.flags & kRandCrop) {
+    float s = a.min_rscale + (a.max_rscale - a.min_rscale) * r8[0];
+    float ar = 1.0f + a.max_aspect * (2.f * r8[1] - 1.f);
+    cw = std::min(iw, std::max(1, static_cast<int>(ow * s * ar + 0.5f)));
+    ch = std::min(ih, std::max(1, static_cast<int>(oh * s + 0.5f)));
+    x0 = static_cast<int>(r8[2] * (iw - cw + 1));
+    y0 = static_cast<int>(r8[3] * (ih - ch + 1));
+  }
+  const float sx = static_cast<float>(cw) / ow;
+  const float sy = static_cast<float>(ch) / oh;
+
+  const bool hsl = (a.flags & kHSL) &&
+                   (a.rand_h > 0 || a.rand_s > 0 || a.rand_l > 0);
+  // jitter deltas in the integer HLS units (h: Q6 degrees, l/s: bytes)
+  const int dh6 = static_cast<int>(a.rand_h * (2.f * r8[5] - 1.f) * 64.f);
+  const int ds8 = static_cast<int>(a.rand_s * (2.f * r8[6] - 1.f) * 255.f);
+  const int dl8 = static_cast<int>(a.rand_l * (2.f * r8[7] - 1.f) * 255.f);
+  const bool mirror = (a.flags & kRandMirror) && r8[4] < 0.5f;
+
+  // precomputed fixed-point column sampling (mirror folded in): the
+  // per-pixel index/weight math was re-derived ow*oh times before
+  struct ColS {
+    int off1, off2;  // byte offsets within a row
+    int w;           // Q8 weight of the right sample
+  };
+  std::vector<ColS> cols(ow);
+  for (int x = 0; x < ow; ++x) {
+    int srcx = mirror ? ow - 1 - x : x;
+    float fx = x0 + (srcx + 0.5f) * sx - 0.5f;
+    fx = std::min(std::max(fx, 0.0f), static_cast<float>(iw - 1));
+    int x1 = static_cast<int>(fx);
+    int x2 = std::min(x1 + 1, iw - 1);
+    cols[x] = {x1 * 3, x2 * 3,
+               static_cast<int>((fx - x1) * 256.f + 0.5f)};
+  }
+
+  // single fused pass: sample -> (integer HLS) -> mean/scale -> CHW
+  float *dst = a.out + static_cast<size_t>(i) * 3 * oh * ow;
+  const size_t plane = static_cast<size_t>(oh) * ow;
+  const unsigned char *src = rgb->data();
+  for (int y = 0; y < oh; ++y) {
+    float fy = y0 + (y + 0.5f) * sy - 0.5f;
+    fy = std::min(std::max(fy, 0.0f), static_cast<float>(ih - 1));
+    int y1 = static_cast<int>(fy);
+    int y2 = std::min(y1 + 1, ih - 1);
+    const int wy = static_cast<int>((fy - y1) * 256.f + 0.5f);
+    const unsigned char *row1 = src + static_cast<size_t>(y1) * iw * 3;
+    const unsigned char *row2 = src + static_cast<size_t>(y2) * iw * 3;
+    size_t o = static_cast<size_t>(y) * ow;
+    for (int x = 0; x < ow; ++x, ++o) {
+      const ColS cs = cols[x];
+      int px[3];
+      for (int c = 0; c < 3; ++c) {
+        // Q8 bilinear, rounded: exact enough for 8-bit augmentation
+        int top = (row1[cs.off1 + c] << 8) +
+                  (row1[cs.off2 + c] - row1[cs.off1 + c]) * cs.w;
+        int bot = (row2[cs.off1 + c] << 8) +
+                  (row2[cs.off2 + c] - row2[cs.off1 + c]) * cs.w;
+        px[c] = (top << 8) + (bot - top) * wy;  // Q16
+      }
+      if (hsl) {
+        int r = px[0] >> 16, g = px[1] >> 16, b = px[2] >> 16;
+        int h, l, s;
+        RgbToHlsInt(r, g, b, &h, &l, &s);
+        h += dh6;
+        if (h < 0) h += 360 << 6;
+        if (h >= 360 << 6) h -= 360 << 6;
+        l = ClampByte(l + dl8);
+        s = ClampByte(s + ds8);
+        HlsToRgbInt(h, l, s, &r, &g, &b);
+        px[0] = r << 16;
+        px[1] = g << 16;
+        px[2] = b << 16;
+      }
+      constexpr float kInvQ16 = 1.0f / 65536.0f;
+      for (int c = 0; c < 3; ++c) {
+        float v = px[c] * kInvQ16;
+        if (a.mean_kind == 1)
+          v -= a.mean[c];
+        else if (a.mean_kind == 2)
+          v -= a.mean[plane * c + o];
+        dst[plane * c + o] = v * a.scale;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; -(index+1) when image `index` failed to decode.
+int ImgdecBatch(const unsigned char *const *bufs, const size_t *sizes, int n,
+                int oh, int ow, int threads, unsigned flags,
+                const float *rands, const float *mean, int mean_kind,
+                float scale, float max_aspect, float min_rscale,
+                float max_rscale, float rand_h, float rand_s, float rand_l,
+                float *out) {
+  BatchArgs a{bufs,   sizes,     n,          oh,         ow,     flags,
+              rands,  mean,      mean_kind,  scale,      max_aspect,
+              min_rscale, max_rscale, rand_h, rand_s, rand_l, out};
+  std::atomic<int> next(0), bad(-1);
+  int nt = std::max(1, std::min(threads, n));
+  auto worker = [&]() {
+    std::vector<unsigned char> rgb;
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) break;
+      if (!ProcessOne(a, i, &rgb)) bad.store(i);
+    }
+  };
+  if (nt == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> ts;
+    ts.reserve(nt);
+    for (int t = 0; t < nt; ++t) ts.emplace_back(worker);
+    for (auto &t : ts) t.join();
+  }
+  int b = bad.load();
+  return b >= 0 ? -(b + 1) : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,282 @@
+// Native RecordIO codec + threaded prefetching reader.
+//
+// TPU-native replacement for the reference's dmlc-core recordio
+// (dmlc::RecordIOWriter/Reader) and its ThreadedIter prefetch pipeline
+// (ref: src/io/iter_prefetcher.h:72-77 uses dmlc::ThreadedIter with a
+// 16-deep queue; SURVEY §2.14). Same on-disk framing as the Python
+// mxnet_tpu/recordio.py path: [kMagic u32][len u32][payload][pad to 4B].
+//
+// The reader owns a producer thread that reads ahead into a bounded
+// queue of records, so file IO and framing-parse overlap with Python-side
+// decode/augment work (the GIL is released while ctypes calls block here).
+//
+// C ABI only — consumed from Python via ctypes (no pybind11 in this
+// environment).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xCED7230A;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Record {
+  std::string data;
+  uint64_t end_offset;  // file offset just past this record (incl. padding)
+};
+
+class Writer {
+ public:
+  explicit Writer(const char* path) : fp_(std::fopen(path, "wb")) {}
+  ~Writer() { Close(); }
+  bool ok() const { return fp_ != nullptr; }
+
+  // Returns the offset the record was written at (for .idx sidecars).
+  // Payloads containing the magic bytes follow the dmlc multipart protocol:
+  // split at each occurrence, magic removed, cflag 1/2/3 in the top 3 bits
+  // (ref: dmlc-core RecordIOWriter::WriteRecord).
+  int64_t Write(const char* data, uint64_t len) {
+    if (!fp_) return -1;
+    if (len > kLenMask) return -1;  // framing carries 29 length bits
+    int64_t pos = static_cast<int64_t>(std::ftell(fp_));
+    const char* magic = reinterpret_cast<const char*>(&kMagic);
+    uint64_t begin = 0;
+    uint32_t nsplit = 0;
+    for (uint64_t i = 0; i + 4 <= len; ++i) {
+      if (std::memcmp(data + i, magic, 4) == 0) {
+        uint32_t cflag = (nsplit == 0) ? 1u : 2u;
+        if (!WritePart(cflag, data + begin, i - begin)) return -1;
+        begin = i + 4;
+        i += 3;
+        ++nsplit;
+      }
+    }
+    uint32_t cflag = (nsplit == 0) ? 0u : 3u;
+    if (!WritePart(cflag, data + begin, len - begin)) return -1;
+    return pos;
+  }
+
+  int64_t Tell() { return fp_ ? static_cast<int64_t>(std::ftell(fp_)) : -1; }
+
+ private:
+  bool WritePart(uint32_t cflag, const char* data, uint64_t len) {
+    uint32_t header[2] = {kMagic,
+                          (cflag << 29) | static_cast<uint32_t>(len & kLenMask)};
+    if (std::fwrite(header, sizeof(header), 1, fp_) != 1) return false;
+    if (len && std::fwrite(data, 1, len, fp_) != len) return false;
+    uint64_t pad = (4 - len % 4) % 4;
+    if (pad) {
+      const char zeros[4] = {0, 0, 0, 0};
+      if (std::fwrite(zeros, 1, pad, fp_) != pad) return false;
+    }
+    return true;
+  }
+
+ public:
+
+  void Close() {
+    if (fp_) {
+      std::fclose(fp_);
+      fp_ = nullptr;
+    }
+  }
+
+ private:
+  std::FILE* fp_;
+};
+
+class Reader {
+ public:
+  Reader(const char* path, int depth)
+      : path_(path), depth_(depth < 1 ? 1 : depth) {
+    Start(0);
+  }
+
+  ~Reader() { Stop(); }
+
+  bool ok() const { return ok_; }
+
+  // Blocks until a record is available; returns false at EOF/error.
+  // The returned pointer stays valid until the next Next/Seek/Reset/Close.
+  bool Next(const char** data, uint64_t* len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !queue_.empty() || done_; });
+    if (queue_.empty()) return false;
+    current_ = std::move(queue_.front());
+    queue_.pop_front();
+    not_full_.notify_one();
+    tell_ = current_.end_offset;
+    *data = current_.data.data();
+    *len = current_.data.size();
+    return true;
+  }
+
+  // Offset where the next un-consumed record starts.
+  uint64_t Tell() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return tell_;
+  }
+
+  void Seek(uint64_t offset) {
+    Stop();
+    Start(offset);
+  }
+
+  void Reset() { Seek(0); }
+
+ private:
+  void Start(uint64_t offset) {
+    done_ = false;
+    ok_ = true;
+    tell_ = offset;
+    queue_.clear();
+    producer_ = std::thread([this, offset] { Produce(offset); });
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    not_full_.notify_all();
+    not_empty_.notify_all();
+    if (producer_.joinable()) producer_.join();
+    stop_ = false;
+  }
+
+  void Produce(uint64_t offset) {
+    std::FILE* fp = std::fopen(path_.c_str(), "rb");
+    if (!fp) {
+      std::lock_guard<std::mutex> lk(mu_);
+      ok_ = false;
+      done_ = true;
+      not_empty_.notify_all();
+      return;
+    }
+    if (offset) std::fseek(fp, static_cast<long>(offset), SEEK_SET);
+    uint64_t pos = offset;
+    const char* magic_bytes = reinterpret_cast<const char*>(&kMagic);
+    for (;;) {
+      // assemble one logical record, re-joining multipart chunks with the
+      // magic re-inserted (ref: dmlc-core RecordIOReader::NextRecord)
+      Record rec;
+      bool in_multipart = false;
+      bool fail = false, eof = false;
+      for (;;) {
+        uint32_t header[2];
+        if (std::fread(header, sizeof(header), 1, fp) != 1) {  // EOF
+          eof = true;
+          fail = in_multipart;  // truncated multipart record
+          break;
+        }
+        if (header[0] != kMagic) {
+          fail = true;
+          break;
+        }
+        uint64_t len = header[1] & kLenMask;
+        uint32_t cflag = header[1] >> 29;
+        uint64_t pad = (4 - len % 4) % 4;
+        size_t prev = rec.data.size();
+        if (cflag == 2 || cflag == 3) {
+          rec.data.append(magic_bytes, 4);
+          prev = rec.data.size();
+        }
+        rec.data.resize(prev + len);
+        if (len && std::fread(&rec.data[prev], 1, len, fp) != len) {
+          fail = true;
+          break;
+        }
+        if (pad) std::fseek(fp, static_cast<long>(pad), SEEK_CUR);
+        pos += 8 + len + pad;
+        if (cflag == 0 || cflag == 3) break;
+        in_multipart = true;
+      }
+      if (fail) {
+        std::lock_guard<std::mutex> lk(mu_);
+        ok_ = false;
+        break;
+      }
+      if (eof) break;
+      rec.end_offset = pos;
+      std::unique_lock<std::mutex> lk(mu_);
+      not_full_.wait(lk, [&] {
+        return queue_.size() < static_cast<size_t>(depth_) || stop_;
+      });
+      if (stop_) break;
+      queue_.push_back(std::move(rec));
+      not_empty_.notify_one();
+    }
+    std::fclose(fp);
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    not_empty_.notify_all();
+  }
+
+  std::string path_;
+  int depth_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<Record> queue_;
+  Record current_;
+  std::thread producer_;
+  uint64_t tell_ = 0;
+  bool done_ = false;
+  bool stop_ = false;
+  bool ok_ = true;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path) {
+  Writer* w = new Writer(path);
+  if (!w->ok()) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int64_t rio_writer_write(void* h, const char* data, uint64_t len) {
+  return static_cast<Writer*>(h)->Write(data, len);
+}
+
+int64_t rio_writer_tell(void* h) { return static_cast<Writer*>(h)->Tell(); }
+
+void rio_writer_close(void* h) { delete static_cast<Writer*>(h); }
+
+void* rio_reader_open(const char* path, int prefetch_depth) {
+  std::FILE* probe = std::fopen(path, "rb");
+  if (!probe) return nullptr;
+  std::fclose(probe);
+  return new Reader(path, prefetch_depth);
+}
+
+// *data points into reader-owned memory, valid until the next call.
+// Returns 1 on success, 0 on EOF, -1 on framing error.
+int rio_reader_next(void* h, const char** data, uint64_t* len) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->Next(data, len)) return 1;
+  return r->ok() ? 0 : -1;
+}
+
+uint64_t rio_reader_tell(void* h) { return static_cast<Reader*>(h)->Tell(); }
+
+void rio_reader_seek(void* h, uint64_t offset) {
+  static_cast<Reader*>(h)->Seek(offset);
+}
+
+void rio_reader_reset(void* h) { static_cast<Reader*>(h)->Reset(); }
+
+void rio_reader_close(void* h) { delete static_cast<Reader*>(h); }
+
+}  // extern "C"

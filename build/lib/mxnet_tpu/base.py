@@ -1,0 +1,102 @@
+"""Base types, dtype tables and environment config for mxnet_tpu.
+
+TPU-native re-design of the reference's base layer
+(ref: include/mxnet/base.h, python/mxnet/base.py). There is no ctypes FFI
+boundary here: the "C API" of the reference collapses into plain Python
+calling into JAX/XLA, so this module only keeps the pieces that are real
+API surface — dtype codes, error type, env-var config (ref:
+docs/how_to/env_var.md, dmlc::GetEnv call sites).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+# Host-callback note: graphs containing host ops (CustomOp/NumpyOp,
+# TorchModule) are executed by the Executor's hybrid mode — jitted
+# segments with the host ops run eagerly between them (executor.py) —
+# so NO jax.pure_callback enters a compiled program on any framework
+# training/inference path. This is the structural replacement for the
+# round-2 import-time `jax_cpu_enable_async_dispatch=False` mitigation
+# (the CPU callback runtime could deadlock a program with several
+# pure_callback nodes); with no callbacks in compiled programs the
+# mitigation and its import-order sensitivity are gone. The
+# pure_callback fallback still exists for user code that jit-traces a
+# Custom op itself (mxnet_tpu/operator.py _custom_fwd).
+
+__all__ = [
+    "MXNetError", "MXTPUError", "string_types", "numeric_types",
+    "_DTYPE_NP_TO_MX", "_DTYPE_MX_TO_NP", "mx_real_t", "mx_uint", "index_t",
+    "getenv", "env_int", "env_bool", "env_str",
+]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (ref: python/mxnet/base.py:43)."""
+
+
+# Alias under the new framework's own name; both are importable.
+MXTPUError = MXNetError
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# dtype integer codes follow the reference's type_flag values
+# (ref: include/mxnet/base.h mshadow type codes used across the C API).
+_DTYPE_NP_TO_MX = {
+    _np.dtype(_np.float32): 0,
+    _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2,
+    _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4,
+    _np.dtype(_np.int8): 5,
+    _np.dtype(_np.int64): 6,
+    # TPU-native addition: bfloat16 is the MXU's preferred dtype.
+    # Code 7 is unused by the 2016 reference.
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes as _ml_dtypes
+
+    _DTYPE_NP_TO_MX[_np.dtype(_ml_dtypes.bfloat16)] = 7
+    _DTYPE_MX_TO_NP[7] = _np.dtype(_ml_dtypes.bfloat16)
+    bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+mx_real_t = _np.float32   # default real type (ref: include/mxnet/base.h:79)
+mx_uint = int
+index_t = int
+
+
+def getenv(name, default=None):
+    return os.environ.get(name, default)
+
+
+def env_int(name, default):
+    """Integer env config knob (ref: dmlc::GetEnv, e.g. src/engine/threaded_engine_perdevice.cc)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise MXNetError("env var %s=%r is not an int" % (name, v))
+
+
+def env_bool(name, default):
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+def env_str(name, default):
+    return os.environ.get(name, default)
+
+
+def check_call(ret):
+    """Kept for API familiarity; there is no C return code to check."""
+    return ret

@@ -1,0 +1,57 @@
+"""Caffe plugin facade (gated): CaffeOp / CaffeLoss / CaffeDataIter.
+
+The reference can embed Caffe layers/losses/data layers as operators when
+built with the caffe plugin (ref: plugin/caffe/caffe_op-inl.h,
+caffe_loss-inl.h, caffe_data_iter.cc; enabled by `CAFFE_PATH` in
+make/config.mk). Caffe is not installable in this environment (no
+pip/apt), so the TPU framework ships the same *surface* behind a runtime
+gate — exactly how the reference behaves when compiled without the
+plugin: the symbols exist only when support is present; here they exist
+and raise a clear MXNetError pointing at the supported bridges.
+
+The supported migration path for caffe models is:
+- layers → native ops (Convolution/Pooling/... have full parity), or
+- arbitrary python → ``CustomOp`` (mxnet_tpu/operator.py), or
+- pytorch modules → ``TorchModule`` (mxnet_tpu/torch.py).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["caffe_available", "CaffeOp", "CaffeLoss", "CaffeDataIter"]
+
+
+def caffe_available():
+    try:
+        import caffe  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+_MSG = (
+    "%s requires the caffe python package, which is not available in this "
+    "build (ref: plugin/caffe, gated on CAFFE_PATH). For whole caffe "
+    "NETWORKS use tools/caffe_converter.py: convert_model() reads "
+    ".prototxt AND .caffemodel (self-contained wire-format reader, no "
+    "pycaffe) and runs the graph through native ops. For single layers, "
+    "port to a native op, a CustomOp (mxnet_tpu.operator), or a "
+    "TorchModule (mxnet_tpu.torch)."
+)
+
+
+def CaffeOp(*args, **kwargs):
+    """ref: plugin/caffe/caffe_op-inl.h — run a caffe layer as an op."""
+    raise MXNetError(_MSG % "CaffeOp")
+
+
+def CaffeLoss(*args, **kwargs):
+    """ref: plugin/caffe/caffe_loss-inl.h — caffe criterion as a loss op."""
+    raise MXNetError(_MSG % "CaffeLoss")
+
+
+def CaffeDataIter(*args, **kwargs):
+    """ref: plugin/caffe/caffe_data_iter.cc — caffe data layer as a
+    DataIter."""
+    raise MXNetError(_MSG % "CaffeDataIter")

@@ -1,0 +1,66 @@
+"""KVStore server bootstrap — API-parity facade.
+
+ref: python/mxnet/kvstore_server.py:1-68. In the reference, a process
+launched with DMLC_ROLE=server skips user code and runs a KVStoreServer
+loop that unpickles optimizer commands and applies updates
+(kvstore_server.py:58 _init_kvstore_server_module).
+
+This framework has no server role (SURVEY §5.8): every process is a
+worker, gradients all-reduce over jax.distributed, and the optimizer
+runs replicated on each worker — the server's aggregation+update duties
+are distributed onto all ranks (see kvstore.KVStore._global_reduce).
+The module keeps the reference entry points so launcher scripts and
+user code that import them keep working:
+
+- ``KVStoreServer``: accepts controller commands (the pickled-optimizer
+  protocol) and applies them to the local kvstore, mirroring
+  server-side ``set_optimizer`` semantics;
+- ``_init_kvstore_server_module()``: the boot hook; a no-op unless a
+  legacy DMLC_ROLE=server environment is detected, in which case it
+  explains the redesign rather than hanging a silent process.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """ref: kvstore_server.py:24 — command handler facade."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self, cmd_id, cmd_body):
+        """ref: kvstore_server.py:33 — head 0 carries a pickled
+        optimizer; apply it like the server's updater installation."""
+        if cmd_id == 0:
+            if isinstance(cmd_body, str):
+                cmd_body = cmd_body.encode("latin-1")
+            optimizer = pickle.loads(cmd_body)
+            self.kvstore.set_optimizer(optimizer)
+        else:
+            raise MXNetError("unknown server command %r" % (cmd_id,))
+
+    def run(self):
+        """The reference blocks in the ps-lite event loop here; with no
+        server role there is nothing to run."""
+        return
+
+
+def _init_kvstore_server_module():
+    """ref: kvstore_server.py:58. Detect a legacy server-role launch and
+    fail loudly instead of silently idling."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server":
+        raise MXNetError(
+            "DMLC_ROLE=server: this framework has no parameter-server "
+            "role — every process is a worker and gradients all-reduce "
+            "over jax.distributed (launch with tools/launch.py; see "
+            "SURVEY §5.8). Remove the server/scheduler entries from "
+            "your cluster spec.")

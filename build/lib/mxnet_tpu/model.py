@@ -1,0 +1,827 @@
+"""FeedForward estimator + checkpointing
+(ref: python/mxnet/model.py:1-924). The _train_multi_device loop
+(model.py:117) is preserved: slice batch per device, fwd/bwd per executor,
+sync gradients through KVStore (update_on_kvstore) or local updater, update
+metric host-side. Checkpoints are `prefix-symbol.json` +
+`prefix-%04d.params` with arg:/aux: name prefixes, as in the reference
+(save_checkpoint model.py:311)."""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, zeros, load as nd_load, save as nd_save
+from . import io
+from . import metric as metric_mod
+from . import optimizer as opt
+from .executor_manager import DataParallelExecutorManager, _check_arguments
+from .initializer import Uniform
+from . import ndarray as nd
+from .symbol import Symbol, load as sym_load
+
+BASE_ESTIMATOR = object
+
+BatchEndParam = namedtuple(
+    "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"]
+)
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """ref: python/mxnet/model.py:39."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            from . import kvstore as kvs
+
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(_np.prod(param.shape) for param in arg_params.values())
+                if max_size < 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        kv = kvstore
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names, update_on_kvstore):
+    """ref: model.py:87."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """ref: model.py:97."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
+    """ref: model.py:107."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def _desc_name(d):
+    """provide_data/provide_label entries are (name, shape) tuples or
+    DataDesc namedtuples."""
+    return d.name if isinstance(d, io.DataDesc) else d[0]
+
+
+def _desc_shape(d):
+    return tuple(d.shape if isinstance(d, io.DataDesc) else d[1])
+
+
+def _scan_k():
+    """Steps fused per dispatch in the scanned fit path; 0 disables."""
+    import os
+
+    if os.environ.get("MXNET_SCAN_TRAIN", "1") in ("0", "false", "off"):
+        return 0
+    return int(os.environ.get("MXNET_TRAIN_SCAN_K", "8"))
+
+
+def _scan_flush(trainer, buf, epoch, nbatch0):
+    """Dispatch one K-batch chunk; returns the pending record drained
+    after the NEXT chunk is in flight (shared by FeedForward's
+    _train_scanned and Module._try_scanned_fit)."""
+    staged = trainer.stage_chunk(buf)
+    return (trainer.run_chunk(staged), buf, epoch, nbatch0)
+
+
+def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
+                nbatch_base):
+    """Metric updates + per-batch callbacks for a completed chunk.
+    nbatch_base: FeedForward numbers batches from 1, Module from 0.
+
+    D2H minimisation: Accuracy only needs the argmax class id per
+    sample — reduce [K,N,C] probabilities to [K,N] ids ON DEVICE before
+    pulling to host (the tunnel's D2H bandwidth would otherwise eat
+    ~30% of a ResNet chunk's wall time). Accuracy already accepts 1-D
+    predicted labels."""
+    if pending is None:
+        return
+    outs, bufs, epoch, nbatch0 = pending
+    if (type(eval_metric) is metric_mod.Accuracy and len(outs) == 1
+            and getattr(outs[0], "ndim", 0) == 3):
+        import jax.numpy as jnp
+
+        host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
+    else:
+        host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
+    for k, b in enumerate(bufs):
+        labels = [NDArray(_np.asarray(
+            b[n].asnumpy() if isinstance(b[n], NDArray) else b[n]),
+            cpu(0)) for n in label_names]
+        preds = [NDArray(h[k], cpu(0)) for h in host_outs]
+        eval_metric.update(labels, preds)
+        if batch_end_callback is not None:
+            _multiple_callbacks(batch_end_callback, BatchEndParam(
+                epoch=epoch, nbatch=nbatch0 + k + nbatch_base,
+                eval_metric=eval_metric, locals=locals()))
+
+
+def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
+                   aux_params, begin_epoch, end_epoch, epoch_size, optimizer,
+                   train_data, eval_data, eval_metric, epoch_end_callback,
+                   batch_end_callback, logger, eval_batch_end_callback, K):
+    """K-step-scanned single-device training loop: same observable
+    semantics as _train_multi_device's per-batch loop (metrics, per-batch
+    callbacks, epoch checkpointing), but the step itself is a compiled
+    K-step lax.scan through parallel/fit_trainer.py — one dispatch per K
+    batches, so the tunnel round-trip and the metric fence amortize.
+    Per-batch callbacks fire after their chunk completes (they lag the
+    device by up to K batches, exactly like the reference's async engine
+    lag between push and metric sync; ref model.py:244)."""
+    input_names = trainer.input_names
+
+    eval_exe = None
+
+    def _flush(buf, epoch, nbatch0):
+        return _scan_flush(trainer, buf, epoch, nbatch0)
+
+    def _drain(pending, eval_metric):
+        _scan_drain(pending, eval_metric, label_names, batch_end_callback,
+                    nbatch_base=1)
+
+    label_names = [_desc_name(d) for d in train_data.provide_label]
+
+    train_data.reset()
+    for epoch in range(begin_epoch, end_epoch):
+        tic = time.time()
+        eval_metric.reset()
+        nbatch = 0
+        pending = None
+        buf = []
+        while True:
+            do_reset = True
+            for data_batch in train_data:
+                arrs = list(data_batch.data) + list(data_batch.label)
+                # hold the NDArray refs — stage_chunk stacks on device
+                # when they are already device-resident (no host trip)
+                buf.append(dict(zip(input_names, arrs)))
+                nbatch += 1
+                if len(buf) == K:
+                    new_pending = _flush(buf, epoch, nbatch - K)
+                    _drain(pending, eval_metric)
+                    pending = new_pending
+                    buf = []
+                if epoch_size is not None and nbatch >= epoch_size:
+                    do_reset = False
+                    break
+            if do_reset:
+                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+                train_data.reset()
+            if epoch_size is None or nbatch >= epoch_size:
+                break
+        if buf:  # epoch tail: smaller scan, compiled once per tail size
+            new_pending = _flush(buf, epoch, nbatch - len(buf))
+            _drain(pending, eval_metric)
+            pending = new_pending
+            buf = []
+        _drain(pending, eval_metric)
+        toc = time.time()
+        logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+
+        trainer.write_back(arg_params, aux_params, aux_names)
+        _multiple_callbacks(epoch_end_callback, epoch, symbol, arg_params,
+                            aux_params)
+
+        if eval_data:
+            if eval_exe is None:
+                eval_shapes = {
+                    _desc_name(d): _desc_shape(d)
+                    for d in list(eval_data.provide_data)
+                    + list(eval_data.provide_label)
+                }
+                eval_exe = symbol.simple_bind(ctx0, grad_req="null",
+                                              **eval_shapes)
+            eval_exe.copy_params_from(arg_params, aux_params)
+            eval_metric.reset()
+            eval_data.reset()
+            eval_label_names = [_desc_name(d)
+                                for d in eval_data.provide_label]
+            eval_data_names = [_desc_name(d)
+                               for d in eval_data.provide_data]
+            for i, eval_batch in enumerate(eval_data):
+                for n, a in zip(eval_data_names, eval_batch.data):
+                    a.copyto(eval_exe.arg_dict[n])
+                # labels too: loss-style heads (MakeLoss/criterions) read
+                # them; leaving bind-time zeros would silently score the
+                # loss against zeros
+                for n, a in zip(eval_label_names, eval_batch.label):
+                    if n in eval_exe.arg_dict:
+                        a.copyto(eval_exe.arg_dict[n])
+                eval_exe.forward(is_train=False)
+                eval_metric.update(eval_batch.label, eval_exe.outputs)
+                if eval_batch_end_callback is not None:
+                    _multiple_callbacks(eval_batch_end_callback, BatchEndParam(
+                        epoch=epoch, nbatch=i, eval_metric=eval_metric,
+                        locals=locals()))
+            for name, value in eval_metric.get_name_value():
+                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+            eval_data.reset()
+
+    from . import engine as _engine
+
+    if _engine.Engine._instance is not None:
+        _engine.Engine._instance.wait_for_all()
+
+
+def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_params,
+                        aux_params, begin_epoch, end_epoch, epoch_size, optimizer,
+                        kvstore, update_on_kvstore, train_data, eval_data=None,
+                        eval_metric=None, epoch_end_callback=None,
+                        batch_end_callback=None, logger=None, work_load_list=None,
+                        monitor=None, eval_batch_end_callback=None,
+                        sym_gen=None, compute_dtype=None):
+    """Core DP training loop (ref: python/mxnet/model.py:117-310)."""
+    if logger is None:
+        logger = logging
+    K = _scan_k()
+    _scan_attempted = False
+    if (K > 1 and len(ctx) == 1 and kvstore is None and not update_on_kvstore
+            and monitor is None and sym_gen is None
+            and work_load_list is None):
+        from .parallel.fit_trainer import make_fit_trainer, supports_optimizer
+
+        if supports_optimizer(optimizer):
+            input_shapes = {
+                _desc_name(d): _desc_shape(d)
+                for d in (list(train_data.provide_data)
+                          + list(train_data.provide_label))
+            }
+            # only CONSTRUCTION falls back (host ops / non-loss heads);
+            # once training starts, errors must surface — a silent
+            # restart on the per-batch path would retrain from epoch 0
+            # with already-mutated params and a shifted lr schedule
+            trainer = None
+            try:
+                trainer = make_fit_trainer(
+                    symbol, ctx[0], input_shapes, optimizer, arg_params,
+                    aux_params, param_names, compute_dtype=compute_dtype)
+            except MXNetError as e:
+                logger.debug("scanned fit unavailable (%s); using the "
+                             "per-batch loop", e)
+            if trainer is not None:
+                return _train_scanned(
+                    trainer, symbol, ctx[0], param_names, aux_names,
+                    arg_params, aux_params, begin_epoch, end_epoch,
+                    epoch_size, optimizer, train_data, eval_data,
+                    eval_metric, epoch_end_callback, batch_end_callback,
+                    logger, eval_batch_end_callback, K)
+            _scan_attempted = True
+    if compute_dtype is not None:
+        # mixed precision rides the scanned trainer; the per-batch loop
+        # trains in the arrays' dtype (f32) — a silent precision change
+        # must not look like it took effect
+        logger.warning(
+            "compute_dtype=%s requested but the scanned fit fast path is "
+            "unavailable (%s); training proceeds in the parameter dtype",
+            compute_dtype,
+            "construction failed" if _scan_attempted else "eligibility")
+    executor_manager = DataParallelExecutorManager(
+        symbol=symbol, sym_gen=sym_gen, ctx=ctx, train_data=train_data,
+        param_names=param_names, arg_names=arg_names, aux_names=aux_names,
+        work_load_list=work_load_list, logger=logger,
+    )
+    if monitor:
+        executor_manager.install_monitor(monitor)
+    executor_manager.set_params(arg_params, aux_params)
+
+    if not update_on_kvstore:
+        updater = opt.get_updater(optimizer)
+    if kvstore:
+        _initialize_kvstore(
+            kvstore=kvstore, param_arrays=executor_manager.param_arrays,
+            arg_params=arg_params, param_names=executor_manager.param_names,
+            update_on_kvstore=update_on_kvstore,
+        )
+    if update_on_kvstore:
+        kvstore.set_optimizer(optimizer)
+
+    train_data.reset()
+    for epoch in range(begin_epoch, end_epoch):
+        tic = time.time()
+        eval_metric.reset()
+        nbatch = 0
+        while True:
+            do_reset = True
+            for data_batch in train_data:
+                executor_manager.load_data_batch(data_batch)
+                if monitor is not None:
+                    monitor.tic()
+                executor_manager.forward(is_train=True)
+                executor_manager.backward()
+                if update_on_kvstore:
+                    _update_params_on_kvstore(
+                        executor_manager.param_arrays, executor_manager.grad_arrays, kvstore
+                    )
+                else:
+                    _update_params(
+                        executor_manager.param_arrays, executor_manager.grad_arrays,
+                        updater=updater, num_device=len(ctx), kvstore=kvstore,
+                    )
+                if monitor is not None:
+                    monitor.toc_print()
+                executor_manager.update_metric(eval_metric, data_batch.label)
+                nbatch += 1
+                if batch_end_callback is not None:
+                    batch_end_params = BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
+                    )
+                    _multiple_callbacks(batch_end_callback, batch_end_params)
+                if epoch_size is not None and nbatch >= epoch_size:
+                    do_reset = False
+                    break
+            if do_reset:
+                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+                train_data.reset()
+            if epoch_size is None or nbatch >= epoch_size:
+                break
+        toc = time.time()
+        logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+
+        if epoch_end_callback or epoch + 1 == end_epoch:
+            executor_manager.copy_to(arg_params, aux_params)
+        _multiple_callbacks(epoch_end_callback, epoch, symbol, arg_params, aux_params)
+
+        if eval_data:
+            eval_metric.reset()
+            eval_data.reset()
+            for i, eval_batch in enumerate(eval_data):
+                executor_manager.load_data_batch(eval_batch)
+                executor_manager.forward(is_train=False)
+                executor_manager.update_metric(eval_metric, eval_batch.label)
+                if eval_batch_end_callback is not None:
+                    batch_end_params = BatchEndParam(
+                        epoch=epoch, nbatch=i, eval_metric=eval_metric, locals=locals()
+                    )
+                    _multiple_callbacks(eval_batch_end_callback, batch_end_params)
+            name_value = eval_metric.get_name_value()
+            for name, value in name_value:
+                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+            eval_data.reset()
+
+    # fence host tasks (async epoch checkpoints): a failed write must
+    # surface here, at the training call site, not be swallowed
+    from . import engine as _engine
+
+    if _engine.Engine._instance is not None:
+        _engine.Engine._instance.wait_for_all()
+
+
+def _multiple_callbacks(callbacks, *args, **kwargs):
+    if isinstance(callbacks, list):
+        for cb in callbacks:
+            cb(*args, **kwargs)
+        return
+    if callbacks:
+        callbacks(*args, **kwargs)
+
+
+_ckpt_vars = {}  # prefix -> engine write-var serializing its checkpoints
+_ckpt_vars_lock = threading.Lock()  # guards check-then-insert on _ckpt_vars
+
+
+def fence_checkpoint(prefix):
+    """Block until all queued async checkpoint writes of `prefix` have
+    landed (no-op when none are pending or the engine is non-native)."""
+    with _ckpt_vars_lock:
+        var = _ckpt_vars.get(prefix)
+    if var is not None:
+        from . import engine as _engine
+
+        _engine.Engine.get().wait_for_var(var)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    sync=False):
+    """ref: python/mxnet/model.py:311.
+
+    Async by default: the file write is pushed to the dependency engine
+    with a per-prefix write variable (successive checkpoints of one
+    prefix serialize; different prefixes overlap) so the training loop
+    keeps stepping while the params hit disk — the TPU-era async
+    checkpoint pattern, fenced by ``nd.waitall()``. ``sync=True`` (or a
+    NaiveEngine / non-native build) writes inline."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    # snapshot device buffers now: later mutations must not leak into
+    # the checkpoint being written
+    save_dict = {("arg:%s" % k): v.asnumpy() for k, v in arg_params.items()}
+    save_dict.update(
+        {("aux:%s" % k): v.asnumpy() for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+
+    def _write():
+        nd_save(param_name, save_dict)
+        logging.info('Saved checkpoint to "%s"', param_name)
+
+    from . import engine as _engine
+
+    eng = _engine.Engine.get()
+    if sync or not eng.is_native:
+        _write()
+        return
+    with _ckpt_vars_lock:
+        var = _ckpt_vars.get(prefix)
+        if var is None:
+            var = _ckpt_vars[prefix] = eng.new_variable()
+    eng.push(_write, mutable_vars=[var])
+
+
+def load_checkpoint(prefix, epoch):
+    """ref: python/mxnet/model.py:341. Fences any in-flight async
+    checkpoint of this prefix before reading."""
+    fence_checkpoint(prefix)
+    symbol = sym_load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward(BASE_ESTIMATOR):
+    """Estimator API (ref: python/mxnet/model.py:378)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=Uniform(0.01), numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, compute_dtype=None, **kwargs):
+        if isinstance(symbol, Symbol):
+            self.symbol = symbol
+            self.sym_gen = None
+        else:
+            assert callable(symbol)
+            self.symbol = None
+            self.sym_gen = symbol
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.argument_checked = False
+        if self.sym_gen is None:
+            self._check_arguments()
+        if ctx is None:
+            ctx = [current_context()]
+        elif isinstance(ctx, Context):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self._pred_exec = None
+        self.begin_epoch = begin_epoch
+        # TPU extension: mixed-precision training through the scanned fit
+        # path (f32 master weights, `compute_dtype` activations/matmuls;
+        # same scheme as parallel/symbol_trainer.py). None = f32, or set
+        # MXNET_COMPUTE_DTYPE=bfloat16 process-wide.
+        import os
+
+        self.compute_dtype = (
+            compute_dtype if compute_dtype is not None
+            else os.environ.get("MXNET_COMPUTE_DTYPE") or None)
+
+    def _check_arguments(self):
+        if self.argument_checked:
+            return
+        assert self.symbol is not None
+        self.argument_checked = True
+        _check_arguments(self.symbol)
+        if self.allow_extra_params:
+            if self.arg_params:
+                arg_names = set(self.symbol.list_arguments())
+                self.arg_params = {
+                    k: v for k, v in self.arg_params.items() if k in arg_names
+                }
+            if self.aux_params:
+                aux_names = set(self.symbol.list_auxiliary_states())
+                self.aux_params = {
+                    k: v for k, v in self.aux_params.items() if k in aux_names
+                }
+
+    @staticmethod
+    def _is_data_arg(name):
+        return name.endswith("data") or name.endswith("label")
+
+    def _init_params(self, inputs, overwrite=False):
+        """ref: model.py:470."""
+        inputs = [
+            x if isinstance(x, io.DataDesc) else io.DataDesc(*x) for x in inputs
+        ]
+        input_shapes = {item.name: item.shape for item in inputs}
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        assert arg_shapes is not None
+        arg_names = self.symbol.list_arguments()
+        input_names = input_shapes.keys()
+        param_names = [key for key in arg_names if key not in input_names]
+        aux_names = self.symbol.list_auxiliary_states()
+
+        param_name_attrs = [
+            x for x in zip(arg_names, arg_shapes) if x[0] in param_names
+        ]
+        arg_params = {k: zeros(s) for k, s in param_name_attrs}
+        aux_name_attrs = list(zip(aux_names, aux_shapes))
+        aux_params = {k: zeros(s) for k, s in aux_name_attrs}
+
+        for k, v in arg_params.items():
+            if self.arg_params and k in self.arg_params and (not overwrite):
+                arg_params[k][:] = self.arg_params[k][:]
+            else:
+                self.initializer(k, v)
+        for k, v in aux_params.items():
+            if self.aux_params and k in self.aux_params and (not overwrite):
+                aux_params[k][:] = self.aux_params[k][:]
+            else:
+                self.initializer(k, v)
+
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        return (arg_names, list(param_names), aux_names)
+
+    def __getstate__(self):
+        this = self.__dict__.copy()
+        this["_pred_exec"] = None
+        return this
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    def _init_predictor(self, input_shapes, type_dict=None):
+        """ref: model.py:522."""
+        if self._pred_exec is not None:
+            arg_shapes, _, _ = self.symbol.infer_shape(**dict(input_shapes))
+            assert arg_shapes is not None, "Incomplete input shapes"
+            pred_shapes = [x.shape for x in self._pred_exec.arg_arrays]
+            if arg_shapes == pred_shapes:
+                return
+        pred_exec = self.symbol.simple_bind(
+            self.ctx[0], grad_req="null", type_dict=type_dict, **dict(input_shapes)
+        )
+        pred_exec.copy_params_from(self.arg_params, self.aux_params)
+        _check_arguments(self.symbol)
+        self._pred_exec = pred_exec
+
+    def _init_iter(self, X, y, is_train):
+        """ref: model.py:544."""
+        if isinstance(X, (_np.ndarray, NDArray)):
+            if y is None:
+                if is_train:
+                    raise ValueError("y must be specified when X is numpy.ndarray")
+                y = _np.zeros(X.shape[0])
+            if not isinstance(y, (_np.ndarray, NDArray)):
+                raise TypeError("y must be ndarray when X is numpy.ndarray")
+            X = X.asnumpy() if isinstance(X, NDArray) else X
+            y = y.asnumpy() if isinstance(y, NDArray) else y
+            if X.shape[0] != y.shape[0]:
+                raise ValueError("The numbers of data points and labels not equal")
+            if y.ndim == 2 and y.shape[1] == 1:
+                y = y.flatten()
+            if y.ndim != 1:
+                raise ValueError("Label must be 1D or 2D (with 2nd dimension being 1)")
+            if is_train:
+                return io.NDArrayIter(
+                    X, y, int(min(X.shape[0] // 2, self.numpy_batch_size)),
+                    shuffle=is_train, last_batch_handle="roll_over",
+                )
+            return io.NDArrayIter(
+                X, y, int(min(X.shape[0], self.numpy_batch_size)), shuffle=False
+            )
+        if not isinstance(X, io.DataIter):
+            raise TypeError("X must be DataIter, NDArray or numpy.ndarray")
+        return X
+
+    def _init_eval_iter(self, eval_data):
+        """ref: model.py:577."""
+        if eval_data is None:
+            return eval_data
+        if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:
+            if eval_data[0] is not None:
+                if eval_data[1] is None and isinstance(eval_data[0], io.DataIter):
+                    return eval_data[0]
+                input_data = (
+                    _np.array(eval_data[0]) if isinstance(eval_data[0], list) else eval_data[0]
+                )
+                input_label = (
+                    _np.array(eval_data[1]) if isinstance(eval_data[1], list) else eval_data[1]
+                )
+                return self._init_iter(input_data, input_label, is_train=True)
+            raise ValueError("Eval data is NONE")
+        if not isinstance(eval_data, io.DataIter):
+            raise TypeError("Eval data must be DataIter, or NDArray/numpy.ndarray pair")
+        return eval_data
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """ref: model.py:602."""
+        X = self._init_iter(X, None, is_train=False)
+        if reset:
+            X.reset()
+        data_shapes = X.provide_data
+        data_names = [x[0] for x in data_shapes]
+        type_dict = dict((key, value.dtype) for (key, value) in self.arg_params.items())
+        for x in X.provide_data:
+            if isinstance(x, io.DataDesc):
+                type_dict[x.name] = x.dtype
+            else:
+                type_dict[x[0]] = _np.float32
+        self._init_predictor(data_shapes, type_dict)
+        batch_size = X.batch_size
+        data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
+        output_list = [[] for _ in range(len(self._pred_exec.outputs))]
+        if return_data:
+            data_list = [[] for _ in X.provide_data]
+            label_list = [[] for _ in X.provide_label]
+        i = 0
+        for batch in X:
+            _load_data(batch, data_arrays)
+            self._pred_exec.forward(is_train=False)
+            padded = batch.pad
+            real_size = batch_size - padded
+            for o_list, o_nd in zip(output_list, self._pred_exec.outputs):
+                o_list.append(o_nd[0:real_size].asnumpy())
+            if return_data:
+                for j, x in enumerate(batch.data):
+                    data_list[j].append(x[0:real_size].asnumpy())
+                for j, x in enumerate(batch.label):
+                    label_list[j].append(x[0:real_size].asnumpy())
+            i += 1
+            if num_batch is not None and i == num_batch:
+                break
+        outputs = [_np.concatenate(x) for x in output_list]
+        if len(outputs) == 1:
+            outputs = outputs[0]
+        if return_data:
+            data = [_np.concatenate(x) for x in data_list]
+            label = [_np.concatenate(x) for x in label_list]
+            if len(data) == 1:
+                data = data[0]
+            if len(label) == 1:
+                label = label[0]
+            return outputs, data, label
+        return outputs
+
+    def score(self, X, eval_metric="acc", num_batch=None, batch_end_callback=None,
+              reset=True):
+        """ref: model.py:677."""
+        X = self._init_iter(X, None, is_train=False)
+        if reset:
+            X.reset()
+        data_shapes = X.provide_data
+        data_names = [x[0] for x in data_shapes]
+        type_dict = dict((key, value.dtype) for (key, value) in self.arg_params.items())
+        for x in X.provide_data:
+            if isinstance(x, io.DataDesc):
+                type_dict[x.name] = x.dtype
+            else:
+                type_dict[x[0]] = _np.float32
+        self._init_predictor(data_shapes, type_dict)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
+        for i, batch in enumerate(X):
+            if num_batch is not None and i == num_batch:
+                break
+            _load_data(batch, data_arrays)
+            self._pred_exec.forward(is_train=False)
+            eval_metric.update(batch.label, self._pred_exec.outputs)
+            if batch_end_callback is not None:
+                batch_end_params = BatchEndParam(
+                    epoch=0, nbatch=i, eval_metric=eval_metric, locals=locals()
+                )
+                _multiple_callbacks(batch_end_callback, batch_end_params)
+        return eval_metric.get()[1]
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_batch_end_callback=None):
+        """ref: python/mxnet/model.py:708."""
+        data = self._init_iter(X, y, is_train=True)
+        eval_data = self._init_eval_iter(eval_data)
+
+        if self.sym_gen:
+            self.symbol = self.sym_gen(data.default_bucket_key)
+            self._check_arguments()
+        self.kwargs["sym"] = self.symbol
+
+        arg_names, param_names, aux_names = self._init_params(
+            data.provide_data + data.provide_label
+        )
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        # create kvstore
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self.ctx), self.arg_params
+        )
+        param_idx2name = {}
+        if update_on_kvstore:
+            param_idx2name.update(enumerate(param_names))
+        else:
+            for i, n in enumerate(param_names):
+                for k in range(len(self.ctx)):
+                    param_idx2name[i * len(self.ctx) + k] = n
+        self.kwargs["param_idx2name"] = param_idx2name
+
+        # init optimizer
+        if isinstance(self.optimizer, str):
+            batch_size = data.batch_size
+            if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+                batch_size *= kvstore.num_workers
+            optimizer = opt.create(
+                self.optimizer, rescale_grad=(1.0 / batch_size), **self.kwargs
+            )
+        elif isinstance(self.optimizer, opt.Optimizer):
+            optimizer = self.optimizer
+
+        _train_multi_device(
+            self.symbol, self.ctx, arg_names, param_names, aux_names,
+            self.arg_params, self.aux_params,
+            begin_epoch=self.begin_epoch, end_epoch=self.num_epoch,
+            epoch_size=self.epoch_size, optimizer=optimizer,
+            train_data=data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback,
+            kvstore=kvstore, update_on_kvstore=update_on_kvstore,
+            logger=logger, work_load_list=work_load_list, monitor=monitor,
+            eval_batch_end_callback=eval_batch_end_callback,
+            sym_gen=self.sym_gen, compute_dtype=self.compute_dtype,
+        )
+
+    def save(self, prefix, epoch=None):
+        """ref: model.py:809."""
+        if epoch is None:
+            epoch = self.num_epoch
+        assert epoch is not None
+        # explicit save → durable on return (async path is the epoch-end
+        # do_checkpoint callback)
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
+                        self.aux_params, sync=True)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """ref: model.py:829."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(
+            symbol, ctx=ctx, arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=epoch, **kwargs
+        )
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=Uniform(0.01), eval_data=None,
+               eval_metric="acc", epoch_end_callback=None, batch_end_callback=None,
+               kvstore="local", logger=None, work_load_list=None,
+               eval_batch_end_callback=None, **kwargs):
+        """ref: model.py:862."""
+        model = FeedForward(
+            symbol, ctx=ctx, num_epoch=num_epoch, epoch_size=epoch_size,
+            optimizer=optimizer, initializer=initializer, **kwargs
+        )
+        model.fit(
+            X, y, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback,
+            kvstore=kvstore, logger=logger, work_load_list=work_load_list,
+            eval_batch_end_callback=eval_batch_end_callback,
+        )
+        return model
+
+
+def _load_data(batch, targets):
+    for d_src, d_target in zip(batch.data, targets):
+        d_src.copyto(d_target)

@@ -1,0 +1,24 @@
+"""Model zoo: the baseline-config model families (SURVEY §2.B).
+
+Symbol-based models mirror the reference examples (LeNet, MLP, ResNet,
+Inception-BN, unrolled LSTM); jax-native models (transformer) target the
+sharded parallel trainer for mesh-scale training.
+"""
+from .lenet import get_lenet
+from .mlp import get_mlp
+from .resnet import get_resnet, get_resnet_small
+from .inception_bn import get_inception_bn_small
+from .classic_convnets import (
+    get_alexnet, get_vgg, get_googlenet, get_inception_v3,
+)
+from .unet import get_unet
+from .lstm import lstm_unroll
+from . import transformer
+
+__all__ = [
+    "get_lenet", "get_mlp", "get_resnet", "get_resnet_small",
+    "get_inception_bn_small",
+    "get_alexnet", "get_vgg", "get_googlenet", "get_inception_v3",
+    "get_unet",
+    "lstm_unroll", "transformer",
+]

@@ -1,0 +1,56 @@
+"""Inception-BN-28-small for CIFAR-10 — the throughput baseline model
+(ref: example/image-classification/symbol_inception-bn-28-small.py,
+BASELINE.md row 1: 842→2943 img/s on 1→4 GTX 980)."""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def _conv_factory(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    conv = sym.Convolution(
+        data=data, num_filter=num_filter, kernel=kernel, stride=stride, pad=pad,
+        name="conv_%s" % name,
+    )
+    bn = sym.BatchNorm(data=conv, name="bn_%s" % name)
+    act = sym.Activation(data=bn, act_type="relu", name="relu_%s" % name)
+    return act
+
+
+def _downsample_factory(data, ch_3x3, name):
+    conv = _conv_factory(data, ch_3x3, (3, 3), (2, 2), (1, 1), "%s_3x3" % name)
+    pool = sym.Pooling(
+        data=data, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max",
+        name="max_pool_%s" % name,
+    )
+    concat = sym.Concat(conv, pool, num_args=2, name="concat_%s" % name)
+    return concat
+
+
+def _simple_factory(data, ch_1x1, ch_3x3, name):
+    conv1x1 = _conv_factory(data, ch_1x1, (1, 1), (1, 1), (0, 0), "%s_1x1" % name)
+    conv3x3 = _conv_factory(data, ch_3x3, (3, 3), (1, 1), (1, 1), "%s_3x3" % name)
+    concat = sym.Concat(conv1x1, conv3x3, num_args=2, name="concat_%s" % name)
+    return concat
+
+
+def get_inception_bn_small(num_classes=10):
+    data = sym.Variable("data")
+    conv1 = _conv_factory(data, 96, (3, 3), (1, 1), (1, 1), "1")
+    in3a = _simple_factory(conv1, 32, 32, "3a")
+    in3b = _simple_factory(in3a, 32, 48, "3b")
+    in3c = _downsample_factory(in3b, 80, "3c")
+    in4a = _simple_factory(in3c, 112, 48, "4a")
+    in4b = _simple_factory(in4a, 96, 64, "4b")
+    in4c = _simple_factory(in4b, 80, 80, "4c")
+    in4d = _simple_factory(in4c, 48, 96, "4d")
+    in4e = _downsample_factory(in4d, 96, "4e")
+    in5a = _simple_factory(in4e, 176, 160, "5a")
+    in5b = _simple_factory(in5a, 176, 160, "5b")
+    pool = sym.Pooling(
+        data=in5b, kernel=(7, 7), stride=(1, 1), pool_type="avg", global_pool=True,
+        name="global_pool",
+    )
+    flatten = sym.Flatten(data=pool, name="flatten1")
+    fc = sym.FullyConnected(data=flatten, num_hidden=num_classes, name="fc1")
+    softmax = sym.SoftmaxOutput(data=fc, name="softmax")
+    return softmax

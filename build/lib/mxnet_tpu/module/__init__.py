@@ -1,0 +1,6 @@
+"""Module API (ref: python/mxnet/module/__init__.py; 2,779 LoC package)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule

@@ -1,0 +1,85 @@
+"""Monitor: per-op output statistics for debugging
+(ref: python/mxnet/monitor.py:1-119, Executor::SetMonitorCallback
+include/mxnet/symbolic.h:386).
+
+The TPU profiler proper is jax.profiler (xplane traces); Monitor keeps the
+reference's lightweight regex-filtered stat stream (SURVEY §5.1).
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    """Per-op tensor tap (ref: python/mxnet/monitor.py Monitor).
+
+    PERFORMANCE: installing a monitor re-executes the monitored graph
+    eagerly and un-jitted on every tapped batch so each op's output can
+    be observed — orders of magnitude slower than the fused jit path.
+    The reference pays an analogous cost (monitoring de-bulks the
+    executor, graph_executor.cc:905-911). Use for debugging, not
+    training runs; the interval only limits how often stats PRINT, not
+    the replay cost."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """|x|/size(x), the reference default."""
+                return x.__abs__().asnumpy().sum() / x.size
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """ref: monitor.py:55."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """ref: monitor.py:63."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """ref: monitor.py:76."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+        for exe in self.exes:
+            for name, array in zip(exe._arg_names, exe.arg_arrays):
+                self.stat_helper(name, array)
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,623 @@
+"""NDArray: imperative, asynchronously-evaluated array on TPU/CPU.
+
+Re-design of the reference NDArray (ref: python/mxnet/ndarray.py:1-1307,
+include/mxnet/ndarray.h:33, src/ndarray/ndarray.cc). The reference pairs a
+mutable buffer with a dependency-engine variable; every op is pushed async
+and `asnumpy()`/`wait_to_read()` synchronise (SURVEY §2.1, §3.3).
+
+TPU-native design: JAX dispatch is already asynchronous and XLA orders
+operations on a stream per device, so the engine's *mechanism* (threaded
+var queues) is unnecessary; its *semantics* survive as:
+
+- an NDArray owns ``self._data`` (an immutable ``jax.Array`` committed to
+  the context's device); a "mutation" rebinds ``_data`` and bumps a version
+  counter — exactly the write-after-read ordering ThreadedVar enforces
+  (ref: src/engine/threaded_engine.h:87-189) but enforced by Python object
+  semantics + XLA program order instead of a scheduler;
+- ``wait_to_read``/``wait_to_write`` → ``jax.Array.block_until_ready``;
+- ``asnumpy`` is the sync point, as in the reference (ndarray.py:560).
+
+Operator functions registered through mxnet_tpu.ops are attached to this
+module at import time by ops/__init__ — the analog of
+``_init_ndarray_module`` (ref: python/mxnet/ndarray.py:1283-1307).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from .base import MXNetError, _DTYPE_MX_TO_NP, _DTYPE_NP_TO_MX, mx_real_t, numeric_types
+from .context import Context, cpu, current_context
+
+__all__ = [
+    "NDArray", "zeros", "ones", "full", "empty", "array", "arange",
+    "concatenate", "load", "save", "waitall", "onehot_encode", "imdecode",
+    "maximum", "minimum",
+]
+
+
+def _as_jax_dtype(dtype):
+    import jax.numpy as jnp
+
+    if dtype is None:
+        return jnp.dtype(mx_real_t)
+    return jnp.dtype(dtype)
+
+
+class NDArray:
+    """A mutable-handle facade over an immutable ``jax.Array``.
+
+    API parity target: python/mxnet/ndarray.py class NDArray.
+    """
+
+    __slots__ = ("_data", "_ctx", "_version", "writable")
+
+    def __init__(self, data, ctx=None, writable=True):
+        import jax
+
+        if ctx is None:
+            ctx = current_context()
+        if not isinstance(data, jax.Array):
+            data = jax.device_put(_np.asarray(data), ctx.jax_device)
+        self._data = data
+        self._ctx = ctx
+        self._version = 0
+        self.writable = writable
+
+    # -- engine-semantics bookkeeping -----------------------------------------
+    def _set_data(self, new_data):
+        """The single mutation point: rebinding the buffer is the TPU analog
+        of an engine write op completing (ref: threaded_engine.h:87-189)."""
+        if not self.writable:
+            raise MXNetError("trying to write to a read-only NDArray")
+        self._data = new_data
+        self._version += 1
+
+    @property
+    def version(self):
+        return self._version
+
+    def wait_to_read(self):
+        """ref: include/mxnet/ndarray.h:123 WaitToRead."""
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # -- basic properties ------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def T(self):
+        """ref: python/mxnet/ndarray.py:524 (reverses all axes)."""
+        import jax.numpy as jnp
+
+        return NDArray(jnp.transpose(self._data), self._ctx)
+
+    # -- conversion ------------------------------------------------------------
+    def asnumpy(self):
+        """Blocking copy to host numpy (ref: python/mxnet/ndarray.py:560)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.shape != (1,) and self.shape != ():
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def astype(self, dtype):
+        import jax.numpy as jnp
+
+        return NDArray(self._data.astype(_as_jax_dtype(dtype)), self._ctx)
+
+    def copyto(self, other):
+        """ref: python/mxnet/ndarray.py:585 — copy into NDArray or Context."""
+        import jax
+
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            if other.shape != self.shape:
+                raise MXNetError(
+                    "copyto shape mismatch: %s vs %s" % (self.shape, other.shape)
+                )
+            moved = jax.device_put(self._data, other._ctx.jax_device)
+            other._set_data(moved.astype(other._data.dtype))
+            return other
+        if isinstance(other, Context):
+            ctx = other
+            return NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def copy(self):
+        return self.copyto(self._ctx)
+
+    def as_in_context(self, context):
+        """ref: python/mxnet/ndarray.py:626."""
+        if self._ctx == context:
+            return self
+        return self.copyto(context)
+
+    # -- shape ops -------------------------------------------------------------
+    def reshape(self, new_shape):
+        """ref: python/mxnet/ndarray.py:427 (supports one -1 wildcard)."""
+        import jax.numpy as jnp
+
+        return NDArray(jnp.reshape(self._data, tuple(new_shape)), self._ctx)
+
+    def broadcast_to(self, shape):
+        import jax.numpy as jnp
+
+        shape = tuple(shape)
+        cur = self.shape
+        if len(cur) != len(shape):
+            raise MXNetError(
+                "Broadcasting the array to shape %s needs the same ndim as %s"
+                % (shape, cur)
+            )
+        for c, s in zip(cur, shape):
+            if c != s and c != 1:
+                raise MXNetError(
+                    "cannot broadcast %s to %s: only size-1 axes may grow" % (cur, shape)
+                )
+        return NDArray(jnp.broadcast_to(self._data, shape), self._ctx)
+
+    # -- indexing --------------------------------------------------------------
+    def __getitem__(self, key):
+        # mxnet 2016 only supports int / slice-without-step on axis 0
+        # (ref: python/mxnet/ndarray.py:384); we support general basic indexing
+        # since jax gives it for free.
+        return NDArray(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, slice) and key == slice(None):
+            src = jnp.broadcast_to(jnp.asarray(value, self._data.dtype), self.shape)
+            self._set_data(jnp.asarray(src, self._data.dtype))
+            return
+        self._set_data(self._data.at[key].set(jnp.asarray(value, self._data.dtype)))
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- arithmetic ------------------------------------------------------------
+    def _binary(self, other, fn, reverse=False):
+        import jax.numpy as jnp
+
+        if isinstance(other, NDArray):
+            if other._ctx != self._ctx:
+                raise MXNetError(
+                    "operands are on different contexts: %s vs %s (ref semantics: "
+                    "src/ndarray/ndarray.cc BinaryOp requires same device)"
+                    % (self._ctx, other._ctx)
+                )
+            rhs = other._data
+        elif isinstance(other, numeric_types):
+            rhs = other
+        else:
+            return NotImplemented
+        a, b = (rhs, self._data) if reverse else (self._data, rhs)
+        return NDArray(fn(a, b), self._ctx)
+
+    def __add__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.subtract, reverse=True)
+
+    def __mul__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.divide, reverse=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.power)
+
+    def __rpow__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.power, reverse=True)
+
+    def __mod__(self, other):
+        import jax.numpy as jnp
+
+        return self._binary(other, jnp.mod)
+
+    def __neg__(self):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.negative(self._data), self._ctx)
+
+    def __abs__(self):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.abs(self._data), self._ctx)
+
+    # in-place: mutate the handle (ref: ndarray.py __iadd__:196 dispatches to
+    # the engine with self in the mutable var set)
+    def _inplace(self, other, fn):
+        out = self._binary(other, fn)
+        if out is NotImplemented:
+            return NotImplemented
+        self._set_data(out._data)
+        return self
+
+    def __iadd__(self, other):
+        import jax.numpy as jnp
+
+        return self._inplace(other, jnp.add)
+
+    def __isub__(self, other):
+        import jax.numpy as jnp
+
+        return self._inplace(other, jnp.subtract)
+
+    def __imul__(self, other):
+        import jax.numpy as jnp
+
+        return self._inplace(other, jnp.multiply)
+
+    def __itruediv__(self, other):
+        import jax.numpy as jnp
+
+        return self._inplace(other, jnp.divide)
+
+    # comparisons (return NDArray of 0/1 like modern mxnet; 2016 reference
+    # compares via numpy after asnumpy — we give both: rich ops produce arrays)
+    def _cmp(self, other, fn):
+        import jax.numpy as jnp
+
+        out = self._binary(other, lambda a, b: fn(a, b).astype(jnp.float32))
+        return out
+
+    def __eq__(self, other):
+        if isinstance(other, (NDArray,) + numeric_types):
+            import jax.numpy as jnp
+
+            return self._cmp(other, jnp.equal)
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (NDArray,) + numeric_types):
+            import jax.numpy as jnp
+
+            return self._cmp(other, jnp.not_equal)
+        return NotImplemented
+
+    def __gt__(self, other):
+        import jax.numpy as jnp
+
+        return self._cmp(other, jnp.greater)
+
+    def __ge__(self, other):
+        import jax.numpy as jnp
+
+        return self._cmp(other, jnp.greater_equal)
+
+    def __lt__(self, other):
+        import jax.numpy as jnp
+
+        return self._cmp(other, jnp.less)
+
+    def __le__(self, other):
+        import jax.numpy as jnp
+
+        return self._cmp(other, jnp.less_equal)
+
+    __hash__ = None  # mutable handle
+
+    def __repr__(self):
+        return "<NDArray %s @%s>" % ("x".join(str(d) for d in self.shape), self._ctx)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy().reshape(-1)[0])
+        raise ValueError("The truth value of an NDArray with more than one element is ambiguous")
+
+
+# -- creation ------------------------------------------------------------------
+
+def empty(shape, ctx=None, dtype=mx_real_t):
+    """Uninitialised array (ref: ndarray.py:698). XLA has no uninitialised
+    buffers; zeros costs the same after fusion."""
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=mx_real_t):
+    import jax
+    import jax.numpy as jnp
+
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        data = jnp.zeros(shape, _as_jax_dtype(dtype))
+    return NDArray(data, ctx)
+
+
+def ones(shape, ctx=None, dtype=mx_real_t):
+    import jax
+    import jax.numpy as jnp
+
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        data = jnp.ones(shape, _as_jax_dtype(dtype))
+    return NDArray(data, ctx)
+
+
+def full(shape, val, ctx=None, dtype=mx_real_t):
+    import jax
+    import jax.numpy as jnp
+
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        data = jnp.full(shape, val, _as_jax_dtype(dtype))
+    return NDArray(data, ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """ref: python/mxnet/ndarray.py:757."""
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = _np.asarray(source_array)
+    if dtype is None:
+        dtype = src.dtype if src.dtype != _np.float64 else mx_real_t
+    if ctx is None:
+        ctx = current_context()
+    return NDArray(src.astype(dtype, copy=False), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=mx_real_t):
+    import jax
+    import jax.numpy as jnp
+
+    if ctx is None:
+        ctx = current_context()
+    with jax.default_device(ctx.jax_device):
+        data = jnp.arange(start, stop, step, _as_jax_dtype(dtype))
+        if repeat != 1:
+            data = jnp.repeat(data, repeat)
+    return NDArray(data, ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    """ref: python/mxnet/ndarray.py:824."""
+    import jax.numpy as jnp
+
+    if not arrays:
+        raise MXNetError("need at least one array to concatenate")
+    if len(arrays) == 1 and not always_copy:
+        return arrays[0]
+    ctx = arrays[0].context
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis), ctx)
+
+
+def onehot_encode(indices, out):
+    """ref: src/ndarray/ndarray.cc:746 _onehot_encode."""
+    import jax.numpy as jnp
+
+    depth = out.shape[1]
+    idx = indices._data.astype(jnp.int32)
+    oh = (idx[:, None] == jnp.arange(depth)[None, :]).astype(out._data.dtype)
+    out._set_data(oh)
+    return out
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mean=None):
+    """Decode an image from compressed bytes (ref: src/ndarray/ndarray.cc:798
+    _imdecode, which uses OpenCV). Uses PIL if available; raises otherwise."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("imdecode requires PIL in this build") from e
+    img = Image.open(_io.BytesIO(str_img))
+    if channels == 3:
+        img = img.convert("RGB")
+    arr = _np.asarray(img, dtype=_np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    x0, y0, x1, y1 = clip_rect
+    if x1 > 0 and y1 > 0:
+        arr = arr[y0:y1, x0:x1]
+    if mean is not None:
+        arr = arr - mean.asnumpy()
+    arr = arr.transpose(2, 0, 1)[None]  # NCHW
+    res = array(arr)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def waitall():
+    """Block until all async work is done (ref: MXNDArrayWaitAll,
+    c_api.h:332). Two fences: drain the host-task dependency engine
+    (mxnet_tpu.engine), then a device barrier via jax.block_until_ready."""
+    import jax
+
+    from . import engine as _engine
+
+    if _engine.Engine._instance is not None:
+        _engine.Engine._instance.wait_for_all()
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# -- serialization -------------------------------------------------------------
+# Binary format (TPU-native re-design of NDArray::Save/Load,
+# ref: src/ndarray/ndarray.cc Save/Load + c_api.h:239 MXNDArraySave):
+#   file  := MAGIC(u64) RESERVED(u64) count(u64) names?(u64) [name] [tensor]
+#   tensor:= ndim(u32) shape(u32*ndim) dtype_code(u32) raw little-endian data
+_ND_MAGIC = 0x112  # same magic family as the reference's NDARRAY_MAGIC
+
+
+def _write_tensor(f, arr):
+    # accepts NDArray or a host numpy snapshot (async checkpoint path)
+    npa = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+    code = _DTYPE_NP_TO_MX[_np.dtype(npa.dtype)]
+    f.write(struct.pack("<I", npa.ndim))
+    for d in npa.shape:
+        f.write(struct.pack("<I", d))
+    f.write(struct.pack("<I", code))
+    f.write(_np.ascontiguousarray(npa).tobytes())
+
+
+def _read_tensor(f, ctx):
+    ndim = struct.unpack("<I", f.read(4))[0]
+    shape = tuple(struct.unpack("<I", f.read(4))[0] for _ in range(ndim))
+    code = struct.unpack("<I", f.read(4))[0]
+    dtype = _DTYPE_MX_TO_NP[code]
+    n = int(_np.prod(shape)) if shape else 1
+    raw = f.read(n * dtype.itemsize)
+    npa = _np.frombuffer(raw, dtype=dtype).reshape(shape)
+    return NDArray(npa, ctx)
+
+
+def save(fname, data):
+    """Save list or dict of NDArray (ref: python/mxnet/ndarray.py:908)."""
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names = []
+        arrays = list(data)
+    else:
+        raise MXNetError("save requires a list or dict of NDArray")
+    from .stream import open_stream
+
+    with open_stream(fname, "wb") as f:
+        f.write(struct.pack("<QQQ", _ND_MAGIC, 0, len(arrays)))
+        f.write(struct.pack("<Q", len(names)))
+        for name in names:
+            enc = name.encode("utf-8")
+            f.write(struct.pack("<Q", len(enc)))
+            f.write(enc)
+        for arr in arrays:
+            _write_tensor(f, arr)
+
+
+def load(fname, ctx=None):
+    """Load list or dict of NDArray (ref: python/mxnet/ndarray.py:876).
+    Accepts stream URIs (s3://, hdfs://, mem://) like dmlc::Stream."""
+    from .stream import open_stream
+
+    with open_stream(fname, "rb") as f:
+        return load_frombuffer(f.read(), ctx)
+
+
+def load_frombuffer(buf, ctx=None):
+    """Load list or dict of NDArray from raw .params bytes — the predict
+    API entry point that receives the file contents instead of a path
+    (ref: c_predict_api.h MXPredCreate param_bytes)."""
+    import io
+
+    if ctx is None:
+        ctx = cpu()
+    f = io.BytesIO(buf)
+    magic, _, count = struct.unpack("<QQQ", f.read(24))
+    if magic != _ND_MAGIC:
+        raise MXNetError("invalid NDArray buffer")
+    num_names = struct.unpack("<Q", f.read(8))[0]
+    names = []
+    for _ in range(num_names):
+        ln = struct.unpack("<Q", f.read(8))[0]
+        names.append(f.read(ln).decode("utf-8"))
+    arrays = [_read_tensor(f, ctx) for _ in range(count)]
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def maximum(lhs, rhs):
+    """Elementwise max of arrays/scalars (ref: python/mxnet/ndarray.py:799
+    dispatching to _maximum/_maximum_scalar). The _maximum* ops are
+    attached to this module's globals by ops.install at import."""
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        # NB: plain max() would hit the attached 'max' reduction op —
+        # registry functions shadow builtins at module scope
+        return lhs if lhs > rhs else rhs
+    if isinstance(rhs, numeric_types):
+        return _maximum_scalar(lhs, scalar=float(rhs))  # noqa: F821
+    if isinstance(lhs, numeric_types):
+        return _maximum_scalar(rhs, scalar=float(lhs))  # noqa: F821
+    return _maximum(lhs, rhs)  # noqa: F821
+
+
+def minimum(lhs, rhs):
+    """Elementwise min (ref: python/mxnet/ndarray.py:825)."""
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        return lhs if lhs < rhs else rhs  # see maximum(): 'min' is shadowed
+    if isinstance(rhs, numeric_types):
+        return _minimum_scalar(lhs, scalar=float(rhs))  # noqa: F821
+    if isinstance(lhs, numeric_types):
+        return _minimum_scalar(rhs, scalar=float(lhs))  # noqa: F821
+    return _minimum(lhs, rhs)  # noqa: F821

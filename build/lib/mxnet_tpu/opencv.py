@@ -1,0 +1,109 @@
+"""OpenCV plugin facade: mx.cv-style image ops without OpenCV.
+
+Re-design of plugin/opencv/cv_api.cc (SURVEY §2.21): the reference
+exposes ``MXCVImdecode``, ``MXCVResize`` and ``MXCVcopyMakeBorder`` as a
+C-API plugin backed by OpenCV. Here the same three operations are
+TPU-native:
+
+- ``imdecode`` — JPEG/PNG decode via PIL when present (the pipeline's
+  native threaded decoder handles the hot path; this is the utility
+  surface), raising a clear gate error otherwise, like the caffe plugin
+  gate;
+- ``resize`` — ``jax.image.resize`` (bilinear/nearest/cubic on device —
+  strictly more capable than the plugin's host-only cv::resize);
+- ``copyMakeBorder`` — ``jnp.pad`` with OpenCV border-type semantics.
+
+Images are HWC uint8/float arrays, matching cv_api's layout.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["imdecode", "resize", "copyMakeBorder",
+           "BORDER_CONSTANT", "BORDER_REPLICATE", "BORDER_REFLECT",
+           "BORDER_WRAP", "IMREAD_COLOR", "IMREAD_GRAYSCALE"]
+
+# OpenCV constants (plugin/opencv/cv_api.h values)
+BORDER_CONSTANT = 0
+BORDER_REPLICATE = 1
+BORDER_REFLECT = 2
+BORDER_WRAP = 3
+IMREAD_GRAYSCALE = 0
+IMREAD_COLOR = 1
+
+_INTERP = {0: "nearest", 1: "linear", 2: "cubic", 3: "cubic", 4: "lanczos3"}
+
+
+def imdecode(buf, flag=IMREAD_COLOR, to_rgb=True):
+    """Decode a compressed image buffer to an HWC uint8 NDArray
+    (ref: MXCVImdecode, plugin/opencv/cv_api.cc)."""
+    try:
+        import io as _io
+
+        from PIL import Image
+    except ImportError as e:
+        raise MXNetError(
+            "opencv.imdecode requires PIL in this build (the data "
+            "pipeline's native decoder is mxnet_tpu.io.ImageRecordIter)"
+        ) from e
+    try:
+        img = Image.open(_io.BytesIO(bytes(buf)))
+        img = img.convert("L" if flag == IMREAD_GRAYSCALE else "RGB")
+    except Exception as e:
+        raise MXNetError("imdecode: cannot decode image buffer: %s" % e) from e
+    arr = _np.asarray(img, dtype=_np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    elif not to_rgb:
+        arr = arr[:, :, ::-1]  # BGR like OpenCV default
+    return NDArray(arr)
+
+
+def resize(src, size, interp=1):
+    """Resize HWC image to ``size=(w, h)``
+    (ref: MXCVResize; interp codes follow cv2: 0=nearest 1=linear
+    2/3=cubic 4=lanczos)."""
+    import jax
+    import jax.numpy as jnp
+
+    if interp not in _INTERP:
+        raise MXNetError("resize: unknown interp %r" % (interp,))
+    data = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    if data.ndim != 3:
+        raise MXNetError("resize expects an HWC image")
+    w, h = int(size[0]), int(size[1])
+    orig_dtype = data.dtype
+    out = jax.image.resize(
+        data.astype(jnp.float32), (h, w, data.shape[2]),
+        method=_INTERP[interp])
+    if _np.issubdtype(_np.dtype(orig_dtype), _np.integer):
+        info = _np.iinfo(_np.dtype(orig_dtype))
+        out = jnp.clip(jnp.round(out), info.min, info.max)
+    return NDArray(out.astype(orig_dtype),
+                   src.context if isinstance(src, NDArray) else None)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=BORDER_CONSTANT,
+                   value=0.0):
+    """Pad an HWC image (ref: MXCVcopyMakeBorder)."""
+    import jax.numpy as jnp
+
+    data = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    if data.ndim != 3:
+        raise MXNetError("copyMakeBorder expects an HWC image")
+    pads = ((top, bot), (left, right), (0, 0))
+    if border_type == BORDER_CONSTANT:
+        out = jnp.pad(data, pads, constant_values=value)
+    elif border_type == BORDER_REPLICATE:
+        out = jnp.pad(data, pads, mode="edge")
+    elif border_type == BORDER_REFLECT:
+        out = jnp.pad(data, pads, mode="reflect")
+    elif border_type == BORDER_WRAP:
+        out = jnp.pad(data, pads, mode="wrap")
+    else:
+        raise MXNetError("copyMakeBorder: unknown border_type %r"
+                         % (border_type,))
+    return NDArray(out, src.context if isinstance(src, NDArray) else None)

@@ -1,0 +1,66 @@
+"""Operator library package.
+
+Importing this package registers every op; ``install`` then exposes each
+OpDef as an imperative NDArray function and a Symbol constructor — the
+analog of _init_ndarray_module/_init_symbol_module
+(ref: python/mxnet/ndarray.py:1283, python/mxnet/symbol.py:1091).
+"""
+from __future__ import annotations
+
+from . import registry
+from .registry import REGISTRY, Field, OpDef, get, list_ops, register
+
+# importing these modules registers all ops
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import sequence  # noqa: F401
+from . import vision  # noqa: F401
+
+
+def _make_imperative(op):
+    def fn(*args, **kwargs):
+        import jax.numpy as jnp
+
+        from .. import random as _random
+        from ..context import current_context
+        from ..ndarray import NDArray
+
+        out = kwargs.pop("out", None)
+        ctx = None
+        inputs = []
+        extra_scalars = []
+        for a in args:
+            if isinstance(a, NDArray):
+                if ctx is None:
+                    ctx = a.context
+                inputs.append(a._data)
+            elif isinstance(a, (int, float)) and "scalar" in op.param_fields:
+                extra_scalars.append(a)
+            else:
+                inputs.append(jnp.asarray(a))
+        if extra_scalars and "scalar" not in kwargs:
+            kwargs["scalar"] = extra_scalars[0]
+        params = op.parse_params(kwargs)
+        rng = _random.next_key() if op.need_rng else None
+        outs, _ = op.apply(params, inputs, aux=[], is_train=False, rng=rng)
+        ctx = ctx or current_context()
+        if out is not None:
+            out._set_data(outs[0].astype(out._data.dtype))
+            return out
+        res = [NDArray(o, ctx) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc or ("Imperative function for op %s" % op.name)
+    return fn
+
+
+def install(ndarray_module, symbol_module):
+    from ..symbol import _make_op_func
+
+    for name, op in sorted(REGISTRY.items()):
+        if op.imperative and not hasattr(ndarray_module, name):
+            setattr(ndarray_module, name, _make_imperative(op))
+        if not hasattr(symbol_module, name):
+            setattr(symbol_module, name, _make_op_func(op, name))

@@ -1,0 +1,428 @@
+"""Output/loss ops with loss-layer backward semantics.
+
+TPU-native redesign of the reference output layers (ref:
+src/operator/softmax_output-inl.h:386, regression_output-inl.h,
+svm_output-inl.h, make_loss-inl.h). These ops are special in the reference:
+their Backward *ignores the incoming out_grad* and writes the loss gradient
+directly (e.g. softmax - onehot(label)). We reproduce that with
+``jax.custom_vjp`` closures: the executor seeds their cotangent with ones
+and the custom bwd substitutes the loss gradient, so `Executor.backward()`
+with no head gradients behaves exactly like the reference
+(SURVEY §2.5, include/mxnet/operator.h DeclareBackwardDependency).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import Field, OpDef, register
+
+
+def _softmax_output_factory(params):
+    grad_scale = params["grad_scale"]
+    ignore_label = params["ignore_label"]
+    use_ignore = params["use_ignore"]
+    multi_output = params["multi_output"]
+    preserve_shape = params["preserve_shape"]
+    normalization = params["normalization"]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _forward(data)
+
+    def _forward(data):
+        if multi_output:
+            return jax.nn.softmax(data, axis=1)
+        if preserve_shape:
+            return jax.nn.softmax(data, axis=-1)
+        n = data.shape[0]
+        from .pallas_kernels import fused_softmax
+
+        return fused_softmax(data.reshape(n, -1)).reshape(data.shape)
+
+    def fwd(data, label):
+        return f(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g  # loss-layer semantics: out_grad ignored (ref: softmax_output-inl.h Backward)
+        if multi_output:
+            prob = _forward(data)
+            c = data.shape[1]
+            lab = label.astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
+            # move class axis of onehot (last) to axis 1
+            onehot = jnp.moveaxis(onehot, -1, 1)
+            grad = prob - onehot
+            valid = jnp.not_equal(label, ignore_label)
+            if use_ignore:
+                grad = grad * valid.astype(data.dtype)[:, None]
+            denom = 1.0
+            if normalization == "batch":
+                denom = float(_np.prod(label.shape))
+            elif normalization == "valid":
+                denom = jnp.maximum(jnp.sum(valid.astype(data.dtype)), 1.0)
+            grad = grad * (grad_scale / denom)
+        else:
+            n = data.shape[0]
+            flat = data.reshape(n, -1)
+            c = flat.shape[1]
+            lab = label.reshape(n).astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
+            grad = jax.nn.softmax(flat, axis=-1) - onehot
+            valid = jnp.not_equal(label.reshape(n), ignore_label)
+            if use_ignore:
+                grad = grad * valid.astype(data.dtype)[:, None]
+            denom = 1.0
+            if normalization == "batch":
+                denom = float(n)
+            elif normalization == "valid":
+                denom = jnp.maximum(jnp.sum(valid.astype(data.dtype)), 1.0)
+            grad = (grad * (grad_scale / denom)).reshape(data.shape)
+        return grad, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _softmax_output_fwd(params, inputs, aux, is_train, rng):
+    f = _softmax_output_factory(params)
+    return [f(inputs[0], inputs[1])], []
+
+
+def _softmax_output_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("SoftmaxOutput: data shape unknown")
+    d = in_shapes[0]
+    if params["multi_output"]:
+        lshape = (d[0],) + d[2:]
+    else:
+        lshape = (d[0],)
+    return [d, lshape], [d], []
+
+
+_SOFTMAX_PARAMS = {
+    "grad_scale": Field("float", default=1.0),
+    "ignore_label": Field("float", default=-1.0),
+    "multi_output": Field("bool", default=False),
+    "use_ignore": Field("bool", default=False),
+    "preserve_shape": Field("bool", default=False),
+    "normalization": Field("str", default="null", enum=["null", "batch", "valid"]),
+    "out_grad": Field("bool", default=False),
+}
+
+register(
+    OpDef(
+        "SoftmaxOutput",
+        _softmax_output_fwd,
+        params=dict(_SOFTMAX_PARAMS),
+        arguments=("data", "label"),
+        infer_shape=_softmax_output_shape,
+        no_head_grad=True,
+    )
+)
+
+# deprecated alias (ref: src/operator/softmax_output.cc registers "Softmax" too)
+from .registry import REGISTRY as _R
+
+_R["Softmax"] = _R["SoftmaxOutput"]
+
+
+def _regression_factory(grad_fn, act_fn, grad_scale):
+    @jax.custom_vjp
+    def f(data, label):
+        return act_fn(data)
+
+    def fwd(data, label):
+        return f(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g
+        out = act_fn(data)
+        n = data.shape[0]
+        grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / 1.0)
+        return grad.astype(data.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _make_regression(name, act_fn, grad_fn):
+    """ref: src/operator/regression_output-inl.h — grad = f(out) - label
+    family, Backward ignores out_grad."""
+
+    def op_fwd(params, inputs, aux, is_train, rng):
+        f = _regression_factory(grad_fn, act_fn, params["grad_scale"])
+        return [f(inputs[0], inputs[1])], []
+
+    def ishape(params, in_shapes):
+        if in_shapes[0] is None:
+            raise MXNetError("%s: data shape unknown" % name)
+        return [in_shapes[0], in_shapes[0]], [in_shapes[0]], []
+
+    register(
+        OpDef(
+            name,
+            op_fwd,
+            params={"grad_scale": Field("float", default=1.0)},
+            arguments=("data", "label"),
+            infer_shape=ishape,
+            no_head_grad=True,
+        )
+    )
+
+
+_make_regression(
+    "LinearRegressionOutput", lambda x: x, lambda out, label: out - label
+)
+_make_regression(
+    "MAERegressionOutput", lambda x: x, lambda out, label: jnp.sign(out - label)
+)
+_make_regression(
+    "LogisticRegressionOutput", jax.nn.sigmoid, lambda out, label: out - label
+)
+
+
+# -- MakeLoss (ref: src/operator/make_loss-inl.h) ------------------------------
+def _make_loss_fwd(params, inputs, aux, is_train, rng):
+    grad_scale = params["grad_scale"]
+    normalization = params["normalization"]
+    valid_thresh = params["valid_thresh"]
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x  # residual carries shape+dtype AND the normalizer data
+
+    def bwd(res, g):
+        del g
+        # normalization (ref: make_loss-inl.h Backward): "valid" divides
+        # by the count of loss elements above valid_thresh (for masked
+        # losses like SSD's smooth_l1 that is the number of live
+        # coordinates — without it the summed gradient scales with the
+        # anchor count and drowns every other loss sharing the trunk);
+        # "batch" divides by batch size
+        if normalization == "valid":
+            denom = jnp.maximum(
+                jnp.sum((res > valid_thresh).astype(res.dtype)), 1.0)
+        elif normalization == "batch":
+            denom = float(res.shape[0])
+        else:
+            denom = 1.0
+        return (jnp.full_like(res, grad_scale) / denom,)
+
+    f.defvjp(fwd, bwd)
+    return [f(inputs[0])], []
+
+
+register(
+    OpDef(
+        "MakeLoss",
+        _make_loss_fwd,
+        params={
+            "grad_scale": Field("float", default=1.0),
+            "valid_thresh": Field("float", default=0.0),
+            "normalization": Field("str", default="null", enum=["null", "batch", "valid"]),
+        },
+        no_head_grad=True,
+    )
+)
+
+
+# -- SVMOutput (ref: src/operator/svm_output-inl.h) ----------------------------
+def _svm_output_fwd(params, inputs, aux, is_train, rng):
+    margin = params["margin"]
+    reg = params["regularization_coefficient"]
+    use_linear = params["use_linear"]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g
+        n, c = data.shape[0], data.shape[1]
+        lab = label.reshape(n).astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, c, dtype=data.dtype)
+        score_correct = jnp.sum(data * onehot, axis=1, keepdims=True)
+        if use_linear:  # L1-SVM hinge
+            viol = ((data - score_correct + margin) > 0).astype(data.dtype) * (1 - onehot)
+            grad = viol - onehot * jnp.sum(viol, axis=1, keepdims=True)
+        else:  # L2-SVM squared hinge
+            m = jnp.maximum(0.0, data - score_correct + margin) * (1 - onehot)
+            grad = 2.0 * m - onehot * jnp.sum(2.0 * m, axis=1, keepdims=True)
+        return (reg * grad).astype(data.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return [f(inputs[0], inputs[1])], []
+
+
+register(
+    OpDef(
+        "SVMOutput",
+        _svm_output_fwd,
+        params={
+            "margin": Field("float", default=1.0),
+            "regularization_coefficient": Field("float", default=1.0),
+            "use_linear": Field("bool", default=False),
+        },
+        arguments=("data", "label"),
+        infer_shape=lambda p, s: ([s[0], (s[0][0],)], [s[0]], []),
+        no_head_grad=True,
+    )
+)
+
+
+# ---------------------------------------------------------------------------
+# WarpCTC (ref: plugin/warpctc/warpctc-inl.h)
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels):
+    """Batched CTC negative log-likelihood in log space.
+
+    TPU-native replacement for Baidu warp-ctc's compute_ctc_loss
+    (ref: plugin/warpctc/warpctc-inl.h:183-194): the standard
+    alpha-recursion over the blank-extended label sequence, as one
+    ``lax.scan`` over time so XLA compiles a single fused loop — and,
+    because it is pure jnp/lax, the activation gradient comes from jax
+    autodiff instead of warp-ctc's hand-written kernel.
+
+    log_probs: (T, B, A) log-softmax activations, blank index 0.
+    labels: (B, L) int labels, 0 = padding (reference removeBlank strips
+    zeros anywhere in the row, warpctc-inl.h:101-110 — we left-pack).
+    Returns (B,) positive costs.
+    """
+    from jax import lax
+
+    T, B, A = log_probs.shape
+    L = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+
+    # left-pack nonzero labels per row (stable): reference strips blanks
+    # wherever they appear, not only trailing padding
+    nonblank = labels != 0
+    order = jnp.argsort(~nonblank, axis=1, stable=True)
+    packed = jnp.take_along_axis(labels, order, axis=1)
+    label_len = nonblank.sum(axis=1)
+
+    # blank-extended sequence z = [0, l1, 0, l2, ..., lL, 0], S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.zeros((B, S), jnp.int32).at[:, 1::2].set(packed)
+    s_len = 2 * label_len + 1
+
+    neg_inf = jnp.array(-1e30, log_probs.dtype)
+    pos = jnp.arange(S)
+    # transition s-2 -> s allowed for label states whose label differs from
+    # the one two back (repeated labels must pass through the blank)
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    allow_skip = (ext != 0) & (ext != ext_m2)
+    in_seq = pos[None, :] < s_len[:, None]
+
+    def emit(logp_t):
+        return jnp.take_along_axis(logp_t, ext, axis=1)  # (B, S)
+
+    alpha0 = jnp.where(pos[None, :] < 2, emit(log_probs[0]), neg_inf)
+    alpha0 = jnp.where(in_seq, alpha0, neg_inf)
+    # a label_len of 0 leaves only the blank state
+    alpha0 = jnp.where((pos[None, :] == 1) & (label_len[:, None] == 0),
+                       neg_inf, alpha0)
+
+    def step(alpha, logp_t):
+        shift1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :S]
+        shift2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg_inf)[:, :S]
+        a = jnp.logaddexp(alpha, shift1)
+        a = jnp.where(allow_skip, jnp.logaddexp(a, shift2), a)
+        a = a + emit(logp_t)
+        a = jnp.where(in_seq, a, neg_inf)
+        return a, None
+
+    alpha, _ = lax.scan(step, alpha0, log_probs[1:])
+    last = jnp.take_along_axis(alpha, (s_len - 1)[:, None], axis=1)[:, 0]
+    prev = jnp.take_along_axis(
+        alpha, jnp.maximum(s_len - 2, 0)[:, None], axis=1)[:, 0]
+    prev = jnp.where(s_len > 1, prev, neg_inf)
+    return -jnp.logaddexp(last, prev)
+
+
+def _warpctc_fwd(params, inputs, aux, is_train, rng):
+    input_length = int(params["input_length"])
+    label_length = int(params["label_length"])
+    if input_length <= 0 or label_length <= 0:
+        raise MXNetError("WarpCTC requires input_length and label_length > 0")
+    data, label = inputs[0], inputs[1]
+    if data.ndim != 2:
+        raise MXNetError("WarpCTC input data shape should be 2: (t*n, p)")
+    T = input_length
+    if data.shape[0] % T != 0:
+        raise MXNetError(
+            "WarpCTC: data rows %d not divisible by input_length %d"
+            % (data.shape[0], T))
+    B = data.shape[0] // T
+    A = data.shape[1]
+
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.softmax(data, axis=-1)
+
+    def fwd(data, label):
+        return f(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        del g  # loss head: grads written directly (warpctc-inl.h Backward)
+
+        def total_cost(d):
+            logp = jax.nn.log_softmax(
+                d.astype(jnp.float32).reshape(T, B, A), axis=-1)
+            lab = label.reshape(B, label_length)
+            return jnp.sum(ctc_loss(logp, lab))
+
+        gd = jax.grad(total_cost)(data).astype(data.dtype)
+        return gd, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return [f(data, label)], []
+
+
+def _warpctc_infer_shape(params, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        raise MXNetError("WarpCTC: data shape required")
+    T = int(params["input_length"])
+    if T <= 0 or int(params["label_length"]) <= 0:
+        raise MXNetError("WarpCTC requires input_length and label_length > 0")
+    if d[0] % T != 0:
+        raise MXNetError(
+            "WarpCTC: data rows %d not divisible by input_length %d"
+            % (d[0], T))
+    B = d[0] // T
+    label = in_shapes[1] if in_shapes[1] is not None else (
+        B * int(params["label_length"]),)
+    return [tuple(d), tuple(label)], [tuple(d)], []
+
+
+register(
+    OpDef(
+        "WarpCTC",
+        _warpctc_fwd,
+        params={
+            "label_length": Field("int", default=0),
+            "input_length": Field("int", default=0),
+        },
+        arguments=("data", "label"),
+        infer_shape=_warpctc_infer_shape,
+        no_head_grad=True,
+        doc="CTC loss layer (ref: plugin/warpctc/warpctc-inl.h); "
+            "forward = softmax over the alphabet, backward = CTC gradient "
+            "wrt activations, blank index 0.",
+    )
+)

@@ -1,0 +1,256 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+This is the TPU-native analog of the reference's cuDNN fast paths: the
+reference swaps in ``cudnn_*-inl.h`` implementations at op-creation time
+when USE_CUDNN is set (ref: src/operator/convolution.cc op-creation switch,
+SURVEY §2.5); we swap in Pallas kernels when running on a TPU backend.
+XLA already fuses elementwise chains into matmuls/convs (that is mshadow's
+expression-template job, SURVEY §2.13), so kernels here are reserved for
+patterns XLA does not schedule optimally by itself:
+
+- ``flash_attention``: blockwise softmax(QK^T)V with running log-sum-exp
+  accumulation in VMEM — avoids materialising the [T, T] score matrix in
+  HBM. Used by the transformer flagship model and available to user code.
+- ``fused_softmax``: one-pass row softmax (max/exp/sum/div in VMEM) used by
+  SoftmaxOutput's forward on large vocabularies.
+
+Enable/disable with MXNET_PALLAS=1/0; by default kernels are active only
+when ``jax.default_backend() == 'tpu'``. Off-TPU (tests) the kernels run
+in Pallas interpret mode so CPU CI exercises the same code path.
+Shapes that violate a kernel's constraints silently fall back to the plain
+jnp implementation — same contract as the reference falling back to the
+non-cuDNN path.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["enabled", "flash_attention", "fused_softmax"]
+
+
+def _on_tpu():
+    """True when computation actually lands on TPU: honours the pinned
+    default device (tests pin CPU while the TPU plugin is still loaded,
+    so ``jax.default_backend()`` alone is the wrong signal)."""
+    import jax
+
+    try:
+        dev = jax.config.jax_default_device
+        if dev is not None:
+            return dev.platform == "tpu"
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - jax init failure
+        return False
+
+
+def enabled():
+    v = os.environ.get("MXNET_PALLAS", "").strip().lower()
+    if v in ("0", "false", "off"):
+        return False
+    if v in ("1", "true", "on"):
+        return True
+    return _on_tpu()
+
+
+def _interpret():
+    """Interpret mode off-TPU so the kernels are testable on CPU."""
+    return not _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+
+def _attention_reference(q, k, v, causal, scale):
+    """Plain XLA attention, also the backward path for the Pallas forward."""
+    import jax.numpy as jnp
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        iq = jnp.arange(tq)[:, None]
+        ik = jnp.arange(tk)[None, :]
+        scores = jnp.where(ik <= iq, scores, -1e30)
+    import jax
+
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                      block_q, block_k, n_k):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    bq, d = q.shape
+
+    def body(i, carry):
+        acc, l, m = carry
+        kblk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            qpos = iq * block_q + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = i * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * alpha[:, None] + pv
+        return acc_new, l_new, m_new
+
+    acc0 = jnp.zeros((bq, v_ref.shape[-1]), jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    m0 = jnp.full((bq,), -1e30, jnp.float32)
+    if causal:
+        # only k blocks whose start can be <= the last q position of this block
+        upper = lax.div((iq + 1) * block_q - 1, block_k) + 1
+        upper = jnp.minimum(upper, n_k)
+    else:
+        upper = n_k
+    acc, l, _ = lax.fori_loop(0, upper, body, (acc0, l0, m0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(q, k, v, causal, scale, block_q, block_k):
+    import jax
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, v.shape[-1])
+    n_q = tq // block_q
+    n_k = tk // block_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, tq, v.shape[-1]), q.dtype),
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, v3.shape[-1]), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, v3.shape[-1]), lambda i, j: (i, j, 0)),
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return out.reshape(b, h, tq, v.shape[-1])
+
+
+def flash_attention(q, k, v, causal=True, scale=None,
+                    block_q=128, block_k=128):
+    """Blockwise-softmax attention. q,k,v: [batch, heads, time, d_head].
+
+    Forward runs as a Pallas kernel (scores never hit HBM); backward
+    recomputes attention with the plain XLA path under ``jax.vjp`` —
+    gradient-checkpoint semantics, exactly the memonger trade the reference
+    makes with mirror nodes (ref: src/symbol/static_graph.cc:404).
+    Falls back to plain XLA when shapes don't tile (time not divisible by
+    block, or kernels disabled).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+    tq, tk = q.shape[2], k.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    # Blocks must respect Mosaic tiling on hardware (sublane multiple of
+    # 16 for bf16, lane dim 128); enforced uniformly so CPU interpret mode
+    # takes the same path the TPU compile would.
+    aligned = block_q % 16 == 0 and block_k % 128 == 0
+    usable = (
+        enabled()
+        and q.ndim == 4
+        and aligned
+        and tq % block_q == 0
+        and tk % block_k == 0
+        # full K AND V per head are resident in VMEM per grid cell
+        and tk * (q.shape[-1] + v.shape[-1]) * 4 <= 8 * 1024 * 1024
+    )
+    if not usable:
+        return _attention_reference(q, k, v, causal, scale)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return _flash_attention_pallas(q, k, v, causal, scale, block_q, block_k)
+
+    def fwd(q, k, v):
+        return attn(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, pullback = jax.vjp(
+            lambda q, k, v: _attention_reference(q, k, v, causal, scale), q, k, v
+        )
+        return pullback(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# fused row softmax
+# ---------------------------------------------------------------------------
+
+
+def _softmax_kernel(x_ref, o_ref):
+    import jax.numpy as jnp
+
+    x = x_ref[...].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def fused_softmax(x):
+    """One-pass softmax over the last axis of a 2-D array.
+
+    Pallas analog of the reference's cuDNN softmax fast path
+    (ref: src/operator/cudnn_softmax_activation-inl.h). Rows are tiled
+    across the grid; each row block is reduced entirely in VMEM. Falls back
+    to jax.nn.softmax when disabled or when a row would overflow VMEM.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if not (enabled() and x.ndim == 2):
+        return jax.nn.softmax(x, axis=-1)
+    n, c = x.shape
+    if c * 4 > 4 * 1024 * 1024:  # one f32 row block must fit VMEM
+        return jax.nn.softmax(x, axis=-1)
+    block_rows = 256
+    while block_rows > 1 and (n % block_rows != 0 or block_rows * c * 4 > 8 * 1024 * 1024):
+        block_rows //= 2
+
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _softmax_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(n // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
+        interpret=_interpret(),
+    )(x)

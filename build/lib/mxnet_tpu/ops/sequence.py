@@ -1,0 +1,296 @@
+"""Sequence ops and the fused RNN op.
+
+TPU-native redesign of src/operator/sequence_last-inl.h,
+sequence_mask-inl.h, sequence_reverse-inl.h and the cuDNN-only RNN op
+(ref: src/operator/cudnn_rnn-inl.h, 513 LoC; the CPU path of rnn.cc:13 is
+LOG(FATAL) in the reference). Here RNN is implemented as a ``lax.scan``
+over time — the XLA-idiomatic fused recurrence: the per-step matmuls hit
+the MXU, scan keeps the loop inside one compiled program, and jax.vjp
+through scan gives BPTT for free (replacing cudnn_rnn backward).
+
+Layout follows the reference: time-major ``(seq_len, batch, feature)``.
+The flat ``parameters`` vector layout is documented in ``rnn_param_size``:
+per layer and direction: W_ih (G*H, I), W_hh (G*H, H), b_ih, b_hh — gate
+order i,f,g,o for LSTM and r,z,n for GRU (cuDNN order, so checkpoints
+trained elsewhere can be repacked deterministically).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import Field, OpDef, register
+
+
+# -- SequenceLast / SequenceMask / SequenceReverse ----------------------------
+def _seq_args(params):
+    if params.get("use_sequence_length"):
+        return ["data", "sequence_length"]
+    return ["data"]
+
+
+def _seq_last_fwd(params, inputs, aux, is_train, rng):
+    data = inputs[0]
+    if params["use_sequence_length"]:
+        lengths = inputs[1].astype(jnp.int32)
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            data, idx[None, :, None].astype(jnp.int32), axis=0
+        )[0] if data.ndim == 3 else data[idx, jnp.arange(data.shape[1])]
+        # general: gather per batch column
+        out = data[idx, jnp.arange(data.shape[1])]
+    else:
+        out = data[-1]
+    return [out], []
+
+
+def _seq_last_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("SequenceLast: data shape unknown")
+    s = in_shapes[0]
+    ins = [s] + ([(s[1],)] if params["use_sequence_length"] else [])
+    return ins, [s[1:]], []
+
+
+register(
+    OpDef(
+        "SequenceLast",
+        _seq_last_fwd,
+        params={"use_sequence_length": Field("bool", default=False)},
+        arguments=_seq_args,
+        infer_shape=_seq_last_shape,
+    )
+)
+
+
+def _seq_mask_fwd(params, inputs, aux, is_train, rng):
+    data = inputs[0]
+    if not params["use_sequence_length"]:
+        return [data], []
+    lengths = inputs[1].astype(jnp.int32)
+    t = jnp.arange(data.shape[0])
+    mask = t[:, None] < lengths[None, :]  # (T, N)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return [jnp.where(mask, data, jnp.asarray(params["value"], data.dtype))], []
+
+
+def _seq_io_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("sequence op: data shape unknown")
+    s = in_shapes[0]
+    ins = [s] + ([(s[1],)] if params["use_sequence_length"] else [])
+    return ins, [s], []
+
+
+register(
+    OpDef(
+        "SequenceMask",
+        _seq_mask_fwd,
+        params={
+            "use_sequence_length": Field("bool", default=False),
+            "value": Field("float", default=0.0),
+        },
+        arguments=_seq_args,
+        infer_shape=_seq_io_shape,
+    )
+)
+
+
+def _seq_reverse_fwd(params, inputs, aux, is_train, rng):
+    data = inputs[0]
+    if not params["use_sequence_length"]:
+        return [jnp.flip(data, axis=0)], []
+    lengths = inputs[1].astype(jnp.int32)
+    T = data.shape[0]
+    t = jnp.arange(T)
+    # index of source row for output row t in column n: len-1-t when t<len else t
+    src = jnp.where(t[:, None] < lengths[None, :], lengths[None, :] - 1 - t[:, None], t[:, None])
+    out = data[src, jnp.arange(data.shape[1])[None, :]]
+    return [out], []
+
+
+register(
+    OpDef(
+        "SequenceReverse",
+        _seq_reverse_fwd,
+        params={"use_sequence_length": Field("bool", default=False)},
+        arguments=_seq_args,
+        infer_shape=_seq_io_shape,
+    )
+)
+
+
+# -- RNN -----------------------------------------------------------------------
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    """Total flat parameter count; layout documented in module docstring."""
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    total = 0
+    for l in range(num_layers):
+        isz = input_size if l == 0 else state_size * D
+        total += D * (G * state_size * isz + G * state_size * state_size + 2 * G * state_size)
+    return total
+
+
+def _slice_layer_params(flat, mode, input_size, state_size, num_layers, bidirectional):
+    G = _GATES[mode]
+    H = state_size
+    D = 2 if bidirectional else 1
+    off = 0
+    layers = []
+    for l in range(num_layers):
+        isz = input_size if l == 0 else H * D
+        dirs = []
+        for _ in range(D):
+            w_ih = flat[off:off + G * H * isz].reshape(G * H, isz); off += G * H * isz
+            w_hh = flat[off:off + G * H * H].reshape(G * H, H); off += G * H * H
+            b_ih = flat[off:off + G * H]; off += G * H
+            b_hh = flat[off:off + G * H]; off += G * H
+            dirs.append((w_ih, w_hh, b_ih, b_hh))
+        layers.append(dirs)
+    return layers
+
+
+def _cell_step(mode, H):
+    def step(carry, gates_x, w_hh, b_hh):
+        if mode == "lstm":
+            h, c = carry
+            gates = gates_x + jnp.dot(h, w_hh.T) + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+        if mode == "gru":
+            h = carry[0]
+            rz_x, n_x = gates_x[..., : 2 * H], gates_x[..., 2 * H:]
+            hh = jnp.dot(h, w_hh.T) + b_hh
+            rz_h, n_h = hh[..., : 2 * H], hh[..., 2 * H:]
+            r, z = jnp.split(jax.nn.sigmoid(rz_x + rz_h), 2, axis=-1)
+            n = jnp.tanh(n_x + r * n_h)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+        h = carry[0]
+        pre = gates_x + jnp.dot(h, w_hh.T) + b_hh
+        h2 = jnp.maximum(pre, 0) if mode == "rnn_relu" else jnp.tanh(pre)
+        return (h2,), h2
+
+    return step
+
+
+def _run_direction(x, h0, c0, wparams, mode, H, reverse):
+    w_ih, w_hh, b_ih, b_hh = wparams
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    gates_x = jnp.einsum("tbi,gi->tbg", x, w_ih) + b_ih  # precompute input proj
+    step = _cell_step(mode, H)
+
+    def scan_fn(carry, gx):
+        return step(carry, gx, w_hh, b_hh)
+
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    carry, ys = jax.lax.scan(scan_fn, carry0, gates_x)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    if mode == "lstm":
+        return ys, carry[0], carry[1]
+    return ys, carry[0], None
+
+
+def _rnn_fwd(params, inputs, aux, is_train, rng):
+    mode = params["mode"]
+    H = params["state_size"]
+    L = params["num_layers"]
+    bidir = params["bidirectional"]
+    D = 2 if bidir else 1
+    data = inputs[0]
+    flat = inputs[1]
+    state = inputs[2]
+    c_state = inputs[3] if mode == "lstm" else None
+    T, N, I = data.shape
+    layers = _slice_layer_params(flat, mode, I, H, L, bidir)
+    x = data
+    h_out, c_out = [], []
+    for l, dirs in enumerate(layers):
+        outs = []
+        for d, wp in enumerate(dirs):
+            h0 = state[l * D + d]
+            c0 = c_state[l * D + d] if c_state is not None else None
+            ys, hT, cT = _run_direction(x, h0, c0, wp, mode, H, reverse=(d == 1))
+            outs.append(ys)
+            h_out.append(hT)
+            if cT is not None:
+                c_out.append(cT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if is_train and params["p"] > 0 and l < L - 1 and rng is not None:
+            keep = 1.0 - params["p"]
+            mask = jax.random.bernoulli(jax.random.fold_in(rng, l), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    outputs = [x]
+    if params["state_outputs"]:
+        outputs.append(jnp.stack(h_out))
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_out))
+    return outputs, []
+
+
+def _rnn_args(params):
+    base = ["data", "parameters", "state"]
+    if params.get("mode") == "lstm":
+        base.append("state_cell")
+    return base
+
+
+def _rnn_outputs(params):
+    outs = ["output"]
+    if params.get("state_outputs"):
+        outs.append("state")
+        if params.get("mode") == "lstm":
+            outs.append("state_cell")
+    return outs
+
+
+def _rnn_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("RNN: data shape unknown")
+    T, N, I = in_shapes[0]
+    H, L = params["state_size"], params["num_layers"]
+    D = 2 if params["bidirectional"] else 1
+    psize = rnn_param_size(params["mode"], I, H, L, params["bidirectional"])
+    sshape = (L * D, N, H)
+    ins = [in_shapes[0], (psize,), sshape]
+    if params["mode"] == "lstm":
+        ins.append(sshape)
+    outs = [(T, N, H * D)]
+    if params["state_outputs"]:
+        outs.append(sshape)
+        if params["mode"] == "lstm":
+            outs.append(sshape)
+    return ins, outs, []
+
+
+register(
+    OpDef(
+        "RNN",
+        _rnn_fwd,
+        params={
+            "state_size": Field("int", required=True),
+            "num_layers": Field("int", required=True),
+            "mode": Field("str", required=True, enum=list(_GATES)),
+            "bidirectional": Field("bool", default=False),
+            "p": Field("float", default=0.0),
+            "state_outputs": Field("bool", default=False),
+            "pkeep_": Field("any", default=None),
+        },
+        arguments=_rnn_args,
+        outputs=_rnn_outputs,
+        infer_shape=_rnn_shape,
+        need_rng=True,
+    )
+)

@@ -1,0 +1,560 @@
+"""Simple tensor ops: elementwise, scalar, reduction, broadcast, matrix.
+
+TPU-native replacement for the reference's simple-op layer
+(ref: src/operator/elementwise_unary_op-inl.h, elementwise_binary_op-inl.h:213-249,
+broadcast_reduce_op-inl.h:394-479, matrix_op-inl.h, smooth_l1_unary-inl.h,
+softmax_cross_entropy-inl.h). Each mshadow scalar functor
+(ref: src/operator/mshadow_op.h) becomes the corresponding jnp call; XLA
+fuses them, which is precisely what mshadow expression templates did on GPU
+(SURVEY §2.13). Gradients come from jax.vjp over the bound graph — no
+per-op backward declarations needed.
+
+Every op here is exposed both imperatively (mx.nd.exp) and symbolically
+(mx.sym.exp), like MXNET_REGISTER_SIMPLE_OP did.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import Field, OpDef, register, scalar_op, simple_binary, simple_unary
+
+# -- elementwise unary (ref: mshadow_op.h functors) ----------------------------
+simple_unary("abs", jnp.abs)
+simple_unary("ceil", jnp.ceil)
+simple_unary("cos", jnp.cos)
+simple_unary("exp", jnp.exp)
+simple_unary("floor", jnp.floor)
+simple_unary("log", jnp.log)
+simple_unary("round", jnp.round)
+simple_unary("rsqrt", lambda x: 1.0 / jnp.sqrt(x))
+simple_unary("sign", jnp.sign)
+simple_unary("sin", jnp.sin)
+simple_unary("sqrt", jnp.sqrt)
+simple_unary("square", jnp.square)
+simple_unary("negative", jnp.negative, aliases=("_neg",))
+simple_unary("tanh_op", jnp.tanh, imperative=False)  # tanh exposed via Activation too
+
+# -- elementwise binary (ref: elementwise_binary_op-inl.h:213-249) -------------
+simple_binary("_plus", jnp.add, aliases=("_add", "elemwise_add"))
+simple_binary("_minus", jnp.subtract, aliases=("_sub",))
+simple_binary("_mul", jnp.multiply)
+simple_binary("_div", jnp.divide)
+simple_binary("_power", jnp.power)
+simple_binary("_maximum", jnp.maximum)
+simple_binary("_minimum", jnp.minimum)
+
+# -- scalar variants (ref: operator_util.h kScalar registrations) --------------
+scalar_op("_plus_scalar", lambda x, s: x + s)
+scalar_op("_minus_scalar", lambda x, s: x - s)
+scalar_op("_rminus_scalar", lambda x, s: s - x)
+scalar_op("_mul_scalar", lambda x, s: x * s)
+scalar_op("_div_scalar", lambda x, s: x / s)
+scalar_op("_rdiv_scalar", lambda x, s: s / x)
+scalar_op("_power_scalar", lambda x, s: jnp.power(x, s))
+scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+scalar_op("_maximum_scalar", lambda x, s: jnp.maximum(x, s))
+scalar_op("_minimum_scalar", lambda x, s: jnp.minimum(x, s))
+
+
+# -- clip (ref: ndarray.cc:751 clip NDArray fun + simple op) -------------------
+def _clip_fwd(params, inputs, aux, is_train, rng):
+    return [jnp.clip(inputs[0], params["a_min"], params["a_max"])], []
+
+
+register(
+    OpDef(
+        "clip",
+        _clip_fwd,
+        params={"a_min": Field("float", required=True), "a_max": Field("float", required=True)},
+    )
+)
+
+
+# -- reductions (ref: broadcast_reduce_op-inl.h:394-479) -----------------------
+def _axis_param(params):
+    ax = params.get("axis")
+    if ax is None or ax == ():
+        return None
+    if isinstance(ax, tuple) and len(ax) == 1:
+        return ax[0]
+    return ax
+
+
+def _reduce_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("reduction: input shape unknown")
+    shape = in_shapes[0]
+    ax = _axis_param(params)
+    keepdims = params.get("keepdims", False)
+    if ax is None:
+        out = (1,) if not keepdims else tuple(1 for _ in shape)
+    else:
+        axes = (ax,) if isinstance(ax, int) else tuple(ax)
+        axes = tuple(a % len(shape) for a in axes)
+        if keepdims:
+            out = tuple(1 if i in axes else d for i, d in enumerate(shape))
+        else:
+            out = tuple(d for i, d in enumerate(shape) if i not in axes)
+            if out == ():
+                out = (1,)
+    return [shape], [out], []
+
+
+def _make_reduce(name, jfn, aliases=()):
+    def fwd(params, inputs, aux, is_train, rng):
+        ax = _axis_param(params)
+        keepdims = params.get("keepdims", False)
+        out = jfn(inputs[0], axis=ax, keepdims=keepdims)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return [out], []
+
+    op = register(
+        OpDef(
+            name,
+            fwd,
+            params={
+                "axis": Field("shape", default=None),
+                "keepdims": Field("bool", default=False),
+            },
+            infer_shape=_reduce_shape,
+        )
+    )
+    from .registry import REGISTRY
+
+    for a in aliases:
+        REGISTRY[a] = op
+    return op
+
+
+_make_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_make_reduce("max", jnp.max, aliases=("max_axis",))
+_make_reduce("min", jnp.min, aliases=("min_axis",))
+_make_reduce("mean", jnp.mean)
+
+
+def _norm_fwd(params, inputs, aux, is_train, rng):
+    return [jnp.sqrt(jnp.sum(jnp.square(inputs[0]))).reshape(1)], []
+
+
+register(
+    OpDef(
+        "norm",
+        _norm_fwd,
+        infer_shape=lambda p, s: ([s[0]], [(1,)], []),
+    )
+)
+
+
+def _argmax_channel_fwd(params, inputs, aux, is_train, rng):
+    # ref: broadcast_reduce_op-inl.h argmax over channel (axis 1) returning floats
+    return [jnp.argmax(inputs[0], axis=1).astype(inputs[0].dtype)], []
+
+
+register(
+    OpDef(
+        "argmax_channel",
+        _argmax_channel_fwd,
+        infer_shape=lambda p, s: ([s[0]], [(s[0][0],)], []),
+    )
+)
+
+
+def _make_arg(name, jfn):
+    def fwd(params, inputs, aux, is_train, rng):
+        ax = params.get("axis")
+        out = jfn(inputs[0], axis=ax)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return [out.astype(inputs[0].dtype)], []
+
+    def ishape(params, s):
+        if s[0] is None:
+            raise MXNetError("%s: input shape unknown" % name)
+        ax = params.get("axis")
+        if ax is None:
+            return [s[0]], [(1,)], []
+        ax = ax % len(s[0])
+        out = tuple(d for i, d in enumerate(s[0]) if i != ax) or (1,)
+        return [s[0]], [out], []
+
+    register(OpDef(name, fwd, params={"axis": Field("int", default=None)}, infer_shape=ishape))
+
+
+_make_arg("argmax", jnp.argmax)
+_make_arg("argmin", jnp.argmin)
+
+
+# -- broadcast ops (ref: broadcast_reduce_op-inl.h broadcast_{axis,to}) --------
+def _broadcast_binary_shape(params, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        known = a or b
+        if known is None:
+            raise MXNetError("broadcast op: no input shape known")
+        return [known, known], [known], []
+    out = tuple(_np.broadcast_shapes(a, b))
+    return [a, b], [out], []
+
+
+for _nm, _fn in [
+    ("broadcast_plus", jnp.add),
+    ("broadcast_minus", jnp.subtract),
+    ("broadcast_mul", jnp.multiply),
+    ("broadcast_div", jnp.divide),
+    ("broadcast_power", jnp.power),
+    ("broadcast_equal", lambda a, b: jnp.equal(a, b).astype(a.dtype)),
+    ("broadcast_greater", lambda a, b: jnp.greater(a, b).astype(a.dtype)),
+    ("broadcast_lesser", lambda a, b: jnp.less(a, b).astype(a.dtype)),
+    ("broadcast_maximum", jnp.maximum),
+    ("broadcast_minimum", jnp.minimum),
+]:
+    simple_binary(_nm, _fn, infer_shape=_broadcast_binary_shape)
+
+
+def _broadcast_axis_fwd(params, inputs, aux, is_train, rng):
+    x = inputs[0]
+    axes = params["axis"]
+    sizes = params["size"]
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return [jnp.broadcast_to(x, tuple(shape))], []
+
+
+def _broadcast_axis_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("broadcast_axis: input shape unknown")
+    shape = list(in_shapes[0])
+    for a, s in zip(params["axis"], params["size"]):
+        if shape[a] != 1:
+            raise MXNetError("broadcast_axis: axis %d is not 1" % a)
+        shape[a] = s
+    return [in_shapes[0]], [tuple(shape)], []
+
+
+register(
+    OpDef(
+        "broadcast_axis",
+        _broadcast_axis_fwd,
+        params={"axis": Field("shape", required=True), "size": Field("shape", required=True)},
+        infer_shape=_broadcast_axis_shape,
+    )
+)
+
+
+def _broadcast_to_fwd(params, inputs, aux, is_train, rng):
+    x = inputs[0]
+    target = list(params["shape"])
+    # 0 in target means keep input dim (ref: broadcast_reduce_op-inl.h)
+    tgt = tuple(x.shape[i] if t == 0 else t for i, t in enumerate(target))
+    return [jnp.broadcast_to(x, tgt)], []
+
+
+def _broadcast_to_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("broadcast_to: input shape unknown")
+    tgt = tuple(
+        in_shapes[0][i] if t == 0 else t for i, t in enumerate(params["shape"])
+    )
+    return [in_shapes[0]], [tgt], []
+
+
+register(
+    OpDef(
+        "broadcast_to",
+        _broadcast_to_fwd,
+        params={"shape": Field("shape", required=True)},
+        infer_shape=_broadcast_to_shape,
+    )
+)
+
+
+# -- matrix ops (ref: matrix_op-inl.h) -----------------------------------------
+def _dot_fwd(params, inputs, aux, is_train, rng):
+    a, b = inputs
+    if params.get("transpose_a"):
+        a = a.T
+    if params.get("transpose_b"):
+        b = b.T
+    # 1-D dot degenerates to inner product returning shape (1,) like the ref
+    if a.ndim == 1 and b.ndim == 1:
+        return [jnp.dot(a, b).reshape(1)], []
+    return [jnp.dot(a, b)], []
+
+
+def _dot_shape(params, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        raise MXNetError("dot: input shapes unknown")
+    ta, tb = params.get("transpose_a"), params.get("transpose_b")
+    if len(a) == 1 and len(b) == 1:
+        return [a, b], [(1,)], []
+    aa = tuple(reversed(a)) if ta else a
+    bb = tuple(reversed(b)) if tb else b
+    if aa[-1] != bb[0]:
+        raise MXNetError("dot shape mismatch: %s x %s" % (aa, bb))
+    return [a, b], [aa[:-1] + bb[1:]], []
+
+
+register(
+    OpDef(
+        "dot",
+        _dot_fwd,
+        params={
+            "transpose_a": Field("bool", default=False),
+            "transpose_b": Field("bool", default=False),
+        },
+        arguments=("lhs", "rhs"),
+        infer_shape=_dot_shape,
+    )
+)
+
+
+def _batch_dot_fwd(params, inputs, aux, is_train, rng):
+    a, b = inputs
+    if params.get("transpose_a"):
+        a = jnp.swapaxes(a, -1, -2)
+    if params.get("transpose_b"):
+        b = jnp.swapaxes(b, -1, -2)
+    return [jnp.matmul(a, b)], []
+
+
+def _batch_dot_shape(params, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        raise MXNetError("batch_dot: input shapes unknown")
+    aa = a[:-2] + (a[-1], a[-2]) if params.get("transpose_a") else a
+    bb = b[:-2] + (b[-1], b[-2]) if params.get("transpose_b") else b
+    return [a, b], [aa[:-1] + (bb[-1],)], []
+
+
+register(
+    OpDef(
+        "batch_dot",
+        _batch_dot_fwd,
+        params={
+            "transpose_a": Field("bool", default=False),
+            "transpose_b": Field("bool", default=False),
+        },
+        arguments=("lhs", "rhs"),
+        infer_shape=_batch_dot_shape,
+    )
+)
+
+
+def _transpose_fwd(params, inputs, aux, is_train, rng):
+    axes = params.get("axes")
+    if not axes:
+        axes = None
+    return [jnp.transpose(inputs[0], axes)], []
+
+
+def _transpose_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("transpose: input shape unknown")
+    s = in_shapes[0]
+    axes = params.get("axes") or tuple(reversed(range(len(s))))
+    return [s], [tuple(s[a] for a in axes)], []
+
+
+register(
+    OpDef(
+        "transpose",
+        _transpose_fwd,
+        params={"axes": Field("shape", default=())},
+        infer_shape=_transpose_shape,
+    )
+)
+
+
+def _expand_dims_fwd(params, inputs, aux, is_train, rng):
+    return [jnp.expand_dims(inputs[0], params["axis"])], []
+
+
+def _expand_dims_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("expand_dims: input shape unknown")
+    s = list(in_shapes[0])
+    s.insert(params["axis"], 1)
+    return [in_shapes[0]], [tuple(s)], []
+
+
+register(
+    OpDef(
+        "expand_dims",
+        _expand_dims_fwd,
+        params={"axis": Field("int", required=True)},
+        infer_shape=_expand_dims_shape,
+    )
+)
+
+
+def _flip_fwd(params, inputs, aux, is_train, rng):
+    return [jnp.flip(inputs[0], params["axis"])], []
+
+
+register(
+    OpDef(
+        "flip",
+        _flip_fwd,
+        params={"axis": Field("int", required=True)},
+        infer_shape=lambda p, s: ([s[0]], [s[0]], []),
+    )
+)
+
+
+def _slice_axis_fwd(params, inputs, aux, is_train, rng):
+    ax, b, e = params["axis"], params["begin"], params["end"]
+    x = inputs[0]
+    if e is None or e == 0:
+        e = x.shape[ax]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(b, e)
+    return [x[tuple(idx)]], []
+
+
+def _slice_axis_shape(params, in_shapes):
+    if in_shapes[0] is None:
+        raise MXNetError("slice_axis: input shape unknown")
+    s = list(in_shapes[0])
+    ax = params["axis"] % len(s)
+    e = params["end"] if params["end"] not in (None, 0) else s[ax]
+    b = params["begin"]
+    if b < 0:
+        b += s[ax]
+    if e < 0:
+        e += s[ax]
+    s[ax] = e - b
+    return [in_shapes[0]], [tuple(s)], []
+
+
+register(
+    OpDef(
+        "slice_axis",
+        _slice_axis_fwd,
+        params={
+            "axis": Field("int", required=True),
+            "begin": Field("int", required=True),
+            "end": Field("int", default=None),
+        },
+        infer_shape=_slice_axis_shape,
+    )
+)
+
+
+def _crop_simple_fwd(params, inputs, aux, is_train, rng):
+    # multi-dim slice (ref: matrix_op-inl.h crop simple-op)
+    x = inputs[0]
+    begin, end = params["begin"], params["end"]
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return [x[idx]], []
+
+
+register(
+    OpDef(
+        "crop_nd",
+        _crop_simple_fwd,
+        params={"begin": Field("shape", required=True), "end": Field("shape", required=True)},
+        infer_shape=lambda p, s: (
+            [s[0]],
+            [tuple(e - b for b, e in zip(p["begin"], p["end"]))],
+            [],
+        ),
+    )
+)
+
+
+# -- smooth_l1 (ref: smooth_l1_unary-inl.h) ------------------------------------
+def _smooth_l1_fwd(params, inputs, aux, is_train, rng):
+    sigma = params["scalar"]
+    s2 = sigma * sigma
+    x = inputs[0]
+    out = jnp.where(
+        jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x), jnp.abs(x) - 0.5 / s2
+    )
+    return [out], []
+
+
+register(
+    OpDef(
+        "smooth_l1",
+        _smooth_l1_fwd,
+        params={"scalar": Field("float", default=1.0)},
+    )
+)
+
+
+# -- softmax_cross_entropy (ref: softmax_cross_entropy-inl.h) ------------------
+def _sce_fwd(params, inputs, aux, is_train, rng):
+    data, label = inputs
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
+    return [jnp.sum(nll).reshape(1)], []
+
+
+register(
+    OpDef(
+        "softmax_cross_entropy",
+        _sce_fwd,
+        arguments=("data", "label"),
+        infer_shape=lambda p, s: ([s[0], (s[0][0],)], [(1,)], []),
+    )
+)
+
+
+# -- element_mask (ref: elementwise_binary_op element_mask) --------------------
+def _element_mask_fwd(params, inputs, aux, is_train, rng):
+    data, mask = inputs
+    m = mask.reshape(mask.shape[0], *([1] * (data.ndim - 1)))
+    return [data * m.astype(data.dtype)], []
+
+
+register(
+    OpDef(
+        "element_mask",
+        _element_mask_fwd,
+        arguments=("data", "mask"),
+        infer_shape=lambda p, s: ([s[0], (s[0][0],)], [s[0]], []),
+    )
+)
+
+
+# -- NDArray-only functions (ref: src/ndarray/ndarray.cc:723-871) --------------
+def _choose_element_0index_fwd(params, inputs, aux, is_train, rng):
+    # out[i] = lhs[i, rhs[i]] (ref: ndarray.cc choose_element_0index)
+    lhs, rhs = inputs
+    idx = rhs.astype(jnp.int32)
+    return [jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]], []
+
+
+register(
+    OpDef(
+        "choose_element_0index",
+        _choose_element_0index_fwd,
+        arguments=("lhs", "rhs"),
+        infer_shape=lambda p, s: ([s[0], (s[0][0],)], [(s[0][0],)], []),
+    )
+)
+
+
+def _fill_element_0index_fwd(params, inputs, aux, is_train, rng):
+    # lhs[i, mhs[i]] = rhs[i] (ref: ndarray.cc fill_element_0index)
+    lhs, mhs, rhs = inputs
+    idx = mhs.astype(jnp.int32)
+    rows = jnp.arange(lhs.shape[0])
+    return [lhs.at[rows, idx].set(rhs)], []
+
+
+register(
+    OpDef(
+        "fill_element_0index",
+        _fill_element_0index_fwd,
+        arguments=("lhs", "mhs", "rhs"),
+        infer_shape=lambda p, s: ([s[0], (s[0][0],), (s[0][0],)], [s[0]], []),
+    )
+)

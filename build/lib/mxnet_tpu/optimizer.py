@@ -1,0 +1,372 @@
+"""Optimizers (ref: python/mxnet/optimizer.py:1-823, src/optimizer/sgd-inl.h).
+
+Registry + the reference's optimizer set: SGD, NAG, SGLD, ccSGD, Adam,
+AdaGrad, RMSProp, AdaDelta, Test. Each ``update(index, weight, grad,
+state)`` mutates the weight NDArray — matching the engine-resident updater
+semantics (SURVEY §2.8). The arithmetic is pure jnp on the arrays' devices;
+XLA fuses each update into one kernel, which is what the C++ `ccsgd`
+fast-path achieved by avoiding temporaries (ref: src/optimizer/sgd-inl.h:56).
+In this framework ccSGD therefore IS SGD; it is kept as a registered alias.
+
+Per-parameter lr/wd multipliers follow the reference: idx2name mapping +
+``__lr_mult__``/``__wd_mult__`` symbol attrs (ref: optimizer.py:109-160).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+
+__all__ = [
+    "Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
+    "AdaDelta", "Test", "create", "get_updater", "register",
+]
+
+
+class Optimizer:
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        """ref: optimizer.py:21 — name registry (case-insensitive)."""
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, rescale_grad=1, **kwargs):
+        """ref: optimizer.py:38."""
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](rescale_grad=rescale_grad, **kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict)
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_mult(self, args_lr_mult):
+        """ref: optimizer.py:109 — reads __lr_mult__ attrs from self.sym."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """ref: optimizer.py:134 — no-wd default for bias/gamma/beta."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _preprocess_grad(self, grad):
+        import jax.numpy as jnp
+
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (ref: optimizer.py:234)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray) and isinstance(grad, NDArray)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data
+        if state is not None:
+            mom = state._data * self.momentum - lr * (g + wd * w)
+            state._set_data(mom)
+            weight._set_data(w + mom)
+        else:
+            weight._set_data(w - lr * (g + wd * w))
+
+
+@register
+class ccSGD(SGD):
+    """Alias of SGD; the reference's C++-engine variant (ref:
+    src/optimizer/sgd.cc:24, python/mxnet/optimizer.py:426). On TPU the
+    Python SGD already lowers to one fused XLA kernel."""
+
+    def __init__(self, momentum=0.0, rescale_grad=1.0, clip_gradient=-1.0, **kwargs):
+        if clip_gradient is not None and clip_gradient < 0:
+            clip_gradient = None
+        super().__init__(momentum=momentum, rescale_grad=rescale_grad,
+                         clip_gradient=clip_gradient, **kwargs)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (ref: optimizer.py:313)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data
+        if state is not None:
+            mom = state._data
+            mom = self.momentum * mom + g + wd * w
+            g2 = self.momentum * mom + g
+            state._set_data(mom)
+            weight._set_data(w - lr * g2)
+        else:
+            weight._set_data(w - lr * (g + wd * w))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref: optimizer.py:361)."""
+
+    def update(self, index, weight, grad, state):
+        import jax
+
+        from . import random as _random
+
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad)
+        w = weight._data
+        noise = jax.random.normal(_random.next_key(), w.shape, w.dtype) * math.sqrt(lr)
+        weight._set_data(w - lr / 2 * (g + wd * w) + noise)
+
+
+@register
+class Adam(Optimizer):
+    """ref: optimizer.py:504."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 decay_factor=(1 - 1e-8), **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        # decay_factor accepted for reference-API compatibility; bias
+        # correction here uses per-index update counts (standard Adam)
+        self.decay_factor = decay_factor
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # mean
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # variance
+        )
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        lr = self._get_lr(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = self._preprocess_grad(grad)
+        wd = self._get_wd(index)
+        g = g + wd * weight._data
+        m = self.beta1 * mean._data + (1 - self.beta1) * g
+        v = self.beta2 * var._data + (1 - self.beta2) * jnp.square(g)
+        mean._set_data(m)
+        var._set_data(v)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        # t may be a traced step index (scanned fit fast path,
+        # parallel/fit_trainer.py) — sqrt must then be jnp, not math
+        _sqrt = math.sqrt if isinstance(t, (int, _np.integer)) else jnp.sqrt
+        lr_t = lr * _sqrt(coef2) / coef1
+        weight._set_data(weight._data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+
+
+@register
+class AdaGrad(Optimizer):
+    """ref: optimizer.py:605."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad)
+        h = state._data + jnp.square(g)
+        state._set_data(h)
+        weight._set_data(
+            weight._data - lr * (g / jnp.sqrt(h + self.float_stable_eps) + wd * weight._data)
+        )
+
+
+@register
+class RMSProp(Optimizer):
+    """Tieleman & Hinton variant with E[g], E[g^2] and momentum delta
+    (ref: optimizer.py:654)."""
+
+    def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # n = E[g^2]
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # g = E[g]
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # delta
+        )
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        n, g_avg, delta = state
+        g = self._preprocess_grad(grad)
+        g = g + wd * weight._data
+        n_ = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
+        g_ = (1 - self.gamma1) * g + self.gamma1 * g_avg._data
+        d_ = self.gamma2 * delta._data - lr * g / jnp.sqrt(n_ - jnp.square(g_) + 1e-4)
+        n._set_data(n_)
+        g_avg._set_data(g_)
+        delta._set_data(d_)
+        weight._set_data(weight._data + d_)
+
+
+@register
+class AdaDelta(Optimizer):
+    """ref: optimizer.py:730."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # E[g^2]
+            zeros(weight.shape, weight.context, dtype=weight.dtype),  # E[dx^2]
+        )
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad)
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g._data + (1.0 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta._data + (1.0 - self.rho) * jnp.square(delta)
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+        weight._set_data(weight._data - delta - wd * weight._data)
+
+
+@register
+class Test(Optimizer):
+    """ref: optimizer.py:784 — weight += grad * rescale_grad; used by the
+    distributed kvstore arithmetic tests (tests/nightly/dist_sync_kvstore.py)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight._data + grad._data * self.rescale_grad)
+
+
+create = Optimizer.create_optimizer
+
+
+def get_updater(optimizer):
+    """Closure with per-index state dict (ref: optimizer.py:803)."""
+    states = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+
+    return updater

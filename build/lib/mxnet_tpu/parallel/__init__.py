@@ -1,0 +1,22 @@
+"""TPU parallelism: meshes, sharded train steps, collectives, ring attention.
+
+This package is the TPU-native replacement for the reference's parallelism
+machinery (SURVEY §2.7, §5.8): KVStore comm trees and ps-lite become XLA
+collectives over an ICI/DCN device mesh; ctx_group model parallelism
+becomes sharding annotations; and sequence/context parallelism (absent in
+the 2016 reference but first-class here) is provided by ring attention.
+"""
+from .mesh import create_mesh, default_mesh, local_devices, set_default_devices
+from .trainer import ShardedTrainer, make_train_step, data_parallel_spec
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention, make_ulysses_attention
+from .moe import init_moe_params, moe_ffn, shard_moe_params
+from .pipeline import make_pipeline, pipeline_apply
+
+__all__ = [
+    "create_mesh", "default_mesh", "local_devices", "set_default_devices",
+    "ShardedTrainer", "make_train_step", "data_parallel_spec",
+    "ring_attention", "ulysses_attention", "make_ulysses_attention",
+    "init_moe_params", "moe_ffn", "shard_moe_params",
+    "make_pipeline", "pipeline_apply",
+]

@@ -1,0 +1,324 @@
+"""Scanned fast path for the public ``fit()`` training loops.
+
+The reference's throughput numbers are ``fit()`` numbers (ref:
+python/mxnet/model.py:117 _train_multi_device) — its engine pipelines the
+per-batch pushes so the Python loop never blocks. On the tunneled TPU
+backend every jitted dispatch costs ~20 ms of host round-trip when the
+loop fences (metric updates fence every batch), so a per-batch loop is
+structurally slower than the compiled trainer bench.py measures
+(docs/perf_analysis.md). This module closes that gap for the public API:
+K training steps run as ONE dispatched ``lax.scan`` program — forward,
+backward, and the REAL ``mxnet_tpu.optimizer.Optimizer.update`` traced
+into the program — so ``FeedForward.fit``/``Module.fit`` get the same
+throughput as the internal trainer while preserving the reference
+semantics (per-index lr/wd multipliers, gradient clipping, rescale,
+schedulers, Adam step counts).
+
+How the Python Optimizer is traced (not reimplemented): inside the scan
+body each parameter/gradient/state leaf is wrapped in an NDArray facade
+around the tracer and ``optimizer.update(index, w, g, state)`` runs with
+two instance patches active:
+
+- ``_get_lr`` returns a traced per-step base lr (host-precomputed from
+  the real scheduler for each of the K steps) times the static
+  lr_mult/idx2name lookup — schedulers stay host logic (see run_chunk
+  for the one-update boundary nuance the per-batch loop itself has).
+- ``_index_update_count`` reads as a traced step number (Adam's bias
+  correction switches to jnp.sqrt on traced t, optimizer.py) and
+  ``_update_count`` is a no-op during tracing; real counts advance on
+  the host after each chunk.
+
+Optimizers whose update is stateful on the host beyond counts (SGLD's
+host-side PRNG draw) are not scan-safe and must use the per-batch path —
+``supports_optimizer`` is the gate.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+# exactly these classes (not subclasses: a subclass may override update
+# with host logic the trace would freeze)
+_SCANNABLE_OPTIMIZERS = ("SGD", "ccSGD", "NAG", "Adam", "AdaGrad",
+                         "RMSProp", "AdaDelta", "Test")
+
+
+def supports_optimizer(optimizer):
+    from .. import optimizer as opt
+
+    cls = type(optimizer)
+    return any(
+        cls is opt.Optimizer.opt_registry.get(n.lower()) for n in _SCANNABLE_OPTIMIZERS
+    )
+
+
+class _TracedCounts(dict):
+    """Every index reads as the traced step count while update() traces."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, key):
+        return self._t
+
+    def __contains__(self, key):
+        return True
+
+
+def _static_lr_mult(optimizer, index):
+    if index in optimizer.lr_mult:
+        return optimizer.lr_mult[index]
+    if index in optimizer.idx2name:
+        return optimizer.lr_mult.get(optimizer.idx2name[index], 1.0)
+    return 1.0
+
+
+class FitTrainer:
+    """Compiled K-step trainer driving a Symbol's fused fwd+bwd program
+    and the user's real Optimizer object. Create via ``make_fit_trainer``."""
+
+    def __init__(self, symbol, ctx, input_shapes, optimizer, arg_params,
+                 aux_params, param_names, compute_dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self.optimizer = optimizer
+        self.param_names = list(param_names)
+        self.input_names = list(input_shapes)
+        self.ctx = ctx
+        self._cdt = jnp.dtype(compute_dtype) if compute_dtype else None
+
+        if any((not n.is_variable) and n.op.is_host_op for n in symbol.nodes):
+            # host ops run eagerly via the Executor's hybrid mode; inside
+            # a lax.scan they would have to become pure_callback nodes —
+            # the compiled-program host-callback path the hybrid engine
+            # exists to avoid. Per-batch loop handles these graphs.
+            raise MXNetError("scanned fit does not support host ops "
+                             "(Custom/NumpyOp/torch bridge)")
+        exe = symbol.simple_bind(ctx, grad_req="null", **input_shapes)
+        if not all(exe._head_no_grad):
+            raise MXNetError("scanned fit requires loss-op heads")
+        self._run = exe._run
+        # _run is a bound method and pins the executor; release its
+        # freshly allocated device arg/grad/aux arrays (the trainer keeps
+        # its own copies — without this the parameters sit in HBM twice)
+        exe._release_device_arrays()
+        self._arg_names = symbol.list_arguments()
+
+        dev = ctx.jax_device
+        self.params = {
+            n: jax.device_put(jnp.asarray(arg_params[n].asnumpy(), jnp.float32), dev)
+            for n in self.param_names
+        }
+        self.aux = [
+            jax.device_put(jnp.asarray(a.asnumpy(), jnp.float32), dev)
+            for a in (aux_params[n] for n in symbol.list_auxiliary_states())
+        ]
+        # real optimizer states (host-created NDArrays) -> jax leaf lists
+        self._state_tree = []
+        self.opt_states = []
+        for i, n in enumerate(self.param_names):
+            st = optimizer.create_state(i, arg_params[n])
+            leaves, treedef = jax.tree_util.tree_flatten(
+                st, is_leaf=lambda x: x is None)
+            self._state_tree.append(treedef)
+            self.opt_states.append([
+                None if l is None else jax.device_put(
+                    jnp.asarray(l.asnumpy(), jnp.float32), dev)
+                for l in leaves
+            ])
+        self._jit_cache = {}
+        # seed the per-step dropout keys from the package random chain so
+        # mx.random.seed governs the scanned path exactly like the
+        # per-batch path (both draw from the same stateful chain)
+        from .. import random as _mxrandom
+
+        self._key = _mxrandom.next_key()
+
+    # -- tracing helpers -------------------------------------------------------
+    def _traced_update(self, params, opt_states, grads, lr_t, t_t):
+        """Run the REAL optimizer.update once per parameter with traced
+        values, returning new (params, opt_states)."""
+        import types
+
+        from ..ndarray import NDArray
+
+        opt = self.optimizer
+        orig_get_lr = opt._get_lr
+        orig_update_count = opt._update_count
+        orig_counts = opt._index_update_count
+
+        def patched_get_lr(self_o, index):
+            return lr_t * _static_lr_mult(self_o, index)
+
+        try:
+            opt._get_lr = types.MethodType(patched_get_lr, opt)
+            opt._update_count = types.MethodType(lambda s, i: None, opt)
+            opt._index_update_count = _TracedCounts(t_t)
+            new_params, new_states = {}, []
+            for i, n in enumerate(self.param_names):
+                w = NDArray(params[n], self.ctx)
+                g = NDArray(grads[n], self.ctx)
+                leaves = [
+                    None if l is None else NDArray(l, self.ctx)
+                    for l in opt_states[i]
+                ]
+                st = self._jax.tree_util.tree_unflatten(
+                    self._state_tree[i], leaves)
+                opt.update(i, w, g, st)
+                new_params[n] = w._data
+                new_states.append([
+                    None if l is None else l._data for l in leaves
+                ])
+            return new_params, new_states
+        finally:
+            opt._get_lr = orig_get_lr
+            opt._update_count = orig_update_count
+            opt._index_update_count = orig_counts
+
+    def _make_loop(self, K):
+        import jax
+        import jax.numpy as jnp
+
+        cdt = self._cdt
+
+        def cast_param(v):
+            return v.astype(cdt) if (cdt is not None and v.ndim >= 2) else v
+
+        def cast_data(v):
+            return (
+                v.astype(cdt)
+                if (cdt is not None and v.ndim >= 2 and
+                    jnp.issubdtype(v.dtype, jnp.floating))
+                else v
+            )
+
+        def step(params, opt_states, aux, batch, lr_t, t_t, rng):
+            def f(p):
+                vals = [
+                    (cast_data(batch[n]) if n in batch else cast_param(p[n]))
+                    for n in self._arg_names
+                ]
+                outs, new_aux = self._run(vals, aux, rng, is_train=True)
+                # inexact heads only get cotangents; aux is state, not a
+                # differentiable output (see symbol_trainer.step_impl)
+                flt = [o for o in outs
+                       if jnp.issubdtype(o.dtype, jnp.inexact)]
+                return flt, (outs, new_aux)
+
+            flt, vjp_fn, (outs, new_aux) = jax.vjp(f, params, has_aux=True)
+            head_grads = [jnp.ones(o.shape, o.dtype) for o in flt]
+            (grads,) = vjp_fn(head_grads)
+            grads = {k: v.astype(jnp.float32) for k, v in grads.items()}
+            params, opt_states = self._traced_update(
+                params, opt_states, grads, lr_t, t_t)
+            return params, opt_states, new_aux, outs
+
+        def loop(params, opt_states, aux, batches, lrs, ts, rngs):
+            def body(carry, xs):
+                params, opt_states, aux = carry
+                batch, lr_t, t_t, rng = xs
+                params, opt_states, aux, outs = step(
+                    params, opt_states, aux, batch, lr_t, t_t, rng)
+                return (params, opt_states, aux), tuple(outs)
+
+            (params, opt_states, aux), stacked = jax.lax.scan(
+                body, (params, opt_states, aux), (batches, lrs, ts, rngs))
+            return params, opt_states, aux, stacked
+
+        return jax.jit(loop, donate_argnums=(0, 1, 2))
+
+    # -- public API ------------------------------------------------------------
+    def stage_chunk(self, batch_list):
+        """Stack K batches (dict name -> numpy or NDArray) into device
+        arrays with leading axis K; returns an opaque staged chunk.
+
+        Arrays already resident on the target device stack ON device
+        (jnp.stack — an HBM copy, no host round trip): a prefetching
+        pipeline or device-cached dataset feeds the scan at HBM speed.
+        Host arrays stack on host and ship once per chunk; with a bf16
+        compute dtype the image tensor is cast before transfer, halving
+        H2D bytes (the tunnel's H2D bandwidth is the scarce resource;
+        docs/perf_analysis.md). Iterator contract: yielded DataBatch
+        arrays must not be mutated afterwards (the reference's async
+        engine imposes the same rule)."""
+        import jax
+
+        from ..ndarray import NDArray
+
+        K = len(batch_list)
+        dev = self.ctx.jax_device
+        jnp = self._jnp
+        bf16 = (self._cdt is not None and str(self._cdt) == "bfloat16")
+        staged = {}
+        for n in self.input_names:
+            vals = [b[n] for b in batch_list]
+            datas = [v._data if isinstance(v, NDArray) else v for v in vals]
+            on_dev = all(
+                getattr(a, "device", None) == dev for a in datas)
+            if on_dev:
+                v = jnp.stack(datas)
+                if bf16 and v.ndim >= 3 and v.dtype == jnp.float32:
+                    v = v.astype(jnp.bfloat16)
+                staged[n] = v
+                continue
+            v = _np.stack([_np.asarray(a) for a in datas])
+            if bf16 and v.ndim >= 3 and v.dtype == _np.float32:
+                v = v.astype(self._jnp.bfloat16)
+            staged[n] = jax.device_put(v, dev)
+        return K, staged
+
+    def run_chunk(self, staged):
+        """Run K fused train steps on a staged chunk. Returns the list of
+        head outputs, each stacked with leading axis K (device arrays)."""
+        import jax
+
+        K, batches = staged
+        opt = self.optimizer
+        base = opt.num_update
+        # lr for step k = scheduler(base+k+1), the count every parameter
+        # AFTER the first sees in the per-batch loop (the reference calls
+        # _get_lr before _update_count, so within one batch the first
+        # parameter reads the pre-increment count and the rest read the
+        # post-increment count — at a scheduler boundary the two differ
+        # by one update for that first parameter; we pick the dominant
+        # post-increment value uniformly)
+        lrs = _np.asarray(
+            [
+                (opt.lr_scheduler(base + k + 1)
+                 if opt.lr_scheduler is not None else opt.lr)
+                for k in range(K)
+            ], _np.float32)
+        ts = _np.arange(base + 1, base + K + 1, dtype=_np.int32)
+        self._key, sub = jax.random.split(self._key)
+        rngs = jax.random.split(sub, K)
+
+        if K not in self._jit_cache:
+            self._jit_cache[K] = self._make_loop(K)
+        self.params, self.opt_states, self.aux, stacked = self._jit_cache[K](
+            self.params, self.opt_states, self.aux, batches, lrs, ts, rngs)
+
+        # host-side optimizer bookkeeping advances by K applied steps
+        for i in range(len(self.param_names)):
+            opt._index_update_count[i] = (
+                opt._index_update_count.get(i, opt.begin_num_update) + K)
+        opt.num_update = max(opt.num_update, base + K)
+        return list(stacked)
+
+    def write_back(self, arg_params, aux_params, aux_names):
+        """Copy the device state into the user-visible NDArray dicts
+        (epoch boundaries, checkpoints, final params)."""
+        for n in self.param_names:
+            arg_params[n][:] = _np.asarray(self.params[n])
+        for n, a in zip(aux_names, self.aux):
+            aux_params[n][:] = _np.asarray(a)
+
+
+def make_fit_trainer(symbol, ctx, input_shapes, optimizer, arg_params,
+                     aux_params, param_names, compute_dtype=None):
+    return FitTrainer(symbol, ctx, input_shapes, optimizer, arg_params,
+                      aux_params, param_names, compute_dtype=compute_dtype)

@@ -1,0 +1,83 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Not in the 2016 reference (SURVEY §2.7 lists expert parallelism among the
+extensions the comm layer must make natural); here it is first-class: the
+expert dimension shards over a mesh axis and XLA inserts the all-to-all/
+all-reduce traffic from sharding annotations alone — the idiomatic
+TPU formulation (gating + dense dispatch einsums, sharded on E).
+
+Design: top-k gating with softmax renormalization over the selected
+experts; dispatch/combine as one-hot einsums (exact, capacity-free —
+the right baseline at framework level; capacity-factor routing is a
+policy layered on top). Expert weights carry PartitionSpec
+('expert', ...); under a mesh with an 'expert' axis each device holds
+E/n experts and XLA reduces the combine einsum across the axis.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+
+def init_moe_params(rng, num_experts, d_model, d_ff, dtype="float32"):
+    """Expert-sharded FFN params: gate + per-expert two-layer MLP."""
+    import jax
+
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = 1.0 / _np.sqrt(d_model)
+    scale_out = 1.0 / _np.sqrt(d_ff)
+    return {
+        "gate": jax.random.normal(k1, (d_model, num_experts), dtype) * scale_in,
+        "w_in": jax.random.normal(
+            k2, (num_experts, d_model, d_ff), dtype) * scale_in,
+        "w_out": jax.random.normal(
+            k3, (num_experts, d_ff, d_model), dtype) * scale_out,
+    }
+
+
+def moe_partition_specs():
+    """PartitionSpecs placing the expert axis on mesh axis 'expert'."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"gate": P(), "w_in": P("expert", None, None),
+            "w_out": P("expert", None, None)}
+
+
+def moe_ffn(params, x, top_k=2):
+    """MoE feed-forward. x: [..., d_model] -> [..., d_model].
+
+    Returns (output, aux_loss) where aux_loss is the standard
+    load-balancing loss (mean_prob · mean_assignment · E)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("...d,de->...e", x, params["gate"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    num_experts = probs.shape[-1]
+    top_p, top_idx = jax.lax.top_k(probs, top_k)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    # dense dispatch: weights[..., e] = sum_k top_p[k] * [top_idx[k] == e]
+    onehot = jax.nn.one_hot(top_idx, num_experts, dtype=x.dtype)
+    combine = jnp.einsum("...k,...ke->...e", top_p.astype(x.dtype), onehot)
+
+    hidden = jnp.einsum("...d,edf->...ef", x, params["w_in"])
+    hidden = jax.nn.relu(hidden)
+    expert_out = jnp.einsum("...ef,efd->...ed", hidden, params["w_out"])
+    out = jnp.einsum("...ed,...e->...d", expert_out, combine)
+
+    # load-balance aux (Switch/GShard form)
+    me = jnp.mean(probs.reshape(-1, num_experts), axis=0)
+    ce = jnp.mean(combine.reshape(-1, num_experts).astype(jnp.float32) > 0,
+                  axis=0)
+    aux = jnp.sum(me * ce) * num_experts
+    return out, aux
+
+
+def shard_moe_params(params, mesh):
+    """Commit params to the mesh per moe_partition_specs."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = moe_partition_specs()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

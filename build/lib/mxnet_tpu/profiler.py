@@ -1,0 +1,103 @@
+"""Profiler: xplane trace capture + per-op annotation.
+
+The 2016 reference has no dedicated profiler (SURVEY §5.1): its
+observability is the Monitor per-op callback (python/mxnet/monitor.py),
+the Speedometer samples/sec log, and `MXNET_ENGINE_INFO` engine debug.
+This module supplies the piece the reference lacks, as SURVEY §5.1's TPU
+plan prescribes: the jax/XLA profiler (xplane traces viewable in
+TensorBoard/Perfetto, including TPU HLO timelines) behind an mxnet-style
+start/stop surface. Monitor stays the per-op numeric hook; this is the
+timeline hook.
+
+Usage::
+
+    mx.profiler.profiler_set_config(filename="/tmp/traces")
+    mx.profiler.profiler_set_state("run")
+    ... training steps ...
+    mx.profiler.profiler_set_state("stop")   # writes the xplane trace
+
+    with mx.profiler.scope("data-load"):     # named trace region
+        batch = next(it)
+
+    @mx.profiler.annotate("fwd-step")        # annotate a function
+    def step(...): ...
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = [
+    "profiler_set_config", "profiler_set_state", "scope", "annotate",
+    "start_server", "state",
+]
+
+_config = {"filename": "profile_output"}
+_state = "stop"
+_server = None
+
+
+def profiler_set_config(mode="all", filename="profile_output"):
+    """Configure the trace output directory (mirrors the later-era
+    MXSetProfilerConfig surface; `mode` accepted for compatibility)."""
+    del mode
+    _config["filename"] = filename
+
+
+def profiler_set_state(new_state="stop"):
+    """'run' starts capture, 'stop' ends it and writes the trace
+    (mirrors MXSetProfilerState)."""
+    global _state
+    import jax
+
+    if new_state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if new_state == _state:
+        return
+    if new_state == "run":
+        os.makedirs(_config["filename"], exist_ok=True)
+        jax.profiler.start_trace(_config["filename"])
+    else:
+        jax.profiler.stop_trace()
+    _state = new_state
+
+
+def state():
+    return _state
+
+
+@contextlib.contextmanager
+def scope(name):
+    """Named region visible in the trace timeline (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def annotate(name=None):
+    """Decorator: wrap a function in a named trace region."""
+    def deco(fn):
+        import functools
+
+        label = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with scope(label):
+                return fn(*a, **k)
+
+        return wrapped
+
+    return deco
+
+
+def start_server(port=9012):
+    """Start the on-demand profiling server (connect from TensorBoard's
+    capture-profile dialog while training runs)."""
+    global _server
+    import jax
+
+    if _server is None:
+        _server = jax.profiler.start_server(port)
+    return _server

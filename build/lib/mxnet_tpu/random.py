@@ -1,0 +1,109 @@
+"""Random sampling on NDArray (ref: python/mxnet/random.py:1-99).
+
+TPU-native design: the reference keeps a per-device mshadow ``Random``
+resource seeded via ``MXRandomSeed`` (ref: src/resource.cc, c_api.h:97).
+Here a single process-wide ``jax.random`` key chain replaces it: stateful
+``seed()`` resets the chain; each draw splits the key. Keys are split
+per-call so imperative draws are reproducible under a fixed seed, while
+compiled graphs (Dropout etc.) thread keys explicitly via the Executor.
+"""
+from __future__ import annotations
+
+from .base import mx_real_t
+from .context import current_context
+from .ndarray import NDArray
+
+__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+
+_state = {"key": None, "seed": 0}
+
+
+def _ensure_key():
+    import jax
+
+    if _state["key"] is None:
+        _state["key"] = jax.random.PRNGKey(_state["seed"])
+    return _state["key"]
+
+
+def next_key():
+    """Split and return a fresh subkey (used by ops needing randomness)."""
+    import jax
+
+    key = _ensure_key()
+    key, sub = jax.random.split(key)
+    _state["key"] = key
+    return sub
+
+
+def seed(seed_state):
+    """Seed all random generators (ref: python/mxnet/random.py:77).
+    Also reseeds every live per-device random resource, matching
+    MXRandomSeed → ResourceManager::SeedRandom (src/resource.cc)."""
+    import jax
+
+    _state["seed"] = int(seed_state)
+    _state["key"] = jax.random.PRNGKey(int(seed_state))
+    from .resource import ResourceManager
+
+    if ResourceManager._instance is not None:
+        ResourceManager._instance.seed(int(seed_state))
+
+
+def uniform(low=0.0, high=1.0, shape=None, ctx=None, out=None):
+    """ref: python/mxnet/random.py:14 (_random_uniform, ndarray.cc:764)."""
+    import jax
+
+    if out is not None:
+        shape = out.shape
+        ctx = out.context
+    if ctx is None:
+        ctx = current_context()
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        data = jax.random.uniform(
+            next_key(), shape, minval=low, maxval=high, dtype=mx_real_t
+        )
+    if out is not None:
+        out._set_data(data)
+        return out
+    return NDArray(data, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, ctx=None, out=None):
+    """ref: python/mxnet/random.py:45 (_random_gaussian, ndarray.cc:781)."""
+    import jax
+
+    if out is not None:
+        shape = out.shape
+        ctx = out.context
+    if ctx is None:
+        ctx = current_context()
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        data = loc + scale * jax.random.normal(next_key(), shape, dtype=mx_real_t)
+    if out is not None:
+        out._set_data(data)
+        return out
+    return NDArray(data, ctx)
+
+
+def randint(low, high, shape=None, ctx=None):
+    """Integer sampling; not in the 2016 reference but needed by data iters."""
+    import jax
+
+    if ctx is None:
+        ctx = current_context()
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        data = jax.random.randint(next_key(), shape, low, high)
+    return NDArray(data, ctx)

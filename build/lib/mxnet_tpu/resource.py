@@ -1,0 +1,163 @@
+"""Resource manager: per-device PRNG streams and temp workspaces.
+
+Re-design of the reference resource layer (ref: include/mxnet/resource.h
+:18-36,156, src/resource.cc — SURVEY §2.3). The reference hands operators
+two resource kinds through ``ResourceManager::Get()->Request(ctx, req)``:
+
+- ``kRandom``: a per-device mshadow PRNG seeded globally;
+- ``kTempSpace``: a rotating set of scratch buffers per device
+  (MXNET_CPU_TEMP_COPY / MXNET_GPU_TEMP_COPY copies, resource.cc:70-71).
+
+TPU-natively, operator *compute* needs neither (XLA allocates scratch,
+jax threads PRNG keys explicitly) — but the escape hatches do: CustomOp /
+NumpyOp kernels and host-side pipeline stages ask the manager for
+randomness and workspace exactly like reference custom ops
+(``OpContext.requested``). So the API is preserved:
+
+    r = ResourceManager.get().request(ctx, "random")
+    key = r.next_key()                      # jax PRNG key stream
+    w = ResourceManager.get().request(ctx, "temp_space")
+    buf = w.get_space((1024,), "f4")        # recycled numpy scratch
+
+Global seeding runs through mxnet_tpu.random.seed, which also reseeds
+every live random resource — matching MXRandomSeed semantics
+(c_api.h; src/resource.cc SeedRandom).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from .base import MXNetError, env_int
+from .context import Context, current_context
+from .storage import Storage
+
+__all__ = ["ResourceManager", "RandomResource", "TempSpaceResource"]
+
+
+class RandomResource:
+    """Per-device PRNG stream (ref: resource.h kRandom)."""
+
+    def __init__(self, ctx, seed_state):
+        self._ctx = ctx
+        self._lock = threading.Lock()
+        self.reseed(seed_state)
+
+    def reseed(self, seed_state):
+        import jax
+
+        # distinct stream per device id, same global seed discipline as
+        # resource.cc (seed + device offset); locked so a concurrent
+        # next_key cannot resurrect the pre-seed stream
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed_state), self._ctx.device_id)
+        with self._lock:
+            self._key = key
+
+    def next_key(self):
+        import jax
+
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def uniform(self, shape, low=0.0, high=1.0, dtype="float32"):
+        import jax
+
+        return jax.random.uniform(
+            self.next_key(), shape, minval=low, maxval=high,
+            dtype=_np.dtype(dtype).name)
+
+    def normal(self, shape, loc=0.0, scale=1.0, dtype="float32"):
+        import jax
+
+        k = self.next_key()
+        return jax.random.normal(
+            k, shape, dtype=_np.dtype(dtype).name) * scale + loc
+
+
+class TempSpaceResource:
+    """Rotating scratch buffers (ref: resource.h kTempSpace; copy count
+    env MXNET_CPU_TEMP_COPY, resource.cc:70-71)."""
+
+    def __init__(self, ctx, ncopy):
+        self._ctx = ctx
+        self._handles = [None] * ncopy
+        self._turn = 0
+        self._lock = threading.Lock()
+
+    def get_space(self, shape, dtype="float32"):
+        """A writable numpy scratch view; contents are undefined between
+        calls — the reference's temp-space contract. Always host memory:
+        custom-op kernels (the consumers of temp space here) run on the
+        host via callbacks, and jax device buffers are immutable."""
+        from .context import cpu
+
+        dt = _np.dtype(dtype)
+        nbytes = int(_np.prod(shape)) * dt.itemsize
+        with self._lock:
+            i = self._turn % len(self._handles)
+            self._turn += 1
+            h = self._handles[i]
+            if h is None or h.size < nbytes:
+                if h is not None:
+                    Storage.get().free(h)
+                h = Storage.get().alloc(nbytes, cpu(self._ctx.device_id))
+                self._handles[i] = h
+        return h.dptr[:nbytes].view(dt).reshape(shape)
+
+
+class ResourceManager:
+    """Singleton (ref: ResourceManager::Get, resource.h:156)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        from . import random as _random
+
+        self._random = {}
+        self._temp = {}
+        # honor a global mx.random.seed() issued before the manager existed
+        self._seed = _random._state["seed"]
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def request(self, ctx, req):
+        """req: 'random' | 'temp_space' (ref: ResourceRequest::Type)."""
+        if ctx is None:
+            ctx = current_context()
+        if not isinstance(ctx, Context):
+            raise MXNetError("request: ctx must be a Context")
+        key = (ctx.device_type, ctx.device_id)
+        with self._mu:
+            if req == "random":
+                r = self._random.get(key)
+                if r is None:
+                    r = self._random[key] = RandomResource(ctx, self._seed)
+                return r
+            if req == "temp_space":
+                t = self._temp.get(key)
+                if t is None:
+                    ncopy = env_int(
+                        "MXNET_CPU_TEMP_COPY"
+                        if ctx.device_type.startswith("cpu")
+                        else "MXNET_GPU_TEMP_COPY", 4)
+                    t = self._temp[key] = TempSpaceResource(ctx, ncopy)
+                return t
+        raise MXNetError("unknown resource request: %r" % (req,))
+
+    def seed(self, seed_state):
+        """Reseed every live random resource (ref: resource.cc
+        SeedRandom; called from mxnet_tpu.random.seed)."""
+        with self._mu:
+            self._seed = int(seed_state)
+            for r in self._random.values():
+                r.reseed(self._seed)

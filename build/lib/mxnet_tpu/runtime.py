@@ -1,0 +1,99 @@
+"""Runtime feature detection — the make/config.mk flag surface.
+
+The reference's capabilities are compile-time flags
+(ref: make/config.mk:41-108 — USE_CUDA, USE_CUDNN, USE_OPENCV, USE_BLAS,
+USE_DIST_KVSTORE, USE_S3, USE_HDFS, USE_NNPACK, plugin toggles) and code
+queries them with #if. A Python/JAX stack resolves the same questions at
+runtime: native extensions either built or gracefully absent, transports
+either importable or not, devices either present or not. This module is
+the single place that answers them.
+
+>>> import mxnet_tpu as mx
+>>> mx.runtime.feature_list()          # {'TPU': False, 'NATIVE_ENGINE': True, ...}
+>>> mx.runtime.has_feature('S3')
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["feature_list", "has_feature", "features_summary"]
+
+
+def _try_import(mod):
+    try:
+        __import__(mod)
+        return True
+    except Exception:
+        return False
+
+
+def _native_lib(name):
+    from . import _native
+
+    try:
+        return _native.load(name) is not None
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _feature_list_cached():
+    """Detected capabilities, keyed by the reference's flag vocabulary.
+
+    | key | reference flag | meaning here |
+    |---|---|---|
+    | TPU | USE_CUDA/USE_CUDNN | a TPU device is visible to jax |
+    | NATIVE_ENGINE | (core) | src/engine.cc built and loadable |
+    | NATIVE_RECORDIO | (dmlc recordio) | src/recordio.cc built |
+    | NATIVE_IMAGEDEC | USE_OPENCV | src/imagedec.cc (libjpeg) built |
+    | OPENCV | USE_OPENCV | the mx.cv facade is importable |
+    | DIST_KVSTORE | USE_DIST_KVSTORE | jax.distributed available |
+    | S3 | USE_S3 | boto3 present (stream.py s3:// backend) |
+    | HDFS | USE_HDFS | pyarrow present (stream.py hdfs:// backend) |
+    | TORCH | torch plugin | torch importable (mx.th bridge) |
+    | CAFFE | caffe plugin | caffe importable (gated facade) |
+    | PROFILER | USE_PROFILER | jax.profiler usable |
+    """
+    import jax
+
+    try:
+        tpu = any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        tpu = False
+    feats = {  # copied on return; the cached dict itself stays private
+        "TPU": tpu,
+        "NATIVE_ENGINE": _native_lib("engine"),
+        "NATIVE_RECORDIO": _native_lib("recordio"),
+        "NATIVE_IMAGEDEC": _native_lib("imagedec"),
+        "OPENCV": _try_import("PIL"),  # mx.cv decodes via PIL + jax.image
+        "DIST_KVSTORE": hasattr(jax, "distributed"),
+        "S3": _try_import("boto3"),
+        "HDFS": _try_import("pyarrow"),
+        "TORCH": _try_import("torch"),
+        "CAFFE": _try_import("caffe"),
+        "PROFILER": hasattr(jax, "profiler"),
+    }
+    return feats
+
+
+def feature_list():
+    """Detected capabilities (see _feature_list_cached for the table).
+    Returns a fresh copy each call so callers cannot corrupt the cache."""
+    return dict(_feature_list_cached())
+
+
+def has_feature(name):
+    """True if the named capability is available (KeyError on unknown
+    names, so typos fail loudly like an undefined #if would)."""
+    feats = _feature_list_cached()
+    if name not in feats:
+        raise KeyError("unknown feature %r (known: %s)"
+                       % (name, sorted(feats)))
+    return feats[name]
+
+
+def features_summary():
+    """Human-readable one-liner-per-feature block (the `mxnet.runtime`
+    print idiom)."""
+    return "\n".join("%-16s %s" % (k, "ON" if v else "OFF")
+                     for k, v in sorted(_feature_list_cached().items()))

@@ -1,0 +1,57 @@
+"""SFrame data-iterator plugin gate (ref: plugin/sframe/iter_sframe.cc,
+SURVEY §2.21).
+
+The reference's optional plugin iterates an SFrame (GraphLab/Turi
+columnar frame) as a DataIter. The sframe/turicreate package is not in
+this environment; the plugin follows the caffe-plugin gating pattern:
+available when importable, a clear MXNetError otherwise. When available,
+rows stream through a standard NDArrayIter-compatible batcher.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["sframe_available", "SFrameIter"]
+
+
+def sframe_available():
+    try:
+        import sframe  # noqa: F401
+
+        return True
+    except ImportError:
+        try:
+            import turicreate  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+
+def SFrameIter(sframe_obj=None, data_field=None, label_field=None,
+               batch_size=1):
+    """Iterate an SFrame as DataBatches (ref: iter_sframe.cc
+    SFrameImageIter/SFrameDataIter)."""
+    if not sframe_available():
+        raise MXNetError(
+            "SFrameIter requires the sframe/turicreate package, which is "
+            "not installed in this build (plugin gate, ref "
+            "plugin/sframe/iter_sframe.cc). Convert the frame to numpy "
+            "and use io.NDArrayIter instead.")
+    from .io import NDArrayIter
+
+    if sframe_obj is None:
+        raise MXNetError("SFrameIter: sframe_obj required")
+    if data_field is None:
+        raise MXNetError("SFrameIter: data_field required")
+    data = _np.asarray(sframe_obj[data_field].to_numpy()
+                       if hasattr(sframe_obj[data_field], "to_numpy")
+                       else sframe_obj[data_field])
+    label = None
+    if label_field is not None:
+        col = sframe_obj[label_field]
+        label = _np.asarray(col.to_numpy() if hasattr(col, "to_numpy")
+                            else col)
+    return NDArrayIter(data=data, label=label, batch_size=batch_size)

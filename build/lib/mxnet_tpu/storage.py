@@ -1,0 +1,171 @@
+"""Storage manager: allocation accounting + pooled host buffers.
+
+Re-design of the reference storage layer (ref: include/mxnet/storage.h,
+src/storage/storage.cc:20-114, gpu_device_storage.h,
+pooled_storage_manager.h — SURVEY §2.2). On TPU, *device* memory is owned
+by XLA/PJRT — the framework must not (and cannot) run its own device
+allocator. What survives, TPU-natively:
+
+- **host staging buffers**: the IO pipeline and kvstore host reductions
+  recycle large numpy buffers; ``Storage`` keeps the reference's
+  exact-size free-list pooling (``GPUPooledStorageManager::Alloc`` keeps
+  per-size free lists and only rounds up to NDEV alignment) for them;
+- **accounting**: live/pooled byte counters per context, surfaced to the
+  profiler/monitor the way the reference's ``Executor::Print`` memory
+  report is (graph_executor.cc:955+);
+- **device arrays**: ``alloc`` on a tpu context returns a zeroed
+  ``jax.Array`` handle — allocation goes through PJRT, but the handle
+  participates in the same accounting so a user sees one ledger.
+
+API parity: ``Storage.get().alloc/free/direct_free`` ≈
+``Storage::Get()->Alloc/Free/DirectFree`` (storage.h).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+
+__all__ = ["Handle", "Storage"]
+
+_ALIGN = 256  # host buffer alignment quantum (ref NDEV alignment role)
+
+
+class Handle:
+    """An allocation ticket (ref: Storage::Handle — dptr/size/ctx)."""
+
+    __slots__ = ("size", "ctx", "_buf", "_freed")
+
+    def __init__(self, size, ctx, buf):
+        self.size = size
+        self.ctx = ctx
+        self._buf = buf
+        self._freed = False
+
+    @property
+    def dptr(self):
+        """The backing buffer: numpy uint8 view (host) or jax.Array
+        (device)."""
+        if self._freed:
+            raise MXNetError("use of freed storage handle")
+        return self._buf
+
+
+class Storage:
+    """Singleton allocator facade (ref: storage.cc StorageImpl)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._pools = {}     # (dev_type, dev_id) -> {rounded: [np buffers]}
+        self._used = {}      # (dev_type, dev_id) -> live bytes
+        self._pooled = {}    # (dev_type, dev_id) -> pooled free bytes
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls):
+        """ref: Storage::Get() (storage.h)."""
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @staticmethod
+    def _key(ctx):
+        return (ctx.device_type, ctx.device_id)
+
+    @staticmethod
+    def _round(size):
+        return max(_ALIGN, (size + _ALIGN - 1) // _ALIGN * _ALIGN)
+
+    # -- allocation ------------------------------------------------------------
+    def alloc(self, size, ctx=None):
+        """ref: Storage::Alloc(size, ctx). Host contexts draw from the
+        exact-size free-list pool; device contexts allocate via PJRT."""
+        if ctx is None:
+            ctx = current_context()
+        if not isinstance(ctx, Context):
+            raise MXNetError("alloc: ctx must be a Context")
+        key = self._key(ctx)
+        if ctx.device_type in ("cpu", "cpu_pinned"):
+            rounded = self._round(size)
+            with self._mu:
+                lst = self._pools.setdefault(key, {}).setdefault(rounded, [])
+                buf = lst.pop() if lst else None
+                if buf is not None:
+                    self._pooled[key] -= rounded
+            if buf is None:
+                buf = _np.empty(rounded, dtype=_np.uint8)
+            with self._mu:
+                self._used[key] = self._used.get(key, 0) + rounded
+            return Handle(size, ctx, buf)
+        # device context: PJRT owns the memory; account it
+        import jax
+        import jax.numpy as jnp
+
+        buf = jax.device_put(
+            jnp.zeros(size, dtype=jnp.uint8), ctx.jax_device)
+        with self._mu:
+            self._used[key] = self._used.get(key, 0) + size
+        return Handle(size, ctx, buf)
+
+    def free(self, handle):
+        """ref: Storage::Free — host buffers return to the pool for reuse;
+        device buffers are released to PJRT."""
+        if handle._freed:
+            return
+        handle._freed = True
+        key = self._key(handle.ctx)
+        if handle.ctx.device_type in ("cpu", "cpu_pinned"):
+            rounded = handle._buf.size
+            with self._mu:
+                self._used[key] -= rounded
+                self._pools.setdefault(key, {}).setdefault(
+                    rounded, []).append(handle._buf)
+                self._pooled[key] = self._pooled.get(key, 0) + rounded
+        else:
+            with self._mu:
+                self._used[key] -= handle.size
+        handle._buf = None
+
+    def direct_free(self, handle):
+        """ref: Storage::DirectFree — bypass the pool entirely."""
+        if handle._freed:
+            return
+        handle._freed = True
+        key = self._key(handle.ctx)
+        rounded = (handle._buf.size
+                   if handle.ctx.device_type in ("cpu", "cpu_pinned")
+                   else handle.size)
+        with self._mu:
+            self._used[key] -= rounded
+        handle._buf = None
+
+    def release_pool(self, ctx=None):
+        """Drop pooled host buffers (ref: GPUPooledStorageManager
+        ReleaseAll on OOM)."""
+        with self._mu:
+            if ctx is None:
+                self._pools.clear()
+                for k in self._pooled:
+                    self._pooled[k] = 0
+            else:
+                self._pools.pop(self._key(ctx), None)
+                self._pooled[self._key(ctx)] = 0
+
+    # -- accounting ------------------------------------------------------------
+    def used_bytes(self, ctx=None):
+        with self._mu:
+            if ctx is None:
+                return sum(self._used.values())
+            return self._used.get(self._key(ctx), 0)
+
+    def pooled_bytes(self, ctx=None):
+        with self._mu:
+            if ctx is None:
+                return sum(self._pooled.values())
+            return self._pooled.get(self._key(ctx), 0)
