@@ -106,6 +106,23 @@ def _scan_k():
     return int(os.environ.get("MXNET_TRAIN_SCAN_K", "8"))
 
 
+def _buffer_batch(data_batch, input_names):
+    """Snapshot one DataBatch for deferred staging (shared by the two
+    scanned loops): stage_chunk and _scan_drain read these values up to
+    K batches after the iterator has advanced, so nothing the iterator
+    can mutate may be held by reference. NDArray entries are unwrapped
+    to their backing ``jax.Array`` — the array itself is immutable, but
+    the NDArray facade is not (``__setitem__`` rebinds ``_data``), so a
+    DataIter recycling its NDArray batch objects would otherwise alias
+    every buffered dict to the newest batch. Raw numpy arrays are
+    copied for the same reason (iterators that reuse their numpy
+    buffers are common in the reference ecosystem)."""
+    arrs = [a._data if isinstance(a, NDArray)
+            else (_np.array(a) if isinstance(a, _np.ndarray) else a)
+            for a in list(data_batch.data) + list(data_batch.label)]
+    return dict(zip(input_names, arrs))
+
+
 def _scan_flush(trainer, buf, epoch, nbatch0):
     """Dispatch one K-batch chunk; returns the pending record drained
     after the NEXT chunk is in flight (shared by FeedForward's
@@ -181,10 +198,7 @@ def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
         while True:
             do_reset = True
             for data_batch in train_data:
-                arrs = list(data_batch.data) + list(data_batch.label)
-                # hold the NDArray refs — stage_chunk stacks on device
-                # when they are already device-resident (no host trip)
-                buf.append(dict(zip(input_names, arrs)))
+                buf.append(_buffer_batch(data_batch, input_names))
                 nbatch += 1
                 if len(buf) == K:
                     new_pending = _flush(buf, epoch, nbatch - K)
@@ -288,6 +302,12 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
             except MXNetError as e:
                 logger.debug("scanned fit unavailable (%s); using the "
                              "per-batch loop", e)
+            except Exception as e:  # device_put/tracing/optimizer-state
+                # failures during CONSTRUCTION must not abort fit() — the
+                # per-batch loop may still train fine
+                logger.warning("scanned fit construction failed (%s: %s); "
+                               "using the per-batch loop",
+                               type(e).__name__, e)
             if trainer is not None:
                 return _train_scanned(
                     trainer, symbol, ctx[0], param_names, aux_names,

@@ -158,6 +158,7 @@ class Module(BaseModule):
         batch_size = data_shapes[0].shape[0]
         self._slices = _split_input_slice(batch_size, self._work_load_list)
 
+        self._grad_req = grad_req
         shared_execs = (
             shared_module._execs if shared_module is not None else [None] * len(self._context)
         )
@@ -424,14 +425,19 @@ class Module(BaseModule):
         import time as _time
 
         from ..base import MXNetError
-        from ..model import (_desc_name, _desc_shape, _multiple_callbacks,
-                             _scan_drain, _scan_flush, _scan_k)
+        from ..model import (_buffer_batch, _desc_name, _desc_shape,
+                             _multiple_callbacks, _scan_drain, _scan_flush,
+                             _scan_k)
         from ..parallel.fit_trainer import make_fit_trainer, supports_optimizer
 
         K = _scan_k()
+        # the scanned trainer has grad_req='write' semantics for every
+        # param — a module bound with 'add'/'null' (frozen or accumulated
+        # params) must keep the per-batch loop
         if (K <= 1 or len(self._context) != 1 or monitor is not None
                 or self._kvstore is not None or self._update_on_kvstore
                 or not train_data.provide_label
+                or getattr(self, "_grad_req", "write") != "write"
                 or not supports_optimizer(self._optimizer)):
             return False
         input_shapes = {
@@ -448,6 +454,10 @@ class Module(BaseModule):
         except MXNetError as e:
             self.logger.debug("scanned fit unavailable (%s); per-batch "
                               "loop", e)
+            return False
+        except Exception as e:  # construction-only failures fall back
+            self.logger.warning("scanned fit construction failed (%s: %s); "
+                                "per-batch loop", type(e).__name__, e)
             return False
         input_names = trainer.input_names
         label_names = [_desc_name(d) for d in train_data.provide_label]
@@ -468,8 +478,7 @@ class Module(BaseModule):
                 buf = []
                 nbatch = 0
                 for data_batch in train_data:
-                    arrs = list(data_batch.data) + list(data_batch.label)
-                    buf.append(dict(zip(input_names, arrs)))
+                    buf.append(_buffer_batch(data_batch, input_names))
                     nbatch += 1
                     if len(buf) == K:
                         new_pending = _scan_flush(trainer, buf, epoch,

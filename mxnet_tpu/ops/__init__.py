@@ -17,6 +17,12 @@ from . import loss  # noqa: F401
 from . import sequence  # noqa: F401
 from . import vision  # noqa: F401
 
+# prose docs (summaries + per-param text) attach inside register() —
+# the analog of the reference generating param-documented docstrings
+# from the C registry at import (ref: python/mxnet/symbol.py:991
+# _make_atomic_symbol_function); build_doc renders them per wrapper
+from .opdoc import build_doc
+
 
 def _make_imperative(op):
     def fn(*args, **kwargs):
@@ -52,7 +58,7 @@ def _make_imperative(op):
         return res[0] if len(res) == 1 else res
 
     fn.__name__ = op.name
-    fn.__doc__ = op.doc or ("Imperative function for op %s" % op.name)
+    fn.__doc__ = build_doc(op, op.name, kind="ndarray")
     return fn
 
 

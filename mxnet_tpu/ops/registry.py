@@ -239,6 +239,9 @@ def register(opdef):
     if opdef.name in REGISTRY:
         raise MXNetError("op %s already registered" % opdef.name)
     REGISTRY[opdef.name] = opdef
+    from . import opdoc  # lazy: opdoc imports nothing from here at top level
+
+    opdoc.apply_to(opdef)
     return opdef
 
 

@@ -44,6 +44,22 @@ _SCANNABLE_OPTIMIZERS = ("SGD", "ccSGD", "NAG", "Adam", "AdaGrad",
                          "RMSProp", "AdaDelta", "Test")
 
 
+def _resident_on(a, dev):
+    """True iff ``a`` is a jax.Array wholly resident on ``dev``.
+
+    Probes ``a.devices()`` (the stable jax.Array API — a set of devices)
+    rather than ``a.device``, whose property-vs-method status has moved
+    across jax versions; numpy arrays and anything else without
+    ``devices()`` report False (host path)."""
+    devices = getattr(a, "devices", None)
+    if devices is None:
+        return False
+    try:
+        return set(devices()) == {dev}
+    except TypeError:  # .devices is data, not callable, on exotic types
+        return False
+
+
 def supports_optimizer(optimizer):
     from .. import optimizer as opt
 
@@ -258,8 +274,7 @@ class FitTrainer:
         for n in self.input_names:
             vals = [b[n] for b in batch_list]
             datas = [v._data if isinstance(v, NDArray) else v for v in vals]
-            on_dev = all(
-                getattr(a, "device", None) == dev for a in datas)
+            on_dev = all(_resident_on(a, dev) for a in datas)
             if on_dev:
                 v = jnp.stack(datas)
                 if bf16 and v.ndim >= 3 and v.dtype == jnp.float32:

@@ -1,0 +1,70 @@
+"""Auto-generated operator docstrings (ops/opdoc.py): every registered
+op's symbol and ndarray wrappers must document all params with
+type/default/required info, like the reference generates from the C
+registry (ref: python/mxnet/symbol.py:991 _make_atomic_symbol_function)."""
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import REGISTRY
+
+
+def _wrapper(modname, name):
+    mod = getattr(mx, modname)
+    return getattr(mod, name, None)
+
+
+def test_all_symbol_docstrings_nontrivial():
+    for key, op in REGISTRY.items():
+        fn = _wrapper("symbol", key)
+        if fn is None:
+            continue
+        doc = fn.__doc__ or ""
+        assert "Parameters" in doc, key
+        # a real summary, not the old one-line fallback
+        assert "Symbol constructor for op" not in doc, key
+        assert len(doc.splitlines()[0]) > 15, key
+        for pname, field in op.param_fields.items():
+            if pname == "__kwargs__" and op.name != "Custom":
+                continue
+            assert ("%s : " % pname) in doc, (key, pname)
+            if field.required:
+                assert "required" in doc, (key, pname)
+
+
+def test_all_ndarray_docstrings_nontrivial():
+    for key, op in REGISTRY.items():
+        if not op.imperative:
+            continue
+        fn = _wrapper("nd", key)
+        if fn is None:
+            continue
+        doc = fn.__doc__ or ""
+        assert "Parameters" in doc, key
+        assert "Imperative function for op" not in doc, key
+        for pname in op.param_fields:
+            if pname == "__kwargs__" and op.name != "Custom":
+                continue
+            assert ("%s : " % pname) in doc, (key, pname)
+
+
+def test_param_docs_have_prose():
+    """Every schema Field carries human text (not just type info) after
+    registration applies the opdoc table."""
+    missing = [
+        "%s.%s" % (op.name, p)
+        for op in REGISTRY.values()
+        for p, f in op.param_fields.items()
+        if p != "__kwargs__" and not f.doc
+    ]
+    assert not missing, missing
+
+
+def test_enum_and_defaults_rendered():
+    doc = mx.symbol.Pooling.__doc__
+    assert "{'max', 'avg', 'sum'}" in doc
+    assert "default='valid'" in doc
+    assert "kernel : Shape(tuple), required" in doc
+
+
+def test_aux_states_rendered():
+    doc = mx.symbol.BatchNorm.__doc__
+    assert "Auxiliary states" in doc
+    assert "moving_mean" in doc or "mean" in doc

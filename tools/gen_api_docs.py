@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Generate the per-module operator API reference under docs/api/.
+
+The reference auto-generates operator docs from the C registry's
+dmlc::Parameter schemas into Python docstrings and a docs tree
+(ref: python/mxnet/symbol.py:991, docs/api/python/). Here the same
+schema lives in ops/registry.py; this tool renders one markdown page
+per op category (the defining ops/ module) from the rendered
+docstrings, so the docs stay mechanically in sync with the code.
+
+Usage: python tools/gen_api_docs.py  (writes docs/api/ops/*.md)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CATEGORY_TITLES = {
+    "nn": "Neural-network layers",
+    "tensor": "Tensor and elementwise ops",
+    "loss": "Loss and output layers",
+    "sequence": "Sequence ops",
+    "vision": "Vision / detection ops",
+    "other": "Other ops",
+}
+
+
+def main():
+    import mxnet_tpu  # noqa: F401  (registers everything)
+    from mxnet_tpu.ops.opdoc import build_doc
+    from mxnet_tpu.ops.registry import REGISTRY
+
+    # group canonical ops by defining module; collect aliases
+    canonical = {}
+    aliases = {}
+    for key, op in REGISTRY.items():
+        if key == op.name:
+            canonical[key] = op
+        else:
+            aliases.setdefault(op.name, []).append(key)
+    groups = {}
+    for name, op in sorted(canonical.items()):
+        mod = getattr(op.forward, "__module__", "") or ""
+        cat = mod.rsplit(".", 1)[-1] if mod.startswith("mxnet_tpu.ops.") else "other"
+        if cat == "registry":  # simple_unary/binary/scalar closures (tensor.py)
+            cat = "tensor"
+        if cat not in CATEGORY_TITLES:
+            cat = "other"
+        groups.setdefault(cat, []).append((name, op))
+
+    outdir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "api", "ops")
+    os.makedirs(outdir, exist_ok=True)
+    index_rows = []
+    for cat, ops in sorted(groups.items()):
+        page = ["# %s" % CATEGORY_TITLES[cat], "",
+                "Auto-generated from the operator registry by "
+                "`tools/gen_api_docs.py`; the same text backs "
+                "`mx.symbol.<Op>.__doc__` / `mx.nd.<op>.__doc__`.", ""]
+        for name, op in ops:
+            title = name
+            if aliases.get(name):
+                title += "  (aliases: %s)" % ", ".join(sorted(aliases[name]))
+            page.append("## %s" % title)
+            page.append("")
+            page.append("```")
+            page.append(build_doc(op, name, kind="symbol"))
+            page.append("```")
+            page.append("")
+            index_rows.append((name, cat, (op.doc or "").split(". ")[0]))
+        with open(os.path.join(outdir, "%s.md" % cat), "w") as f:
+            f.write("\n".join(page))
+        print("wrote docs/api/ops/%s.md (%d ops)" % (cat, len(ops)))
+
+    idx = ["# Operator API reference", "",
+           "One page per category, generated from the registry "
+           "(`python tools/gen_api_docs.py`).", "",
+           "| op | category | summary |", "|---|---|---|"]
+    for name, cat, summary in sorted(index_rows):
+        idx.append("| [%s](%s.md) | %s | %s |" % (name, cat, cat, summary))
+    with open(os.path.join(outdir, "index.md"), "w") as f:
+        f.write("\n".join(idx))
+    print("wrote docs/api/ops/index.md (%d ops)" % len(index_rows))
+
+
+if __name__ == "__main__":
+    main()
