@@ -1,0 +1,29 @@
+# Cross-binding predict conformance consumer (R): same shared fixture as
+# the C++/Java/MATLAB tests (tests/fixtures/predict_conformance).
+# Run from the repo root after R CMD INSTALL bindings/R-package:
+#   Rscript bindings/R-package/tests/predict_fixture.R
+library(mxnet)
+
+read.tensor <- function(path) {
+  lines <- readLines(path)
+  shape <- as.integer(strsplit(trimws(lines[1]), "\\s+")[[1]])
+  vals <- as.numeric(lines[-1])
+  list(shape = shape, vals = vals)
+}
+
+dir <- "tests/fixtures/predict_conformance"
+input <- read.tensor(file.path(dir, "input.txt"))
+want <- read.tensor(file.path(dir, "expected.txt"))
+
+model <- mx.model.load(file.path(dir, "model"), 1)
+# fixture values are row-major; predict.mx.model takes a flat row-major
+# batch plus the input shape
+got <- predict.mx.model(model, input$vals, input$shape)
+
+stopifnot(length(got) == length(want$vals))
+rel <- abs(got - want$vals) / (abs(want$vals) + 1e-8)
+if (max(rel) > 1e-3) {
+  stop(sprintf("FAILED: max rel diff %.6f", max(rel)))
+}
+cat(sprintf("PASSED: max rel diff %.2e over %d logits\n",
+            max(rel), length(got)))

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build the JVM binding + examples. Requires JDK 22+ (java.lang.foreign).
+# Usage: bash bindings/jvm/build.sh   (from the repo root)
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+javac --release 22 -d build \
+  src/main/java/org/mxnettpu/*.java \
+  examples/TrainMnist.java examples/PredictFixture.java
+echo "built into bindings/jvm/build; run e.g.:"
+echo "  PYTHONPATH=\$(git rev-parse --show-toplevel) java -cp bindings/jvm/build TrainMnist"

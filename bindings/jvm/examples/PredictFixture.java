@@ -1,0 +1,109 @@
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+import org.mxnettpu.Context;
+import org.mxnettpu.Executor;
+import org.mxnettpu.NDArray;
+import org.mxnettpu.Symbol;
+
+/**
+ * Cross-binding predict conformance: load the shared fixture
+ * (tests/fixtures/predict_conformance — one checkpoint + input +
+ * expected logits consumed by the C++, Java, R and MATLAB binding
+ * tests), run forward, and compare logits to 1e-3 relative tolerance.
+ *
+ * Fixture text format (language-neutral): first line of input.txt /
+ * expected.txt is the shape (space-separated dims), then one value per
+ * line, row-major.
+ *
+ * Run: PYTHONPATH=. java -cp bindings/jvm/build PredictFixture \
+ *          tests/fixtures/predict_conformance
+ */
+public final class PredictFixture {
+  public static void main(String[] args) throws Exception {
+    Path dir = Path.of(args.length > 0 ? args[0]
+        : "tests/fixtures/predict_conformance");
+    float[][] in = readTensor(dir.resolve("input.txt"));
+    float[][] expected = readTensor(dir.resolve("expected.txt"));
+
+    try (Symbol net = Symbol.load(dir.resolve("model-symbol.json").toString())) {
+      Map<String, NDArray> params =
+          NDArray.load(dir.resolve("model-0001.params").toString());
+      int[] inShape = toShape(in[0]);
+      List<String> argNames = net.listArguments();
+      Map<String, int[]> known = new LinkedHashMap<>();
+      known.put("data", inShape);
+      Symbol.InferredShapes inf = net.inferShape(known);
+      NDArray[] argArr = new NDArray[argNames.size()];
+      int[] reqs = new int[argNames.size()];
+      for (int i = 0; i < argNames.size(); i++) {
+        String name = argNames.get(i);
+        argArr[i] = NDArray.zeros(inf.argShapes()[i], Context.cpu());
+        NDArray saved = params.get("arg:" + name);
+        if (saved != null) {
+          argArr[i].set(saved.toArray());
+        }
+        reqs[i] = Executor.GRAD_NULL;
+      }
+      List<String> auxNames = net.listAuxiliaryStates();
+      NDArray[] auxArr = new NDArray[auxNames.size()];
+      for (int i = 0; i < auxNames.size(); i++) {
+        auxArr[i] = NDArray.zeros(inf.auxShapes()[i], Context.cpu());
+        NDArray saved = params.get("aux:" + auxNames.get(i));
+        if (saved != null) {
+          auxArr[i].set(saved.toArray());
+        }
+      }
+      try (Executor exec = Executor.bind(net, Context.cpu(), argArr,
+              null, reqs, auxArr)) {
+        argArr[argNames.indexOf("data")].set(in[1]);
+        exec.forward(false);
+        float[] got = exec.outputs()[0].toArray();
+        float[] want = expected[1];
+        if (got.length != want.length) {
+          System.err.println("FAILED: output size " + got.length
+              + " != expected " + want.length);
+          System.exit(1);
+        }
+        double worst = 0;
+        for (int i = 0; i < got.length; i++) {
+          double rel = Math.abs(got[i] - want[i])
+              / (Math.abs(want[i]) + 1e-8);
+          worst = Math.max(worst, rel);
+        }
+        if (worst > 1e-3) {
+          System.err.printf("FAILED: max rel diff %.6f%n", worst);
+          System.exit(1);
+        }
+        System.out.printf("PASSED: max rel diff %.2e over %d logits%n",
+            worst, got.length);
+      }
+    }
+  }
+
+  /** Returns {shape-as-floats, values}. */
+  private static float[][] readTensor(Path p) throws Exception {
+    List<String> lines = Files.readAllLines(p);
+    String[] dims = lines.get(0).trim().split("\\s+");
+    float[] shape = new float[dims.length];
+    for (int i = 0; i < dims.length; i++) {
+      shape[i] = Integer.parseInt(dims[i]);
+    }
+    float[] vals = new float[lines.size() - 1];
+    for (int i = 1; i < lines.size(); i++) {
+      vals[i - 1] = Float.parseFloat(lines.get(i).trim());
+    }
+    return new float[][] {shape, vals};
+  }
+
+  private static int[] toShape(float[] dims) {
+    int[] out = new int[dims.length];
+    for (int i = 0; i < dims.length; i++) {
+      out[i] = (int) dims[i];
+    }
+    return out;
+  }
+}

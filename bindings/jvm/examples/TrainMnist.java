@@ -1,0 +1,59 @@
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+import org.mxnettpu.Context;
+import org.mxnettpu.DataIter;
+import org.mxnettpu.Initializer;
+import org.mxnettpu.Metric;
+import org.mxnettpu.Module;
+import org.mxnettpu.Optimizer;
+import org.mxnettpu.Symbol;
+import org.mxnettpu.SymbolOps;
+
+/**
+ * Train an MLP on (synthetic) MNIST from Java — the JVM equivalent of
+ * tests/train/test_mlp.py and of the reference's Scala
+ * TrainMnist example (ref: scala-package/examples/.../TrainMnist.scala).
+ * Exits 0 when final train accuracy &gt; 0.9.
+ *
+ * Run (JDK 22+):
+ *   cd <repo> && bash bindings/jvm/build.sh && \
+ *   PYTHONPATH=. java -cp bindings/jvm/build TrainMnist
+ */
+public final class TrainMnist {
+  public static void main(String[] args) {
+    Symbol data = Symbol.variable("data");
+    Symbol fc1 = SymbolOps.FullyConnected("fc1", data, null, null, "128", null);
+    Symbol act1 = SymbolOps.Activation("act1", fc1, "relu", null);
+    Symbol fc2 = SymbolOps.FullyConnected("fc2", act1, null, null, "64", null);
+    Symbol act2 = SymbolOps.Activation("act2", fc2, "relu", null);
+    Symbol fc3 = SymbolOps.FullyConnected("fc3", act2, null, null, "10", null);
+    Symbol net = SymbolOps.SoftmaxOutput("softmax", fc3, null, null);
+
+    int batch = 32;
+    Map<String, int[]> shapes = new LinkedHashMap<>();
+    shapes.put("data", new int[] {batch, 784});
+    shapes.put("softmax_label", new int[] {batch});
+
+    try (Module mod = new Module(net, Context.cpu(),
+            List.of("data"), List.of("softmax_label"));
+         DataIter train = DataIter.create("MNISTIter", Map.of(
+             "batch_size", Integer.toString(batch),
+             "num_synthetic", "512", "seed", "1", "flat", "true"));
+         Optimizer opt = Optimizer.create("ccsgd", Map.of(
+             "momentum", "0.9", "rescale_grad",
+             Float.toString(1.0f / batch)))) {
+      mod.bind(shapes, true);
+      mod.initParams(new Initializer.Xavier(7), shapes);
+      double acc = mod.fit(train, opt, 0.1f, 0.0f, 3, new Metric.Accuracy());
+      System.out.printf("final train accuracy: %.4f%n", acc);
+      if (!(acc > 0.9)) {
+        System.err.println("FAILED: accuracy gate 0.9 not met");
+        System.exit(1);
+      }
+      mod.saveParams("/tmp/jvm_mnist.params");
+      System.out.println("PASSED");
+    }
+  }
+}

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Generate the JVM binding's operator surface from the op registry.
+
+The reference Scala package generates its op methods from the C registry
+with compile-time macros (ref: scala-package/macros/src/main/scala/
+ml/dmlc/mxnet/NDArrayMacro.scala, SymbolMacro.scala). Here the same
+schema (ops/registry.py Field) drives a source generator: one typed
+static creator per op in SymbolOps.java (symbolic) and NDArrayOps.java
+(imperative), javadoc'd from the same prose that backs the Python
+docstrings (ops/opdoc.py). Regenerate after adding ops:
+
+    python bindings/jvm/gen_ops.py
+
+The generated files are committed; tests/unittest/test_jvm_binding.py
+asserts they are in sync with the registry.
+"""
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+OUT_DIR = os.path.join(ROOT, "bindings", "jvm", "src", "main", "java",
+                       "org", "mxnettpu")
+
+JAVA_KEYWORDS = {"abstract", "boolean", "break", "byte", "case", "catch",
+                 "char", "class", "const", "continue", "default", "do",
+                 "double", "else", "enum", "extends", "final", "finally",
+                 "float", "for", "goto", "if", "implements", "import",
+                 "instanceof", "int", "interface", "long", "native", "new",
+                 "package", "private", "protected", "public", "return",
+                 "short", "static", "strictfp", "super", "switch",
+                 "synchronized", "this", "throw", "throws", "transient",
+                 "try", "void", "volatile", "while"}
+
+
+def camel(name):
+    parts = [p for p in name.split("_") if p]
+    if not parts:
+        return name
+    out = parts[0] + "".join(p.capitalize() for p in parts[1:])
+    return out + "_" if out in JAVA_KEYWORDS else out
+
+
+def method_name(op_key):
+    n = op_key.lstrip("_")
+    n = re.sub(r"[^A-Za-z0-9_]", "_", n)
+    if op_key.startswith("_"):
+        n = "op" + n[0].upper() + n[1:]
+    return n + "_" if n in JAVA_KEYWORDS else n
+
+
+def javadoc(text, indent="  "):
+    lines = [indent + " * " + l.replace("*/", "*\\/")
+             for l in text.splitlines()]
+    return (indent + "/**\n" + "\n".join(lines) + "\n" + indent + " */")
+
+
+def gen_symbol_ops(registry, build_doc):
+    methods = []
+    seen = set()
+    for key in sorted(registry):
+        op = registry[key]
+        if key != op.name:
+            continue  # aliases share the canonical creator
+        mname = method_name(key)
+        if mname in seen:
+            continue
+        seen.add(mname)
+        doc = build_doc(op, key, kind="symbol")
+        required = [(p, f) for p, f in op.param_fields.items()
+                    if f.required and p != "__kwargs__"]
+        if op.key_var_num_args:
+            sig = ["String name"]
+            sig += ["String %s" % camel(p) for p, _ in required
+                    if p != op.key_var_num_args]
+            sig += ["java.util.Map<String, String> optParams",
+                    "Symbol... args"]
+            body = [
+                "    java.util.Map<String, String> p = new java.util.LinkedHashMap<>();",
+                "    if (optParams != null) { p.putAll(optParams); }",
+            ]
+            for p, _ in required:
+                if p != op.key_var_num_args:
+                    body.append('    p.put("%s", %s);' % (p, camel(p)))
+            body += [
+                '    p.put("%s", Integer.toString(args.length));'
+                % op.key_var_num_args,
+                "    java.util.Map<String, Symbol> in = new java.util.LinkedHashMap<>();",
+                "    for (int i = 0; i < args.length; i++) {",
+                '      in.put("arg" + i, args[i]);',
+                "    }",
+                '    return Symbol.create("%s", name, p, in);' % key,
+            ]
+        else:
+            try:
+                arg_names = op.list_arguments({})
+            except Exception:
+                arg_names = ["data"]
+            sig = ["String name"]
+            sig += ["Symbol %s" % camel(a) for a in arg_names]
+            sig += ["String %s" % camel(p) for p, _ in required]
+            sig += ["java.util.Map<String, String> optParams"]
+            body = [
+                "    java.util.Map<String, String> p = new java.util.LinkedHashMap<>();",
+                "    if (optParams != null) { p.putAll(optParams); }",
+            ]
+            for p, _ in required:
+                body.append('    p.put("%s", %s);' % (p, camel(p)))
+            body.append(
+                "    java.util.Map<String, Symbol> in = new java.util.LinkedHashMap<>();")
+            for a in arg_names:
+                body.append('    if (%s != null) { in.put("%s", %s); }'
+                            % (camel(a), a, camel(a)))
+            body.append('    return Symbol.create("%s", name, p, in);' % key)
+        methods.append("%s\n  public static Symbol %s(%s) {\n%s\n  }\n"
+                       % (javadoc(doc), mname, ", ".join(sig), "\n".join(body)))
+    return methods
+
+
+def gen_ndarray_ops(registry, build_doc):
+    methods = []
+    seen = set()
+    for key in sorted(registry):
+        op = registry[key]
+        if key != op.name or not op.imperative:
+            continue
+        mname = method_name(key)
+        if mname in seen:
+            continue
+        seen.add(mname)
+        doc = build_doc(op, key, kind="ndarray")
+        methods.append(
+            "%s\n  public static NDArray[] %s(java.util.Map<String, String> "
+            "params, NDArray... inputs) {\n"
+            '    return NDArray.invoke("%s", inputs, params);\n  }\n'
+            % (javadoc(doc), mname, key))
+    return methods
+
+
+HEADER = """package org.mxnettpu;
+
+// GENERATED by bindings/jvm/gen_ops.py from the op registry
+// (mxnet_tpu/ops/registry.py) — do not edit by hand. The reference
+// generates the same surface with Scala macros from the C registry
+// (ref: scala-package/macros/.../SymbolMacro.scala). Regenerate with:
+//     python bindings/jvm/gen_ops.py
+
+/** %s */
+public final class %s {
+  private %s() {}
+
+"""
+
+
+def main():
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.ops.opdoc import build_doc
+    from mxnet_tpu.ops.registry import REGISTRY
+
+    sym = gen_symbol_ops(REGISTRY, build_doc)
+    with open(os.path.join(OUT_DIR, "SymbolOps.java"), "w") as f:
+        f.write(HEADER % (
+            "Typed symbolic creators for every registered operator; "
+            "null Symbol inputs become auto-named variables.",
+            "SymbolOps", "SymbolOps"))
+        f.write("\n".join(sym))
+        f.write("}\n")
+    nd = gen_ndarray_ops(REGISTRY, build_doc)
+    with open(os.path.join(OUT_DIR, "NDArrayOps.java"), "w") as f:
+        f.write(HEADER % (
+            "Imperative invokers for every registered imperative op "
+            "(over MXFuncInvokeByName).",
+            "NDArrayOps", "NDArrayOps"))
+        f.write("\n".join(nd))
+        f.write("}\n")
+    print("generated SymbolOps.java (%d ops), NDArrayOps.java (%d ops)"
+          % (len(sym), len(nd)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+package org.mxnettpu;
+
+/**
+ * Device context (ref: python/mxnet/context.py:126, include/mxnet/base.h:85).
+ * Device-type codes match the C ABI: 1=cpu, 2=gpu (alias of tpu here),
+ * 3=cpu_pinned, 6=tpu.
+ */
+public final class Context {
+  public final int devType;
+  public final int devId;
+
+  private Context(int devType, int devId) {
+    this.devType = devType;
+    this.devId = devId;
+  }
+
+  public static Context cpu() {
+    return cpu(0);
+  }
+
+  public static Context cpu(int id) {
+    return new Context(1, id);
+  }
+
+  public static Context tpu() {
+    return tpu(0);
+  }
+
+  public static Context tpu(int id) {
+    return new Context(6, id);
+  }
+
+  /** Reference-compatible alias: gpu maps to the accelerator (tpu). */
+  public static Context gpu(int id) {
+    return new Context(2, id);
+  }
+
+  @Override
+  public String toString() {
+    String name = switch (devType) {
+      case 1 -> "cpu";
+      case 3 -> "cpu_pinned";
+      default -> "tpu";
+    };
+    return name + "(" + devId + ")";
+  }
+}

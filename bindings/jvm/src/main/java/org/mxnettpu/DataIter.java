@@ -1,0 +1,123 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.MemorySegment;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+
+import static org.mxnettpu.LibMx.C_INT;
+import static org.mxnettpu.LibMx.PTR;
+import static org.mxnettpu.LibMx.check;
+import static org.mxnettpu.LibMx.fd;
+import static org.mxnettpu.LibMx.mh;
+
+/**
+ * Data iterator over MXDataIterCreateIter (include/c_api.h:224-243) —
+ * the JVM analog of the reference Scala package's IO
+ * (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/IO.scala).
+ * Registered iterators: MNISTIter, CSVIter, NDArrayIter, ImageRecordIter
+ * (list with {@link #listIters}).
+ */
+public final class DataIter implements AutoCloseable {
+  final MemorySegment handle;
+  private boolean closed;
+
+  private DataIter(MemorySegment handle) {
+    this.handle = handle;
+  }
+
+  public static DataIter create(String iterName, Map<String, String> params) {
+    Map<String, String> p = params == null ? Map.of() : params;
+    try (Arena a = Arena.ofConfined()) {
+      String[] keys = p.keySet().toArray(new String[0]);
+      String[] vals = new String[keys.length];
+      for (int i = 0; i < keys.length; i++) {
+        vals[i] = p.get(keys[i]);
+      }
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXDataIterCreateIter", fd(PTR, C_INT, PTR, PTR, PTR))
+          .invoke(LibMx.cstr(iterName, a), keys.length,
+                  LibMx.cstrArray(keys, a), LibMx.cstrArray(vals, a), out));
+      return new DataIter(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Advance; false at epoch end (ref: MXDataIterNext). */
+  public boolean next() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(C_INT);
+      check((int) mh("MXDataIterNext", fd(PTR, PTR)).invoke(handle, out));
+      return out.get(C_INT, 0) != 0;
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Rewind to epoch start (ref: MXDataIterBeforeFirst). */
+  public void reset() {
+    try {
+      check((int) mh("MXDataIterBeforeFirst", fd(PTR)).invoke(handle));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  private NDArray get(String fn) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh(fn, fd(PTR, PTR)).invoke(handle, out));
+      return new NDArray(out.get(PTR, 0), true);
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Current batch's data array. */
+  public NDArray getData() {
+    return get("MXDataIterGetData");
+  }
+
+  /** Current batch's label array. */
+  public NDArray getLabel() {
+    return get("MXDataIterGetLabel");
+  }
+
+  /** Padding count of the final partial batch (ref: MXDataIterGetPadNum). */
+  public int getPadNum() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(C_INT);
+      check((int) mh("MXDataIterGetPadNum", fd(PTR, PTR)).invoke(handle, out));
+      return out.get(C_INT, 0);
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Registered iterator names (ref: MXListDataIters). */
+  public static List<String> listIters() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment n = a.allocate(C_INT);
+      MemorySegment arr = a.allocate(PTR);
+      check((int) mh("MXListDataIters", fd(PTR, PTR)).invoke(n, arr));
+      String[] out = LibMx.readCStringArray(arr.get(PTR, 0), n.get(C_INT, 0));
+      return new ArrayList<>(List.of(out));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      try {
+        check((int) mh("MXDataIterFree", fd(PTR)).invoke(handle));
+      } catch (Throwable t) {
+        throw NDArray.wrap(t);
+      }
+    }
+  }
+}

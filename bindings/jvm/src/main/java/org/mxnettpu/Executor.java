@@ -1,0 +1,158 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.MemorySegment;
+import java.util.List;
+import java.util.Map;
+
+import static org.mxnettpu.LibMx.C_INT;
+import static org.mxnettpu.LibMx.PTR;
+import static org.mxnettpu.LibMx.check;
+import static org.mxnettpu.LibMx.fd;
+import static org.mxnettpu.LibMx.mh;
+
+/**
+ * Bound computation graph: forward/backward over MXExecutorBindEX /
+ * MXExecutorForward / MXExecutorBackward (include/c_api.h:192-222) —
+ * the JVM analog of the reference Scala Executor
+ * (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/Executor.scala).
+ *
+ * <p>Argument order follows {@code symbol.listArguments()}; grad_req
+ * codes are 0=null 1=write 3=add, as in the header.</p>
+ */
+public final class Executor implements AutoCloseable {
+  public static final int GRAD_NULL = 0;
+  public static final int GRAD_WRITE = 1;
+  public static final int GRAD_ADD = 3;
+
+  final MemorySegment handle;
+  private final NDArray[] args;
+  private final NDArray[] grads;
+  private final NDArray[] aux;
+  private boolean closed;
+
+  private Executor(MemorySegment handle, NDArray[] args, NDArray[] grads,
+                   NDArray[] aux) {
+    this.handle = handle;
+    this.args = args;
+    this.grads = grads;
+    this.aux = aux;
+  }
+
+  /**
+   * Bind a symbol on ctx. args/grads follow symbol.listArguments() order
+   * (grads entries may be null where gradReq is GRAD_NULL); aux follows
+   * listAuxiliaryStates() order.
+   */
+  public static Executor bind(Symbol symbol, Context ctx, NDArray[] args,
+                              NDArray[] grads, int[] gradReq, NDArray[] aux) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment argArr = a.allocate(PTR, Math.max(1, args.length));
+      MemorySegment gradArr = a.allocate(PTR, Math.max(1, args.length));
+      for (int i = 0; i < args.length; i++) {
+        argArr.setAtIndex(PTR, i, args[i].handle);
+        gradArr.setAtIndex(PTR, i,
+            grads != null && grads[i] != null ? grads[i].handle
+                                              : MemorySegment.NULL);
+      }
+      MemorySegment reqArr = LibMx.uintArray(gradReq, a);
+      MemorySegment auxArr = a.allocate(PTR, Math.max(1, aux.length));
+      for (int i = 0; i < aux.length; i++) {
+        auxArr.setAtIndex(PTR, i, aux[i].handle);
+      }
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXExecutorBindEX",
+              fd(PTR, C_INT, C_INT, C_INT, PTR, PTR, PTR,
+                 C_INT, PTR, PTR, PTR, C_INT, PTR, PTR, PTR))
+          .invoke(symbol.handle, ctx.devType, ctx.devId,
+                  0, MemorySegment.NULL, MemorySegment.NULL, MemorySegment.NULL,
+                  args.length, argArr, gradArr, reqArr,
+                  aux.length, auxArr, MemorySegment.NULL, out));
+      return new Executor(out.get(PTR, 0), args.clone(),
+          grads == null ? new NDArray[args.length] : grads.clone(),
+          aux.clone());
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public void forward(boolean isTrain) {
+    try {
+      check((int) mh("MXExecutorForward", fd(PTR, C_INT))
+          .invoke(handle, isTrain ? 1 : 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Backward from loss heads (no explicit head gradients). */
+  public void backward() {
+    backward(new NDArray[0]);
+  }
+
+  public void backward(NDArray[] headGrads) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment arr = a.allocate(PTR, Math.max(1, headGrads.length));
+      for (int i = 0; i < headGrads.length; i++) {
+        arr.setAtIndex(PTR, i, headGrads[i].handle);
+      }
+      check((int) mh("MXExecutorBackward", fd(PTR, C_INT, PTR))
+          .invoke(handle, headGrads.length, arr));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Output arrays (library-owned handles, refreshed per forward). */
+  public NDArray[] outputs() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment n = a.allocate(C_INT);
+      MemorySegment arr = a.allocate(PTR);
+      check((int) mh("MXExecutorOutputs", fd(PTR, PTR, PTR))
+          .invoke(handle, n, arr));
+      MemorySegment[] hs = LibMx.readPtrArray(arr.get(PTR, 0), n.get(C_INT, 0));
+      NDArray[] out = new NDArray[hs.length];
+      for (int i = 0; i < hs.length; i++) {
+        out[i] = new NDArray(hs[i], true);
+      }
+      return out;
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public NDArray[] argArrays() {
+    return args;
+  }
+
+  public NDArray[] gradArrays() {
+    return grads;
+  }
+
+  public NDArray[] auxArrays() {
+    return aux;
+  }
+
+  /** Memory/plan report (ref: MXExecutorPrint). */
+  public String print() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXExecutorPrint", fd(PTR, PTR)).invoke(handle, out));
+      return LibMx.readCString(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      try {
+        check((int) mh("MXExecutorFree", fd(PTR)).invoke(handle));
+      } catch (Throwable t) {
+        throw NDArray.wrap(t);
+      }
+    }
+  }
+}

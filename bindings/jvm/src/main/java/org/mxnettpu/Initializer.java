@@ -1,0 +1,82 @@
+package org.mxnettpu;
+
+import java.util.Random;
+
+/**
+ * Host-side weight initialisers, mirroring mx.initializer
+ * (ref: python/mxnet/initializer.py; Scala analog
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/Initializer.scala).
+ * Name-based dispatch matches the reference convention: *_bias and
+ * *_beta to zero, *_gamma / moving_var to one, weights by the strategy.
+ */
+public abstract class Initializer {
+  protected final Random rng;
+
+  protected Initializer(long seed) {
+    this.rng = new Random(seed);
+  }
+
+  /** Fill arr according to its role (derived from the argument name). */
+  public void init(String name, NDArray arr) {
+    int[] shape = arr.shape();
+    int n = (int) NDArray.size(shape);
+    float[] buf = new float[n];
+    if (name.endsWith("_bias") || name.endsWith("_beta")
+        || name.endsWith("moving_mean")) {
+      // zeros: buf already 0
+    } else if (name.endsWith("_gamma") || name.endsWith("moving_var")) {
+      java.util.Arrays.fill(buf, 1.0f);
+    } else {
+      fillWeight(shape, buf);
+    }
+    arr.set(buf);
+  }
+
+  protected abstract void fillWeight(int[] shape, float[] buf);
+
+  /** Xavier/Glorot uniform (ref: initializer.py Xavier). */
+  public static final class Xavier extends Initializer {
+    private final float magnitude;
+
+    public Xavier(long seed) {
+      this(seed, 3.0f);
+    }
+
+    public Xavier(long seed, float magnitude) {
+      super(seed);
+      this.magnitude = magnitude;
+    }
+
+    @Override
+    protected void fillWeight(int[] shape, float[] buf) {
+      // fan_in/fan_out as the reference computes them: dim0 = out,
+      // remaining dims = in (convolution kernels included)
+      long fanOut = shape.length > 0 ? shape[0] : 1;
+      long fanIn = 1;
+      for (int i = 1; i < shape.length; i++) {
+        fanIn *= shape[i];
+      }
+      float scale = (float) Math.sqrt(2.0 * magnitude / (fanIn + fanOut));
+      for (int i = 0; i < buf.length; i++) {
+        buf[i] = (rng.nextFloat() * 2 - 1) * scale;
+      }
+    }
+  }
+
+  /** Uniform in [-scale, scale] (ref: initializer.py Uniform). */
+  public static final class Uniform extends Initializer {
+    private final float scale;
+
+    public Uniform(long seed, float scale) {
+      super(seed);
+      this.scale = scale;
+    }
+
+    @Override
+    protected void fillWeight(int[] shape, float[] buf) {
+      for (int i = 0; i < buf.length; i++) {
+        buf[i] = (rng.nextFloat() * 2 - 1) * scale;
+      }
+    }
+  }
+}

@@ -1,0 +1,170 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.MemorySegment;
+import java.lang.invoke.MethodHandle;
+import java.lang.invoke.MethodHandles;
+import java.lang.invoke.MethodType;
+
+import static org.mxnettpu.LibMx.C_INT;
+import static org.mxnettpu.LibMx.PTR;
+import static org.mxnettpu.LibMx.check;
+import static org.mxnettpu.LibMx.fd;
+import static org.mxnettpu.LibMx.mh;
+
+/**
+ * Key-value store for multi-device / distributed synchronization over
+ * MXKVStore* (include/c_api.h:245-273) — the JVM analog of the reference
+ * Scala KVStore
+ * (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/KVStore.scala).
+ * Types: "local", "device" (ICI all-reduce), "dist_sync", "dist_async".
+ *
+ * <p>The Java updater callback is registered through an FFM upcall stub;
+ * callback-visible NDArray handles are BORROWED (header contract,
+ * include/c_api.h:41-46) and must not be freed or retained.</p>
+ */
+public final class KVStore implements AutoCloseable {
+  /** Java-side updater: merge recv into local (both borrowed). */
+  public interface Updater {
+    void update(int key, NDArray recv, NDArray local);
+  }
+
+  final MemorySegment handle;
+  private final Arena callbackArena = Arena.ofShared();
+  private Updater updater;  // strong ref: the stub must outlive the store
+  private boolean closed;
+
+  private KVStore(MemorySegment handle) {
+    this.handle = handle;
+  }
+
+  public static KVStore create(String type) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXKVStoreCreate", fd(PTR, PTR))
+          .invoke(LibMx.cstr(type, a), out));
+      return new KVStore(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  private void keyedOp(String fn, int[] keys, NDArray[] vals, Integer priority) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment keyArr = a.allocateFrom(C_INT, keys);
+      MemorySegment valArr = a.allocate(PTR, Math.max(1, vals.length));
+      for (int i = 0; i < vals.length; i++) {
+        valArr.setAtIndex(PTR, i, vals[i].handle);
+      }
+      if (priority == null) {
+        check((int) mh(fn, fd(PTR, C_INT, PTR, PTR))
+            .invoke(handle, keys.length, keyArr, valArr));
+      } else {
+        check((int) mh(fn, fd(PTR, C_INT, PTR, PTR, C_INT))
+            .invoke(handle, keys.length, keyArr, valArr, (int) priority));
+      }
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public void init(int[] keys, NDArray[] vals) {
+    keyedOp("MXKVStoreInit", keys, vals, null);
+  }
+
+  public void push(int[] keys, NDArray[] vals, int priority) {
+    keyedOp("MXKVStorePush", keys, vals, priority);
+  }
+
+  public void pull(int[] keys, NDArray[] vals, int priority) {
+    keyedOp("MXKVStorePull", keys, vals, priority);
+  }
+
+  /** Install a Java updater (ref: MXKVStoreSetUpdater). */
+  public void setUpdater(Updater u) {
+    this.updater = u;
+    try {
+      MethodHandle target = MethodHandles.lookup().findVirtual(
+          KVStore.class, "updaterBridge",
+          MethodType.methodType(void.class, int.class, MemorySegment.class,
+                                MemorySegment.class, MemorySegment.class))
+          .bindTo(this);
+      MemorySegment stub = LibMx.upcall(
+          target,
+          FunctionDescriptor.ofVoid(C_INT, PTR, PTR, PTR),
+          callbackArena);
+      check((int) mh("MXKVStoreSetUpdater", fd(PTR, PTR, PTR))
+          .invoke(handle, stub, MemorySegment.NULL));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Upcall target; handles are borrowed, so the NDArrays are non-owning. */
+  public void updaterBridge(int key, MemorySegment recv, MemorySegment local,
+                            MemorySegment user) {
+    updater.update(key, new NDArray(recv, false), new NDArray(local, false));
+  }
+
+  public String type() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXKVStoreGetType", fd(PTR, PTR)).invoke(handle, out));
+      return LibMx.readCString(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  private int intQuery(String fn) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(C_INT);
+      check((int) mh(fn, fd(PTR, PTR)).invoke(handle, out));
+      return out.get(C_INT, 0);
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public int rank() {
+    return intQuery("MXKVStoreGetRank");
+  }
+
+  public int numWorkers() {
+    return intQuery("MXKVStoreGetGroupSize");
+  }
+
+  public void barrier() {
+    try {
+      check((int) mh("MXKVStoreBarrier", fd(PTR)).invoke(handle));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public int numDeadNode(int nodeId, int timeoutSec) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(C_INT);
+      check((int) mh("MXKVStoreGetNumDeadNode", fd(PTR, C_INT, PTR, C_INT))
+          .invoke(handle, nodeId, out, timeoutSec));
+      return out.get(C_INT, 0);
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      try {
+        check((int) mh("MXKVStoreFree", fd(PTR)).invoke(handle));
+      } catch (Throwable t) {
+        throw NDArray.wrap(t);
+      } finally {
+        callbackArena.close();
+      }
+    }
+  }
+}

@@ -1,0 +1,152 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemoryLayout;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.util.HashMap;
+import java.util.Map;
+
+/**
+ * FFI core of the JVM binding: binds the flat C ABI of libc_api.so
+ * (include/c_api.h) through the Java Foreign Function &amp; Memory API
+ * (JDK 22+). This plays the role of the reference's JNI shim
+ * (ref: scala-package/native/src/main/native/ml_dmlc_mxnet_native_c_api.cc)
+ * with no native glue to compile: downcall handles are built straight
+ * from the header's signatures.
+ *
+ * <p>The library embeds CPython (src/c_api.cc), so the process must run
+ * with PYTHONPATH containing the repo root, exactly like the C++ binding
+ * (bindings/cpp/train_lenet.cc). Library path resolution: the
+ * MXNET_TPU_NATIVE env var, else {@code mxnet_tpu/_native/libc_api.so}
+ * relative to the working directory.</p>
+ */
+public final class LibMx {
+  public static final ValueLayout.OfInt C_INT = ValueLayout.JAVA_INT;
+  public static final ValueLayout.OfFloat C_FLOAT = ValueLayout.JAVA_FLOAT;
+  public static final ValueLayout.OfLong C_LONG = ValueLayout.JAVA_LONG;
+  public static final ValueLayout.AddressLayout PTR = ValueLayout.ADDRESS;
+
+  private static final Linker LINKER = Linker.nativeLinker();
+  private static final SymbolLookup LIB;
+  private static final Map<String, MethodHandle> HANDLES = new HashMap<>();
+
+  static {
+    String path = System.getenv("MXNET_TPU_NATIVE");
+    if (path == null || path.isEmpty()) {
+      path = "mxnet_tpu/_native/libc_api.so";
+    }
+    LIB = SymbolLookup.libraryLookup(path, Arena.global());
+  }
+
+  private LibMx() {}
+
+  /** Downcall handle for a C function, cached by name. */
+  public static synchronized MethodHandle mh(String name, FunctionDescriptor desc) {
+    return HANDLES.computeIfAbsent(
+        name,
+        n -> LINKER.downcallHandle(
+            LIB.find(n).orElseThrow(
+                () -> new MXNetException("symbol not found: " + n)),
+            desc));
+  }
+
+  /** Build an upcall stub for a Java callback (KVStore updater etc.). */
+  public static MemorySegment upcall(MethodHandle target, FunctionDescriptor desc,
+                                     Arena arena) {
+    return LINKER.upcallStub(target, desc, arena);
+  }
+
+  /** Raise MXNetException with MXGetLastError() when rc != 0. */
+  public static void check(int rc) {
+    if (rc != 0) {
+      throw new MXNetException(lastError());
+    }
+  }
+
+  public static String lastError() {
+    try {
+      MethodHandle h = mh("MXGetLastError", FunctionDescriptor.of(PTR));
+      MemorySegment s = (MemorySegment) h.invoke();
+      return readCString(s);
+    } catch (Throwable t) {
+      return "MXGetLastError failed: " + t;
+    }
+  }
+
+  // -- marshalling helpers ---------------------------------------------------
+
+  /** NUL-terminated UTF-8 copy of s in arena (NULL segment for null). */
+  public static MemorySegment cstr(String s, Arena arena) {
+    return s == null ? MemorySegment.NULL : arena.allocateFrom(s);
+  }
+
+  /** const char** array of NUL-terminated strings. */
+  public static MemorySegment cstrArray(String[] strs, Arena arena) {
+    MemorySegment arr = arena.allocate(PTR, Math.max(1, strs.length));
+    for (int i = 0; i < strs.length; i++) {
+      arr.setAtIndex(PTR, i, cstr(strs[i], arena));
+    }
+    return arr;
+  }
+
+  /** void** array of raw handles (NULL entries allowed). */
+  public static MemorySegment ptrArray(MemorySegment[] ptrs, Arena arena) {
+    MemorySegment arr = arena.allocate(PTR, Math.max(1, ptrs.length));
+    for (int i = 0; i < ptrs.length; i++) {
+      arr.setAtIndex(PTR, i, ptrs[i] == null ? MemorySegment.NULL : ptrs[i]);
+    }
+    return arr;
+  }
+
+  /** Read a C string (library-owned, valid until next call). */
+  public static String readCString(MemorySegment s) {
+    if (s == null || s.equals(MemorySegment.NULL)) {
+      return null;
+    }
+    return s.reinterpret(Long.MAX_VALUE).getString(0);
+  }
+
+  /** Read const char** of n entries into a String[]. */
+  public static String[] readCStringArray(MemorySegment arr, int n) {
+    MemorySegment a = arr.reinterpret(PTR.byteSize() * Math.max(1, n));
+    String[] out = new String[n];
+    for (int i = 0; i < n; i++) {
+      out[i] = readCString(a.getAtIndex(PTR, i));
+    }
+    return out;
+  }
+
+  /** Read void** of n entries. */
+  public static MemorySegment[] readPtrArray(MemorySegment arr, int n) {
+    MemorySegment a = arr.reinterpret(PTR.byteSize() * Math.max(1, n));
+    MemorySegment[] out = new MemorySegment[n];
+    for (int i = 0; i < n; i++) {
+      out[i] = a.getAtIndex(PTR, i);
+    }
+    return out;
+  }
+
+  /** Read mx_uint* of n entries into an int[]. */
+  public static int[] readUIntArray(MemorySegment arr, int n) {
+    MemorySegment a = arr.reinterpret(C_INT.byteSize() * Math.max(1, n));
+    int[] out = new int[n];
+    for (int i = 0; i < n; i++) {
+      out[i] = a.getAtIndex(C_INT, i);
+    }
+    return out;
+  }
+
+  public static MemorySegment uintArray(int[] vals, Arena arena) {
+    return arena.allocateFrom(C_INT, vals.length == 0 ? new int[] {0} : vals);
+  }
+
+  /** Common FunctionDescriptor shapes. */
+  public static FunctionDescriptor fd(MemoryLayout... layouts) {
+    return FunctionDescriptor.of(C_INT, layouts);
+  }
+}

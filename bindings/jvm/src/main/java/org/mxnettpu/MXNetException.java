@@ -1,0 +1,9 @@
+package org.mxnettpu;
+
+/** Error raised when a C API call returns nonzero; message comes from
+ *  MXGetLastError() (ref: include/mxnet/c_api.h:144 error convention). */
+public class MXNetException extends RuntimeException {
+  public MXNetException(String message) {
+    super(message);
+  }
+}

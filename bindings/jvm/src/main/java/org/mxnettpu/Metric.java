@@ -1,0 +1,48 @@
+package org.mxnettpu;
+
+/**
+ * Evaluation metrics, mirroring mx.metric (ref: python/mxnet/metric.py;
+ * Scala analog
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/EvalMetric.scala).
+ */
+public abstract class Metric {
+  protected long sumMetric;
+  protected long numInst;
+
+  public void reset() {
+    sumMetric = 0;
+    numInst = 0;
+  }
+
+  public abstract void update(NDArray label, NDArray pred);
+
+  public double get() {
+    return numInst == 0 ? Double.NaN : (double) sumMetric / numInst;
+  }
+
+  /** Classification accuracy: argmax over the trailing class axis. */
+  public static final class Accuracy extends Metric {
+    @Override
+    public void update(NDArray label, NDArray pred) {
+      float[] l = label.toArray();
+      float[] p = pred.toArray();
+      int[] shape = pred.shape();
+      int classes = shape[shape.length - 1];
+      int rows = p.length / classes;
+      for (int r = 0; r < rows && r < l.length; r++) {
+        int best = 0;
+        float bv = p[r * classes];
+        for (int c = 1; c < classes; c++) {
+          if (p[r * classes + c] > bv) {
+            bv = p[r * classes + c];
+            best = c;
+          }
+        }
+        if (best == Math.round(l[r])) {
+          sumMetric++;
+        }
+        numInst++;
+      }
+    }
+  }
+}

@@ -1,0 +1,192 @@
+package org.mxnettpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * High-level train/predict workflow — the JVM analog of the reference
+ * Scala Module
+ * (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/Module.scala /
+ * module/base_module.py fit): bind → initParams → per-batch
+ * forward/backward → optimizer update → metric, plus predict and
+ * checkpoint save/load through the NDArray binary format.
+ */
+public final class Module implements AutoCloseable {
+  private final Symbol symbol;
+  private final Context ctx;
+  private final List<String> argNames;
+  private final List<String> auxNames;
+  private final Map<String, NDArray> args = new LinkedHashMap<>();
+  private final Map<String, NDArray> grads = new LinkedHashMap<>();
+  private final Map<String, NDArray> aux = new LinkedHashMap<>();
+  private final List<String> dataNames;
+  private final List<String> labelNames;
+  private Executor exec;
+
+  public Module(Symbol symbol, Context ctx, List<String> dataNames,
+                List<String> labelNames) {
+    this.symbol = symbol;
+    this.ctx = ctx;
+    this.dataNames = dataNames;
+    this.labelNames = labelNames;
+    this.argNames = symbol.listArguments();
+    this.auxNames = symbol.listAuxiliaryStates();
+  }
+
+  /** Infer shapes from the input shapes, allocate params/grads/aux, bind. */
+  public void bind(Map<String, int[]> inputShapes, boolean forTraining) {
+    Symbol.InferredShapes inf = symbol.inferShape(inputShapes);
+    if (inf == null) {
+      throw new MXNetException("bind: incomplete shape inference");
+    }
+    NDArray[] argArr = new NDArray[argNames.size()];
+    NDArray[] gradArr = new NDArray[argNames.size()];
+    int[] reqs = new int[argNames.size()];
+    for (int i = 0; i < argNames.size(); i++) {
+      String name = argNames.get(i);
+      NDArray arr = NDArray.zeros(inf.argShapes()[i], ctx);
+      args.put(name, arr);
+      argArr[i] = arr;
+      boolean isParam = !inputShapes.containsKey(name);
+      if (forTraining && isParam) {
+        NDArray g = NDArray.zeros(inf.argShapes()[i], ctx);
+        grads.put(name, g);
+        gradArr[i] = g;
+        reqs[i] = Executor.GRAD_WRITE;
+      } else {
+        reqs[i] = Executor.GRAD_NULL;
+      }
+    }
+    NDArray[] auxArr = new NDArray[auxNames.size()];
+    for (int i = 0; i < auxNames.size(); i++) {
+      NDArray arr = NDArray.zeros(inf.auxShapes()[i], ctx);
+      aux.put(auxNames.get(i), arr);
+      auxArr[i] = arr;
+    }
+    exec = Executor.bind(symbol, ctx, argArr, gradArr, reqs, auxArr);
+  }
+
+  /** Initialise parameters (inputs are skipped — they're fed per batch). */
+  public void initParams(Initializer init, Map<String, int[]> inputShapes) {
+    for (Map.Entry<String, NDArray> e : args.entrySet()) {
+      if (!inputShapes.containsKey(e.getKey())) {
+        init.init(e.getKey(), e.getValue());
+      }
+    }
+  }
+
+  /**
+   * Train numEpochs over the iterator with the engine-resident optimizer
+   * (ccSGD pattern). Returns the final epoch's training accuracy.
+   */
+  public double fit(DataIter train, Optimizer opt, float lr, float wd,
+                    int numEpochs, Metric metric) {
+    List<String> paramNames = new ArrayList<>(grads.keySet());
+    double acc = 0;
+    for (int epoch = 0; epoch < numEpochs; epoch++) {
+      metric.reset();
+      train.reset();
+      while (train.next()) {
+        try (NDArray data = train.getData(); NDArray label = train.getLabel()) {
+          feed(data, label);
+          exec.forward(true);
+          exec.backward();
+          for (int i = 0; i < paramNames.size(); i++) {
+            String p = paramNames.get(i);
+            opt.update(i, args.get(p), grads.get(p), lr, wd);
+          }
+          NDArray[] outs = exec.outputs();
+          metric.update(label, outs[0]);
+          for (NDArray o : outs) {
+            o.close();
+          }
+        }
+      }
+      acc = metric.get();
+      System.out.printf("Epoch[%d] Train-accuracy=%.4f%n", epoch, acc);
+    }
+    return acc;
+  }
+
+  /** Score the iterator with the current parameters. */
+  public double score(DataIter data, Metric metric) {
+    metric.reset();
+    data.reset();
+    while (data.next()) {
+      try (NDArray d = data.getData(); NDArray label = data.getLabel()) {
+        feed(d, label);
+        exec.forward(false);
+        NDArray[] outs = exec.outputs();
+        metric.update(label, outs[0]);
+        for (NDArray o : outs) {
+          o.close();
+        }
+      }
+    }
+    return metric.get();
+  }
+
+  private void feed(NDArray data, NDArray label) {
+    // single data/label input each: copy host-side into the bound arrays
+    args.get(dataNames.get(0)).set(data.toArray());
+    if (!labelNames.isEmpty() && args.containsKey(labelNames.get(0))) {
+      args.get(labelNames.get(0)).set(label.toArray());
+    }
+  }
+
+  /** Save params in the reference checkpoint format (arg:/aux: prefixes,
+   *  ref: python/mxnet/model.py save_checkpoint). */
+  public void saveParams(String fname) {
+    Map<String, NDArray> named = new LinkedHashMap<>();
+    for (Map.Entry<String, NDArray> e : args.entrySet()) {
+      if (!dataNames.contains(e.getKey()) && !labelNames.contains(e.getKey())) {
+        named.put("arg:" + e.getKey(), e.getValue());
+      }
+    }
+    for (Map.Entry<String, NDArray> e : aux.entrySet()) {
+      named.put("aux:" + e.getKey(), e.getValue());
+    }
+    NDArray.save(fname, named);
+  }
+
+  /** Load params saved by any binding (same binary format). */
+  public void loadParams(String fname) {
+    Map<String, NDArray> loaded = NDArray.load(fname);
+    for (Map.Entry<String, NDArray> e : loaded.entrySet()) {
+      String k = e.getKey();
+      String bare = k.contains(":") ? k.substring(k.indexOf(':') + 1) : k;
+      Map<String, NDArray> target = k.startsWith("aux:") ? aux : args;
+      NDArray dst = target.get(bare);
+      if (dst != null) {
+        dst.set(e.getValue().toArray());
+      }
+      e.getValue().close();
+    }
+  }
+
+  public Map<String, NDArray> argDict() {
+    return args;
+  }
+
+  public Executor executor() {
+    return exec;
+  }
+
+  @Override
+  public void close() {
+    if (exec != null) {
+      exec.close();
+    }
+    for (NDArray a : args.values()) {
+      a.close();
+    }
+    for (NDArray g : grads.values()) {
+      g.close();
+    }
+    for (NDArray a : aux.values()) {
+      a.close();
+    }
+  }
+}

@@ -1,0 +1,274 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.MemorySegment;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+import static org.mxnettpu.LibMx.C_FLOAT;
+import static org.mxnettpu.LibMx.C_INT;
+import static org.mxnettpu.LibMx.C_LONG;
+import static org.mxnettpu.LibMx.PTR;
+import static org.mxnettpu.LibMx.check;
+import static org.mxnettpu.LibMx.fd;
+import static org.mxnettpu.LibMx.mh;
+
+/**
+ * Imperative n-dimensional array over the C ABI — the JVM analog of the
+ * reference Scala package's NDArray
+ * (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/NDArray.scala),
+ * built on MXNDArray* plus the generic MXFuncInvokeByName imperative
+ * registry (include/c_api.h:67-99).
+ */
+public final class NDArray implements AutoCloseable {
+  final MemorySegment handle;
+  private final boolean owned;
+  private boolean closed;
+
+  NDArray(MemorySegment handle, boolean owned) {
+    this.handle = handle;
+    this.owned = owned;
+  }
+
+  // -- creation --------------------------------------------------------------
+
+  /** Allocate an uninitialised array on ctx. */
+  public static NDArray empty(int[] shape, Context ctx) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXNDArrayCreate",
+              fd(PTR, C_INT, C_INT, C_INT, C_INT, PTR))
+          .invoke(LibMx.uintArray(shape, a), shape.length,
+                  ctx.devType, ctx.devId, 0, out));
+      return new NDArray(out.get(PTR, 0), true);
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  public static NDArray zeros(int[] shape, Context ctx) {
+    NDArray x = empty(shape, ctx);
+    x.set(new float[(int) size(shape)]);
+    return x;
+  }
+
+  /** Create from a host float buffer (row-major, f32). */
+  public static NDArray fromArray(float[] data, int[] shape, Context ctx) {
+    NDArray x = empty(shape, ctx);
+    x.set(data);
+    return x;
+  }
+
+  static long size(int[] shape) {
+    long n = 1;
+    for (int s : shape) {
+      n *= s;
+    }
+    return n;
+  }
+
+  // -- data movement ---------------------------------------------------------
+
+  /** Synchronous host-to-device copy (ref: MXNDArraySyncCopyFromCPU). */
+  public void set(float[] data) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment buf = a.allocateFrom(C_FLOAT, data);
+      check((int) mh("MXNDArraySyncCopyFromCPU", fd(PTR, PTR, C_LONG))
+          .invoke(handle, buf, (long) data.length));
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  /** Synchronous device-to-host copy (ref: MXNDArraySyncCopyToCPU). */
+  public float[] toArray() {
+    int n = (int) size(shape());
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment buf = a.allocate(C_FLOAT, n);
+      check((int) mh("MXNDArraySyncCopyToCPU", fd(PTR, PTR, C_LONG))
+          .invoke(handle, buf, (long) n));
+      return buf.toArray(C_FLOAT);
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  public int[] shape() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment dim = a.allocate(C_INT);
+      MemorySegment pdata = a.allocate(PTR);
+      check((int) mh("MXNDArrayGetShape", fd(PTR, PTR, PTR))
+          .invoke(handle, dim, pdata));
+      return LibMx.readUIntArray(pdata.get(PTR, 0), dim.get(C_INT, 0));
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  public Context context() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment dt = a.allocate(C_INT);
+      MemorySegment di = a.allocate(C_INT);
+      check((int) mh("MXNDArrayGetContext", fd(PTR, PTR, PTR))
+          .invoke(handle, dt, di));
+      int t = dt.get(C_INT, 0);
+      int i = di.get(C_INT, 0);
+      return t == 1 ? Context.cpu(i) : Context.tpu(i);
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  /** Block until pending writes land (ref: MXNDArrayWaitToRead). */
+  public void waitToRead() {
+    try {
+      check((int) mh("MXNDArrayWaitToRead", fd(PTR)).invoke(handle));
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  public static void waitAll() {
+    try {
+      check((int) mh("MXNDArrayWaitAll",
+          java.lang.foreign.FunctionDescriptor.of(C_INT)).invoke());
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  /** [start, stop) view along axis 0 (ref: MXNDArraySlice). */
+  public NDArray slice(int start, int stop) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXNDArraySlice", fd(PTR, C_INT, C_INT, PTR))
+          .invoke(handle, start, stop, out));
+      return new NDArray(out.get(PTR, 0), true);
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  // -- imperative ops --------------------------------------------------------
+
+  /**
+   * Invoke a registered imperative function by name
+   * (ref: MXFuncInvokeByName / c_api.h:447 MXFuncInvoke). kwargs are
+   * string key/value pairs; returns the op's outputs.
+   */
+  public static NDArray[] invoke(String name, NDArray[] inputs,
+                                 Map<String, String> kwargs) {
+    Map<String, String> kw = kwargs == null ? Map.of() : kwargs;
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment ins = a.allocate(PTR, Math.max(1, inputs.length));
+      for (int i = 0; i < inputs.length; i++) {
+        ins.setAtIndex(PTR, i, inputs[i].handle);
+      }
+      String[] keys = kw.keySet().toArray(new String[0]);
+      String[] vals = new String[keys.length];
+      for (int i = 0; i < keys.length; i++) {
+        vals[i] = kw.get(keys[i]);
+      }
+      int cap = 8;
+      MemorySegment nOut = a.allocate(C_INT);
+      nOut.set(C_INT, 0, cap);
+      MemorySegment outs = a.allocate(PTR, cap);
+      check((int) mh("MXFuncInvokeByName",
+              fd(PTR, PTR, C_INT, C_INT, PTR, PTR, PTR, PTR))
+          .invoke(LibMx.cstr(name, a), ins, inputs.length, keys.length,
+                  LibMx.cstrArray(keys, a), LibMx.cstrArray(vals, a),
+                  nOut, outs));
+      int n = nOut.get(C_INT, 0);
+      NDArray[] res = new NDArray[n];
+      for (int i = 0; i < n; i++) {
+        res[i] = new NDArray(outs.getAtIndex(PTR, i), true);
+      }
+      return res;
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  public NDArray plus(NDArray other) {
+    return invoke("_plus", new NDArray[] {this, other}, null)[0];
+  }
+
+  public NDArray mul(float scalar) {
+    return invoke("_mul_scalar", new NDArray[] {this},
+        Map.of("scalar", Float.toString(scalar)))[0];
+  }
+
+  // -- persistence -----------------------------------------------------------
+
+  /** Save named arrays in the reference binary format (ref: MXNDArraySave). */
+  public static void save(String fname, Map<String, NDArray> arrays) {
+    try (Arena a = Arena.ofConfined()) {
+      String[] keys = arrays.keySet().toArray(new String[0]);
+      MemorySegment handles = a.allocate(PTR, Math.max(1, keys.length));
+      for (int i = 0; i < keys.length; i++) {
+        handles.setAtIndex(PTR, i, arrays.get(keys[i]).handle);
+      }
+      check((int) mh("MXNDArraySave", fd(PTR, C_INT, PTR, PTR))
+          .invoke(LibMx.cstr(fname, a), keys.length, handles,
+                  LibMx.cstrArray(keys, a)));
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  /** Load a named-array file (ref: MXNDArrayLoad). */
+  public static Map<String, NDArray> load(String fname) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment outSize = a.allocate(C_INT);
+      MemorySegment outArr = a.allocate(PTR);
+      MemorySegment nameSize = a.allocate(C_INT);
+      MemorySegment names = a.allocate(PTR);
+      check((int) mh("MXNDArrayLoad", fd(PTR, PTR, PTR, PTR, PTR))
+          .invoke(LibMx.cstr(fname, a), outSize, outArr, nameSize, names));
+      int n = outSize.get(C_INT, 0);
+      int nn = nameSize.get(C_INT, 0);
+      MemorySegment[] handles = LibMx.readPtrArray(outArr.get(PTR, 0), n);
+      String[] keyArr = nn > 0
+          ? LibMx.readCStringArray(names.get(PTR, 0), nn) : new String[0];
+      Map<String, NDArray> out = new LinkedHashMap<>();
+      for (int i = 0; i < n; i++) {
+        String k = i < keyArr.length ? keyArr[i] : ("arg:" + i);
+        out.put(k, new NDArray(handles[i], true));
+      }
+      return out;
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  /** All registered imperative op names (ref: MXListAllOpNames). */
+  public static List<String> listOps() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment n = a.allocate(C_INT);
+      MemorySegment arr = a.allocate(PTR);
+      check((int) mh("MXListAllOpNames", fd(PTR, PTR)).invoke(n, arr));
+      String[] names = LibMx.readCStringArray(arr.get(PTR, 0), n.get(C_INT, 0));
+      return new ArrayList<>(List.of(names));
+    } catch (Throwable t) {
+      throw wrap(t);
+    }
+  }
+
+  @Override
+  public void close() {
+    if (owned && !closed) {
+      closed = true;
+      try {
+        check((int) mh("MXNDArrayFree", fd(PTR)).invoke(handle));
+      } catch (Throwable t) {
+        throw wrap(t);
+      }
+    }
+  }
+
+  static RuntimeException wrap(Throwable t) {
+    return t instanceof RuntimeException re ? re : new MXNetException(t.toString());
+  }
+}

@@ -1,0 +1,80 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.MemorySegment;
+import java.util.Map;
+
+import static org.mxnettpu.LibMx.C_FLOAT;
+import static org.mxnettpu.LibMx.C_INT;
+import static org.mxnettpu.LibMx.PTR;
+import static org.mxnettpu.LibMx.check;
+import static org.mxnettpu.LibMx.fd;
+import static org.mxnettpu.LibMx.mh;
+
+/**
+ * Engine-resident optimizer over MXOptimizerCreateOptimizer /
+ * MXOptimizerUpdate (include/c_api.h:299-308) — the ccSGD pattern: the
+ * update formula runs inside the library (jitted on device), the JVM
+ * only drives it per parameter index, exactly how the reference's
+ * kvstore servers run the C++ sgd updater without the GIL
+ * (ref: src/optimizer/sgd.cc:24, python/mxnet/optimizer.py:426 ccSGD).
+ *
+ * <p>Available creators mirror mx.optimizer: sgd, ccsgd, nag, adam,
+ * adagrad, rmsprop, adadelta, sgld, test.</p>
+ */
+public final class Optimizer implements AutoCloseable {
+  final MemorySegment handle;
+  private boolean closed;
+
+  private Optimizer(MemorySegment handle) {
+    this.handle = handle;
+  }
+
+  /** Create by name with string hyperparams, e.g.
+   *  {@code Optimizer.create("sgd", Map.of("momentum", "0.9"))}. */
+  public static Optimizer create(String name, Map<String, String> params) {
+    Map<String, String> p = params == null ? Map.of() : params;
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment creator = a.allocate(PTR);
+      check((int) mh("MXOptimizerFindCreator", fd(PTR, PTR))
+          .invoke(LibMx.cstr(name, a), creator));
+      String[] keys = p.keySet().toArray(new String[0]);
+      String[] vals = new String[keys.length];
+      for (int i = 0; i < keys.length; i++) {
+        vals[i] = p.get(keys[i]);
+      }
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXOptimizerCreateOptimizer",
+              fd(PTR, C_INT, PTR, PTR, PTR))
+          .invoke(creator.get(PTR, 0), keys.length,
+                  LibMx.cstrArray(keys, a), LibMx.cstrArray(vals, a), out));
+      return new Optimizer(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** In-place weight update: index keys per-parameter optimizer state. */
+  public void update(int index, NDArray weight, NDArray grad, float lr,
+                     float wd) {
+    try {
+      check((int) mh("MXOptimizerUpdate",
+              fd(PTR, C_INT, PTR, PTR, C_FLOAT, C_FLOAT))
+          .invoke(handle, index, weight.handle, grad.handle, lr, wd));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      try {
+        check((int) mh("MXOptimizerFree", fd(PTR)).invoke(handle));
+      } catch (Throwable t) {
+        throw NDArray.wrap(t);
+      }
+    }
+  }
+}

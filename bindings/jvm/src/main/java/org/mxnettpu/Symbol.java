@@ -1,0 +1,312 @@
+package org.mxnettpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.MemorySegment;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+import static org.mxnettpu.LibMx.C_INT;
+import static org.mxnettpu.LibMx.PTR;
+import static org.mxnettpu.LibMx.check;
+import static org.mxnettpu.LibMx.fd;
+import static org.mxnettpu.LibMx.mh;
+
+/**
+ * Symbolic graph node — the JVM analog of the reference Scala package's
+ * Symbol (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/Symbol.scala),
+ * over MXSymbolCreateAtomicSymbol / MXSymbolCompose / MXSymbolInferShape
+ * (include/c_api.h:101-190). Typed creators for every registered op live
+ * in {@link SymbolOps} (generated); {@link #create} is the generic
+ * runtime path driven by the C registry, like the reference's macros.
+ */
+public final class Symbol implements AutoCloseable {
+  final MemorySegment handle;
+  private boolean closed;
+
+  Symbol(MemorySegment handle) {
+    this.handle = handle;
+  }
+
+  // -- construction ----------------------------------------------------------
+
+  /** Placeholder input (ref: MXSymbolCreateVariable). */
+  public static Symbol variable(String name) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXSymbolCreateVariable", fd(PTR, PTR))
+          .invoke(LibMx.cstr(name, a), out));
+      return new Symbol(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /**
+   * Generic op construction: atomic symbol from string params, composed
+   * with named inputs — exactly the two-call sequence every binding in
+   * the reference uses (ref: R-package/src/symbol.cc, scala macros).
+   */
+  public static Symbol create(String opName, String name,
+                              Map<String, String> params,
+                              Map<String, Symbol> inputs) {
+    Map<String, String> p = params == null ? Map.of() : params;
+    Map<String, Symbol> in = inputs == null ? Map.of() : inputs;
+    try (Arena a = Arena.ofConfined()) {
+      String[] pk = p.keySet().toArray(new String[0]);
+      String[] pv = new String[pk.length];
+      for (int i = 0; i < pk.length; i++) {
+        pv[i] = p.get(pk[i]);
+      }
+      MemorySegment atom = a.allocate(PTR);
+      check((int) mh("MXSymbolCreateAtomicSymbol",
+              fd(PTR, C_INT, PTR, PTR, PTR))
+          .invoke(LibMx.cstr(opName, a), pk.length,
+                  LibMx.cstrArray(pk, a), LibMx.cstrArray(pv, a), atom));
+      String[] ik = in.keySet().toArray(new String[0]);
+      MemorySegment args = a.allocate(PTR, Math.max(1, ik.length));
+      for (int i = 0; i < ik.length; i++) {
+        args.setAtIndex(PTR, i, in.get(ik[i]).handle);
+      }
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXSymbolCompose", fd(PTR, PTR, C_INT, PTR, PTR, PTR))
+          .invoke(atom.get(PTR, 0), LibMx.cstr(name, a), ik.length,
+                  LibMx.cstrArray(ik, a), args, out));
+      return new Symbol(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Group heads into one multi-output symbol (ref: MXSymbolCreateGroup). */
+  public static Symbol group(List<Symbol> symbols) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment arr = a.allocate(PTR, Math.max(1, symbols.size()));
+      for (int i = 0; i < symbols.size(); i++) {
+        arr.setAtIndex(PTR, i, symbols.get(i).handle);
+      }
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXSymbolCreateGroup", fd(C_INT, PTR, PTR))
+          .invoke(symbols.size(), arr, out));
+      return new Symbol(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  // -- serialization ---------------------------------------------------------
+
+  public static Symbol fromJson(String json) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXSymbolCreateFromJSON", fd(PTR, PTR))
+          .invoke(LibMx.cstr(json, a), out));
+      return new Symbol(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public static Symbol load(String fname) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXSymbolCreateFromFile", fd(PTR, PTR))
+          .invoke(LibMx.cstr(fname, a), out));
+      return new Symbol(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public String toJson() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      check((int) mh("MXSymbolSaveToJSON", fd(PTR, PTR)).invoke(handle, out));
+      return LibMx.readCString(out.get(PTR, 0));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public void save(String fname) {
+    try (Arena a = Arena.ofConfined()) {
+      check((int) mh("MXSymbolSaveToFile", fd(PTR, PTR))
+          .invoke(handle, LibMx.cstr(fname, a)));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  // -- introspection ---------------------------------------------------------
+
+  private List<String> listStrings(String fn) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment n = a.allocate(C_INT);
+      MemorySegment arr = a.allocate(PTR);
+      check((int) mh(fn, fd(PTR, PTR, PTR)).invoke(handle, n, arr));
+      String[] out = LibMx.readCStringArray(arr.get(PTR, 0), n.get(C_INT, 0));
+      return new ArrayList<>(List.of(out));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public List<String> listArguments() {
+    return listStrings("MXSymbolListArguments");
+  }
+
+  public List<String> listOutputs() {
+    return listStrings("MXSymbolListOutputs");
+  }
+
+  public List<String> listAuxiliaryStates() {
+    return listStrings("MXSymbolListAuxiliaryStates");
+  }
+
+  public String getAttr(String key) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment out = a.allocate(PTR);
+      MemorySegment ok = a.allocate(C_INT);
+      check((int) mh("MXSymbolGetAttr", fd(PTR, PTR, PTR, PTR))
+          .invoke(handle, LibMx.cstr(key, a), out, ok));
+      return ok.get(C_INT, 0) != 0 ? LibMx.readCString(out.get(PTR, 0)) : null;
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  public void setAttr(String key, String value) {
+    try (Arena a = Arena.ofConfined()) {
+      check((int) mh("MXSymbolSetAttr", fd(PTR, PTR, PTR))
+          .invoke(handle, LibMx.cstr(key, a), LibMx.cstr(value, a)));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /**
+   * Shape inference (ref: MXSymbolInferShape, CSR packing). Known
+   * argument shapes in; returns {argShapes, outShapes, auxShapes} or
+   * null when inference is incomplete.
+   */
+  public InferredShapes inferShape(Map<String, int[]> knownArgs) {
+    try (Arena a = Arena.ofConfined()) {
+      String[] keys = knownArgs.keySet().toArray(new String[0]);
+      int[] indPtr = new int[keys.length + 1];
+      int total = 0;
+      for (int i = 0; i < keys.length; i++) {
+        total += knownArgs.get(keys[i]).length;
+        indPtr[i + 1] = total;
+      }
+      int[] flat = new int[Math.max(1, total)];
+      int pos = 0;
+      for (String k : keys) {
+        for (int d : knownArgs.get(k)) {
+          flat[pos++] = d;
+        }
+      }
+      MemorySegment inSize = a.allocate(C_INT);
+      MemorySegment inNdim = a.allocate(PTR);
+      MemorySegment inData = a.allocate(PTR);
+      MemorySegment outSize = a.allocate(C_INT);
+      MemorySegment outNdim = a.allocate(PTR);
+      MemorySegment outData = a.allocate(PTR);
+      MemorySegment auxSize = a.allocate(C_INT);
+      MemorySegment auxNdim = a.allocate(PTR);
+      MemorySegment auxData = a.allocate(PTR);
+      MemorySegment complete = a.allocate(C_INT);
+      check((int) mh("MXSymbolInferShape",
+              fd(PTR, C_INT, PTR, PTR, PTR,
+                 PTR, PTR, PTR, PTR, PTR, PTR, PTR, PTR, PTR, PTR))
+          .invoke(handle, keys.length, LibMx.cstrArray(keys, a),
+                  LibMx.uintArray(indPtr, a), LibMx.uintArray(flat, a),
+                  inSize, inNdim, inData, outSize, outNdim, outData,
+                  auxSize, auxNdim, auxData, complete));
+      if (complete.get(C_INT, 0) == 0) {
+        return null;
+      }
+      return new InferredShapes(
+          readShapes(inSize, inNdim, inData),
+          readShapes(outSize, outNdim, outData),
+          readShapes(auxSize, auxNdim, auxData));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  private static int[][] readShapes(MemorySegment size, MemorySegment ndim,
+                                    MemorySegment data) {
+    int n = size.get(C_INT, 0);
+    int[] ndims = LibMx.readUIntArray(ndim.get(PTR, 0), n);
+    MemorySegment[] rows = LibMx.readPtrArray(data.get(PTR, 0), n);
+    int[][] out = new int[n][];
+    for (int i = 0; i < n; i++) {
+      out[i] = LibMx.readUIntArray(rows[i], ndims[i]);
+    }
+    return out;
+  }
+
+  /** Result triple of {@link #inferShape}. */
+  public record InferredShapes(int[][] argShapes, int[][] outShapes,
+                               int[][] auxShapes) {}
+
+  /** Registered op names (ref: MXSymbolListAtomicSymbolCreators). */
+  public static List<String> listOps() {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment n = a.allocate(C_INT);
+      MemorySegment arr = a.allocate(PTR);
+      check((int) mh("MXSymbolListAtomicSymbolCreators", fd(PTR, PTR))
+          .invoke(n, arr));
+      String[] out = LibMx.readCStringArray(arr.get(PTR, 0), n.get(C_INT, 0));
+      return new ArrayList<>(List.of(out));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Op metadata from the registry (ref: MXSymbolGetAtomicSymbolInfo). */
+  public static OpInfo opInfo(String opName) {
+    try (Arena a = Arena.ofConfined()) {
+      MemorySegment name = a.allocate(PTR);
+      MemorySegment desc = a.allocate(PTR);
+      MemorySegment nArgs = a.allocate(C_INT);
+      MemorySegment argNames = a.allocate(PTR);
+      MemorySegment argTypes = a.allocate(PTR);
+      MemorySegment argDescs = a.allocate(PTR);
+      MemorySegment kv = a.allocate(PTR);
+      MemorySegment ret = a.allocate(PTR);
+      check((int) mh("MXSymbolGetAtomicSymbolInfo",
+              fd(PTR, PTR, PTR, PTR, PTR, PTR, PTR, PTR, PTR))
+          .invoke(LibMx.cstr(opName, a), name, desc, nArgs,
+                  argNames, argTypes, argDescs, kv, ret));
+      int n = nArgs.get(C_INT, 0);
+      return new OpInfo(
+          LibMx.readCString(name.get(PTR, 0)),
+          LibMx.readCString(desc.get(PTR, 0)),
+          LibMx.readCStringArray(argNames.get(PTR, 0), n),
+          LibMx.readCStringArray(argTypes.get(PTR, 0), n),
+          LibMx.readCStringArray(argDescs.get(PTR, 0), n),
+          LibMx.readCString(kv.get(PTR, 0)));
+    } catch (Throwable t) {
+      throw NDArray.wrap(t);
+    }
+  }
+
+  /** Registry metadata row for one op. */
+  public record OpInfo(String name, String description, String[] argNames,
+                       String[] argTypeInfos, String[] argDescriptions,
+                       String keyVarNumArgs) {}
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      try {
+        check((int) mh("MXSymbolFree", fd(PTR)).invoke(handle));
+      } catch (Throwable t) {
+        throw NDArray.wrap(t);
+      }
+    }
+  }
+}

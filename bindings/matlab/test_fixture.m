@@ -1,0 +1,37 @@
+% Cross-binding predict conformance consumer (MATLAB/Octave): the same
+% shared fixture as the C++/Java/R binding tests
+% (tests/fixtures/predict_conformance). Run from the repo root:
+%   matlab -batch "run('bindings/matlab/test_fixture.m')"
+function test_fixture()
+  dir_ = 'tests/fixtures/predict_conformance';
+  [in_shape, input] = read_tensor(fullfile(dir_, 'input.txt'));
+  [~, want] = read_tensor(fullfile(dir_, 'expected.txt'));
+
+  addpath('bindings/matlab');
+  m = mxnet.model;
+  m.load(fullfile(dir_, 'model'), 1);
+
+  % fixture is row-major (N, F); model.forward permutes a MATLAB
+  % W x H x C x N array into N C H W order, so hand it the transpose:
+  % F x N column-major == N x F row-major with H=F, W=1 mapping
+  batch = reshape(input, fliplr(in_shape));  % F x N column-major
+  out = m.forward(batch);                    % comes back N x ... row-major
+
+  got = out(:);
+  want = want(:);
+  % outputs return permuted column-major; flatten both in matched order
+  got = reshape(permute(out, ndims(out):-1:1), [], 1);
+  assert(numel(got) == numel(want), 'output size mismatch');
+  rel = abs(got - want) ./ (abs(want) + 1e-8);
+  assert(max(rel) <= 1e-3, sprintf('FAILED: max rel diff %g', max(rel)));
+  fprintf('PASSED: max rel diff %.2e over %d logits\n', max(rel), numel(got));
+end
+
+function [shape, vals] = read_tensor(path)
+  fid = fopen(path, 'r');
+  assert(fid ~= -1, ['cannot open ', path]);
+  header = fgetl(fid);
+  shape = sscanf(header, '%d')';
+  vals = fscanf(fid, '%f');
+  fclose(fid);
+end

@@ -844,13 +844,22 @@ def apply_to(op):
     """Copy the prose table onto one live OpDef: op.doc gets the summary
     (keeping any richer existing text) and each Field gets its doc.
     Called from registry.register() so late registrations (Custom,
-    plugin ops) are covered too."""
+    plugin ops) are covered too.
+
+    Fields may be SHARED between ops (e.g. Convolution and Deconvolution
+    build their params from one dict whose Field objects are not
+    copied), so a documented Field is replaced with a per-op copy rather
+    than mutated — otherwise one op's prose would overwrite another's."""
+    from .registry import Field
+
     summary, pdocs = OPDOC.get(op.name, (None, {}))
     if summary and not op.doc:
         op.doc = summary
     for pname, text in pdocs.items():
         f = op.param_fields.get(pname)
         if f is not None and not f.doc:
-            f.doc = text
+            op.param_fields[pname] = Field(
+                f.type, default=f.default, required=f.required,
+                enum=f.enum, doc=text)
 
 

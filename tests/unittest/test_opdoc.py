@@ -57,6 +57,16 @@ def test_param_docs_have_prose():
     assert not missing, missing
 
 
+def test_shared_fields_get_per_op_docs():
+    """Convolution and Deconvolution build params from one shared dict;
+    documenting one must not overwrite the other's prose (review r4)."""
+    c = REGISTRY["Convolution"].param_fields["stride"]
+    d = REGISTRY["Deconvolution"].param_fields["stride"]
+    assert c is not d
+    assert c.doc != d.doc
+    assert "Upsampling" in d.doc
+
+
 def test_enum_and_defaults_rendered():
     doc = mx.symbol.Pooling.__doc__
     assert "{'max', 'avg', 'sum'}" in doc
