@@ -28,6 +28,12 @@ BASELINE_IMG_S_PER_GPU = 513.0 / 4.0  # ref README.md:255, see docstring
 
 
 def main():
+    # second flagship: BENCH_MODEL=transformer runs the MXU-bound LM
+    # bench (bench_lm.py) with its measured-MFU JSON instead
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        import bench_lm
+
+        return bench_lm.main()
     batch_size = int(os.environ.get("BENCH_BATCH", "128"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     steps = int(os.environ.get("BENCH_STEPS", "64"))

