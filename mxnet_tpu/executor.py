@@ -458,6 +458,18 @@ class Executor:
         from .base import env_bool, env_int
 
         mirror_all = env_bool("MXNET_BACKWARD_DO_MIRROR", False)
+        # selective recompute: regex over op names — remat only matching
+        # nodes (e.g. "BatchNorm|Activation" recomputes the cheap
+        # elementwise ops in backward, trading VPU time for the HBM
+        # re-reads that bound convnets, WITHOUT recomputing the convs
+        # the way MXNET_BACKWARD_DO_MIRROR=1 does). Extends the ref's
+        # per-node force_mirroring attr to a pattern
+        # (ref: static_graph.cc:404-422).
+        import os as _os
+        import re as _re
+
+        pattern = _os.environ.get("MXNET_BACKWARD_MIRROR_PATTERN", "")
+        pat = _re.compile(pattern) if pattern else None
         # segment length: remat in chunks so backward peak holds one
         # chunk's activations, not the whole graph's (ref mirror_step,
         # static_graph.cc:404-422). 0 = sqrt(run length), the classic
@@ -470,6 +482,8 @@ class Executor:
             a = n.attrs.get("force_mirroring")
             if a is not None:
                 return str(a).lower() in ("true", "1")
+            if pat is not None and pat.search(n.op.name):
+                return True
             return mirror_all
 
         # multi-device eager pipeline doesn't jit; keep per-node plan

@@ -166,6 +166,25 @@ def test_mirror_env_grads_match(monkeypatch):
                                    err_msg=n)
 
 
+def test_mirror_pattern_grads_match(monkeypatch):
+    """MXNET_BACKWARD_MIRROR_PATTERN remats only matching op names
+    (selective recompute of cheap ops, round 4); grads are unchanged
+    and only Activation nodes join segments."""
+    monkeypatch.delenv("MXNET_BACKWARD_MIRROR_PATTERN", raising=False)
+    base, _ = _mlp_grads()
+    monkeypatch.setenv("MXNET_BACKWARD_MIRROR_PATTERN", "Activation")
+    mirrored, exe1 = _mlp_grads()
+    assert any(kind == "seg" for kind, *_ in exe1._plan)
+    # only the activations are segment members
+    for kind, *rest in exe1._plan:
+        if kind == "seg":
+            for serial in rest[0]:
+                assert exe1._nodes[serial].op.name == "Activation"
+    for n in base:
+        np.testing.assert_allclose(mirrored[n], base[n], rtol=1e-5,
+                                   err_msg=n)
+
+
 def test_mirror_with_aux_and_dropout(monkeypatch):
     """Mirrored segments must thread BatchNorm aux state and per-node rng."""
     monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
