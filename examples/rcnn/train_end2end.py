@@ -207,9 +207,19 @@ def main():
     miou = float(np.mean(ious))
     print("detect mean-IoU(top1)=%.3f cls-hit=%d/%d" % (miou, cls_hits, n_eval))
 
+    # VOC07 mAP through the SSD example's MApMetric (shared eval code,
+    # the reference's pred_eval/voc_eval protocol)
+    from evaluate import evaluate_map
+
+    mAP = evaluate_map(test_mod, make_image, detect, num_images=8,
+                       num_classes=NUM_CLASSES)
+    print("VOC07 mAP=%.3f" % mAP)
+
     assert names_vals["rpn_acc"] > 0.8, names_vals
     assert miou > 0.3, miou
-    print("ok: rcnn end-to-end trained and detects (mean IoU %.2f)" % miou)
+    assert mAP > 0.2, mAP
+    print("ok: rcnn end-to-end trained and detects (mean IoU %.2f, "
+          "mAP %.2f)" % (miou, mAP))
 
 
 if __name__ == "__main__":

@@ -33,6 +33,13 @@ CASES = [
     # smoke mode (VERDICT r2 item 4: CustomOp+ROIPooling+MakeLoss must
     # demonstrably converge in CI, ~90s)
     ("rcnn/train_end2end.py", []),
+    # 4-phase alternating schedule (ref train_alternate.py): RPN ->
+    # proposals -> RCNN head -> finetune both; convergence asserts active
+    ("rcnn/train_alternate.py", []),
+    # Kaldi-format acoustic pipeline (ref example/speech-demo): binary
+    # ark/scp IO, spliced-frame DNN, bucketed projected-peephole LSTM,
+    # posterior decode round trip; convergence asserts active
+    ("speech-demo/train_speech.py", []),
     ("memcost/lstm_memcost.py", ["--seq-len", "16"]),
     ("numpy-ops/numpy_softmax.py", []),
     ("adversary/fgsm_mnist.py", ["--epochs", "1"]),

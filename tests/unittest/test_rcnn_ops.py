@@ -1,0 +1,210 @@
+"""Faster R-CNN proposal/proposal_target parity fixtures (VERDICT r3
+item 5): the CustomOps must match the reference's numpy semantics
+(ref: example/rcnn/rcnn/rpn/proposal.py:19,164, proposal_target.py) on
+fixed fixtures — anchors against the canonical published values, NMS on
+a hand-computed case, box encode/decode round trips, and full-op
+invariants on deterministic inputs.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(ROOT, "examples", "rcnn"))
+
+import mxnet_tpu as mx  # noqa: E402
+
+from proposal import (ProposalOperator, bbox_pred, generate_anchors,  # noqa: E402
+                      nms)
+from proposal_target import ProposalTargetOperator  # noqa: E402
+from rcnn_utils import bbox_overlaps, bbox_transform  # noqa: E402
+
+
+# The canonical Faster R-CNN anchors for base_size=16, ratios (0.5,1,2),
+# scales (8,16,32) — published in the original py-faster-rcnn
+# generate_anchors self-test, reproduced by the reference's
+# example/rcnn/helper/processing/generate_anchor.py. External ground
+# truth, not a regression golden.
+CANONICAL_ANCHORS = np.array([
+    [-84., -40., 99., 55.],
+    [-176., -88., 191., 103.],
+    [-360., -184., 375., 199.],
+    [-56., -56., 71., 71.],
+    [-120., -120., 135., 135.],
+    [-248., -248., 263., 263.],
+    [-36., -80., 51., 95.],
+    [-80., -168., 95., 183.],
+    [-168., -344., 183., 359.],
+])
+
+
+def test_generate_anchors_matches_published_values():
+    got = generate_anchors(base_size=16, ratios=(0.5, 1, 2),
+                           scales=(8, 16, 32))
+    # row order here is ratio-major (ratio, scale); the canonical table
+    # is too — compare as sets of rows to be order-insensitive
+    got_sorted = got[np.lexsort(got.T[::-1])]
+    want_sorted = CANONICAL_ANCHORS[np.lexsort(CANONICAL_ANCHORS.T[::-1])]
+    np.testing.assert_allclose(got_sorted, want_sorted, atol=1e-6)
+
+
+def test_nms_hand_computed_case():
+    # three boxes: A and B overlap heavily (IoU ~0.68), C is disjoint.
+    # scores A > B > C: NMS at 0.5 keeps A (suppresses B) and C.
+    dets = np.array([
+        [0, 0, 99, 99, 0.9],       # A
+        [10, 10, 109, 109, 0.8],   # B: IoU(A,B) = 8100/(2*10000-8100)=0.68
+        [200, 200, 299, 299, 0.7],  # C
+    ], np.float32)
+    keep = nms(dets, 0.5)
+    assert list(keep) == [0, 2]
+    # at a looser threshold everything survives
+    assert list(nms(dets, 0.7)) == [0, 1, 2]
+
+
+def test_bbox_encode_decode_round_trip():
+    rng = np.random.RandomState(0)
+    ex = np.abs(rng.rand(16, 4)) * 50
+    ex[:, 2:] = ex[:, :2] + 20 + rng.rand(16, 2) * 80
+    gt = np.abs(rng.rand(16, 4)) * 50
+    gt[:, 2:] = gt[:, :2] + 20 + rng.rand(16, 2) * 80
+    t = bbox_transform(ex, gt)
+    back = bbox_pred(ex, t)
+    np.testing.assert_allclose(back, gt, atol=1e-3)
+
+
+def _run_proposal(post_nms=20, H=8, W=8, seed=3):
+    rng = np.random.RandomState(seed)
+    op = ProposalOperator(feat_stride=16, scales=(8, 16), ratios=(0.5, 1, 2),
+                          rpn_post_nms_top_n=post_nms, rpn_min_size=16)
+    A = op._num_anchors
+    cls_prob = mx.nd.array(rng.rand(1, 2 * A, H, W).astype(np.float32))
+    deltas = mx.nd.array((rng.randn(1, 4 * A, H, W) * 0.2).astype(np.float32))
+    im_info = mx.nd.array(np.array([[H * 16.0, W * 16.0, 1.0]], np.float32))
+    out = mx.nd.zeros((post_nms, 5), mx.cpu(0))
+    op.forward(True, ["write"], [cls_prob, deltas, im_info], [out], [])
+    return out.asnumpy(), cls_prob.asnumpy(), op
+
+
+def test_proposal_op_reference_invariants():
+    """The full pipeline the reference documents (proposal.py:40-48):
+    decode -> clip -> min-size filter -> score sort -> NMS -> top-N,
+    fixed-size output."""
+    rois, cls_prob, op = _run_proposal()
+    assert rois.shape == (20, 5)
+    np.testing.assert_array_equal(rois[:, 0], 0)  # single-image batch ids
+    boxes = rois[:, 1:]
+    live = (boxes[:, 2] > boxes[:, 0])  # zero-padded tail allowed
+    b = boxes[live]
+    # clipped to the image frame
+    assert (b[:, 0::2] >= 0).all() and (b[:, 0::2] <= 8 * 16 - 1).all()
+    assert (b[:, 1::2] >= 0).all() and (b[:, 1::2] <= 8 * 16 - 1).all()
+    # min-size filter survived decode
+    assert ((b[:, 2] - b[:, 0] + 1) >= 16).all()
+    assert ((b[:, 3] - b[:, 1] + 1) >= 16).all()
+    # NMS: no two kept boxes overlap above the threshold
+    ov = bbox_overlaps(b.astype(np.float32), b.astype(np.float32))
+    np.fill_diagonal(ov, 0)
+    assert ov.max() <= 0.7 + 1e-6
+
+
+def test_proposal_op_score_ordering():
+    """Proposals come out highest-score-first (the reference sorts then
+    NMS-keeps in order; NMS keep preserves descending score order)."""
+    rois, _, op = _run_proposal(post_nms=10, seed=5)
+    # recompute each kept box's best achievable fg score bound: kept
+    # boxes' order must be non-increasing in their originating scores.
+    # We can't recover the exact mapping post-NMS, but the operator's
+    # contract is that output k was kept before output k+1, which NMS
+    # guarantees to be in descending score order; verify via rerun with
+    # deltas = 0 where the mapping is identity over anchors.
+    rng = np.random.RandomState(7)
+    # small scales: anchors comparable to the 64px image so NMS keeps a
+    # diverse prefix rather than one whole-image box
+    op = ProposalOperator(feat_stride=16, scales=(1, 2), ratios=(0.5, 1, 2),
+                          rpn_post_nms_top_n=10, rpn_min_size=1)
+    A = op._num_anchors
+    H = W = 4
+    scores = rng.rand(1, 2 * A, H, W).astype(np.float32)
+    cls_prob = mx.nd.array(scores)
+    deltas = mx.nd.zeros((1, 4 * A, H, W), mx.cpu(0))
+    im_info = mx.nd.array(np.array([[H * 16.0, W * 16.0, 1.0]], np.float32))
+    out = mx.nd.zeros((10, 5), mx.cpu(0))
+    op.forward(True, ["write"], [cls_prob, deltas, im_info], [out], [])
+    rois = out.asnumpy()
+    # with zero deltas, proposals are clipped anchors; map each roi back
+    # to its max possible fg score by matching against all anchors
+    fg = scores[0, A:].transpose(1, 2, 0).reshape(-1)
+    shift = np.arange(4) * 16
+    sx, sy = np.meshgrid(shift, shift)
+    shifts = np.vstack((sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel())).T
+    anchors = (op._anchors.reshape(1, A, 4)
+               + shifts.reshape(1, -1, 4).transpose(1, 0, 2)).reshape(-1, 4)
+    anchors[:, 0::2] = np.clip(anchors[:, 0::2], 0, W * 16 - 1)
+    anchors[:, 1::2] = np.clip(anchors[:, 1::2], 0, H * 16 - 1)
+    kept_scores = []
+    for r in rois:
+        if r[3] <= r[1]:  # zero-padded tail (static output shape)
+            continue
+        match = np.where((np.abs(anchors - r[1:]) < 1e-4).all(axis=1))[0]
+        assert match.size >= 1, r
+        kept_scores.append(fg[match].max())
+    assert len(kept_scores) >= 3  # NMS kept a meaningful prefix
+    assert all(kept_scores[i] >= kept_scores[i + 1] - 1e-6
+               for i in range(len(kept_scores) - 1)), kept_scores
+
+
+def test_proposal_target_reference_semantics():
+    """proposal_target (ref: rcnn/rpn/proposal_target.py sample_rois):
+    fg capped at fg_fraction*num_rois, labels = gt class for fg / 0 for
+    bg, per-class bbox target layout with weights only on the labelled
+    class slot, and targets that decode back to the gt box."""
+    num_classes, num_rois = 3, 16
+    op = ProposalTargetOperator(num_classes, num_rois, fg_fraction=0.25,
+                                seed=0)
+    gt = np.zeros((1, 4, 5), np.float32)
+    gt[0, 0] = [10, 10, 60, 60, 1]
+    gt[0, 1] = [70, 70, 120, 120, 2]
+    rng = np.random.RandomState(1)
+    # proposals: 8 near gt0, 8 near gt1, 16 background
+    rois = np.zeros((32, 5), np.float32)
+    rois[:8, 1:] = gt[0, 0, :4] + rng.randn(8, 4) * 2
+    rois[8:16, 1:] = gt[0, 1, :4] + rng.randn(8, 4) * 2
+    rois[16:, 1:] = np.abs(rng.rand(16, 4)) * 30 + np.array([130, 130, 160, 160])
+    ins = [mx.nd.array(rois), mx.nd.array(gt)]
+    outs = [mx.nd.zeros((num_rois, 5), mx.cpu(0)),
+            mx.nd.zeros((num_rois,), mx.cpu(0)),
+            mx.nd.zeros((num_rois, 4 * num_classes), mx.cpu(0)),
+            mx.nd.zeros((num_rois, 4 * num_classes), mx.cpu(0))]
+    op.forward(True, ["write"] * 4, ins, outs, [])
+    s_rois, label, target, weight = [o.asnumpy() for o in outs]
+    fg = label > 0
+    assert fg.sum() == 4  # fg_fraction(0.25) * 16, candidates abundant
+    for i in range(num_rois):
+        c = int(label[i])
+        if c == 0:
+            assert not weight[i].any()
+            continue
+        # weights exactly on the labelled class's 4-slot
+        expect = np.zeros(4 * num_classes)
+        expect[4 * c:4 * c + 4] = 1
+        np.testing.assert_array_equal(weight[i], expect)
+        # decoding the target from the sampled roi recovers a gt box
+        dec = bbox_pred(s_rois[i:i + 1, 1:], target[i:i + 1, 4 * c:4 * c + 4])
+        ious = bbox_overlaps(dec.astype(np.float32),
+                             gt[0, :2, :4])
+        assert ious.max() > 0.95, (i, dec, ious)
+
+
+def test_proposal_backward_zero_grads():
+    """Proposal/ProposalTarget declare no gradient (need_top_grad=False,
+    backward writes zeros) — the reference's contract for both ops."""
+    rois, _, op = _run_proposal(post_nms=8)
+    grads = [mx.nd.array(np.ones((1, 12, 8, 8), np.float32)),
+             mx.nd.array(np.ones((1, 24, 8, 8), np.float32)),
+             mx.nd.array(np.ones((1, 3), np.float32))]
+    op.backward(["write"] * 3, [], [], [], grads, [])
+    for g in grads:
+        assert not g.asnumpy().any()
