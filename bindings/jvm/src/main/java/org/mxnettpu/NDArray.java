@@ -175,11 +175,22 @@ public final class NDArray implements AutoCloseable {
       MemorySegment nOut = a.allocate(C_INT);
       nOut.set(C_INT, 0, cap);
       MemorySegment outs = a.allocate(PTR, cap);
-      check((int) mh("MXFuncInvokeByName",
+      int rc = (int) mh("MXFuncInvokeByName",
               fd(PTR, PTR, C_INT, C_INT, PTR, PTR, PTR, PTR))
           .invoke(LibMx.cstr(name, a), ins, inputs.length, keys.length,
                   LibMx.cstrArray(keys, a), LibMx.cstrArray(vals, a),
-                  nOut, outs));
+                  nOut, outs);
+      if (rc != 0 && nOut.get(C_INT, 0) > cap) {
+        // capacity protocol: the failed call reported the required count
+        cap = nOut.get(C_INT, 0);
+        outs = a.allocate(PTR, cap);
+        rc = (int) mh("MXFuncInvokeByName",
+                fd(PTR, PTR, C_INT, C_INT, PTR, PTR, PTR, PTR))
+            .invoke(LibMx.cstr(name, a), ins, inputs.length, keys.length,
+                    LibMx.cstrArray(keys, a), LibMx.cstrArray(vals, a),
+                    nOut, outs);
+      }
+      check(rc);
       int n = nOut.get(C_INT, 0);
       NDArray[] res = new NDArray[n];
       for (int i = 0; i < n; i++) {

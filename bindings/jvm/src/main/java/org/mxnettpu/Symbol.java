@@ -70,9 +70,13 @@ public final class Symbol implements AutoCloseable {
         args.setAtIndex(PTR, i, in.get(ik[i]).handle);
       }
       MemorySegment out = a.allocate(PTR);
-      check((int) mh("MXSymbolCompose", fd(PTR, PTR, C_INT, PTR, PTR, PTR))
+      int rc = (int) mh("MXSymbolCompose", fd(PTR, PTR, C_INT, PTR, PTR, PTR))
           .invoke(atom.get(PTR, 0), LibMx.cstr(name, a), ik.length,
-                  LibMx.cstrArray(ik, a), args, out));
+                  LibMx.cstrArray(ik, a), args, out);
+      // Compose does not consume the atomic handle (header contract,
+      // exercised by test_atomic_symbol_reused) — free it here
+      mh("MXSymbolFree", fd(PTR)).invoke(atom.get(PTR, 0));
+      check(rc);
       return new Symbol(out.get(PTR, 0));
     } catch (Throwable t) {
       throw NDArray.wrap(t);

@@ -66,7 +66,11 @@ def _softmax_output_factory(params):
                 denom = jnp.maximum(jnp.sum(valid.astype(data.dtype)), 1.0)
             grad = grad * (grad_scale / denom)
         else:
-            n = data.shape[0]
+            # preserve_shape: every leading position is its own row —
+            # label has shape data.shape[:-1] (ref: softmax_output-inl.h
+            # preserve_shape Backward); plain mode: one row per sample
+            n = int(_np.prod(data.shape[:-1])) if preserve_shape \
+                else data.shape[0]
             flat = data.reshape(n, -1)
             c = flat.shape[1]
             lab = label.reshape(n).astype(jnp.int32)
@@ -98,6 +102,11 @@ def _softmax_output_shape(params, in_shapes):
     d = in_shapes[0]
     if params["multi_output"]:
         lshape = (d[0],) + d[2:]
+    elif params["preserve_shape"]:
+        # softmax over the last axis at every position: label is the
+        # data shape minus the class axis (ref: softmax_output-inl.h
+        # preserve_shape label plan)
+        lshape = d[:-1]
     else:
         lshape = (d[0],)
     return [d, lshape], [d], []

@@ -235,23 +235,56 @@ register(
 
 
 # -- Deconvolution (ref: src/operator/deconvolution-inl.h) ---------------------
+def _deconv_pad_adj(params, in_sp):
+    """Effective (pad, adj) per spatial dim. With target_shape set, pad
+    and adj are deduced so the output hits the target exactly and the
+    explicit pad/adj params are ignored (ref: deconvolution-inl.h:64-88
+    InferPad)."""
+    nsp = len(in_sp)
+    k = _pair(params["kernel"], nsp)
+    stride = _pair(params["stride"] or (1,) * nsp, nsp)
+    target = params.get("target_shape") or ()
+    if any(target):
+        target = _pair(target, nsp)
+        pad, adj = [], []
+        for i in range(nsp):
+            total = stride[i] * (in_sp[i] - 1) + k[i]
+            if total < target[i]:
+                raise MXNetError(
+                    "Deconvolution: target_shape %s too big (max %d on "
+                    "axis %d)" % (target, total, i))
+            excess = total - target[i]
+            adj.append(excess % 2)
+            pad.append((excess + 1) // 2)
+        return tuple(pad), tuple(adj)
+    pad = _pair(params["pad"] or (0,) * nsp, nsp)
+    adj = _pair(params.get("adj") or (0,) * nsp, nsp)
+    for i in range(nsp):
+        if adj[i] >= max(stride[i], 1) and adj[i] != 0:
+            raise MXNetError("Deconvolution: adj must be < stride")
+    return pad, adj
+
+
 def _deconv_fwd(params, inputs, aux, is_train, rng):
     data, weight = inputs[0], inputs[1]
     if weight.dtype != data.dtype:
         weight = weight.astype(data.dtype)
     nsp = data.ndim - 2
     stride = _pair(params["stride"] or (1,) * nsp, nsp)
-    pad = _pair(params["pad"] or (0,) * nsp, nsp)
+    pad, adj = _deconv_pad_adj(params, data.shape[2:])
     k = _pair(params["kernel"], nsp)
     # transposed conv = gradient of conv wrt input: lhs-dilate by stride,
-    # pad by k-1-p, spatially-flipped kernel with I/O swapped
-    # (weight layout is (in_ch, num_filter/group, *k), ref deconvolution-inl.h:119)
+    # pad by k-1-p (adj extends the high side only — extra output rows
+    # at the bottom/right, ref InferPad), spatially-flipped kernel with
+    # I/O swapped (weight layout (in_ch, num_filter/group, *k),
+    # ref deconvolution-inl.h:119)
     w = jnp.flip(weight, axis=tuple(range(2, 2 + nsp)))
     out = jax.lax.conv_general_dilated(
         data,
         w,
         window_strides=(1,) * nsp,
-        padding=[(k[i] - 1 - pad[i], k[i] - 1 - pad[i]) for i in range(nsp)],
+        padding=[(k[i] - 1 - pad[i], k[i] - 1 - pad[i] + adj[i])
+                 for i in range(nsp)],
         lhs_dilation=stride,
         dimension_numbers=("NC" + "DHW"[-nsp:], "IO" + "DHW"[-nsp:], "NC" + "DHW"[-nsp:]),
         feature_group_count=params["num_group"],
@@ -269,22 +302,29 @@ def _deconv_shape(params, in_shapes):
     nsp = len(dshape) - 2
     k = _pair(params["kernel"], nsp)
     stride = _pair(params["stride"] or (1,) * nsp, nsp)
-    pad = _pair(params["pad"] or (0,) * nsp, nsp)
+    pad, adj = _deconv_pad_adj(params, dshape[2:])
     nf, ng = params["num_filter"], params["num_group"]
     wshape = (dshape[1], nf // ng) + k
     out_sp = tuple(
-        stride[i] * (dshape[2 + i] - 1) + k[i] - 2 * pad[i] for i in range(nsp)
+        stride[i] * (dshape[2 + i] - 1) + k[i] - 2 * pad[i] + adj[i]
+        for i in range(nsp)
     )
     oshape = (dshape[0], nf) + out_sp
     ins = [dshape, wshape] + ([] if params["no_bias"] else [(nf,)])
     return ins, [oshape], []
 
 
+_DECONV_PARAMS = dict(_CONV_PARAMS)
+_DECONV_PARAMS.update({
+    "adj": Field("shape", default=None),
+    "target_shape": Field("shape", default=None),
+})
+
 register(
     OpDef(
         "Deconvolution",
         _deconv_fwd,
-        params=dict(_CONV_PARAMS),
+        params=_DECONV_PARAMS,
         arguments=_fc_args,
         infer_shape=_deconv_shape,
     )

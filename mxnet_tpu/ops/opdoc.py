@@ -172,6 +172,10 @@ OPDOC = {
             "stride": "Upsampling factor per spatial axis.",
             "dilate": "Spacing between filter taps.",
             "pad": "Padding that the matching forward convolution would use.",
+            "adj": "Extra output rows/cols on the bottom/right edge "
+                   "(must be < stride); ignored when target_shape is set.",
+            "target_shape": "Exact output spatial size; pad and adj are "
+                            "deduced automatically.",
             "num_filter": "Number of output channels.",
             "num_group": "Channel groups processed independently.",
             "workspace": "Accepted for API compatibility; ignored.",
