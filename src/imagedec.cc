@@ -216,32 +216,65 @@ bool ProcessOne(const BatchArgs &a, int i, std::vector<unsigned char> *rgb) {
                static_cast<int>((fx - x1) * 256.f + 0.5f)};
   }
 
-  // single fused pass: sample -> (integer HLS) -> mean/scale -> CHW
+  // Separable bilinear (round-3 profile: the fused per-pixel loop was
+  // gather-bound — each output pixel gathered 4 source texels through
+  // data-dependent offsets, defeating auto-vectorization). Split:
+  //   pass H: horizontally resample each SOURCE row once into a Q8 int
+  //           row cache (the only gather pass; consecutive output rows
+  //           share source rows, so each is resampled once, not twice);
+  //   pass V: vertical lerp + HLS + mean/scale over the two cached rows
+  //           — purely sequential loads the compiler vectorizes.
   float *dst = a.out + static_cast<size_t>(i) * 3 * oh * ow;
   const size_t plane = static_cast<size_t>(oh) * ow;
   const unsigned char *src = rgb->data();
+  const int rowlen = ow * 3;
+  std::vector<int32_t> hbuf(2 * rowlen);
+  int hrow_idx[2] = {-1, -1};
+
+  auto hsample = [&](int srcy, int slot) {
+    const unsigned char *row = src + static_cast<size_t>(srcy) * iw * 3;
+    int32_t *buf = hbuf.data() + slot * rowlen;
+    for (int x = 0; x < ow; ++x) {
+      const ColS cs = cols[x];
+      const unsigned char *p1 = row + cs.off1;
+      const unsigned char *p2 = row + cs.off2;
+      buf[3 * x + 0] = (p1[0] << 8) + (p2[0] - p1[0]) * cs.w;
+      buf[3 * x + 1] = (p1[1] << 8) + (p2[1] - p1[1]) * cs.w;
+      buf[3 * x + 2] = (p1[2] << 8) + (p2[2] - p1[2]) * cs.w;
+    }
+    hrow_idx[slot] = srcy;
+  };
+  auto slot_for = [&](int srcy, int other) {
+    for (int s = 0; s < 2; ++s)
+      if (hrow_idx[s] == srcy) return s;
+    int s = (other == 0) ? 1 : 0;
+    hsample(srcy, s);
+    return s;
+  };
+
+  std::vector<int32_t> vrow(rowlen);  // Q16 pixel row after vertical lerp
   for (int y = 0; y < oh; ++y) {
     float fy = y0 + (y + 0.5f) * sy - 0.5f;
     fy = std::min(std::max(fy, 0.0f), static_cast<float>(ih - 1));
     int y1 = static_cast<int>(fy);
     int y2 = std::min(y1 + 1, ih - 1);
     const int wy = static_cast<int>((fy - y1) * 256.f + 0.5f);
-    const unsigned char *row1 = src + static_cast<size_t>(y1) * iw * 3;
-    const unsigned char *row2 = src + static_cast<size_t>(y2) * iw * 3;
+    const int s1 = slot_for(y1, -1);
+    const int s2 = (y2 == y1) ? s1 : slot_for(y2, s1);
+    const int32_t *top = hbuf.data() + s1 * rowlen;
+    const int32_t *bot = hbuf.data() + s2 * rowlen;
+    // vectorizable: contiguous int32 in, contiguous int32 out
+    for (int j = 0; j < rowlen; ++j)
+      vrow[j] = (top[j] << 8) + (bot[j] - top[j]) * wy;  // Q16
     size_t o = static_cast<size_t>(y) * ow;
-    for (int x = 0; x < ow; ++x, ++o) {
-      const ColS cs = cols[x];
-      int px[3];
-      for (int c = 0; c < 3; ++c) {
-        // Q8 bilinear, rounded: exact enough for 8-bit augmentation
-        int top = (row1[cs.off1 + c] << 8) +
-                  (row1[cs.off2 + c] - row1[cs.off1 + c]) * cs.w;
-        int bot = (row2[cs.off1 + c] << 8) +
-                  (row2[cs.off2 + c] - row2[cs.off1 + c]) * cs.w;
-        px[c] = (top << 8) + (bot - top) * wy;  // Q16
-      }
-      if (hsl) {
-        int r = px[0] >> 16, g = px[1] >> 16, b = px[2] >> 16;
+    if (hsl) {
+      // integer LUT conversion (see RgbToHlsInt): a SoA float rewrite
+      // with real divisions was probed in round 4 and measured SLOWER
+      // (268 vs 356 img/s full-augment) — the reciprocal LUTs live in
+      // L1 and beat vectorized divps on this target; kept scalar.
+      for (int x = 0; x < ow; ++x) {
+        int r = vrow[3 * x + 0] >> 16, g = vrow[3 * x + 1] >> 16,
+            b = vrow[3 * x + 2] >> 16;
         int h, l, s;
         RgbToHlsInt(r, g, b, &h, &l, &s);
         h += dh6;
@@ -250,18 +283,26 @@ bool ProcessOne(const BatchArgs &a, int i, std::vector<unsigned char> *rgb) {
         l = ClampByte(l + dl8);
         s = ClampByte(s + ds8);
         HlsToRgbInt(h, l, s, &r, &g, &b);
-        px[0] = r << 16;
-        px[1] = g << 16;
-        px[2] = b << 16;
+        vrow[3 * x + 0] = r << 16;
+        vrow[3 * x + 1] = g << 16;
+        vrow[3 * x + 2] = b << 16;
       }
-      constexpr float kInvQ16 = 1.0f / 65536.0f;
-      for (int c = 0; c < 3; ++c) {
-        float v = px[c] * kInvQ16;
-        if (a.mean_kind == 1)
-          v -= a.mean[c];
-        else if (a.mean_kind == 2)
-          v -= a.mean[plane * c + o];
-        dst[plane * c + o] = v * a.scale;
+    }
+    constexpr float kInvQ16 = 1.0f / 65536.0f;
+    // per-plane sweeps: sequential writes, stride-3 reads — vectorizable
+    for (int c = 0; c < 3; ++c) {
+      float *d = dst + plane * c + o;
+      if (a.mean_kind == 1) {
+        const float m = a.mean[c];
+        for (int x = 0; x < ow; ++x)
+          d[x] = (vrow[3 * x + c] * kInvQ16 - m) * a.scale;
+      } else if (a.mean_kind == 2) {
+        const float *m = a.mean + plane * c + o;
+        for (int x = 0; x < ow; ++x)
+          d[x] = (vrow[3 * x + c] * kInvQ16 - m[x]) * a.scale;
+      } else {
+        for (int x = 0; x < ow; ++x)
+          d[x] = vrow[3 * x + c] * kInvQ16 * a.scale;
       }
     }
   }
