@@ -1,0 +1,50 @@
+# Data iterator wrappers over the C API iterator registry — the role of
+# the reference's R-package/R/io.R (mx.io.* creators).
+
+mx.io.create <- function(iter_name, params) {
+  keys <- names(params)
+  vals <- vapply(params, function(v) {
+    if (is.logical(v)) ifelse(v, "True", "False") else as.character(v)
+  }, "")
+  structure(.Call("MXR_DataIterCreate", iter_name, as.character(keys),
+                  as.character(vals), PACKAGE = "mxnet"),
+            class = "mx.dataiter")
+}
+
+#' MNIST iterator (synthetic fallback when the idx files are absent,
+#' like the Python frontend's MNISTIter).
+mx.io.MNISTIter <- function(batch.size = 32, num.synthetic = 512,
+                            seed = 1, flat = TRUE, shuffle = TRUE) {
+  mx.io.create("MNISTIter", list(
+    batch_size = batch.size, num_synthetic = num.synthetic,
+    seed = seed, flat = flat, shuffle = shuffle))
+}
+
+#' CSV iterator (ref: src/io/iter_csv.cc role).
+mx.io.CSVIter <- function(data.csv, data.shape, label.csv = NULL,
+                          batch.size = 32) {
+  params <- list(data_csv = data.csv,
+                 data_shape = paste0("(", paste(data.shape, collapse = ","),
+                                     ")"),
+                 batch_size = batch.size)
+  if (!is.null(label.csv)) params$label_csv <- label.csv
+  mx.io.create("CSVIter", params)
+}
+
+mx.io.next <- function(it) {
+  .Call("MXR_DataIterNext", unclass(it), PACKAGE = "mxnet")
+}
+
+mx.io.reset <- function(it) {
+  invisible(.Call("MXR_DataIterReset", unclass(it), PACKAGE = "mxnet"))
+}
+
+mx.io.data <- function(it) {
+  structure(.Call("MXR_DataIterGetData", unclass(it), PACKAGE = "mxnet"),
+            class = "MXNDArray")
+}
+
+mx.io.label <- function(it) {
+  structure(.Call("MXR_DataIterGetLabel", unclass(it), PACKAGE = "mxnet"),
+            class = "MXNDArray")
+}

@@ -1,0 +1,147 @@
+# FeedForward estimator in R — the reference R-package's
+# mx.model.FeedForward.create (ref: R-package/R/model.R:391) over the
+# .Call training surface: bind, Xavier init, per-batch
+# forward/backward, engine-resident optimizer update, accuracy metric.
+
+#' Train a FeedForward model.
+#'
+#' @param symbol loss-headed mx.symbol network
+#' @param X training mx.dataiter (e.g. mx.io.MNISTIter)
+#' @param ctx ignored (single-device cpu in the R surface)
+#' @param num.round epochs
+#' @param learning.rate,momentum,wd ccSGD hyperparameters
+#' @param initializer "xavier" or "uniform"
+#' @param verbose print per-epoch train accuracy
+#' @return mx.model.ff: list(symbol json, arg.params, aux.params)
+mx.model.FeedForward.create <- function(symbol, X, ctx = NULL,
+                                        num.round = 5,
+                                        learning.rate = 0.1,
+                                        momentum = 0.9, wd = 0,
+                                        initializer = "xavier",
+                                        eval.metric = "accuracy",
+                                        verbose = TRUE, seed = 7) {
+  arg.names <- mx.symbol.arguments(symbol)
+  aux.names <- mx.symbol.auxiliary.states(symbol)
+
+  # first batch fixes the input shapes (batch-size included)
+  mx.io.reset(X)
+  stopifnot(mx.io.next(X))
+  d0 <- as.array.MXNDArray(mx.io.data(X))
+  l0 <- as.array.MXNDArray(mx.io.label(X))
+  data.shape <- rev(dim(d0))
+  label.shape <- rev(dim(l0))
+  input.shapes <- list(data = as.integer(data.shape))
+  label.name <- grep("label$", arg.names, value = TRUE)[1]
+  input.shapes[[label.name]] <- as.integer(label.shape)
+  inf <- mx.symbol.infer.shape(symbol, input.shapes)
+  if (is.null(inf)) stop("incomplete shape inference")
+
+  set.seed(seed)
+  args <- list(); grads <- list(); reqs <- integer(length(arg.names))
+  for (i in seq_along(arg.names)) {
+    n <- arg.names[i]
+    shp <- inf$arg[[i]]
+    args[[i]] <- mx.nd.zeros(shp)
+    if (n %in% names(input.shapes)) {
+      grads[i] <- list(NULL)
+      reqs[i] <- 0L  # null
+    } else {
+      mx.nd.set(args[[i]], mx.init.weight(n, shp, initializer))
+      grads[[i]] <- mx.nd.zeros(shp)
+      reqs[i] <- 1L  # write
+    }
+  }
+  aux <- lapply(seq_along(aux.names), function(i) {
+    a <- mx.nd.zeros(inf$aux[[i]])
+    if (grepl("var$", aux.names[i])) {
+      mx.nd.set(a, rep(1, prod(inf$aux[[i]])))
+    }
+    a
+  })
+
+  exec <- .Call("MXR_ExecutorBind", unclass(symbol), lapply(args, unclass),
+                lapply(grads, function(g) if (is.null(g)) NULL else unclass(g)),
+                reqs, lapply(aux, unclass), PACKAGE = "mxnet")
+  opt <- .Call("MXR_OptimizerCreate", "ccsgd",
+               c("momentum", "rescale_grad"),
+               c(as.character(momentum),
+                 as.character(1.0 / data.shape[1])), PACKAGE = "mxnet")
+
+  param.idx <- which(reqs == 1L)
+  data.idx <- match("data", arg.names)
+  label.idx <- match(label.name, arg.names)
+
+  acc <- 0
+  for (round in seq_len(num.round)) {
+    mx.io.reset(X)
+    correct <- 0; total <- 0
+    while (mx.io.next(X)) {
+      db <- as.array.MXNDArray(mx.io.data(X))
+      lb <- as.array.MXNDArray(mx.io.label(X))
+      mx.nd.set(args[[data.idx]], db)
+      mx.nd.set(args[[label.idx]], lb)
+      .Call("MXR_ExecutorForward", exec, TRUE, PACKAGE = "mxnet")
+      .Call("MXR_ExecutorBackward", exec, PACKAGE = "mxnet")
+      for (j in seq_along(param.idx)) {
+        i <- param.idx[j]
+        .Call("MXR_OptimizerUpdate", opt, j - 1L, unclass(args[[i]]),
+              unclass(grads[[i]]), learning.rate, wd, PACKAGE = "mxnet")
+      }
+      outs <- .Call("MXR_ExecutorOutputs", exec, PACKAGE = "mxnet")
+      prob <- as.array.MXNDArray(structure(outs[[1]], class = "MXNDArray"))
+      # prob dims (R, column-major) = rev(framework (N, C)) = (C, N)
+      pred <- apply(prob, 2, which.max) - 1
+      correct <- correct + sum(pred == as.vector(lb))
+      total <- total + length(lb)
+    }
+    acc <- correct / total
+    if (verbose) {
+      cat(sprintf("Round [%d] Train-%s=%f\n", round, eval.metric, acc))
+    }
+  }
+
+  arg.params <- list()
+  for (i in param.idx) {
+    arg.params[[paste0("arg:", arg.names[i])]] <- args[[i]]
+  }
+  aux.params <- list()
+  for (i in seq_along(aux.names)) {
+    aux.params[[paste0("aux:", aux.names[i])]] <- aux[[i]]
+  }
+  structure(list(symbol = mx.symbol.tojson(symbol),
+                 arg.params = arg.params, aux.params = aux.params,
+                 train.accuracy = acc),
+            class = "mx.model.ff")
+}
+
+#' Name-based initialisation, the reference convention.
+mx.init.weight <- function(name, shape, initializer) {
+  n <- prod(shape)
+  if (grepl("bias$|beta$|mean$", name)) return(rep(0, n))
+  if (grepl("gamma$|var$", name)) return(rep(1, n))
+  if (identical(initializer, "xavier")) {
+    fan.out <- shape[1]
+    fan.in <- if (length(shape) > 1) prod(shape[-1]) else shape[1]
+    s <- sqrt(6 / (fan.in + fan.out))
+    return(runif(n, -s, s))
+  }
+  runif(n, -0.07, 0.07)
+}
+
+#' Save in the shared checkpoint format (prefix-symbol.json +
+#' prefix-%04d.params with arg:/aux: keys).
+mx.model.save <- function(model, prefix, iteration = 1) {
+  writeLines(model$symbol, sprintf("%s-symbol.json", prefix))
+  all <- c(model$arg.params, model$aux.params)
+  mx.nd.save(sprintf("%s-%04d.params", prefix, iteration), all)
+  invisible(NULL)
+}
+
+#' Predict with a trained mx.model.ff through the predict ABI (shares
+#' the path of predict.mx.model on loaded checkpoints).
+predict.mx.model.ff <- function(object, batch, input.shape, ...) {
+  tmp <- tempfile("rmodel")
+  mx.model.save(object, tmp, 1)
+  m <- mx.model.load(tmp, 1)
+  predict.mx.model(m, batch, input.shape)
+}

@@ -1,0 +1,43 @@
+# NDArray helpers beyond the creation/readback pair in mxnet.R —
+# the role of the reference's R-package/R/ndarray.R. Imperative
+# mx.nd.* op functions are generated into R/ops.R.
+
+#' Zero-filled NDArray with framework (row-major) shape.
+mx.nd.zeros <- function(shape) {
+  structure(.Call("MXR_NDZeros", as.integer(shape), PACKAGE = "mxnet"),
+            class = "MXNDArray")
+}
+
+#' Overwrite an NDArray in place from an R array (column-major buffer
+#' passed through, as in mx.nd.array).
+mx.nd.set <- function(nd, x) {
+  invisible(.Call("MXR_NDSet", unclass(nd), as.double(x),
+                  PACKAGE = "mxnet"))
+}
+
+#' Load a .params / NDArray binary file -> named list of NDArrays.
+mx.nd.load <- function(fname) {
+  out <- .Call("MXR_NDLoad", fname, PACKAGE = "mxnet")
+  lapply(out, function(h) structure(h, class = "MXNDArray"))
+}
+
+#' Save a named list of NDArrays.
+mx.nd.save <- function(fname, arrays) {
+  invisible(.Call("MXR_NDSave", fname, lapply(arrays, unclass),
+                  names(arrays), PACKAGE = "mxnet"))
+}
+
+#' Invoke a registered imperative op by name.
+mx.nd.invoke <- function(op, ins, params = list()) {
+  keys <- names(params)
+  if (is.null(keys)) keys <- character(0)
+  vals <- vapply(params, mx.param.string, "")
+  out <- .Call("MXR_FuncInvoke", op, lapply(ins, unclass),
+               as.character(keys), as.character(vals), PACKAGE = "mxnet")
+  lapply(out, function(h) structure(h, class = "MXNDArray"))
+}
+
+#' Seed the framework RNG.
+mx.set.seed <- function(seed) {
+  invisible(.Call("MXR_RandomSeed", as.integer(seed), PACKAGE = "mxnet"))
+}

@@ -1,0 +1,76 @@
+# Symbolic graph construction over the .Call glue — the role of the
+# reference's R-package/R/symbol.R (generic creators; the per-op
+# surface in R/ops.R is generated from the registry by gen_ops.py,
+# like the reference generates from the C registry at install).
+
+#' Create a placeholder variable symbol.
+mx.symbol.Variable <- function(name) {
+  structure(.Call("MXR_SymbolVariable", name, PACKAGE = "mxnet"),
+            class = "mx.symbol")
+}
+
+#' Generic operator construction: named list arguments that are
+#' mx.symbol objects become graph inputs; everything else is passed as
+#' a string operator parameter (the reference's macro-generated
+#' creators do exactly this split).
+mx.symbol.create <- function(op, ..., name = "") {
+  argv <- list(...)
+  keys <- names(argv)
+  if (is.null(keys)) keys <- rep("", length(argv))
+  pk <- character(0); pv <- character(0)
+  ik <- character(0); ih <- list()
+  for (i in seq_along(argv)) {
+    v <- argv[[i]]
+    if (inherits(v, "mx.symbol")) {
+      ik <- c(ik, keys[i])
+      ih <- c(ih, list(unclass(v)))
+    } else if (!is.null(v)) {
+      pv <- c(pv, mx.param.string(v))
+      pk <- c(pk, keys[i])
+    }
+  }
+  structure(.Call("MXR_SymbolCreate", op, name, pk, pv, ik, ih,
+                  PACKAGE = "mxnet"),
+            class = "mx.symbol")
+}
+
+#' Serialise an operator parameter the way the C API expects.
+mx.param.string <- function(v) {
+  if (is.logical(v)) return(ifelse(v, "True", "False"))
+  if (length(v) > 1) {
+    return(paste0("(", paste(v, collapse = ", "), ")"))
+  }
+  as.character(v)
+}
+
+mx.symbol.arguments <- function(sym) {
+  .Call("MXR_SymbolListArguments", unclass(sym), PACKAGE = "mxnet")
+}
+
+mx.symbol.auxiliary.states <- function(sym) {
+  .Call("MXR_SymbolListAuxiliaryStates", unclass(sym), PACKAGE = "mxnet")
+}
+
+mx.symbol.tojson <- function(sym) {
+  .Call("MXR_SymbolToJSON", unclass(sym), PACKAGE = "mxnet")
+}
+
+mx.symbol.fromjson <- function(json) {
+  structure(.Call("MXR_SymbolFromJSON", json, PACKAGE = "mxnet"),
+            class = "mx.symbol")
+}
+
+#' Shape inference. `shapes` is a named list of integer vectors in
+#' framework (row-major) order. Returns list(arg=, out=, aux=) or NULL.
+mx.symbol.infer.shape <- function(sym, shapes) {
+  keys <- names(shapes)
+  indptr <- c(0L, cumsum(vapply(shapes, length, 1L)))
+  flat <- as.integer(unlist(shapes))
+  .Call("MXR_SymbolInferShape", unclass(sym), keys, as.integer(indptr),
+        flat, PACKAGE = "mxnet")
+}
+
+#' All registered operator names (from the live registry).
+mx.symbol.list.ops <- function() {
+  .Call("MXR_ListOps", PACKAGE = "mxnet")
+}

@@ -1,0 +1,2 @@
+/* stub for syntax-only CI compile; see Rinternals.h */
+#include "Rinternals.h"

@@ -1,0 +1,70 @@
+/* Minimal stub of the stable R C API surface used by src/mxnet_r.cc,
+ * for SYNTAX-CHECK-ONLY compilation in CI (this image ships no R).
+ * It validates our glue's own C++ well-formedness and catches typos in
+ * our code; it does NOT substitute for compiling against real R
+ * headers (R CMD INSTALL does that wherever R exists). Declarations
+ * mirror R-4.x Rinternals.h for exactly the entry points we call. */
+#ifndef MXR_TEST_RINTERNALS_STUB_H_
+#define MXR_TEST_RINTERNALS_STUB_H_
+
+#include <cstddef>
+
+typedef struct SEXPREC *SEXP;
+typedef long R_xlen_t;
+
+extern SEXP R_NilValue;
+extern SEXP R_DimSymbol;
+extern SEXP R_NamesSymbol;
+
+#define REALSXP 14
+#define INTSXP 13
+#define STRSXP 16
+#define VECSXP 19
+#define RAWSXP 24
+
+extern "C" {
+SEXP Rf_allocVector(unsigned int, R_xlen_t);
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+void Rf_error(const char *, ...);
+int Rf_length(SEXP);
+SEXP Rf_mkChar(const char *);
+SEXP Rf_mkString(const char *);
+SEXP Rf_ScalarLogical(int);
+int Rf_asLogical(SEXP);
+int Rf_asInteger(SEXP);
+double Rf_asReal(SEXP);
+int Rf_isNull(SEXP);
+SEXP Rf_setAttrib(SEXP, SEXP, SEXP);
+double *REAL(SEXP);
+int *INTEGER(SEXP);
+unsigned char *RAW(SEXP);
+SEXP STRING_ELT(SEXP, R_xlen_t);
+void SET_STRING_ELT(SEXP, R_xlen_t, SEXP);
+SEXP VECTOR_ELT(SEXP, R_xlen_t);
+SEXP SET_VECTOR_ELT(SEXP, R_xlen_t, SEXP);
+const char *CHAR(SEXP);
+SEXP R_MakeExternalPtr(void *, SEXP, SEXP);
+void *R_ExternalPtrAddr(SEXP);
+void R_ClearExternalPtr(SEXP);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);
+
+typedef void *(*DL_FUNC)();
+typedef struct {
+  const char *name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+typedef struct _DllInfo DllInfo;
+void R_registerRoutines(DllInfo *, const void *, const R_CallMethodDef *,
+                        const void *, const void *);
+int R_useDynamicSymbols(DllInfo *, int);
+}
+
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+#define TRUE 1
+#define FALSE 0
+
+#endif  /* MXR_TEST_RINTERNALS_STUB_H_ */

@@ -1,0 +1,37 @@
+# The reference's R MNIST flow, translated (ref:
+# R-package/vignettes/mnistCompetition.Rmd: build an MLP with
+# mx.symbol.*, train with mx.model.FeedForward.create, predict, score).
+# Run from the repo root after R CMD INSTALL bindings/R-package:
+#   PYTHONPATH=. Rscript bindings/R-package/tests/train_mnist.R
+library(mxnet)
+
+mx.set.seed(7)
+
+# network: the vignette's 3-layer MLP
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data = data, num_hidden = 128, name = "fc1")
+act1 <- mx.symbol.Activation(data = fc1, act_type = "relu", name = "relu1")
+fc2 <- mx.symbol.FullyConnected(data = act1, num_hidden = 64, name = "fc2")
+act2 <- mx.symbol.Activation(data = fc2, act_type = "relu", name = "relu2")
+fc3 <- mx.symbol.FullyConnected(data = act2, num_hidden = 10, name = "fc3")
+softmax <- mx.symbol.SoftmaxOutput(data = fc3, name = "softmax")
+
+train <- mx.io.MNISTIter(batch.size = 32, num.synthetic = 512, seed = 1)
+
+model <- mx.model.FeedForward.create(
+  softmax, X = train, num.round = 3,
+  learning.rate = 0.1, momentum = 0.9)
+
+cat(sprintf("final train accuracy: %f\n", model$train.accuracy))
+stopifnot(model$train.accuracy > 0.9)
+
+# checkpoint in the shared format and predict through the C predict ABI
+prefix <- file.path(tempdir(), "r_mnist")
+mx.model.save(model, prefix, 1)
+loaded <- mx.model.load(prefix, 1)
+mx.io.reset(train)
+stopifnot(mx.io.next(train))
+batch <- as.array.MXNDArray(mx.io.data(train))
+pred <- predict.mx.model(loaded, batch, rev(dim(batch)))
+stopifnot(identical(dim(pred)[1], 10L))
+cat("PASSED\n")

@@ -92,7 +92,9 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
 int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
 /* Generic invoke by name (ref: MXFuncInvoke c_api.h:447); kwargs as
  * key/value strings, outputs appended to out_handles (caller provides
- * capacity >= *num_outputs; actual count written back). */
+ * capacity >= *num_outputs; actual count written back). When capacity
+ * is too small the call fails AND writes the required count into
+ * *num_outputs so the caller can retry with a larger buffer. */
 int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
                        mx_uint num_inputs, mx_uint num_params,
                        const char **keys, const char **vals,

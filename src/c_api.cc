@@ -380,6 +380,8 @@ int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
   if (r == nullptr) return HandleException();
   Py_ssize_t n = PyList_Size(r);
   if (static_cast<mx_uint>(n) > *num_outputs) {
+    // report the required capacity so callers can retry (header contract)
+    *num_outputs = static_cast<mx_uint>(n);
     Py_DECREF(r);
     tl_last_error = "MXFuncInvokeByName: output capacity too small";
     return -1;

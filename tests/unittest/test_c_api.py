@@ -98,6 +98,33 @@ def test_func_invoke_and_op_list(lib):
         lib.MXNDArrayFree(h)
 
 
+def test_func_invoke_capacity_protocol(lib):
+    """When output capacity is too small the call fails AND reports the
+    required count in *num_outputs so callers retry (header contract;
+    the R/JVM bindings rely on this for >8-output ops)."""
+    shape = (ctypes.c_uint * 2)(4, 16)
+    h = ctypes.c_void_p()
+    check(lib, lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)))
+    d = np.zeros((4, 16), np.float32)
+    check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, d.ctypes.data_as(ctypes.c_void_p), d.size))
+    keys = (ctypes.c_char_p * 2)(b"num_outputs", b"axis")
+    vals = (ctypes.c_char_p * 2)(b"16", b"1")
+    ins = (ctypes.c_void_p * 1)(h)
+    nout = ctypes.c_uint(2)  # deliberately too small
+    small = (ctypes.c_void_p * 2)()
+    rc = lib.MXFuncInvokeByName(b"SliceChannel", ins, 1, 2, keys, vals,
+                                ctypes.byref(nout), small)
+    assert rc != 0 and nout.value == 16
+    big = (ctypes.c_void_p * 16)()
+    check(lib, lib.MXFuncInvokeByName(b"SliceChannel", ins, 1, 2, keys,
+                                      vals, ctypes.byref(nout), big))
+    assert nout.value == 16
+    lib.MXNDArrayFree(h)
+    for i in range(16):
+        lib.MXNDArrayFree(ctypes.c_void_p(big[i]))
+
+
 def test_error_reporting(lib):
     h = ctypes.c_void_p()
     nout = ctypes.c_uint(1)
