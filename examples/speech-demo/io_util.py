@@ -1,0 +1,128 @@
+"""Utterance iterators over Kaldi tables — the reference's feat_io
+stream roles (ref: example/speech-demo/io_func/feat_io.py
+DataReadStream: context splicing, utterance buckets):
+
+- FrameIter: frame-level DNN training — splice +-context windows around
+  every frame, shuffle across utterances (TNet-style stream).
+- UtteranceIter: bucketed sequence training for the projected LSTM —
+  utterances padded per bucket, label -1 padding ignored by the loss.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+import kaldi_io  # noqa: E402
+
+
+def splice(feats, context):
+    """[T, D] -> [T, (2*context+1)*D] context windows, edge-padded."""
+    T, D = feats.shape
+    padded = np.pad(feats, ((context, context), (0, 0)), mode="edge")
+    out = np.zeros((T, (2 * context + 1) * D), feats.dtype)
+    for k in range(2 * context + 1):
+        out[:, k * D:(k + 1) * D] = padded[k:k + T]
+    return out
+
+
+class FrameIter(mx.io.DataIter):
+    """Spliced-frame iterator from feature + alignment arks."""
+
+    def __init__(self, feat_ark, ali_ark, batch_size=128, context=4,
+                 shuffle=True, seed=0):
+        super().__init__()
+        self.batch_size = batch_size
+        feats = dict(kaldi_io.read_ark(feat_ark))
+        alis = dict(kaldi_io.read_ark(ali_ark))
+        xs, ys = [], []
+        for key, f in feats.items():
+            a = alis[key]
+            assert len(a) == f.shape[0], key
+            xs.append(splice(f, context))
+            ys.append(a)
+        self._x = np.concatenate(xs).astype(np.float32)
+        self._y = np.concatenate(ys).astype(np.float32)
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(len(self._x))
+            self._x, self._y = self._x[order], self._y[order]
+        self._i = 0
+        self.provide_data = [("data", (batch_size, self._x.shape[1]))]
+        self.provide_label = [("softmax_label", (batch_size,))]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i + self.batch_size > len(self._x):
+            raise StopIteration
+        sl = slice(self._i, self._i + self.batch_size)
+        self._i += self.batch_size
+        return mx.io.DataBatch(
+            data=[mx.nd.array(self._x[sl])],
+            label=[mx.nd.array(self._y[sl])], pad=0, index=None)
+
+
+class UtteranceIter(mx.io.DataIter):
+    """Bucketed whole-utterance iterator (ref: TruncatedSentenceStream /
+    the rnn bucket_io pattern): batches of same-bucket utterances,
+    features padded with zeros and labels with -1 (ignored by the
+    sequence softmax)."""
+
+    def __init__(self, feat_ark, ali_ark, buckets=(32, 64), batch_size=4,
+                 context=0, seed=0):
+        super().__init__()
+        self.batch_size = batch_size
+        self.buckets = sorted(buckets)
+        feats = dict(kaldi_io.read_ark(feat_ark))
+        alis = dict(kaldi_io.read_ark(ali_ark))
+        dim = next(iter(feats.values())).shape[1] * (2 * context + 1)
+        self._per_bucket = {b: [] for b in self.buckets}
+        for key, f in feats.items():
+            if context:
+                f = splice(f, context)
+            a = alis[key]
+            for b in self.buckets:
+                if f.shape[0] <= b:
+                    x = np.zeros((b, dim), np.float32)
+                    y = np.full((b,), -1, np.float32)
+                    x[:f.shape[0]] = f
+                    y[:a.shape[0]] = a
+                    self._per_bucket[b].append((x, y))
+                    break
+        self.default_bucket_key = self.buckets[-1]
+        self._plan = [
+            (b, lo) for b in self.buckets
+            for lo in range(0, len(self._per_bucket[b]) // batch_size
+                            * batch_size, batch_size)
+        ]
+        self._rng = np.random.RandomState(seed)
+        self._i = 0
+        self.provide_data = [("data",
+                              (batch_size, self.default_bucket_key, dim))]
+        self.provide_label = [("softmax_label",
+                               (batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self._i = 0
+        self._rng.shuffle(self._plan)
+
+    def next(self):
+        if self._i >= len(self._plan):
+            raise StopIteration
+        b, lo = self._plan[self._i]
+        self._i += 1
+        items = self._per_bucket[b][lo:lo + self.batch_size]
+        x = np.stack([it[0] for it in items])
+        y = np.stack([it[1] for it in items])
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)], pad=0,
+            index=None)
+        batch.bucket_key = b
+        batch.provide_data = [("data", x.shape)]
+        batch.provide_label = [("softmax_label", y.shape)]
+        return batch

@@ -1,0 +1,148 @@
+"""Self-contained Kaldi ark/scp IO — the role of the reference's
+libkaldi-python-wrap ctypes bridge (ref:
+example/speech-demo/io_func/feat_readers/reader_kaldi.py loads a
+compiled Kaldi shim; here the table formats are read/written directly,
+so the pipeline needs no Kaldi install).
+
+Supported (the subset the acoustic pipeline uses):
+- binary FloatMatrix ark entries:  key ' ' '\\0' 'B' 'FM ' \\4 rows \\4 cols data
+- binary FloatVector:              ... 'FV ' \\4 dim data
+- binary int32 vectors (alignments): ... \\4 n (n x (\\4 int32))
+- scp files: "key path:offset" lines indexing into an ark
+- text ark matrices: "key  [\\n r1c1 r1c2 ...\\n ... ]"
+"""
+import struct
+
+import numpy as np
+
+
+def _write_token(f, tok):
+    f.write(tok.encode() + b" ")
+
+
+def _write_int(f, v):
+    f.write(b"\x04" + struct.pack("<i", v))
+
+
+def _read_int(f):
+    sz = f.read(1)
+    assert sz == b"\x04", "expected int32 size marker, got %r" % sz
+    return struct.unpack("<i", f.read(4))[0]
+
+
+def write_ark_matrix(f, key, mat, scp=None, ark_path=None):
+    """Append one binary FloatMatrix entry; optionally add an scp line."""
+    mat = np.asarray(mat, np.float32)
+    f.write(key.encode() + b" ")
+    offset = f.tell()
+    f.write(b"\x00B")
+    _write_token(f, "FM")
+    _write_int(f, mat.shape[0])
+    _write_int(f, mat.shape[1])
+    f.write(mat.tobytes())
+    if scp is not None:
+        scp.write("%s %s:%d\n" % (key, ark_path, offset))
+
+
+def write_ark_ints(f, key, vec, scp=None, ark_path=None):
+    """Append one binary int32-vector entry (alignment format)."""
+    vec = np.asarray(vec, np.int32)
+    f.write(key.encode() + b" ")
+    offset = f.tell()
+    f.write(b"\x00B")
+    _write_int(f, len(vec))
+    for v in vec:
+        _write_int(f, int(v))
+    if scp is not None:
+        scp.write("%s %s:%d\n" % (key, ark_path, offset))
+
+
+def _read_key(f):
+    key = b""
+    while True:
+        c = f.read(1)
+        if not c:
+            return None
+        if c == b" ":
+            return key.decode()
+        key += c
+
+
+def _read_binary_value(f):
+    first = f.read(1)
+    if first == b"\x04":
+        # int32 vector (alignment): \x04 n, then n x (\x04 int32)
+        n = struct.unpack("<i", f.read(4))[0]
+        vals = np.empty(n, np.int32)
+        for i in range(n):
+            vals[i] = _read_int(f)
+        return vals
+    tok = first
+    while True:
+        c = f.read(1)
+        if c == b" " or not c:
+            break
+        tok += c
+    if tok == b"FM":
+        rows = _read_int(f)
+        cols = _read_int(f)
+        data = np.frombuffer(f.read(4 * rows * cols), np.float32)
+        return data.reshape(rows, cols).copy()
+    if tok == b"FV":
+        dim = _read_int(f)
+        return np.frombuffer(f.read(4 * dim), np.float32).copy()
+    raise ValueError("unsupported Kaldi binary token %r" % tok)
+
+
+def read_ark(path):
+    """Iterate (key, value) over a binary ark file."""
+    with open(path, "rb") as f:
+        while True:
+            key = _read_key(f)
+            if key is None:
+                return
+            marker = f.read(2)
+            assert marker == b"\x00B", "text ark in binary reader"
+            yield key, _read_binary_value(f)
+
+
+def read_scp(path):
+    """Iterate (key, value) through an scp index."""
+    with open(path) as f:
+        for line in f:
+            key, loc = line.split()
+            ark, off = loc.rsplit(":", 1)
+            with open(ark, "rb") as a:
+                a.seek(int(off))
+                marker = a.read(2)
+                assert marker == b"\x00B"
+                yield key, _read_binary_value(a)
+
+
+def write_text_ark(path, entries):
+    """Write matrices in Kaldi text-table format."""
+    with open(path, "w") as f:
+        for key, mat in entries:
+            mat = np.asarray(mat)
+            f.write("%s  [\n" % key)
+            for row in mat:
+                f.write("  " + " ".join("%.6f" % v for v in row) + "\n")
+            f.write("]\n")
+
+
+def read_text_ark(path):
+    """Iterate (key, matrix) over a text-format ark."""
+    with open(path) as f:
+        key, rows = None, []
+        for line in f:
+            line = line.strip()
+            if line.endswith("["):
+                key = line.split()[0]
+                rows = []
+            elif line.endswith("]"):
+                body = line[:-1].strip()
+                if body:
+                    rows.append([float(v) for v in body.split()])
+                yield key, np.array(rows, np.float32)
+            elif line:
+                rows.append([float(v) for v in line.split()])

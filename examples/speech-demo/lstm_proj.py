@@ -1,0 +1,87 @@
+"""Projected LSTM with peephole connections — the reference's acoustic
+sequence model (ref: example/speech-demo/lstm_proj.py: i2h/h2h gates,
+cell-to-gate peephole biases Wci/Wcf/Wco, and a projection layer h2h_proj
+that shrinks the recurrent state). Built by explicit unrolling over the
+bucketed sequence, the same construction the reference uses.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+
+def lstm_proj_cell(num_hidden, num_proj, indata, prev_c, prev_h, param,
+                   seqidx, layeridx):
+    """One projected-LSTM step. param: dict of shared weight symbols."""
+    i2h = sym.FullyConnected(data=indata, weight=param["i2h_weight"],
+                             bias=param["i2h_bias"],
+                             num_hidden=num_hidden * 4,
+                             name="t%d_l%d_i2h" % (seqidx, layeridx))
+    h2h = sym.FullyConnected(data=prev_h, weight=param["h2h_weight"],
+                             no_bias=True, num_hidden=num_hidden * 4,
+                             name="t%d_l%d_h2h" % (seqidx, layeridx))
+    gates = i2h + h2h
+    sliced = sym.SliceChannel(gates, num_outputs=4,
+                              name="t%d_l%d_slice" % (seqidx, layeridx))
+    # peepholes: cell state modulates input/forget gates before the
+    # nonlinearity and the output gate after the cell update
+    in_gate = sym.Activation(
+        sliced[0] + sym.broadcast_mul(param["c2i_bias"], prev_c),
+        act_type="sigmoid")
+    in_transform = sym.Activation(sliced[1], act_type="tanh")
+    forget_gate = sym.Activation(
+        sliced[2] + sym.broadcast_mul(param["c2f_bias"], prev_c),
+        act_type="sigmoid")
+    next_c = (forget_gate * prev_c) + (in_gate * in_transform)
+    out_gate = sym.Activation(
+        sliced[3] + sym.broadcast_mul(param["c2o_bias"], next_c),
+        act_type="sigmoid")
+    next_h_full = out_gate * sym.Activation(next_c, act_type="tanh")
+    # projection: recurrent state lives in num_proj dims
+    next_h = sym.FullyConnected(data=next_h_full,
+                                weight=param["ph2h_weight"], no_bias=True,
+                                num_hidden=num_proj,
+                                name="t%d_l%d_proj" % (seqidx, layeridx))
+    return next_c, next_h
+
+
+def lstm_proj_unroll(seq_len, num_hidden=64, num_proj=32, num_label=10):
+    """Acoustic LSTMP network for one bucket length: data [N, T, D] ->
+    per-frame softmax with -1 padding ignored."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    param = {
+        "i2h_weight": sym.Variable("i2h_weight"),
+        "i2h_bias": sym.Variable("i2h_bias"),
+        "h2h_weight": sym.Variable("h2h_weight"),
+        "ph2h_weight": sym.Variable("ph2h_weight"),
+        "c2i_bias": sym.Variable("c2i_bias"),
+        "c2f_bias": sym.Variable("c2f_bias"),
+        "c2o_bias": sym.Variable("c2o_bias"),
+        "cls_weight": sym.Variable("cls_weight"),
+        "cls_bias": sym.Variable("cls_bias"),
+        "init_c": sym.Variable("init_c"),
+        "init_h": sym.Variable("init_h"),
+    }
+    frames = sym.SliceChannel(data, num_outputs=seq_len, axis=1,
+                              squeeze_axis=True, name="frames")
+    prev_c, prev_h = param["init_c"], param["init_h"]
+    outs = []
+    for t in range(seq_len):
+        prev_c, prev_h = lstm_proj_cell(
+            num_hidden, num_proj, frames[t], prev_c, prev_h, param, t, 0)
+        score = sym.FullyConnected(data=prev_h, weight=param["cls_weight"],
+                                   bias=param["cls_bias"],
+                                   num_hidden=num_label,
+                                   name="t%d_cls" % t)
+        outs.append(sym.Reshape(data=score, shape=(0, 1, num_label),
+                                name="t%d_rs" % t))
+    stacked = sym.Concat(*outs, num_args=seq_len, dim=1, name="scores")
+    # [N, T, C] softmax with ignore_label for the -1 padding
+    return sym.SoftmaxOutput(data=stacked, label=label, preserve_shape=True,
+                             use_ignore=True, ignore_label=-1,
+                             normalization="valid", name="softmax")
