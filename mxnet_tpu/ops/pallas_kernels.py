@@ -334,7 +334,7 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, scale,
 
 
 def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=128, block_k=128):
+                    block_q=512, block_k=128):
     """Blockwise-softmax attention. q,k,v: [batch, heads, time, d_head].
 
     Forward AND backward run as Pallas kernels: the forward saves the
@@ -351,12 +351,24 @@ def flash_attention(q, k, v, causal=True, scale=None,
 
     Falls back to plain XLA when shapes don't tile (time not divisible
     by block, or kernels disabled).
+
+    Block sizing (measured, docs/perf_analysis.md round 4): every
+    q-block grid cell DMAs the FULL K/V into VMEM, so K/V HBM traffic
+    scales with tq/block_q — block_q 128 -> 512 took T=8192 training
+    from 41% to 59% MFU and T=1024 from 55% to 61%. Default block_q=512
+    (clamped to tq); MXNET_FLASH_BLOCK_Q/K override for probes.
     """
     import jax
 
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     tq, tk = q.shape[2], k.shape[2]
+    # block tuning: each q-block grid cell DMAs the FULL K/V into VMEM,
+    # so K/V HBM traffic scales with n_q = tq/block_q — larger q blocks
+    # cut it proportionally at long T (measured probe in
+    # docs/perf_analysis.md); env knobs for A/B
+    block_q = int(os.environ.get("MXNET_FLASH_BLOCK_Q", block_q))
+    block_k = int(os.environ.get("MXNET_FLASH_BLOCK_K", block_k))
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     # Blocks must respect Mosaic tiling on hardware (sublane multiple of
