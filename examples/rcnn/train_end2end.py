@@ -209,7 +209,7 @@ def main():
 
     # VOC07 mAP through the SSD example's MApMetric (shared eval code,
     # the reference's pred_eval/voc_eval protocol)
-    from evaluate import evaluate_map
+    from eval_map import evaluate_map
 
     mAP = evaluate_map(test_mod, make_image, detect, num_images=8,
                        num_classes=NUM_CLASSES)

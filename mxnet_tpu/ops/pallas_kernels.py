@@ -58,6 +58,16 @@ def _interpret():
     return not _on_tpu()
 
 
+def _env_int(name, default):
+    """Int env knob; malformed/empty values fall back to the default
+    (the kernels' silent-fallback contract must survive a bad export)."""
+    try:
+        v = os.environ.get(name, "")
+        return int(v) if v.strip() else default
+    except ValueError:
+        return default
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
@@ -366,16 +376,24 @@ def flash_attention(q, k, v, causal=True, scale=None,
     # block tuning: each q-block grid cell DMAs the FULL K/V into VMEM,
     # so K/V HBM traffic scales with n_q = tq/block_q — larger q blocks
     # cut it proportionally at long T (measured probe in
-    # docs/perf_analysis.md); env knobs for A/B
-    block_q = int(os.environ.get("MXNET_FLASH_BLOCK_Q", block_q))
-    block_k = int(os.environ.get("MXNET_FLASH_BLOCK_K", block_k))
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+    # docs/perf_analysis.md); env knobs for A/B. Malformed env values
+    # fall back to the defaults (silent-fallback contract).
+    block_q = _env_int("MXNET_FLASH_BLOCK_Q", block_q)
+    block_k = _env_int("MXNET_FLASH_BLOCK_K", block_k)
+    block_q = max(16, min(block_q, tq))
+    block_k = max(16, min(block_k, tk))
+    # shrink to a divisor so lengths tileable at a smaller block (e.g.
+    # T=1280 with the 512 default) stay on the kernel instead of
+    # silently falling back to the dense O(T^2) path
+    while block_q > 16 and tq % block_q:
+        block_q //= 2
+    while block_k > 16 and tk % block_k:
+        block_k //= 2
     # Blocks must respect Mosaic tiling on hardware (sublane multiple of
     # 16 for bf16, lane dim 128); enforced uniformly so CPU interpret mode
     # takes the same path the TPU compile would.
     aligned = block_q % 16 == 0 and block_k % 128 == 0
-    min_t = int(os.environ.get("MXNET_FLASH_MIN_T", "0"))
+    min_t = _env_int("MXNET_FLASH_MIN_T", 0)
     usable = (
         enabled()
         and q.ndim == 4

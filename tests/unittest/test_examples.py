@@ -67,7 +67,18 @@ def test_example_smoke(script, argv, monkeypatch):
     monkeypatch.setattr(sys, "argv", [path] + argv)
     # examples import siblings relative to their own directory
     monkeypatch.syspath_prepend(os.path.dirname(path))
-    runpy.run_path(path, run_name="__main__")
+    before = set(sys.modules)
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        # drop modules the example imported: different example families
+        # use the same sibling module names (evaluate, proposal, ...) and
+        # a cached one from a previous family would shadow this one's
+        for name in set(sys.modules) - before:
+            mod = sys.modules.get(name)
+            f = getattr(mod, "__file__", "") or ""
+            if f.startswith(os.path.join(ROOT, "examples")):
+                del sys.modules[name]
 
 
 def test_example_smoke_torch(monkeypatch):

@@ -110,6 +110,28 @@ def test_flash_attention_dense_bwd_probe_path(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4)
 
 
+def test_flash_attention_block_divisor_shrink(monkeypatch):
+    """T divisible by 128 but not by the 512 default must stay on the
+    kernel (block shrinks to a divisor) and malformed env knobs fall
+    back silently (review r4)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(9)
+    t = 640  # not divisible by 512; tiles at 128
+    q = jnp.asarray(rng.randn(1, 1, t, 32), jnp.float32)
+    out = pk.flash_attention(q, q, q, causal=True)
+    ref = pk._attention_reference(q, q, q, True, 32 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+    for bad in ("", "0", "notanint"):
+        monkeypatch.setenv("MXNET_FLASH_BLOCK_Q", bad)
+        monkeypatch.setenv("MXNET_FLASH_MIN_T", bad)
+        out = pk.flash_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5)
+
+
 def test_flash_attention_fallback_odd_shapes():
     import jax.numpy as jnp
 
