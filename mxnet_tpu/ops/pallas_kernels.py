@@ -343,8 +343,83 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, scale,
             dv.reshape(b, h, tk, dv_dim))
 
 
+def _select_blocks(tq, tk, block_q=None, block_k=128, d=None, dv=None):
+    """Resolve flash block sizes for a (tq, tk) problem.
+
+    Returns ``(block_q, block_k, ok)``; ``ok=False`` means no legal tiling
+    exists and the caller must use the dense path.
+
+    - ``block_q=None`` picks the shape-keyed default: 1024 for T>=8192,
+      512 below (measured in docs/perf_analysis.md — K/V HBM traffic per
+      q row scales with 1/block_q, so long context wants larger q blocks;
+      1024 buys ~+5 MFU points at T=8192 with no effect at 1k-4k).
+    - Env knobs MXNET_FLASH_BLOCK_Q/K override for A/B probes; malformed
+      values fall back silently.
+    - Blocks shrink to a divisor of T so lengths tileable at a smaller
+      block stay on the kernel.
+    - Mosaic legality (enforced uniformly so CPU interpret mode takes the
+      same path a TPU compile would): sublane dims must be multiples of
+      16, and block_q ALSO rides the lane (last) dimension of the
+      (1, 8, block_q) lse/dcap stats blocks, where Mosaic accepts only a
+      multiple of 128 or the full dimension — so a 16/32/64 divisor-shrink
+      result (e.g. tq=1088 -> 64) must fall back to dense rather than
+      raise a lowering error on hardware (advisor r4).
+    """
+    if block_q is None:
+        block_q = 1024 if tq >= 8192 else 512
+    block_q = _env_int("MXNET_FLASH_BLOCK_Q", block_q)
+    block_k = _env_int("MXNET_FLASH_BLOCK_K", block_k)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    # sub-128 blocks are never lane-legal unless they span the full dim,
+    # so a smaller request (arg or env probe) rounds up rather than
+    # silently dropping the shape to the dense path
+    if block_q < 128:
+        block_q = min(128, tq)
+    if block_k < 128:
+        block_k = min(128, tk)
+    # shrink to the largest 128-multiple that divides T, so lengths
+    # tileable at a smaller block stay on the kernel; scanning every
+    # multiple (not just halvings) keeps e.g. tq=8320 on block_q=640
+    # instead of collapsing to 128. Also re-scan when the requested block
+    # is not itself a 128-multiple (e.g. an env probe of 192): a legal
+    # divisor beats both the dense fallback and a full-dim block. The
+    # scan leaves the block unchanged when no 128-multiple divides T —
+    # the full-dim last resort below still applies then.
+    if tq % block_q or block_q % 128:
+        for m in range(block_q // 128, 0, -1):
+            if tq % (m * 128) == 0:
+                block_q = m * 128
+                break
+    if tk % block_k or block_k % 128:
+        for m in range(block_k // 128, 0, -1):
+            if tk % (m * 128) == 0:
+                block_k = m * 128
+                break
+    aligned = (
+        block_q % 16 == 0
+        and (block_q % 128 == 0 or block_q == tq)
+        and block_k % 128 == 0
+    )
+    ok = aligned and tq % block_q == 0 and tk % block_k == 0
+    if not ok and tq % 16 == 0 and tk % 16 == 0:
+        # Last resort for off-128 lengths (1088, 8256, ...): a block that
+        # spans the FULL dimension is always Mosaic-legal (no tiling of
+        # that axis), so whichever side failed to tile can run as a single
+        # block instead of dropping to the dense O(T^2) path — provided
+        # the q block plus the [bq, bk] score/mask intermediates fit the
+        # per-cell VMEM budget alongside the resident K/V (which
+        # flash_attention guards separately).
+        bq2 = block_q if (block_q % 128 == 0 and tq % block_q == 0) else tq
+        bk2 = block_k if (block_k % 128 == 0 and tk % block_k == 0) else tk
+        extra = bq2 * ((d or 0) + (dv or d or 0) + 2 * bk2) * 4
+        if d is None or extra <= 4 * 1024 * 1024:
+            return bq2, bk2, True
+    return block_q, block_k, ok
+
+
 def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=512, block_k=128):
+                    block_q=None, block_k=128):
     """Blockwise-softmax attention. q,k,v: [batch, heads, time, d_head].
 
     Forward AND backward run as Pallas kernels: the forward saves the
@@ -362,44 +437,26 @@ def flash_attention(q, k, v, causal=True, scale=None,
     Falls back to plain XLA when shapes don't tile (time not divisible
     by block, or kernels disabled).
 
-    Block sizing (measured, docs/perf_analysis.md round 4): every
+    Block sizing (measured, docs/perf_analysis.md rounds 4-5): every
     q-block grid cell DMAs the FULL K/V into VMEM, so K/V HBM traffic
     scales with tq/block_q — block_q 128 -> 512 took T=8192 training
-    from 41% to 59% MFU and T=1024 from 55% to 61%. Default block_q=512
-    (clamped to tq); MXNET_FLASH_BLOCK_Q/K override for probes.
+    from 41% to 59% MFU and T=1024 from 55% to 61%; 512 -> 1024 buys a
+    further ~+5 MFU points at T=8192. The default is therefore
+    shape-keyed in ``_select_blocks`` (1024 for T>=8192, 512 below,
+    clamped to tq); MXNET_FLASH_BLOCK_Q/K override for probes.
     """
     import jax
 
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     tq, tk = q.shape[2], k.shape[2]
-    # block tuning: each q-block grid cell DMAs the FULL K/V into VMEM,
-    # so K/V HBM traffic scales with n_q = tq/block_q — larger q blocks
-    # cut it proportionally at long T (measured probe in
-    # docs/perf_analysis.md); env knobs for A/B. Malformed env values
-    # fall back to the defaults (silent-fallback contract).
-    block_q = _env_int("MXNET_FLASH_BLOCK_Q", block_q)
-    block_k = _env_int("MXNET_FLASH_BLOCK_K", block_k)
-    block_q = max(16, min(block_q, tq))
-    block_k = max(16, min(block_k, tk))
-    # shrink to a divisor so lengths tileable at a smaller block (e.g.
-    # T=1280 with the 512 default) stay on the kernel instead of
-    # silently falling back to the dense O(T^2) path
-    while block_q > 16 and tq % block_q:
-        block_q //= 2
-    while block_k > 16 and tk % block_k:
-        block_k //= 2
-    # Blocks must respect Mosaic tiling on hardware (sublane multiple of
-    # 16 for bf16, lane dim 128); enforced uniformly so CPU interpret mode
-    # takes the same path the TPU compile would.
-    aligned = block_q % 16 == 0 and block_k % 128 == 0
+    block_q, block_k, tiles = _select_blocks(tq, tk, block_q, block_k,
+                                             d=q.shape[-1], dv=v.shape[-1])
     min_t = _env_int("MXNET_FLASH_MIN_T", 0)
     usable = (
         enabled()
         and q.ndim == 4
-        and aligned
-        and tq % block_q == 0
-        and tk % block_k == 0
+        and tiles
         # the crossover is a hardware-perf decision; interpret mode
         # (CPU tests) always takes the kernel path for coverage
         and (tk >= min_t or _interpret())

@@ -36,13 +36,16 @@ def test_flash_attention_grad_matches_reference():
     from mxnet_tpu.ops import pallas_kernels as pk
 
     rng = np.random.RandomState(1)
-    b, h, t, d = 1, 2, 128, 32
+    b, h, t, d = 1, 2, 256, 32
     q = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
 
     def loss_fast(q, k, v):
-        return pk.flash_attention(q, k, v, causal=True, block_q=16, block_k=128).sum()
+        # 128 is the smallest block that lowers on hardware (the lse/dcap
+        # stats blocks put block_q in the lane dim); t=256 keeps multiple
+        # q blocks in play for the grad reconstruction
+        return pk.flash_attention(q, k, v, causal=True, block_q=128, block_k=128).sum()
 
     def loss_ref(q, k, v):
         return pk._attention_reference(q, k, v, True, 1.0 / d**0.5).sum()
@@ -71,7 +74,7 @@ def test_flash_attention_bwd_kernel_parity_multiblock():
     for causal in (True, False):
         def fast(q, k, v):
             return pk.flash_attention(q, k, v, causal=causal,
-                                      block_q=64, block_k=128)
+                                      block_q=128, block_k=128)
 
         def ref(q, k, v):
             return pk._attention_reference(q, k, v, causal, 1.0 / d**0.5)
@@ -100,7 +103,7 @@ def test_flash_attention_dense_bwd_probe_path(monkeypatch):
     v = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
 
     def loss(q, k, v):
-        return pk.flash_attention(q, k, v, causal=True, block_q=16,
+        return pk.flash_attention(q, k, v, causal=True, block_q=128,
                                   block_k=128).sum()
 
     g_kernel = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
@@ -130,6 +133,53 @@ def test_flash_attention_block_divisor_shrink(monkeypatch):
         out = pk.flash_attention(q, q, q, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5)
+
+
+def test_flash_block_selection_rules():
+    """Block selection must only emit hardware-legal tilings: block_q
+    rides the lane dim of the stats blocks, so it must be a multiple of
+    128 or the full q length (advisor r4); the default is shape-keyed
+    (1024 at T>=8192)."""
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    assert pk._select_blocks(8192, 8192) == (1024, 128, True)
+    assert pk._select_blocks(16384, 16384) == (1024, 128, True)
+    assert pk._select_blocks(4096, 4096) == (512, 128, True)
+    # divisor shrink keeps tileable lengths on the kernel, scanning all
+    # 128-multiples (8320 = 128*65 tiles at 640, not a power-of-two)
+    assert pk._select_blocks(640, 640) == (128, 128, True)
+    assert pk._select_blocks(1280, 1280) == (256, 128, True)
+    assert pk._select_blocks(8320, 8320) == (640, 128, True)
+    # a sub-128 request rounds up to a legal block instead of going dense
+    assert pk._select_blocks(8192, 8192, block_q=64, d=64, dv=64) == \
+        (128, 128, True)
+    # full-dim q block is legal even when not a 128-multiple
+    bq, _, ok = pk._select_blocks(192, 256)
+    assert (bq, ok) == (192, True)
+    # off-128 lengths with no legal divisor fall back to a full-dim block
+    # (always Mosaic-legal) when the intermediates fit VMEM: the q side
+    # alone (cross-attention, tiled k) ...
+    assert pk._select_blocks(1088, 1024, d=32, dv=32) == (1088, 128, True)
+    # ... or both sides (off-128 self-attention at small T)
+    assert pk._select_blocks(544, 544, d=32, dv=32) == (544, 544, True)
+    # but NOT when the score intermediates blow the budget: then it is a
+    # dense fallback, never a sub-128 block that would raise a Mosaic
+    # lowering error on chip
+    for tq, tk in ((1088, 1088), (8256, 8256)):
+        bq, bk, ok = pk._select_blocks(tq, tk, d=64, dv=64)
+        assert not ok and bq % 128 == 0 and bk % 16 == 0
+    # an explicit sub-128 block_q is rounded up to the legal 128 tiling
+    # rather than lowered as-is or dropped to dense
+    assert pk._select_blocks(256, 256, block_q=64, d=32, dv=32) == \
+        (128, 128, True)
+    # a non-128-multiple request re-scans for a legal divisor instead of
+    # going dense (192 @ 4992 -> 128) or ballooning to full-dim
+    # (320 @ 1280 -> 256)
+    assert pk._select_blocks(4992, 4992, block_q=192, d=64, dv=64)[0] == 128
+    assert pk._select_blocks(1280, 1280, block_q=320, d=64, dv=64) == \
+        (256, 128, True)
+    # lengths not even sublane-aligned stay dense
+    assert not pk._select_blocks(1090, 1090, d=32, dv=32)[2]
 
 
 def test_flash_attention_fallback_odd_shapes():
