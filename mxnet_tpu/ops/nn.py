@@ -17,6 +17,9 @@ non-TPU backends low-precision conv accumulation is backend-default.
 """
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -414,9 +417,93 @@ register(
 
 
 # -- BatchNorm (ref: src/operator/batch_norm-inl.h:314) ------------------------
+def _bn_norm_fwd_impl(x, gamma, beta, eps, axes, bshape, sample=1):
+    # E[x^2]-E[x]^2 instead of jnp.var's E[(x-E[x])^2]: the two-pass
+    # form must finish the mean reduction before it can START the
+    # variance pass (two full HBM reads of the activation, serialized);
+    # sum and sum-of-squares reduce in ONE fused read. f32 accumulation
+    # keeps the cancellation benign for activation-scale data (the
+    # cuDNN BN fast path makes the same trade). Clamp: cancellation
+    # can produce a small negative where true var ~ 0.
+    x32 = x.astype(jnp.float32)
+    # sample>1: statistics from a CONTIGUOUS batch prefix of N/sample
+    # rows (ghost-BN style estimator over N/sample images x all spatial
+    # positions; batches are shuffled so a prefix is an unbiased sample)
+    # — cuts the stats pass's HBM read by the same factor. Contiguity
+    # matters: a strided x[::k] slice measured 897 img/s vs the 2,630
+    # baseline on chip (XLA materializes the gather); the prefix slice
+    # is a view-shaped read that fuses. Opt-in via
+    # MXNET_BN_STATS_SAMPLE; default exact (reference semantics).
+    xs = x32[:max(1, x32.shape[0] // sample)] if sample > 1 else x32
+    mean = jnp.mean(xs, axis=axes)
+    sqmean = jnp.mean(jnp.square(xs), axis=axes)
+    var = jnp.maximum(sqmean - jnp.square(mean), 0.0)
+    # multiply by rsqrt (not divide by sqrt): XLA:TPU keeps the division
+    # out of the fused elementwise loop this way
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    y32 = (x32 - mean.reshape(bshape)) * inv
+    y = (y32 * gamma.reshape(bshape) + beta.reshape(bshape)).astype(x.dtype)
+    return y, mean, var, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train_norm(x, gamma, beta, eps, axes, bshape):
+    """Training-mode batch normalization with a hand-written backward.
+
+    Why not plain autodiff: the traced chain upcasts the activation to
+    f32 and the vjp then keeps full-size f32 intermediates (x32, the
+    centered product) as residuals — under the bf16 mixed-precision
+    policy that doubles the HBM bytes the backward re-reads for every
+    BatchNorm in the network (the named ResNet-50 roofline residual,
+    docs/perf_analysis.md). This custom vjp pins the residuals to the
+    activation in its OWN storage dtype (the very buffer the preceding
+    conv already wrote — XLA aliases it, so BN stores nothing
+    full-size) plus per-channel f32 stats, and recomputes x_hat
+    blockwise in the backward fused into the reduction reads. The
+    gradient formulas are the reference's BatchNormBackward
+    (ref: src/operator/batch_norm-inl.h:220-260) in the standard
+    two-reduction form.
+    """
+    return _bn_norm_fwd_impl(x, gamma, beta, eps, axes, bshape)[:3]
+
+
+def _bn_train_norm_fwd(x, gamma, beta, eps, axes, bshape):
+    y, mean, var, inv = _bn_norm_fwd_impl(x, gamma, beta, eps, axes, bshape)
+    return (y, mean, var), (x, mean, inv, gamma)
+
+
+def _bn_train_norm_bwd(eps, axes, bshape, res, cts):
+    x, mean, inv, gamma = res
+    dy, dmean_ct, dvar_ct = cts
+    n = 1.0
+    for a in axes:
+        n *= x.shape[a]
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xc = x32 - mean.reshape(bshape)
+    xhat = xc * inv
+    # two reductions in one fused read of (x, dy)
+    dbeta = jnp.sum(dy32, axis=axes)
+    dgamma = jnp.sum(dy32 * xhat, axis=axes)
+    g = gamma.reshape(bshape) * inv
+    dx32 = g * (dy32 - (xhat * dgamma.reshape(bshape)
+                        + dbeta.reshape(bshape)) / n)
+    # cotangents of the mean/var outputs: zero in the training path (the
+    # moving-average update stop_gradients them) but kept exact so the
+    # op stays a correct primitive wherever stats are consumed
+    # differentiably; d var/dx uses the one-pass identity 2(x-mean)/n
+    dx32 = dx32 + (dmean_ct.reshape(bshape)
+                   + 2.0 * xc * dvar_ct.reshape(bshape)) / n
+    return dx32.astype(x.dtype), dgamma, dbeta
+
+
+_bn_train_norm.defvjp(_bn_train_norm_fwd, _bn_train_norm_bwd)
+
+
 def _bn_fwd(params, inputs, aux, is_train, rng):
     # statistics and normalization in f32 regardless of activation dtype —
-    # bf16 batch stats are numerically unusable (SURVEY §7 "dtype care")
+    # bf16 batch stats are numerically unusable (SURVEY §7 "dtype care");
+    # residuals stay in the activation's storage dtype (custom vjp above)
     data, gamma, beta = inputs
     moving_mean, moving_var = aux
     eps, momentum = params["eps"], params["momentum"]
@@ -424,31 +511,33 @@ def _bn_fwd(params, inputs, aux, is_train, rng):
         gamma = jnp.ones_like(jax.lax.stop_gradient(gamma))
     axes = (0,) + tuple(range(2, data.ndim))
     bshape = (1, -1) + (1,) * (data.ndim - 2)
-    x32 = data.astype(jnp.float32)
     if is_train and not params["use_global_stats"]:
-        # E[x^2]-E[x]^2 instead of jnp.var's E[(x-E[x])^2]: the two-pass
-        # form must finish the mean reduction before it can START the
-        # variance pass (two full HBM reads of the activation, serialized);
-        # sum and sum-of-squares reduce in ONE fused read. f32 accumulation
-        # keeps the cancellation benign for activation-scale data (the
-        # cuDNN BN fast path makes the same trade). Clamp: cancellation
-        # can produce a small negative where true var ~ 0.
-        mean = jnp.mean(x32, axis=axes)
-        sqmean = jnp.mean(jnp.square(x32), axis=axes)
-        var = jnp.maximum(sqmean - jnp.square(mean), 0.0)
+        try:
+            sample = max(1, int(os.environ.get("MXNET_BN_STATS_SAMPLE", "1")))
+        except ValueError:
+            sample = 1
+        if sample > 1 or os.environ.get("MXNET_BN_AUTODIFF", "") == "1":
+            # autodiff path: the r4 backward (A/B probe — measured within
+            # ~0.6% of the custom vjp, docs/perf_analysis.md r5) and the
+            # only path where subsampled statistics differentiate exactly
+            # (the stats gradient flows to sampled rows only; the custom
+            # bwd formula assumes full-batch stats)
+            out, mean, var, _ = _bn_norm_fwd_impl(
+                data, gamma.astype(jnp.float32), beta.astype(jnp.float32),
+                eps, axes, bshape, sample=sample)
+        else:
+            out, mean, var = _bn_train_norm(
+                data, gamma.astype(jnp.float32), beta.astype(jnp.float32),
+                eps, axes, bshape)
         new_mm = moving_mean * momentum + jax.lax.stop_gradient(mean) * (1 - momentum)
         new_mv = moving_var * momentum + jax.lax.stop_gradient(var) * (1 - momentum)
-        new_aux = [new_mm, new_mv]
-    else:
-        mean = jax.lax.stop_gradient(moving_mean).astype(jnp.float32)
-        var = jax.lax.stop_gradient(moving_var).astype(jnp.float32)
-        new_aux = [moving_mean, moving_var]
-    # multiply by rsqrt (not divide by sqrt): XLA:TPU keeps the division
-    # out of the fused elementwise loop this way
+        return [out], [new_mm, new_mv]
+    mean = jax.lax.stop_gradient(moving_mean).astype(jnp.float32)
+    var = jax.lax.stop_gradient(moving_var).astype(jnp.float32)
     inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
-    out = (x32 - mean.reshape(bshape)) * inv
+    out = (data.astype(jnp.float32) - mean.reshape(bshape)) * inv
     out = out * gamma.astype(jnp.float32).reshape(bshape) + beta.astype(jnp.float32).reshape(bshape)
-    return [out.astype(data.dtype)], new_aux
+    return [out.astype(data.dtype)], [moving_mean, moving_var]
 
 
 def _bn_shape(params, in_shapes):

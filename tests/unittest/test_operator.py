@@ -182,6 +182,70 @@ def test_batchnorm_train_stats():
     assert np.allclose(mm, 0.1 * batch_mean, rtol=1e-3)
 
 
+def test_batchnorm_stats_subsample(monkeypatch):
+    """MXNET_BN_STATS_SAMPLE=k normalizes with statistics from the
+    first N/k batch rows (ghost-BN estimator over a contiguous prefix —
+    strided sampling measured 3x slower on chip, docs/perf_analysis.md
+    r5); default stays exact. Gradients still agree with finite
+    differences of the sampled objective."""
+    x = np.random.rand(8, 3, 4, 4).astype("f") * 5
+    s = sym.BatchNorm(sym.Variable("data"), fix_gamma=False, name="bn")
+
+    def run():
+        args = {"data": mx.nd.array(x),
+                "bn_gamma": mx.nd.ones((3,)),
+                "bn_beta": mx.nd.zeros((3,))}
+        aux = {"bn_moving_mean": mx.nd.zeros((3,)),
+               "bn_moving_var": mx.nd.ones((3,))}
+        exe = s.bind(mx.cpu(), args, aux_states=aux, grad_req="null")
+        return exe.forward(is_train=True)[0].asnumpy()
+
+    monkeypatch.setenv("MXNET_BN_STATS_SAMPLE", "2")
+    out = run()
+    mean = x[:4].mean((0, 2, 3))
+    var = x[:4].var((0, 2, 3))
+    expect = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-3)
+    assert np.allclose(out, expect, atol=1e-4)
+    # gradient of the SAMPLED objective agrees with finite differences
+    # (the sampled path must route through autodiff — the custom vjp
+    # formula assumes full-batch statistics)
+    def loss_and_grad(xv):
+        args = {"data": mx.nd.array(xv),
+                "bn_gamma": mx.nd.ones((3,)),
+                "bn_beta": mx.nd.zeros((3,))}
+        grads = {"data": mx.nd.zeros(xv.shape)}
+        aux = {"bn_moving_mean": mx.nd.zeros((3,)),
+               "bn_moving_var": mx.nd.ones((3,))}
+        exe = s.bind(mx.cpu(), args, args_grad=grads, aux_states=aux,
+                     grad_req={"data": "write"})
+        out = exe.forward(is_train=True)[0]
+        w = np.cos(np.arange(out.size)).reshape(out.shape).astype("f")
+        exe.backward([mx.nd.array(w)])
+        return float((out.asnumpy() * w).sum()), \
+            exe.grad_dict["data"].asnumpy().copy()
+
+    _, g = loss_and_grad(x)
+    eps = 1e-2
+    rng = np.random.RandomState(3)
+    for _ in range(4):
+        i = tuple(rng.randint(0, d) for d in x.shape)
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        lp, _ = loss_and_grad(xp)
+        lm, _ = loss_and_grad(xm)
+        assert np.allclose(g[i], (lp - lm) / (2 * eps), atol=2e-2), \
+            (g[i], (lp - lm) / (2 * eps))
+
+    monkeypatch.delenv("MXNET_BN_STATS_SAMPLE")
+    out = run()
+    mean = x.mean((0, 2, 3))
+    var = x.var((0, 2, 3))
+    expect = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-3)
+    assert np.allclose(out, expect, atol=1e-4)
+
+
 def test_softmax_output_grad():
     x = np.random.rand(4, 5).astype("f")
     y = np.array([0, 1, 2, 3], dtype="f")
