@@ -1,19 +1,28 @@
 """JVM binding (bindings/jvm): training-parity Java API over the C ABI.
 
-No JDK ships in this image, so validation is three-fold (the fourth —
-compile+run under javac — activates automatically when a JDK 22+ is
-present):
+No JDK ships in this image, so validation is mechanical (the final
+proof — compile+run under javac — activates automatically when a
+JDK 22+ is present):
 
 1. the generated op surface (SymbolOps/NDArrayOps.java) is in sync with
    the live registry (gen_ops.py is deterministic);
 2. every C symbol the Java FFI layer binds exists in include/c_api.h —
    a typo'd downcall would otherwise only fail at Java runtime;
-3. structural sanity of all Java sources (balanced braces/parens,
-   package/class names match paths).
+3. every FFM FunctionDescriptor matches the parsed C declaration —
+   return kind, arity and per-position pointer/int/long/float kinds
+   (tools/java_check.py; the signature-table check javac+linker would
+   do for the reference's LibInfo.scala JNI shim);
+4. token-level source sanity: escape-aware tokenizer proves delimiter
+   balance, and a package-closure pass resolves every referenced class
+   against the package, imports and java.lang (tools/java_check.py —
+   replaces the r4 regex check, which could pass uncompilable files).
 
-The C-API call sequence Module.fit issues (symbol compose → infer shape
-→ bind → forward/backward → MXOptimizerUpdate → metric) is proven to
-train by test_c_api.py::test_c_api_train_lenet_end_to_end over ctypes.
+What stays unproven without a JDK (documented in tools/java_check.py):
+body-level type checking, overload resolution, FFM runtime Arena/layout
+discipline. The C-API call sequence Module.fit issues (symbol compose →
+infer shape → bind → forward/backward → MXOptimizerUpdate → metric) is
+proven to train by test_c_api.py::test_c_api_train_lenet_end_to_end
+over ctypes.
 """
 import os
 import re
@@ -77,20 +86,94 @@ def test_every_bound_symbol_exists_in_header():
         assert required in bound, "training surface misses %s" % required
 
 
+def _java_check():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "java_check", os.path.join(ROOT, "tools", "java_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ffm_descriptors_match_header():
+    """Every LibMx.mh() downcall descriptor — including names routed
+    through String-parameter helpers — must agree with the C declaration
+    parsed from the headers: existence, return kind, arity, and
+    per-position pointer/int/long/float kind. Upcall stubs must match a
+    header callback typedef (VERDICT r4 item 2a)."""
+    jc = _java_check()
+    headers = [os.path.join(ROOT, "include", "c_api.h"),
+               os.path.join(ROOT, "include", "c_predict_api.h")]
+    errors = jc.check_ffm_consistency(_java_files(), headers)
+    assert not errors, "\n".join(errors)
+    # the extraction itself must have real coverage, not vacuous success
+    sites = jc.extract_ffm_sites(_java_files())
+    names = set().union(*(s["names"] for s in sites))
+    assert len(names) >= 60, sorted(names)
+
+
+def test_ffm_checker_catches_mismatches(tmp_path):
+    """The checker must actually fail on the bug classes it claims to
+    catch: wrong arity, wrong kind, unknown symbol, bad upcall."""
+    jc = _java_check()
+    headers = [os.path.join(ROOT, "include", "c_api.h"),
+               os.path.join(ROOT, "include", "c_predict_api.h")]
+    cases = {
+        "arity": 'mh("MXNDArrayFree", fd(PTR, PTR))',
+        "kind": 'mh("MXNDArraySyncCopyToCPU", fd(PTR, PTR, C_INT))',
+        "unknown": 'mh("MXTotallyMadeUp", fd(PTR))',
+        "upcall": ("LibMx.upcall(t, FunctionDescriptor.ofVoid("
+                   "C_FLOAT, PTR), a)"),
+    }
+    for label, snippet in cases.items():
+        f = tmp_path / ("Bad%s.java" % label.title())
+        f.write_text("package org.mxnettpu;\nfinal class Bad%s {\n"
+                     "  void x() { %s; }\n}\n" % (label.title(), snippet))
+        errors = jc.check_ffm_consistency([str(f)], headers)
+        assert errors, "checker missed the %s mismatch" % label
+
+
 def test_java_sources_structurally_sane():
-    for f in _java_files():
+    """Token-level sanity over every Java source: escape-aware delimiter
+    balance, class/file agreement, package declarations, and closure of
+    referenced class names over package+imports+java.lang (VERDICT r4
+    item 2b — replaces the regex check)."""
+    jc = _java_check()
+    files = _java_files()
+    package_classes = {os.path.basename(f)[:-5] for f in files}
+    for f in files:
         text = open(f).read()
-        # strip string literals and comments before counting braces
-        stripped = re.sub(r'"(\\.|[^"\\])*"', '""', text)
-        stripped = re.sub(r"//[^\n]*", "", stripped)
-        stripped = re.sub(r"/\*.*?\*/", "", stripped, flags=re.S)
-        assert stripped.count("{") == stripped.count("}"), f
-        assert stripped.count("(") == stripped.count(")"), f
+        stripped = jc.check_balance(text, f)  # raises on imbalance
         name = os.path.basename(f)[:-5]
         assert re.search(r"\b(class|interface|record|enum)\s+%s\b"
                          % re.escape(name), stripped), f
+        jc.check_class_closure(f, stripped, package_classes)
         if os.path.dirname(f) == SRC:
             assert "package org.mxnettpu;" in text, f
+
+
+def test_structural_checker_catches_breakage(tmp_path):
+    """The tokenizer must reject the things javac would: unbalanced
+    delimiters hidden outside strings, unterminated literals, and
+    references to undeclared classes."""
+    jc = _java_check()
+    bad_balance = 'class B { void x() { if (a) { y(); } }'  # missing }
+    bad_literal = 'class B { String s = "unterminated; }'
+    bad_ref = ('package p;\nclass B { void x() { '
+               'TypoClass.method(); } }')
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        jc.check_balance(bad_balance, "B.java")
+    with _pytest.raises(ValueError):
+        jc.strip_java_noise(bad_literal, "B.java")
+    stripped = jc.check_balance(bad_ref, "B.java")
+    with _pytest.raises(ValueError):
+        jc.check_class_closure("B.java", stripped, {"B"})
+    # balanced braces inside strings/comments must NOT be counted
+    ok = ('class B { String s = "}}}"; // }\n'
+          '  /* ) */ void x() { } }')
+    jc.check_balance(ok, "B.java")
 
 
 def test_op_surface_covers_registry():

@@ -1,0 +1,438 @@
+"""JDK-less mechanical verification of the JVM binding (VERDICT r4 item 2).
+
+No Java compiler ships in this image, so two facts about
+``bindings/jvm`` are proven mechanically instead:
+
+1. **FFM descriptor <-> C header consistency**: every
+   ``LibMx.mh("MXFoo", <descriptor>)`` downcall site in the Java sources
+   is extracted (including names routed through ``String fn`` helper
+   methods), its ``FunctionDescriptor`` expression is parsed
+   structurally, and the result is checked against the actual C
+   declaration parsed out of ``include/c_api.h`` /
+   ``include/c_predict_api.h``: the function must exist, the return
+   kind must match, the arity must match, and every parameter position
+   must agree on kind (pointer vs 32-bit int vs 64-bit long vs float).
+   This is the moral equivalent of what the linker + javac would verify
+   for the reference's JNI shim signature table
+   (ref: scala-package/core/src/main/scala/ml/dmlc/mxnet/LibInfo.scala).
+   Upcall stubs (``FunctionDescriptor.ofVoid``) are checked against the
+   header's callback typedefs the same way.
+
+2. **Token-level source sanity** (replaces the r4 regex check): a real
+   character-level tokenizer (string/char/comment aware, escape
+   handling) verifies brace/paren/bracket balance never goes negative
+   and closes at zero, and a package-closure pass resolves every
+   capitalized identifier used in static-member position or ``new``
+   expressions against the package's own classes, explicit imports and
+   the ``java.lang`` namespace — an undeclared class reference (the
+   typo class javac would catch) fails.
+
+What remains UNPROVEN without a JDK: method-level type checking inside
+bodies, overload resolution, and the FFM runtime behaviors
+(``Arena`` lifetime discipline, layout alignment at invoke time). The
+``test_java_compiles_and_trains`` gate runs the real proof automatically
+wherever a JDK 22+ exists.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+KIND_BY_C_BASE = {
+    "char": "int", "int": "int", "bool": "int", "unsigned": "int",
+    "mx_uint": "int", "uint32_t": "int", "int32_t": "int",
+    "size_t": "long", "uint64_t": "long", "int64_t": "long", "long": "long",
+    "float": "float", "mx_float": "float",
+    "double": "double",
+    "void": "void",
+}
+
+KIND_BY_JAVA_LAYOUT = {
+    "C_INT": "int", "JAVA_INT": "int",
+    "C_LONG": "long", "JAVA_LONG": "long",
+    "C_FLOAT": "float", "JAVA_FLOAT": "float",
+    "C_DOUBLE": "double", "JAVA_DOUBLE": "double",
+    "PTR": "ptr", "ADDRESS": "ptr",
+}
+
+JAVA_LANG = {
+    "String", "System", "Integer", "Long", "Float", "Double", "Boolean",
+    "Byte", "Short", "Character", "Math", "Object", "Class", "ClassLoader",
+    "Exception", "RuntimeException", "IllegalStateException",
+    "IllegalArgumentException", "UnsupportedOperationException",
+    "IndexOutOfBoundsException", "NullPointerException",
+    "NumberFormatException", "OutOfMemoryError", "Error", "Throwable",
+    "StringBuilder", "Thread", "Runnable", "AutoCloseable", "Iterable",
+    "CharSequence", "Number", "Void", "Override", "SuppressWarnings",
+    "Deprecated", "FunctionalInterface", "InterruptedException",
+}
+
+
+# ---------------------------------------------------------------------------
+# C header parsing
+# ---------------------------------------------------------------------------
+
+
+def _strip_c_comments(text):
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", text)
+
+
+def _c_param_kind(param, typedefs):
+    """Kind of one C parameter declaration string."""
+    param = param.strip()
+    if param in ("void", ""):
+        return None  # empty parameter list
+    if "*" in param or "[" in param:
+        return "ptr"
+    words = [w for w in re.findall(r"[A-Za-z_]\w*", param)
+             if w not in ("const", "struct", "signed")]
+    # last word is the parameter name unless the decl is name-less
+    for w in words:
+        if w in typedefs:
+            return typedefs[w]
+        if w in KIND_BY_C_BASE:
+            return KIND_BY_C_BASE[w]
+    raise ValueError("cannot classify C parameter: %r" % param)
+
+
+def parse_header(paths):
+    """Parse C headers -> (decls, callbacks).
+
+    decls: {name: (ret_kind, [param_kind, ...])} for every function
+    declaration; callbacks: same shape for function-pointer typedefs.
+    """
+    text = "\n".join(_strip_c_comments(open(p).read()) for p in paths)
+    typedefs = {}
+    # plain typedefs only — struct typedefs (whose bodies contain ';')
+    # are excluded by the '{' guard; struct names reaching a parameter
+    # list do so by pointer, which the '*' rule classifies
+    for m in re.finditer(r"typedef\s+([^;({]+?)\s*(\*?)\s*([A-Za-z_]\w+)\s*;",
+                         text):
+        base, star, name = m.group(1), m.group(2), m.group(3)
+        if star or "*" in base:
+            typedefs[name] = "ptr"
+        else:
+            typedefs[name] = _c_param_kind(base + " x", typedefs)
+    callbacks = {}
+    for m in re.finditer(
+            r"typedef\s+([\w ]+\*?)\s*\(\s*\*\s*([A-Za-z_]\w+)\s*\)"
+            r"\s*\(([^;]*?)\)\s*;", text, flags=re.S):
+        ret, name, args = m.groups()
+        callbacks[name] = (_c_param_kind(ret + " x", typedefs) or "void",
+                           _c_params(args, typedefs))
+        typedefs[name] = "ptr"  # as a parameter type it is a pointer
+    decls = {}
+    for m in re.finditer(
+            r"([A-Za-z_][\w ]*?[\w*])\s+\**(MX\w+)\s*\(([^;{]*?)\)\s*;",
+            text, flags=re.S):
+        ret, name, args = m.groups()
+        ret_kind = "ptr" if "*" in m.group(0).split(name)[0] else \
+            _c_param_kind(ret + " x", typedefs)
+        decls[name] = (ret_kind, _c_params(args, typedefs))
+    return decls, callbacks
+
+
+def _c_params(args, typedefs):
+    kinds = []
+    for p in _split_top(args):
+        k = _c_param_kind(p, typedefs)
+        if k is not None:
+            kinds.append(k)
+    return kinds
+
+
+def _split_top(s):
+    """Split on commas at paren depth 0."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "(<[":
+            depth += 1
+        elif ch in ")>]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        out.append("".join(cur))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Java tokenizer
+# ---------------------------------------------------------------------------
+
+
+def strip_java_noise(text, path="<java>"):
+    """Remove comments and collapse string/char literals via a real
+    character scan (escape-aware). Returns the stripped text; raises
+    ValueError on an unterminated literal or comment."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if ch == "/" and nxt == "/":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif ch == "/" and nxt == "*":
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise ValueError("%s: unterminated block comment" % path)
+            i = j + 2
+        elif ch in "\"'":
+            quote = ch
+            j = i + 1
+            while j < n:
+                if text[j] == "\\":
+                    j += 2
+                    continue
+                if text[j] == quote:
+                    break
+                if text[j] == "\n" and quote == '"':
+                    raise ValueError(
+                        "%s: newline in string literal" % path)
+                j += 1
+            if j >= n:
+                raise ValueError("%s: unterminated literal" % path)
+            out.append('""' if quote == '"' else "'x'")
+            i = j + 1
+            continue
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def check_balance(text, path="<java>"):
+    """Delimiter balance over the noise-stripped source: depth must never
+    go negative and must end at zero for (), {}, []."""
+    stripped = strip_java_noise(text, path)
+    pairs = {"(": ")", "{": "}", "[": "]"}
+    stack = []
+    line = 1
+    for ch in stripped:
+        if ch == "\n":
+            line += 1
+        elif ch in pairs:
+            stack.append((pairs[ch], line))
+        elif ch in pairs.values():
+            if not stack or stack[-1][0] != ch:
+                raise ValueError("%s:%d: unbalanced %r" % (path, line, ch))
+            stack.pop()
+    if stack:
+        raise ValueError("%s:%d: unclosed %r" % (path, stack[-1][1],
+                                                 stack[-1][0]))
+    return stripped
+
+
+def check_class_closure(path, stripped, package_classes):
+    """Every capitalized identifier used as `new X(...)`, `X.member`, in
+    extends/implements/throws or catch position must resolve to a
+    package class, an explicit import, or java.lang."""
+    imports = set(re.findall(r"import\s+(?:static\s+)?[\w.]*?(\w+)\s*;",
+                             stripped))
+    imports |= {m.split(".")[-1]
+                for m in re.findall(r"import\s+(?:static\s+)?([\w.]+)\s*;",
+                                    stripped)}
+    # nested classes/records/enums declared in this same file
+    nested = set(re.findall(r"\b(?:class|interface|record|enum)\s+([A-Z]\w*)",
+                            stripped))
+    known = package_classes | imports | JAVA_LANG | nested
+    used = set(re.findall(r"\bnew\s+([A-Z]\w*)\s*[(<\[]", stripped))
+    used |= set(re.findall(r"(?<![\w.$])([A-Z]\w*)\s*\.\s*[a-zA-Z_]",
+                           stripped))
+    used |= set(re.findall(r"\b(?:extends|implements|throws)\s+([A-Z]\w*)",
+                           stripped))
+    used |= set(re.findall(r"\bcatch\s*\(\s*([A-Z]\w*)", stripped))
+    # SCREAMING_CASE member access (C_INT.byteSize(), LIB.find()) is a
+    # constant/field reference, not a class reference
+    bad = sorted(u for u in used
+                 if u not in known and not re.fullmatch(r"[A-Z][A-Z0-9_]*", u))
+    if bad:
+        raise ValueError("%s: unresolvable class references: %s"
+                         % (path, bad))
+
+
+# ---------------------------------------------------------------------------
+# FFM descriptor extraction
+# ---------------------------------------------------------------------------
+
+
+def _parse_descriptor(expr):
+    """(ret_kind, [param_kinds]) of a FunctionDescriptor expression."""
+    e = re.sub(r"\s+", "", expr)
+    e = e.replace("java.lang.foreign.", "").replace("LibMx.", "")
+    m = re.match(r"^fd\((.*)\)$", e)
+    if m:
+        return ("int", _layout_kinds(m.group(1)))
+    m = re.match(r"^FunctionDescriptor\.of\((.*)\)$", e)
+    if m:
+        parts = _split_top(m.group(1))
+        return (_layout_kinds(parts[0])[0],
+                _layout_kinds(",".join(parts[1:])))
+    m = re.match(r"^FunctionDescriptor\.ofVoid\((.*)\)$", e)
+    if m:
+        return ("void", _layout_kinds(m.group(1)))
+    raise ValueError("unrecognized descriptor expression: %r" % expr)
+
+
+def _layout_kinds(args):
+    kinds = []
+    for a in _split_top(args):
+        a = a.strip()
+        if not a:
+            continue
+        token = a.split(".")[-1]
+        if token not in KIND_BY_JAVA_LAYOUT:
+            raise ValueError("unknown layout token: %r" % a)
+        kinds.append(KIND_BY_JAVA_LAYOUT[token])
+    return kinds
+
+
+def _balanced_call_args(text, open_paren):
+    """Args substring of a call whose '(' is at open_paren."""
+    depth = 0
+    for i in range(open_paren, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_paren + 1:i]
+    raise ValueError("unbalanced call at offset %d" % open_paren)
+
+
+def _enclosing_helper(stripped, offset, ident):
+    """Name of the method enclosing `offset` that takes `ident` as its
+    String parameter — the helper-indirection pattern
+    (``private X get(String fn) { ... mh(fn, ...) ... }``)."""
+    decls = list(re.finditer(
+        r"\b(?:private|public|protected|static|final|synchronized|\s)*"
+        r"[\w<>\[\],. ]+?\b(\w+)\s*\(([^)]*)\)\s*\{", stripped))
+    best = None
+    for d in decls:
+        if d.start() < offset and re.search(
+                r"\bString\s+%s\b" % re.escape(ident), d.group(2)):
+            best = d.group(1)
+    return best
+
+
+def extract_ffm_sites(java_files):
+    """All mh(...) downcall sites -> list of dicts:
+    {file, names (set), desc (ret, params), via (None | helper name)}.
+    Dynamic `String fn` helper sites resolve their name set from the
+    helper's literal-argument call sites in the same file."""
+    sites = []
+    for path in java_files:
+        raw = open(path).read()
+        if os.path.basename(path) == "LibMx.java":
+            # skip the mh() definition itself but keep its internal uses
+            pass
+        stripped = strip_java_noise(raw, path)
+        # keep literals for name extraction: operate on raw for args, on
+        # stripped only for helper-signature discovery
+        for m in re.finditer(r"\bmh\s*\(", raw):
+            # skip the declaration `MethodHandle mh(String name, ...)`
+            pre = raw[max(0, m.start() - 40):m.start()]
+            if re.search(r"MethodHandle\s+$", pre):
+                continue
+            args = _balanced_call_args(raw, m.end() - 1)
+            parts = _split_top(args)
+            if len(parts) != 2:
+                raise ValueError("%s: mh() with %d args" % (path, len(parts)))
+            name_expr, desc_expr = parts[0].strip(), parts[1]
+            desc = _parse_descriptor(desc_expr)
+            lit = re.match(r'^"(\w+)"$', name_expr)
+            if lit:
+                sites.append({"file": path, "names": {lit.group(1)},
+                              "desc": desc, "via": None})
+                continue
+            helper = _enclosing_helper(stripped, m.start(), name_expr)
+            if helper is None:
+                raise ValueError(
+                    "%s: cannot resolve dynamic mh() name %r"
+                    % (path, name_expr))
+            names = set(re.findall(
+                r'\b%s\s*\(\s*"(\w+)"' % re.escape(helper), raw))
+            if not names:
+                raise ValueError(
+                    "%s: helper %s() has no literal-name call sites"
+                    % (path, helper))
+            sites.append({"file": path, "names": names, "desc": desc,
+                          "via": helper})
+    return sites
+
+
+def extract_upcall_descs(java_files):
+    """FunctionDescriptor.ofVoid(...) expressions used for upcall stubs."""
+    out = []
+    for path in java_files:
+        raw = open(path).read()
+        for m in re.finditer(r"FunctionDescriptor\s*\.\s*ofVoid\s*\(", raw):
+            args = _balanced_call_args(raw, m.end() - 1)
+            out.append((path, ("void", _layout_kinds(args))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Consistency check
+# ---------------------------------------------------------------------------
+
+
+def check_ffm_consistency(java_files, header_paths):
+    """Return a list of human-readable mismatch strings (empty = clean)."""
+    decls, callbacks = parse_header(header_paths)
+    errors = []
+    sites = extract_ffm_sites(java_files)
+    # group descriptors per (file, via) so helper sites use the
+    # at-least-one semantics (a helper may select among descriptor
+    # variants at runtime, e.g. with/without the priority argument)
+    for site in sites:
+        rel = os.path.basename(site["file"])
+        for name in sorted(site["names"]):
+            if name not in decls:
+                errors.append("%s: binds %s which is not declared in the "
+                              "header" % (rel, name))
+                continue
+            want = decls[name]
+            got = site["desc"]
+            if site["via"] is None:
+                if got != want:
+                    errors.append(
+                        "%s: %s descriptor %r != header %r"
+                        % (rel, name, got, want))
+    # helper sites: every name must match at least one descriptor bound
+    # through the same helper, and every descriptor must serve >=1 name
+    helpers = {}
+    for site in sites:
+        if site["via"] is not None:
+            helpers.setdefault((site["file"], site["via"]),
+                               []).append(site)
+    for (path, via), group in sorted(helpers.items()):
+        rel = os.path.basename(path)
+        names = set().union(*(s["names"] for s in group))
+        descs = [s["desc"] for s in group]
+        for name in sorted(names):
+            if name not in decls:
+                continue  # already reported above
+            if not any(d == decls[name] for d in descs):
+                errors.append(
+                    "%s: %s (via %s) matches none of the helper's "
+                    "descriptors %r; header wants %r"
+                    % (rel, name, via, descs, decls[name]))
+        for d in descs:
+            if not any(name in decls and decls[name] == d
+                       for name in names):
+                errors.append("%s: helper %s binds descriptor %r that "
+                              "matches no routed symbol" % (rel, via, d))
+    # upcall stubs must match some callback typedef
+    for path, desc in extract_upcall_descs(java_files):
+        if desc not in callbacks.values():
+            errors.append("%s: upcall descriptor %r matches no header "
+                          "callback typedef %r"
+                          % (os.path.basename(path), desc,
+                             sorted(callbacks.items())))
+    return errors
