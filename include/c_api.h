@@ -94,7 +94,10 @@ int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
  * key/value strings, outputs appended to out_handles (caller provides
  * capacity >= *num_outputs; actual count written back). When capacity
  * is too small the call fails AND writes the required count into
- * *num_outputs so the caller can retry with a larger buffer. */
+ * *num_outputs so the caller can retry with a larger buffer. The op has
+ * executed by then; its outputs are parked per-thread and an identical
+ * retry returns them WITHOUT re-executing (stateful/random ops advance
+ * state exactly once). Any different call on the thread drops them. */
 int MXFuncInvokeByName(const char *name, NDArrayHandle *inputs,
                        mx_uint num_inputs, mx_uint num_params,
                        const char **keys, const char **vals,

@@ -125,6 +125,52 @@ def test_func_invoke_capacity_protocol(lib):
         lib.MXNDArrayFree(ctypes.c_void_p(big[i]))
 
 
+def test_func_invoke_capacity_retry_single_execution(lib):
+    """The capacity-failure retry returns the FIRST invocation's parked
+    outputs — the op executes exactly once (advisor r4: a re-execution
+    would advance stateful/random ops twice). Proven by mutating the
+    input between the failed call and the retry: the retried outputs
+    still hold pre-mutation values, while a fresh call afterwards (cache
+    consumed) sees the mutation."""
+    shape = (ctypes.c_uint * 2)(2, 4)
+    h = ctypes.c_void_p()
+    check(lib, lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)))
+    d = np.arange(8, dtype=np.float32).reshape(2, 4)
+    check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, d.ctypes.data_as(ctypes.c_void_p), d.size))
+    keys = (ctypes.c_char_p * 2)(b"num_outputs", b"axis")
+    vals = (ctypes.c_char_p * 2)(b"4", b"1")
+    ins = (ctypes.c_void_p * 1)(h)
+    nout = ctypes.c_uint(1)  # deliberately too small
+    small = (ctypes.c_void_p * 1)()
+    rc = lib.MXFuncInvokeByName(b"SliceChannel", ins, 1, 2, keys, vals,
+                                ctypes.byref(nout), small)
+    assert rc != 0 and nout.value == 4
+
+    def first_col(handle):
+        res = np.zeros(2, dtype=np.float32)
+        check(lib, lib.MXNDArraySyncCopyToCPU(
+            ctypes.c_void_p(handle), res.ctypes.data_as(ctypes.c_void_p), 2))
+        return res
+
+    d2 = d + 100.0
+    check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, d2.ctypes.data_as(ctypes.c_void_p), d2.size))
+    big = (ctypes.c_void_p * 4)()
+    check(lib, lib.MXFuncInvokeByName(b"SliceChannel", ins, 1, 2, keys,
+                                      vals, ctypes.byref(nout), big))
+    assert nout.value == 4
+    np.testing.assert_allclose(first_col(big[0]), d[:, 0])  # pre-mutation
+    big2 = (ctypes.c_void_p * 4)()
+    check(lib, lib.MXFuncInvokeByName(b"SliceChannel", ins, 1, 2, keys,
+                                      vals, ctypes.byref(nout), big2))
+    np.testing.assert_allclose(first_col(big2[0]), d2[:, 0])  # re-executed
+    lib.MXNDArrayFree(h)
+    for i in range(4):
+        lib.MXNDArrayFree(ctypes.c_void_p(big[i]))
+        lib.MXNDArrayFree(ctypes.c_void_p(big2[i]))
+
+
 def test_error_reporting(lib):
     h = ctypes.c_void_p()
     nout = ctypes.c_uint(1)
