@@ -343,7 +343,7 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, scale,
             dv.reshape(b, h, tk, dv_dim))
 
 
-def _select_blocks(tq, tk, block_q=None, block_k=128, d=None, dv=None):
+def _select_blocks(tq, tk, block_q=None, block_k=128):
     """Resolve flash block sizes for a (tq, tk) problem.
 
     Returns ``(block_q, block_k, ok)``; ``ok=False`` means no legal tiling
@@ -358,12 +358,15 @@ def _select_blocks(tq, tk, block_q=None, block_k=128, d=None, dv=None):
     - Blocks shrink to a divisor of T so lengths tileable at a smaller
       block stay on the kernel.
     - Mosaic legality (enforced uniformly so CPU interpret mode takes the
-      same path a TPU compile would): sublane dims must be multiples of
-      16, and block_q ALSO rides the lane (last) dimension of the
-      (1, 8, block_q) lse/dcap stats blocks, where Mosaic accepts only a
-      multiple of 128 or the full dimension — so a 16/32/64 divisor-shrink
-      result (e.g. tq=1088 -> 64) must fall back to dense rather than
-      raise a lowering error on hardware (advisor r4).
+      same path a TPU compile would): block_q rides the lane (last)
+      dimension of the (1, 8, block_q) lse/dcap stats blocks AND the
+      backward kernels' ``pl.ds(j * block_q, block_q)`` lane slices,
+      whose start index is a dynamic loop variable — Mosaic must prove
+      it a multiple of 128, which only holds when block_q itself is.
+      Probed on chip (r5): even a FULL-dim off-128 block fails with
+      "cannot statically prove that index in dimension 2 is a multiple
+      of 128", so the rule is strict 128-multiples for both blocks and
+      off-128 lengths (including any T < 128) take the dense path.
     """
     if block_q is None:
         block_q = 1024 if tq >= 8192 else 512
@@ -371,9 +374,10 @@ def _select_blocks(tq, tk, block_q=None, block_k=128, d=None, dv=None):
     block_k = _env_int("MXNET_FLASH_BLOCK_K", block_k)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
-    # sub-128 blocks are never lane-legal unless they span the full dim,
-    # so a smaller request (arg or env probe) rounds up rather than
-    # silently dropping the shape to the dense path
+    # sub-128 blocks are never lane-legal, so a smaller request (arg or
+    # env probe) rounds up rather than silently dropping a tileable
+    # shape to the dense path; T < 128 itself stays dense (min keeps the
+    # block at T, which the legality check below rejects)
     if block_q < 128:
         block_q = min(128, tq)
     if block_k < 128:
@@ -383,9 +387,9 @@ def _select_blocks(tq, tk, block_q=None, block_k=128, d=None, dv=None):
     # multiple (not just halvings) keeps e.g. tq=8320 on block_q=640
     # instead of collapsing to 128. Also re-scan when the requested block
     # is not itself a 128-multiple (e.g. an env probe of 192): a legal
-    # divisor beats both the dense fallback and a full-dim block. The
-    # scan leaves the block unchanged when no 128-multiple divides T —
-    # the full-dim last resort below still applies then.
+    # divisor beats the dense fallback. The scan leaves the block
+    # unchanged when no 128-multiple divides T — the legality check
+    # below then routes the shape to the dense path.
     if tq % block_q or block_q % 128:
         for m in range(block_q // 128, 0, -1):
             if tq % (m * 128) == 0:
@@ -396,25 +400,8 @@ def _select_blocks(tq, tk, block_q=None, block_k=128, d=None, dv=None):
             if tk % (m * 128) == 0:
                 block_k = m * 128
                 break
-    aligned = (
-        block_q % 16 == 0
-        and (block_q % 128 == 0 or block_q == tq)
-        and block_k % 128 == 0
-    )
+    aligned = block_q % 128 == 0 and block_k % 128 == 0
     ok = aligned and tq % block_q == 0 and tk % block_k == 0
-    if not ok and tq % 16 == 0 and tk % 16 == 0:
-        # Last resort for off-128 lengths (1088, 8256, ...): a block that
-        # spans the FULL dimension is always Mosaic-legal (no tiling of
-        # that axis), so whichever side failed to tile can run as a single
-        # block instead of dropping to the dense O(T^2) path — provided
-        # the q block plus the [bq, bk] score/mask intermediates fit the
-        # per-cell VMEM budget alongside the resident K/V (which
-        # flash_attention guards separately).
-        bq2 = block_q if (block_q % 128 == 0 and tq % block_q == 0) else tq
-        bk2 = block_k if (block_k % 128 == 0 and tk % block_k == 0) else tk
-        extra = bq2 * ((d or 0) + (dv or d or 0) + 2 * bk2) * 4
-        if d is None or extra <= 4 * 1024 * 1024:
-            return bq2, bk2, True
     return block_q, block_k, ok
 
 
@@ -450,8 +437,7 @@ def flash_attention(q, k, v, causal=True, scale=None,
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     tq, tk = q.shape[2], k.shape[2]
-    block_q, block_k, tiles = _select_blocks(tq, tk, block_q, block_k,
-                                             d=q.shape[-1], dv=v.shape[-1])
+    block_q, block_k, tiles = _select_blocks(tq, tk, block_q, block_k)
     min_t = _env_int("MXNET_FLASH_MIN_T", 0)
     usable = (
         enabled()

@@ -151,35 +151,23 @@ def test_flash_block_selection_rules():
     assert pk._select_blocks(1280, 1280) == (256, 128, True)
     assert pk._select_blocks(8320, 8320) == (640, 128, True)
     # a sub-128 request rounds up to a legal block instead of going dense
-    assert pk._select_blocks(8192, 8192, block_q=64, d=64, dv=64) == \
-        (128, 128, True)
-    # full-dim q block is legal even when not a 128-multiple
-    bq, _, ok = pk._select_blocks(192, 256)
-    assert (bq, ok) == (192, True)
-    # off-128 lengths with no legal divisor fall back to a full-dim block
-    # (always Mosaic-legal) when the intermediates fit VMEM: the q side
-    # alone (cross-attention, tiled k) ...
-    assert pk._select_blocks(1088, 1024, d=32, dv=32) == (1088, 128, True)
-    # ... or both sides (off-128 self-attention at small T)
-    assert pk._select_blocks(544, 544, d=32, dv=32) == (544, 544, True)
-    # but NOT when the score intermediates blow the budget: then it is a
-    # dense fallback, never a sub-128 block that would raise a Mosaic
-    # lowering error on chip
-    for tq, tk in ((1088, 1088), (8256, 8256)):
-        bq, bk, ok = pk._select_blocks(tq, tk, d=64, dv=64)
-        assert not ok and bq % 128 == 0 and bk % 16 == 0
+    assert pk._select_blocks(8192, 8192, block_q=64) == (128, 128, True)
+    # off-128 lengths have NO legal tiling — probed on real Mosaic (r5):
+    # even a full-dim off-128 block fails, because the backward kernels'
+    # dynamic lane slices need a provable 128-multiple start index. Such
+    # shapes (including any T < 128) must fall back to dense, never emit
+    # a block that raises a lowering error on chip.
+    for tq, tk in ((192, 256), (544, 544), (1088, 1088), (8256, 8256),
+                   (64, 64), (1090, 1090)):
+        bq, bk, ok = pk._select_blocks(tq, tk)
+        assert not ok, (tq, tk)
     # an explicit sub-128 block_q is rounded up to the legal 128 tiling
     # rather than lowered as-is or dropped to dense
-    assert pk._select_blocks(256, 256, block_q=64, d=32, dv=32) == \
-        (128, 128, True)
+    assert pk._select_blocks(256, 256, block_q=64) == (128, 128, True)
     # a non-128-multiple request re-scans for a legal divisor instead of
-    # going dense (192 @ 4992 -> 128) or ballooning to full-dim
-    # (320 @ 1280 -> 256)
-    assert pk._select_blocks(4992, 4992, block_q=192, d=64, dv=64)[0] == 128
-    assert pk._select_blocks(1280, 1280, block_q=320, d=64, dv=64) == \
-        (256, 128, True)
-    # lengths not even sublane-aligned stay dense
-    assert not pk._select_blocks(1090, 1090, d=32, dv=32)[2]
+    # going dense (192 @ 4992 -> 128, 320 @ 1280 -> 256)
+    assert pk._select_blocks(4992, 4992, block_q=192)[0] == 128
+    assert pk._select_blocks(1280, 1280, block_q=320) == (256, 128, True)
 
 
 def test_flash_attention_fallback_odd_shapes():
