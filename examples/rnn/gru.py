@@ -1,0 +1,79 @@
+"""Fixed-length unrolled GRU LM (ref: example/rnn/gru.py).
+
+Trains the model-zoo GRU (mxnet_tpu/models/gru.py) on the synthetic
+Markov corpus from bucket_io and asserts the perplexity actually drops —
+the convergence check stays ACTIVE in smoke mode. Padding rows are
+excluded from the loss (use_ignore), so the first-epoch perplexity IS
+the uniform baseline and any sustained drop is learned bigram
+structure; the smoke threshold (0.95) reflects the measured plateau of
+the rank-24-embedding smoke model on the 200-vocab corpus.
+"""
+import argparse
+import os
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.gru import gru_unroll
+from bucket_io import BucketSentenceIter
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--seq-len', type=int, default=20)
+    p.add_argument('--num-hidden', type=int, default=100)
+    p.add_argument('--num-embed', type=int, default=64)
+    p.add_argument('--num-gru-layer', type=int, default=1)
+    p.add_argument('--num-epochs', type=int, default=10)
+    p.add_argument('--batch-size', type=int, default=32)
+    # r5 stability sweep on the synthetic corpus: with the sum-CE loss
+    # the gradient scale grows with seq_len, and at T=20 every lr >=
+    # 0.05 eventually diverges under momentum; 0.025 is the measured
+    # stable point (smoke runs at T=10 where 0.1 is fine)
+    p.add_argument('--lr', type=float, default=0.025)
+    args = p.parse_args()
+    smoke = bool(os.environ.get("MXNET_EXAMPLE_SMOKE"))
+    if smoke:
+        args.seq_len, args.num_hidden, args.num_embed = 10, 32, 24
+        args.num_epochs = 8  # ~6 batches/epoch in the smoke bucket
+        args.lr = 0.1
+    import numpy as np
+    mx.random.seed(7)
+    np.random.seed(7)  # batch order (iter.reset shuffles via np.random)
+
+    # GRU carries only h state (no cell state)
+    init_states = [('l%d_init_h' % l, (args.batch_size, args.num_hidden))
+                   for l in range(args.num_gru_layer)]
+    data_train = BucketSentenceIter(None, None, [args.seq_len],
+                                    args.batch_size, init_states)
+    sym = gru_unroll(args.num_gru_layer, args.seq_len,
+                     data_train.vocab_size, num_hidden=args.num_hidden,
+                     num_embed=args.num_embed,
+                     num_label=data_train.vocab_size, ignore_label=0)
+
+    ppl = []
+
+    def track(param):
+        for _name, val in param.eval_metric.get_name_value():
+            ppl.append((param.epoch, val))
+
+    model = mx.FeedForward(sym, num_epoch=args.num_epochs,
+                           learning_rate=args.lr, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=data_train,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              batch_end_callback=[mx.callback.Speedometer(args.batch_size, 20),
+                                  track])
+    first = [v for e, v in ppl if e == 0][-1]
+    last = [v for e, v in ppl if e == ppl[-1][0]][-1]
+    print("train perplexity: %.2f -> %.2f" % (first, last))
+    # smoke (CI): strict 0.95 learning gate — with use_ignore the first
+    # epoch is the uniform baseline and the rank-bounded smoke model
+    # measures ~0.91. Full budget runs at the stability-limited lr
+    # (module docstring) where progress per epoch is small: sustained-
+    # improvement gate.
+    thresh = 0.95 if smoke else 0.98
+    assert last < first * thresh, (
+        "GRU LM did not converge (%.2f -> %.2f)" % (first, last))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,144 @@
+"""The fused RNN *op* in its non-LSTM modes (ref: example/rnn/rnn_cell_demo.py).
+
+Where lstm.py/gru.py unroll cells symbol-by-symbol, this demo drives the
+single fused ``RNN`` operator — the reference's cuDNN-backed path, here
+one lax.scan program (mxnet_tpu/ops/sequence.py) — in ``gru`` and
+``rnn_tanh`` modes on a next-token task, plus the explicitly-unrolled
+Elman LM (models/rnn.py) for the vanilla-cell twin of lstm.py. Both the
+fused modes and the unrolled run must LEARN; the asserts stay active in
+smoke mode.
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models.rnn import rnn_unroll
+from mxnet_tpu.ops.sequence import rnn_param_size
+from bucket_io import BucketSentenceIter
+
+
+def fused_rnn_symbol(mode, vocab, num_embed, num_hidden):
+    """data [N, T] int tokens -> per-step logits via the fused RNN op
+    (data enters the op time-major [T, N, I] like the reference's; the
+    graph is length-agnostic — T comes from the bound data shape)."""
+    data = mx.symbol.Variable("data")
+    embed = mx.symbol.Embedding(data=data, input_dim=vocab,
+                                output_dim=num_embed, name="embed")
+    tmajor = mx.symbol.SwapAxis(data=embed, dim1=0, dim2=1)
+    out = mx.symbol.RNN(
+        data=tmajor, parameters=mx.symbol.Variable("rnn_parameters"),
+        state=mx.symbol.Variable("rnn_state"),
+        state_size=num_hidden, num_layers=1, mode=mode, name="rnn")
+    # [T, N, H] -> [T*N, H] rows match the label transpose below
+    flat = mx.symbol.Reshape(data=out, shape=(-1, num_hidden))
+    pred = mx.symbol.FullyConnected(data=flat, num_hidden=vocab,
+                                    name="pred")
+    label = mx.symbol.Variable("softmax_label")
+    label = mx.symbol.transpose(data=label)
+    label = mx.symbol.Reshape(data=label, shape=(-1,))
+    # padding rows carry label 0; without use_ignore the ~40% padding
+    # positions dominate the sum-CE gradient and a small ungated cell
+    # collapses onto the padding class (metric perplexity then RISES
+    # while raw loss falls) — ignore them in the loss like the metric
+    return mx.symbol.SoftmaxOutput(data=pred, label=label, name="softmax",
+                                   use_ignore=True, ignore_label=0)
+
+
+def train_fused(mode, args, data_train, lr):
+    vocab = data_train.vocab_size
+    sym = fused_rnn_symbol(mode, vocab, args.num_embed, args.num_hidden)
+    ppl = []
+
+    def track(param):
+        for _name, val in param.eval_metric.get_name_value():
+            ppl.append((param.epoch, val))
+
+    # the op's flat parameter vector is 1-D (cuDNN-style packed layout),
+    # which shape-based initializers cannot scale — seed it explicitly,
+    # like the reference's FusedRNN init story
+    psize = rnn_param_size(mode, args.num_embed, args.num_hidden, 1, False)
+    rng = np.random.RandomState(7)
+    arg_params = {"rnn_parameters": mx.nd.array(
+        rng.uniform(-0.08, 0.08, (psize,)).astype(np.float32))}
+    model = mx.FeedForward(sym, num_epoch=args.num_epochs,
+                           learning_rate=lr, momentum=0.9,
+                           initializer=mx.initializer.Xavier(),
+                           arg_params=arg_params)
+    model.fit(X=data_train,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              batch_end_callback=track)
+    first = [v for e, v in ppl if e == 0][-1]
+    last = [v for e, v in ppl if e == ppl[-1][0]][-1]
+    print("RNN op mode=%s perplexity: %.2f -> %.2f" % (mode, first, last))
+    # with use_ignore the first-epoch value IS the uniform baseline
+    # (~vocab_size), so any sustained drop is learned structure; the
+    # smoke-budget plateau on this tiny corpus measures ~0.91. Full
+    # budget runs at the stability-limited lr (see main), so its gate is
+    # sustained improvement.
+    thresh = 0.95 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.98
+    assert last < first * thresh, (
+        "fused %s did not converge (%.2f -> %.2f)" % (mode, first, last))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--seq-len', type=int, default=20)
+    p.add_argument('--num-hidden', type=int, default=64)
+    p.add_argument('--num-embed', type=int, default=32)
+    p.add_argument('--num-epochs', type=int, default=10)
+    p.add_argument('--batch-size', type=int, default=32)
+    args = p.parse_args()
+    if os.environ.get("MXNET_EXAMPLE_SMOKE"):
+        args.seq_len, args.num_hidden, args.num_embed = 10, 32, 24
+        args.num_epochs = 8  # the smoke bucket keeps only ~6 batches/epoch
+    mx.random.seed(42)  # decouple init from whatever ran in this process
+    np.random.seed(42)  # batch order (iter.reset shuffles via np.random)
+
+    # the fused op takes its initial state as a provided input. lr notes
+    # (r5 stability sweep): the sum-CE gradient scale grows with
+    # seq_len, so the full-budget T=20 runs need the measured-stable
+    # steps (gru 0.03, ungated tanh 0.01) where the T=10 smoke runs
+    # take 0.1 for both.
+    smoke = bool(os.environ.get("MXNET_EXAMPLE_SMOKE"))
+    init_states = [("rnn_state", (1, args.batch_size, args.num_hidden))]
+    data_train = BucketSentenceIter(None, None, [args.seq_len],
+                                    args.batch_size, init_states)
+    for mode, full_lr in (("gru", 0.03), ("rnn_tanh", 0.01)):
+        train_fused(mode, args, data_train, lr=0.1 if smoke else full_lr)
+
+    # vanilla-cell twin of lstm.py: explicit unroll from the model zoo
+    init_states = [('l0_init_h', (args.batch_size, args.num_hidden))]
+    data_train = BucketSentenceIter(None, None, [args.seq_len],
+                                    args.batch_size, init_states)
+    sym = rnn_unroll(1, args.seq_len, data_train.vocab_size,
+                     num_hidden=args.num_hidden, num_embed=args.num_embed,
+                     num_label=data_train.vocab_size, ignore_label=0)
+    ppl = []
+
+    def track(param):
+        for _name, val in param.eval_metric.get_name_value():
+            ppl.append((param.epoch, val))
+
+    # the ungated tanh recurrence needs a gentler step than the gated
+    # cells (no forget gate damping the h2h Jacobian; measured: 0.1
+    # oscillates, 0.02 converges at T=10; 0.005 is the stable point for
+    # the unrolled form at T=20)
+    elman_lr = 0.02 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.005
+    model = mx.FeedForward(sym, num_epoch=args.num_epochs,
+                           learning_rate=elman_lr, momentum=0.9,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=data_train,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              batch_end_callback=track)
+    first = [v for e, v in ppl if e == 0][-1]
+    last = [v for e, v in ppl if e == ppl[-1][0]][-1]
+    print("unrolled Elman perplexity: %.2f -> %.2f" % (first, last))
+    thresh = 0.95 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.98
+    assert last < first * thresh, (
+        "unrolled Elman RNN did not converge (%.2f -> %.2f)" % (first, last))
+
+
+if __name__ == '__main__':
+    main()

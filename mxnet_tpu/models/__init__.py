@@ -13,6 +13,8 @@ from .classic_convnets import (
 )
 from .unet import get_unet
 from .lstm import lstm_unroll
+from .gru import gru_unroll
+from .rnn import rnn_unroll
 from . import transformer
 
 __all__ = [
@@ -20,5 +22,5 @@ __all__ = [
     "get_inception_bn_small",
     "get_alexnet", "get_vgg", "get_googlenet", "get_inception_v3",
     "get_unet",
-    "lstm_unroll", "transformer",
+    "lstm_unroll", "gru_unroll", "rnn_unroll", "transformer",
 ]

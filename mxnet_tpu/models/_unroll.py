@@ -1,0 +1,61 @@
+"""Shared explicit-unroll LM scaffold for the recurrent model zoo.
+
+lstm/gru/rnn unrolls differ only in their per-layer parameter bundles
+and cell step; the embedding -> SliceChannel -> timestep loop ->
+Concat -> decoder -> SoftmaxOutput scaffold lives here once
+(lstm_unroll keeps its own copy because it additionally tags layers
+with AttrScope(ctx_group=...) for the model-parallel variant).
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+
+def unroll_lm(num_layers, seq_len, input_size, num_hidden, num_embed,
+              num_label, make_params, make_state, cell, dropout=0.0,
+              ignore_label=None):
+    """Build an unrolled LM symbol.
+
+    make_params(layer_idx) -> per-layer parameter bundle;
+    make_state(layer_idx) -> initial state (Variables named l%d_init_*);
+    cell(num_hidden, indata, prev_state, param, seqidx, layeridx,
+    dropout) -> next state with ``.h``.
+    """
+    embed_weight = sym.Variable("embed_weight")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    param_cells = [make_params(i) for i in range(num_layers)]
+    last_states = [make_state(i) for i in range(num_layers)]
+
+    data = sym.Variable("data")
+    embed = sym.Embedding(data=data, input_dim=input_size,
+                          weight=embed_weight, output_dim=num_embed,
+                          name="embed")
+    wordvec = sym.SliceChannel(data=embed, num_outputs=seq_len, axis=1,
+                               squeeze_axis=True, name="wordvec")
+
+    hidden_all = []
+    for seqidx in range(seq_len):
+        hidden = wordvec[seqidx]
+        for i in range(num_layers):
+            next_state = cell(
+                num_hidden, indata=hidden, prev_state=last_states[i],
+                param=param_cells[i], seqidx=seqidx, layeridx=i,
+                dropout=dropout if i > 0 else 0.0,
+            )
+            hidden = next_state.h
+            last_states[i] = next_state
+        hidden_all.append(hidden)
+
+    hidden_concat = sym.Concat(*hidden_all, dim=0, num_args=len(hidden_all))
+    if dropout > 0.0:
+        hidden_concat = sym.Dropout(data=hidden_concat, p=dropout)
+    pred = sym.FullyConnected(data=hidden_concat, num_hidden=num_label,
+                              weight=cls_weight, bias=cls_bias, name="pred")
+    label = sym.Variable("softmax_label")
+    label = sym.transpose(data=label)
+    label = sym.Reshape(data=label, target_shape=(0,), shape=(-1,))
+    if ignore_label is not None:
+        return sym.SoftmaxOutput(data=pred, label=label, name="softmax",
+                                 use_ignore=True, ignore_label=ignore_label)
+    return sym.SoftmaxOutput(data=pred, label=label, name="softmax")

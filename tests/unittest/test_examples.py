@@ -40,6 +40,12 @@ CASES = [
     # ark/scp IO, spliced-frame DNN, bucketed projected-peephole LSTM,
     # posterior decode round trip; convergence asserts active
     ("speech-demo/train_speech.py", []),
+    # GRU + vanilla-RNN examples (VERDICT r4 item 7): explicit-unroll GRU
+    # LM, its bucketed variant, and the fused RNN op's non-LSTM modes —
+    # every perplexity-drop assert stays ACTIVE in smoke mode
+    ("rnn/gru.py", []),
+    ("rnn/gru_bucketing.py", []),
+    ("rnn/rnn_cell_demo.py", []),
     ("memcost/lstm_memcost.py", ["--seq-len", "16"]),
     ("numpy-ops/numpy_softmax.py", []),
     ("adversary/fgsm_mnist.py", ["--epochs", "1"]),
