@@ -49,9 +49,28 @@ void JpegErrExit(j_common_ptr cinfo) {
   longjmp(reinterpret_cast<JpegErr *>(cinfo->err)->jmp, 1);
 }
 
+// Crop window in DECODED-image coordinates (float: scaled decode maps
+// full-resolution crops onto the reduced grid).
+struct CropSpec {
+  float x0, y0, cw, ch;
+};
+
 // Decode a JPEG into an RGB8 buffer; returns false on corrupt input.
-bool DecodeJpeg(const unsigned char *buf, size_t size,
-                std::vector<unsigned char> *rgb, int *iw, int *ih) {
+//
+// Scaled DCT decode (round 5): the crop window is drawn in FULL-source
+// coordinates from the header dims (reference geometry, independent of
+// decode scale), then the smallest libjpeg M/8 scale that keeps the
+// cropped region at or above the target size is selected before
+// jpeg_start_decompress — IDCT cost drops ~quadratically with M and the
+// whole row pipeline shrinks proportionally, and because the scale never
+// reduces the crop below the output size no upsampling is introduced
+// (detail under the crop is preserved). The crop is then mapped onto
+// the decoded grid with the exact per-axis ratios.
+bool DecodeJpeg(const unsigned char *buf, size_t size, int ow, int oh,
+                unsigned flags, const float *r8, float max_aspect,
+                float min_rscale, float max_rscale,
+                std::vector<unsigned char> *rgb, int *iw, int *ih,
+                CropSpec *crop) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -67,15 +86,47 @@ bool DecodeJpeg(const unsigned char *buf, size_t size,
     jpeg_destroy_decompress(&cinfo);
     return false;
   }
+  const int fw = static_cast<int>(cinfo.image_width);
+  const int fh = static_cast<int>(cinfo.image_height);
+  if (fw <= 0 || fh <= 0) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  // crop window in full-res coords (ref DefaultImageAugmenter: scale in
+  // [min,max], aspect jitter on the width; clamped to the source).
+  // Every decision consumes its own uniform — correlated randomness
+  // biases training.
+  int cw = fw, ch = fh, x0 = 0, y0 = 0;
+  if (flags & kRandCrop) {
+    float s = min_rscale + (max_rscale - min_rscale) * r8[0];
+    float ar = 1.0f + max_aspect * (2.f * r8[1] - 1.f);
+    cw = std::min(fw, std::max(1, static_cast<int>(ow * s * ar + 0.5f)));
+    ch = std::min(fh, std::max(1, static_cast<int>(oh * s + 0.5f)));
+    x0 = static_cast<int>(r8[2] * (fw - cw + 1));
+    y0 = static_cast<int>(r8[3] * (fh - ch + 1));
+  }
+  int M = 8;
+  while (M > 1 && static_cast<float>(cw) * (M - 1) / 8.f >= ow &&
+         static_cast<float>(ch) * (M - 1) / 8.f >= oh)
+    --M;
+  cinfo.scale_num = static_cast<unsigned>(M);
+  cinfo.scale_denom = 8;
   cinfo.out_color_space = JCS_RGB;
   // training-pipeline decode: fast integer DCT + plain upsampling, the
   // accuracy/speed point image pipelines use (augmentation noise dwarfs
-  // the DCT approximation error)
+  // the DCT approximation error); at M<8 libjpeg picks its scaled
+  // (islow-family) IDCTs, which do less work than the full ifast 8x8
   cinfo.dct_method = JDCT_IFAST;
   cinfo.do_fancy_upsampling = FALSE;
   jpeg_start_decompress(&cinfo);
   *iw = static_cast<int>(cinfo.output_width);
   *ih = static_cast<int>(cinfo.output_height);
+  const float rx = static_cast<float>(*iw) / fw;
+  const float ry = static_cast<float>(*ih) / fh;
+  crop->x0 = x0 * rx;
+  crop->y0 = y0 * ry;
+  crop->cw = cw * rx;
+  crop->ch = ch * ry;
   rgb->resize(static_cast<size_t>(*iw) * (*ih) * 3);
   while (cinfo.output_scanline < cinfo.output_height) {
     unsigned char *row = rgb->data() +
@@ -172,24 +223,18 @@ struct BatchArgs {
 
 bool ProcessOne(const BatchArgs &a, int i, std::vector<unsigned char> *rgb) {
   int iw = 0, ih = 0;
-  if (!DecodeJpeg(a.bufs[i], a.sizes[i], rgb, &iw, &ih)) return false;
   const float *r8 = a.rands + static_cast<size_t>(i) * 8;
   const int oh = a.oh, ow = a.ow;
-
-  // crop window (ref DefaultImageAugmenter: scale in [min,max], aspect
-  // jitter on the width; clamped to the source image). Every decision
-  // consumes its own uniform — correlated randomness biases training.
-  int cw = iw, ch = ih, x0 = 0, y0 = 0;
-  if (a.flags & kRandCrop) {
-    float s = a.min_rscale + (a.max_rscale - a.min_rscale) * r8[0];
-    float ar = 1.0f + a.max_aspect * (2.f * r8[1] - 1.f);
-    cw = std::min(iw, std::max(1, static_cast<int>(ow * s * ar + 0.5f)));
-    ch = std::min(ih, std::max(1, static_cast<int>(oh * s + 0.5f)));
-    x0 = static_cast<int>(r8[2] * (iw - cw + 1));
-    y0 = static_cast<int>(r8[3] * (ih - ch + 1));
-  }
-  const float sx = static_cast<float>(cw) / ow;
-  const float sy = static_cast<float>(ch) / oh;
+  // the crop window is drawn inside DecodeJpeg (full-res coords, before
+  // the scaled-decode factor is chosen) and arrives mapped onto the
+  // decoded grid
+  CropSpec crop{0, 0, 0, 0};
+  if (!DecodeJpeg(a.bufs[i], a.sizes[i], ow, oh, a.flags, r8, a.max_aspect,
+                  a.min_rscale, a.max_rscale, rgb, &iw, &ih, &crop))
+    return false;
+  const float x0 = crop.x0, y0 = crop.y0;
+  const float sx = crop.cw / ow;
+  const float sy = crop.ch / oh;
 
   const bool hsl = (a.flags & kHSL) &&
                    (a.rand_h > 0 || a.rand_s > 0 || a.rand_l > 0);
