@@ -60,7 +60,8 @@ def main():
     # ctx_group-tagged symbol (ref model-parallel-lstm/lstm.py:48-99)
     sym = lstm_unroll(args.num_lstm_layer, args.seq_len, data_train.vocab_size,
                       num_hidden=args.num_hidden, num_embed=args.num_embed,
-                      num_label=data_train.vocab_size, group2ctx_layers=True)
+                      num_label=data_train.vocab_size, group2ctx_layers=True,
+                      ignore_label=0)
     group2ctx = lstm_group2ctx(args.num_lstm_layer, devs)
 
     # bind with group placement (ref lstm.py setup_rnn_model → simple_bind

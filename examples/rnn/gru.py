@@ -5,8 +5,8 @@ Markov corpus from bucket_io and asserts the perplexity actually drops —
 the convergence check stays ACTIVE in smoke mode. Padding rows are
 excluded from the loss (use_ignore), so the first-epoch perplexity IS
 the uniform baseline and any sustained drop is learned bigram
-structure; the smoke threshold (0.95) reflects the measured plateau of
-the rank-24-embedding smoke model on the 200-vocab corpus.
+structure (measured: smoke ~0.84x, full budget ~0.67x of baseline —
+with the r5 N-major metric alignment, models/_unroll.py).
 """
 import argparse
 import os
@@ -65,12 +65,10 @@ def main():
     first = [v for e, v in ppl if e == 0][-1]
     last = [v for e, v in ppl if e == ppl[-1][0]][-1]
     print("train perplexity: %.2f -> %.2f" % (first, last))
-    # smoke (CI): strict 0.95 learning gate — with use_ignore the first
-    # epoch is the uniform baseline and the rank-bounded smoke model
-    # measures ~0.91. Full budget runs at the stability-limited lr
-    # (module docstring) where progress per epoch is small: sustained-
-    # improvement gate.
-    thresh = 0.95 if smoke else 0.98
+    # strict learning gates (measured with margin: smoke ~0.84, full
+    # ~0.67); full budget runs at the stability-limited lr, hence the
+    # slightly looser bar over its longer horizon
+    thresh = 0.9 if smoke else 0.95
     assert last < first * thresh, (
         "GRU LM did not converge (%.2f -> %.2f)" % (first, last))
 

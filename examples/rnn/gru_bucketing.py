@@ -5,13 +5,10 @@ lstm_bucketing.py. Uses PTB text when present, else the synthetic
 Markov corpus. Padding rows are excluded from the loss (use_ignore):
 at the longer buckets they otherwise dominate the sum-CE gradient.
 
-Smoke budget note (r5, measured): at the smoke-scale model the
-embedding rank (24) bounds how much of the 200-vocab Markov bigram
-table is learnable, so the running perplexity approaches its floor
-slowly; the smoke gate therefore asserts sustained IMPROVEMENT (no
-divergence), while the full-budget default keeps the strict
-convergence assert. gru.py and rnn_cell_demo.py keep strict asserts in
-smoke mode.
+Smoke budget note (r5, measured): three smoke epochs over two small
+buckets buy a modest drop (~0.94x of the uniform baseline), so the
+smoke gate is a sustained-improvement bar; the full-budget run clears
+a stricter one and the PTB path keeps the vignette's 0.9.
 """
 import argparse
 import os
@@ -96,7 +93,7 @@ def main():
     last = [v for e, v in ppl if e == ppl[-1][0]][-1]
     print("train perplexity: %.2f -> %.2f" % (first, last))
     if smoke:
-        assert last < first * 0.98, (
+        assert last < first * 0.96, (
             "bucketed GRU LM failed to improve (%.2f -> %.2f)"
             % (first, last))
     else:

@@ -32,7 +32,7 @@ if __name__ == '__main__':
                                     init_states)
     sym = lstm_unroll(args.num_lstm_layer, args.seq_len, data_train.vocab_size,
                       num_hidden=args.num_hidden, num_embed=args.num_embed,
-                      num_label=data_train.vocab_size)
+                      num_label=data_train.vocab_size, ignore_label=0)
     import logging
     logging.basicConfig(level=logging.DEBUG)
     model = mx.FeedForward(sym, num_epoch=args.num_epochs, learning_rate=0.1,

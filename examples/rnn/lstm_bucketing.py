@@ -53,7 +53,7 @@ if __name__ == '__main__':
         # (ref lstm_bucketing.py:53-56)
         return lstm_unroll(args.num_lstm_layer, seq_len, vocab_size,
                            num_hidden=args.num_hidden, num_embed=args.num_embed,
-                           num_label=vocab_size)
+                           num_label=vocab_size, ignore_label=0)
 
     model = mx.FeedForward(
         ctx=mx.context.current_context(),

@@ -31,12 +31,14 @@ def fused_rnn_symbol(mode, vocab, num_embed, num_hidden):
         data=tmajor, parameters=mx.symbol.Variable("rnn_parameters"),
         state=mx.symbol.Variable("rnn_state"),
         state_size=num_hidden, num_layers=1, mode=mode, name="rnn")
-    # [T, N, H] -> [T*N, H] rows match the label transpose below
-    flat = mx.symbol.Reshape(data=out, shape=(-1, num_hidden))
+    # back to batch-major [N, T, H] -> [N*T, H]: pred row (n, t) then
+    # pairs with label[n, t] under the metric's plain reshape(-1)
+    # (see models/_unroll.py for the r5 alignment finding)
+    nmajor = mx.symbol.SwapAxis(data=out, dim1=0, dim2=1)
+    flat = mx.symbol.Reshape(data=nmajor, shape=(-1, num_hidden))
     pred = mx.symbol.FullyConnected(data=flat, num_hidden=vocab,
                                     name="pred")
     label = mx.symbol.Variable("softmax_label")
-    label = mx.symbol.transpose(data=label)
     label = mx.symbol.Reshape(data=label, shape=(-1,))
     # padding rows carry label 0; without use_ignore the ~40% padding
     # positions dominate the sum-CE gradient and a small ungated cell
@@ -73,11 +75,10 @@ def train_fused(mode, args, data_train, lr):
     last = [v for e, v in ppl if e == ppl[-1][0]][-1]
     print("RNN op mode=%s perplexity: %.2f -> %.2f" % (mode, first, last))
     # with use_ignore the first-epoch value IS the uniform baseline
-    # (~vocab_size), so any sustained drop is learned structure; the
-    # smoke-budget plateau on this tiny corpus measures ~0.91. Full
-    # budget runs at the stability-limited lr (see main), so its gate is
-    # sustained improvement.
-    thresh = 0.95 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.98
+    # (~vocab_size), so any sustained drop is learned structure
+    # (measured with margin: smoke ~0.85, full ~0.94 at the
+    # stability-limited lr)
+    thresh = 0.9 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.96
     assert last < first * thresh, (
         "fused %s did not converge (%.2f -> %.2f)" % (mode, first, last))
 
@@ -135,7 +136,7 @@ def main():
     first = [v for e, v in ppl if e == 0][-1]
     last = [v for e, v in ppl if e == ppl[-1][0]][-1]
     print("unrolled Elman perplexity: %.2f -> %.2f" % (first, last))
-    thresh = 0.95 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.98
+    thresh = 0.9 if os.environ.get("MXNET_EXAMPLE_SMOKE") else 0.95
     assert last < first * thresh, (
         "unrolled Elman RNN did not converge (%.2f -> %.2f)" % (first, last))
 

@@ -1,0 +1,85 @@
+"""Single-step inference models for the unrolled RNN family
+(ref: example/rnn/rnn_model.py LSTMInferenceModel).
+
+Builds a one-timestep symbol sharing the training weight names, binds a
+batch-1 executor, and carries the recurrent state across ``forward``
+calls — the sampling engine char_rnn.py uses. ``new_seq=True`` resets
+the state to zeros.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as S
+from mxnet_tpu.models.lstm import LSTMState, LSTMParam, lstm_cell
+
+
+def lstm_inference_symbol(num_lstm_layer, input_size, num_hidden,
+                          num_embed, num_label, dropout=0.0):
+    """One LSTM step: data (batch,) token -> (softmax, c..., h...)."""
+    param_cells = []
+    last_states = []
+    for i in range(num_lstm_layer):
+        param_cells.append(LSTMParam(
+            i2h_weight=S.Variable("l%d_i2h_weight" % i),
+            i2h_bias=S.Variable("l%d_i2h_bias" % i),
+            h2h_weight=S.Variable("l%d_h2h_weight" % i),
+            h2h_bias=S.Variable("l%d_h2h_bias" % i),
+        ))
+        last_states.append(LSTMState(
+            c=S.Variable("l%d_init_c" % i),
+            h=S.Variable("l%d_init_h" % i),
+        ))
+    data = S.Variable("data")
+    hidden = S.Embedding(data=data, input_dim=input_size,
+                         weight=S.Variable("embed_weight"),
+                         output_dim=num_embed, name="embed")
+    for i in range(num_lstm_layer):
+        state = lstm_cell(num_hidden, indata=hidden,
+                          prev_state=last_states[i], param=param_cells[i],
+                          seqidx=0, layeridx=i, dropout=dropout)
+        hidden = state.h
+        last_states[i] = state
+    fc = S.FullyConnected(data=hidden, num_hidden=num_label,
+                          weight=S.Variable("cls_weight"),
+                          bias=S.Variable("cls_bias"), name="pred")
+    outs = [S.SoftmaxOutput(data=fc, name="softmax")]
+    for state in last_states:
+        outs.append(S.BlockGrad(state.c))
+        outs.append(S.BlockGrad(state.h))
+    return S.Group(outs)
+
+
+class LSTMInferenceModel:
+    """Stateful batch-1 sampler over a trained unrolled LSTM's weights
+    (ref: example/rnn/rnn_model.py:13)."""
+
+    def __init__(self, num_lstm_layer, input_size, num_hidden, num_embed,
+                 num_label, arg_params, ctx=None, dropout=0.0):
+        self.num_lstm_layer = num_lstm_layer
+        sym = lstm_inference_symbol(num_lstm_layer, input_size, num_hidden,
+                                    num_embed, num_label, dropout)
+        ctx = ctx or mx.context.current_context()
+        shapes = {"data": (1,)}
+        for i in range(num_lstm_layer):
+            shapes["l%d_init_c" % i] = (1, num_hidden)
+            shapes["l%d_init_h" % i] = (1, num_hidden)
+        self.executor = sym.simple_bind(ctx, grad_req="null", **shapes)
+        for key, arr in arg_params.items():
+            if key in self.executor.arg_dict:
+                arr.copyto(self.executor.arg_dict[key])
+
+    def forward(self, input_token, new_seq=False):
+        """input_token: (1,) array-like; returns softmax probs (1, V)."""
+        if new_seq:
+            for i in range(self.num_lstm_layer):
+                self.executor.arg_dict["l%d_init_c" % i][:] = 0.0
+                self.executor.arg_dict["l%d_init_h" % i][:] = 0.0
+        self.executor.arg_dict["data"][:] = np.asarray(
+            input_token, dtype=np.float32)
+        outs = self.executor.forward(is_train=False)
+        prob = outs[0].asnumpy()
+        # carry state into the next step
+        for i in range(self.num_lstm_layer):
+            outs[1 + 2 * i].copyto(self.executor.arg_dict["l%d_init_c" % i])
+            outs[2 + 2 * i].copyto(self.executor.arg_dict["l%d_init_h" % i])
+        return prob

@@ -47,14 +47,24 @@ def unroll_lm(num_layers, seq_len, input_size, num_hidden, num_embed,
             last_states[i] = next_state
         hidden_all.append(hidden)
 
-    hidden_concat = sym.Concat(*hidden_all, dim=0, num_args=len(hidden_all))
+    # N-major prediction rows: [N, 1, H] per step -> [N, T, H] ->
+    # [N*T, H], pairing row (n, t) with label[n, t].reshape(-1) — the
+    # SAME flattening EvalMetric applies to the batch label, so the
+    # in-graph loss and the reported metric read identical pairings.
+    # (The t-major Concat(dim=0) + label-transpose form the reference's
+    # lstm.py uses trains the same loss but scrambles every metric
+    # reading against [T*N]-ordered predictions — r5 finding: measured
+    # train perplexity could not beat the unigram floor on a corpus
+    # whose true bigram perplexity was 4.3.)
+    steps = [sym.Reshape(data=h, shape=(0, 1, -1)) for h in hidden_all]
+    hidden_concat = sym.Concat(*steps, dim=1, num_args=len(steps))
+    hidden_concat = sym.Reshape(data=hidden_concat, shape=(-1, num_hidden))
     if dropout > 0.0:
         hidden_concat = sym.Dropout(data=hidden_concat, p=dropout)
     pred = sym.FullyConnected(data=hidden_concat, num_hidden=num_label,
                               weight=cls_weight, bias=cls_bias, name="pred")
     label = sym.Variable("softmax_label")
-    label = sym.transpose(data=label)
-    label = sym.Reshape(data=label, target_shape=(0,), shape=(-1,))
+    label = sym.Reshape(data=label, shape=(-1,))
     if ignore_label is not None:
         return sym.SoftmaxOutput(data=pred, label=label, name="softmax",
                                  use_ignore=True, ignore_label=ignore_label)

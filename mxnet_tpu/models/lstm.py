@@ -46,10 +46,16 @@ def lstm_cell(num_hidden, indata, prev_state, param, seqidx, layeridx, dropout=0
 
 
 def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
-                num_label, dropout=0.0, group2ctx_layers=False):
+                num_label, dropout=0.0, group2ctx_layers=False,
+                ignore_label=None):
     """Unrolled LSTM LM symbol (ref: example/rnn/lstm.py lstm_unroll:44).
     With group2ctx_layers=True, tags embed/layers/decode with ctx_group
-    attrs like example/model-parallel-lstm/lstm.py:48-99."""
+    attrs like example/model-parallel-lstm/lstm.py:48-99.
+    ignore_label: exclude padding rows from the loss — on padded
+    sequence data the un-ignored label-0 positions otherwise teach the
+    model to smear probability onto the padding class, monotonically
+    worsening real-token perplexity while the optimized loss still
+    falls (r5 finding, examples/rnn)."""
 
     def scoped(group):
         if group2ctx_layers:
@@ -100,7 +106,13 @@ def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
         hidden_all.append(hidden)
 
     with scoped("decode"):
-        hidden_concat = sym.Concat(*hidden_all, dim=0, num_args=len(hidden_all))
+        # N-major rows so pred row (n, t) pairs with label[n, t] under
+        # the metric's plain reshape(-1) — see models/_unroll.py for the
+        # r5 finding behind this layout
+        steps = [sym.Reshape(data=h, shape=(0, 1, -1)) for h in hidden_all]
+        hidden_concat = sym.Concat(*steps, dim=1, num_args=len(steps))
+        hidden_concat = sym.Reshape(data=hidden_concat,
+                                    shape=(-1, num_hidden))
         if dropout > 0.0:
             hidden_concat = sym.Dropout(data=hidden_concat, p=dropout)
         pred = sym.FullyConnected(
@@ -108,9 +120,13 @@ def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
             bias=cls_bias, name="pred",
         )
         label = sym.Variable("softmax_label")
-        label = sym.transpose(data=label)
-        label = sym.Reshape(data=label, target_shape=(0,), shape=(-1,))
-        loss = sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        label = sym.Reshape(data=label, shape=(-1,))
+        if ignore_label is not None:
+            loss = sym.SoftmaxOutput(data=pred, label=label, name="softmax",
+                                     use_ignore=True,
+                                     ignore_label=ignore_label)
+        else:
+            loss = sym.SoftmaxOutput(data=pred, label=label, name="softmax")
     return loss
 
 

@@ -104,7 +104,7 @@ def test_baseline_lstm_lm():
                                 label=batch.label, pad=0, index=None)
             mod.forward(b, is_train=True)
             prob = mod.get_outputs()[0].asnumpy()  # (B*T, vocab)
-            lab = batch.label[0].asnumpy().T.reshape(-1).astype(int)
+            lab = batch.label[0].asnumpy().reshape(-1).astype(int)  # N-major rows (r5 layout)
             tot += -np.log(np.maximum(
                 prob[np.arange(len(lab)), lab], 1e-9)).sum()
             cnt += len(lab)
@@ -149,7 +149,7 @@ def test_baseline_model_parallel_lstm():
         exe.arg_dict["softmax_label"][:] = Y[lo:lo + 16]
         exe.forward(is_train=True)
         prob = exe.outputs[0].asnumpy()
-        lab = Y[lo:lo + 16].T.reshape(-1).astype(int)
+        lab = Y[lo:lo + 16].reshape(-1).astype(int)  # N-major rows (r5 layout)
         ce = -np.log(np.maximum(prob[np.arange(len(lab)), lab], 1e-9)).mean()
         if first is None:
             first = ce

@@ -46,6 +46,13 @@ CASES = [
     ("rnn/gru.py", []),
     ("rnn/gru_bucketing.py", []),
     ("rnn/rnn_cell_demo.py", []),
+    # char-rnn notebook as a script: char LSTM + stateful batch-1
+    # sampling through rnn_model.LSTMInferenceModel; perplexity AND
+    # legal-bigram sampling asserts active
+    ("rnn/char_rnn.py", []),
+    # cardiac MRI volume CDF regression (ref kaggle-ndsb2): frame-diff
+    # LeNet, 600-bin LogisticRegressionOutput, CRPS halving assert active
+    ("kaggle-ndsb2/train_ndsb2.py", []),
     ("memcost/lstm_memcost.py", ["--seq-len", "16"]),
     ("numpy-ops/numpy_softmax.py", []),
     ("adversary/fgsm_mnist.py", ["--epochs", "1"]),

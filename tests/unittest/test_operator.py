@@ -505,6 +505,11 @@ def test_spatial_transformer_identity_and_shift():
 
 
 def test_spatial_transformer_backward_fd():
+    # pin the GLOBAL RNG: check_numeric_gradient draws its projection
+    # from it, and the bilinear kinks make unlucky projections fail the
+    # loose theta bound — earlier tests (examples seed np.random now)
+    # otherwise shift the draw with suite ordering
+    np.random.seed(1234)
     x = np.random.rand(1, 1, 5, 5).astype("f")
     theta = np.array([[0.9, 0.05, 0.1, -0.05, 1.1, -0.1]], dtype="f")
     s = sym.SpatialTransformer(sym.Variable("d"), sym.Variable("t"),
