@@ -343,7 +343,7 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, scale,
             dv.reshape(b, h, tk, dv_dim))
 
 
-def _select_blocks(tq, tk, block_q=None, block_k=128):
+def _select_blocks(tq, tk, block_q=None, block_k=None):
     """Resolve flash block sizes for a (tq, tk) problem.
 
     Returns ``(block_q, block_k, ok)``; ``ok=False`` means no legal tiling
@@ -353,6 +353,11 @@ def _select_blocks(tq, tk, block_q=None, block_k=128):
       512 below (measured in docs/perf_analysis.md — K/V HBM traffic per
       q row scales with 1/block_q, so long context wants larger q blocks;
       1024 buys ~+5 MFU points at T=8192 with no effect at 1k-4k).
+    - ``block_k=None`` defaults to 512 (capped there): wider K tiles
+      halve/quarter the inner-loop iterations and widen the MXU dots —
+      128 -> 512 measured +19% tokens/s at T=1024 and +54% at T=8192
+      (docs/perf_analysis.md r5). 1024 FAILS to compile (VMEM), so the
+      cap is hard and env probes clamp to it.
     - Env knobs MXNET_FLASH_BLOCK_Q/K override for A/B probes; malformed
       values fall back silently.
     - Blocks shrink to a divisor of T so lengths tileable at a smaller
@@ -370,10 +375,12 @@ def _select_blocks(tq, tk, block_q=None, block_k=128):
     """
     if block_q is None:
         block_q = 1024 if tq >= 8192 else 512
+    if block_k is None:
+        block_k = 512
     block_q = _env_int("MXNET_FLASH_BLOCK_Q", block_q)
     block_k = _env_int("MXNET_FLASH_BLOCK_K", block_k)
     block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+    block_k = min(block_k, tk, 512)
     # sub-128 blocks are never lane-legal, so a smaller request (arg or
     # env probe) rounds up rather than silently dropping a tileable
     # shape to the dense path; T < 128 itself stays dense (min keeps the
@@ -406,7 +413,7 @@ def _select_blocks(tq, tk, block_q=None, block_k=128):
 
 
 def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=None, block_k=128):
+                    block_q=None, block_k=None):
     """Blockwise-softmax attention. q,k,v: [batch, heads, time, d_head].
 
     Forward AND backward run as Pallas kernels: the forward saves the
@@ -427,10 +434,16 @@ def flash_attention(q, k, v, causal=True, scale=None,
     Block sizing (measured, docs/perf_analysis.md rounds 4-5): every
     q-block grid cell DMAs the FULL K/V into VMEM, so K/V HBM traffic
     scales with tq/block_q — block_q 128 -> 512 took T=8192 training
-    from 41% to 59% MFU and T=1024 from 55% to 61%; 512 -> 1024 buys a
-    further ~+5 MFU points at T=8192. The default is therefore
-    shape-keyed in ``_select_blocks`` (1024 for T>=8192, 512 below,
-    clamped to tq); MXNET_FLASH_BLOCK_Q/K override for probes.
+    from 41% to 59% MFU and T=1024 from 55% to 61% (r4 figures, under
+    the OLD 18Td accounting — r5 switched the bench to the standard
+    12Td convention, so don't compare them to current MFU numbers;
+    tokens/s comparisons are convention-free); 512 -> 1024 buys a
+    further ~12% tokens/s at T=8192. block_k widens the inner-loop MXU
+    dots and cuts loop iterations: 128 -> 512 measured +19% tokens/s at
+    T=1024 and +54% at T=8192 (1024 fails to compile — VMEM — so 512
+    is a hard cap). Defaults are therefore shape-keyed in
+    ``_select_blocks`` (block_q: 1024 for T>=8192, 512 below, clamped
+    to tq; block_k: 512); MXNET_FLASH_BLOCK_Q/K override for probes.
     """
     import jax
 

@@ -142,16 +142,18 @@ def test_flash_block_selection_rules():
     (1024 at T>=8192)."""
     from mxnet_tpu.ops import pallas_kernels as pk
 
-    assert pk._select_blocks(8192, 8192) == (1024, 128, True)
-    assert pk._select_blocks(16384, 16384) == (1024, 128, True)
-    assert pk._select_blocks(4096, 4096) == (512, 128, True)
+    assert pk._select_blocks(8192, 8192) == (1024, 512, True)
+    assert pk._select_blocks(16384, 16384) == (1024, 512, True)
+    assert pk._select_blocks(4096, 4096) == (512, 512, True)
+    # block_k is hard-capped at 512 (1024 fails to compile on chip)
+    assert pk._select_blocks(8192, 8192, block_k=1024) == (1024, 512, True)
     # divisor shrink keeps tileable lengths on the kernel, scanning all
     # 128-multiples (8320 = 128*65 tiles at 640, not a power-of-two)
     assert pk._select_blocks(640, 640) == (128, 128, True)
-    assert pk._select_blocks(1280, 1280) == (256, 128, True)
+    assert pk._select_blocks(1280, 1280) == (256, 256, True)
     assert pk._select_blocks(8320, 8320) == (640, 128, True)
     # a sub-128 request rounds up to a legal block instead of going dense
-    assert pk._select_blocks(8192, 8192, block_q=64) == (128, 128, True)
+    assert pk._select_blocks(8192, 8192, block_q=64) == (128, 512, True)
     # off-128 lengths have NO legal tiling — probed on real Mosaic (r5):
     # even a full-dim off-128 block fails, because the backward kernels'
     # dynamic lane slices need a provable 128-multiple start index. Such
@@ -163,11 +165,12 @@ def test_flash_block_selection_rules():
         assert not ok, (tq, tk)
     # an explicit sub-128 block_q is rounded up to the legal 128 tiling
     # rather than lowered as-is or dropped to dense
-    assert pk._select_blocks(256, 256, block_q=64) == (128, 128, True)
+    assert pk._select_blocks(256, 256, block_q=64) == (128, 256, True)
     # a non-128-multiple request re-scans for a legal divisor instead of
-    # going dense (192 @ 4992 -> 128, 320 @ 1280 -> 256)
-    assert pk._select_blocks(4992, 4992, block_q=192)[0] == 128
-    assert pk._select_blocks(1280, 1280, block_q=320) == (256, 128, True)
+    # going dense (192 @ 4992 -> 128, 320 @ 1280 -> 256); the k side
+    # scans the same way (4992 = 13*384)
+    assert pk._select_blocks(4992, 4992, block_q=192) == (128, 384, True)
+    assert pk._select_blocks(1280, 1280, block_q=320) == (256, 256, True)
 
 
 def test_flash_attention_fallback_odd_shapes():
