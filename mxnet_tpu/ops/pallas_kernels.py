@@ -26,7 +26,8 @@ from __future__ import annotations
 import functools
 import os
 
-__all__ = ["enabled", "flash_attention", "fused_softmax"]
+__all__ = ["enabled", "flash_attention", "flash_kernel_usable",
+           "fused_softmax"]
 
 
 def _on_tpu():
@@ -412,6 +413,31 @@ def _select_blocks(tq, tk, block_q=None, block_k=None):
     return block_q, block_k, ok
 
 
+def flash_kernel_usable(tq, tk, d, dv, block_q=None, block_k=None):
+    """True iff ``flash_attention`` will take the PALLAS KERNEL path for
+    ``[.., tq, d] x [.., tk, d] -> [.., tk, dv]`` operands: every gate
+    the kernel applies — enablement, block-tiling legality, the
+    ``MXNET_FLASH_MIN_T`` crossover, and the per-cell VMEM residency of
+    the full K/V (and Q/dO in the backward). Public so composers
+    (e.g. the Ulysses sequence-parallel local attention) can choose
+    between the kernel and their OWN memory-bounded fallback instead of
+    ever hitting flash_attention's dense O(T^2) fallback."""
+    _, _, tiles = _select_blocks(tq, tk, block_q, block_k)
+    min_t = _env_int("MXNET_FLASH_MIN_T", 0)
+    budget = 8 * 1024 * 1024
+    return (
+        enabled()
+        and tiles
+        # the crossover is a hardware-perf decision; interpret mode
+        # (CPU tests) always takes the kernel path for coverage
+        and (tk >= min_t or _interpret())
+        # full K AND V per head are resident in VMEM per grid cell
+        # (same budget for Q+dO in the dkv backward kernel)
+        and tk * (d + dv) * 4 <= budget
+        and tq * (d + dv) * 4 <= budget
+    )
+
+
 def flash_attention(q, k, v, causal=True, scale=None,
                     block_q=None, block_k=None):
     """Blockwise-softmax attention. q,k,v: [batch, heads, time, d_head].
@@ -450,20 +476,9 @@ def flash_attention(q, k, v, causal=True, scale=None,
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
     tq, tk = q.shape[2], k.shape[2]
-    block_q, block_k, tiles = _select_blocks(tq, tk, block_q, block_k)
-    min_t = _env_int("MXNET_FLASH_MIN_T", 0)
-    usable = (
-        enabled()
-        and q.ndim == 4
-        and tiles
-        # the crossover is a hardware-perf decision; interpret mode
-        # (CPU tests) always takes the kernel path for coverage
-        and (tk >= min_t or _interpret())
-        # full K AND V per head are resident in VMEM per grid cell
-        # (same budget for Q+dO in the dkv backward kernel)
-        and tk * (q.shape[-1] + v.shape[-1]) * 4 <= 8 * 1024 * 1024
-        and tq * (q.shape[-1] + v.shape[-1]) * 4 <= 8 * 1024 * 1024
-    )
+    block_q, block_k, _tiles = _select_blocks(tq, tk, block_q, block_k)
+    usable = q.ndim == 4 and flash_kernel_usable(
+        tq, tk, q.shape[-1], v.shape[-1], block_q, block_k)
     if not usable:
         return _attention_reference(q, k, v, causal, scale)
 

@@ -55,10 +55,23 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     from .ring_attention import _block_attn, _merge_block
 
     ql, kl, vl = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # ql: [B, H/n, T_global, D]; attend blockwise over key chunks with the
-    # shared flash-style LSE accumulation — peak memory O(T_global·chunk)
-    # scores per head-chunk, not O(T_global^2)
+    # ql: [B, H/n, T_global, D] — exactly the flash kernel's shape, and
+    # unlike ring there is no cross-step LSE combine, so the local
+    # attention can ride the tuned Pallas kernels (fwd AND custom-vjp
+    # backward) whenever the local problem tiles and K/V fit the
+    # kernel's per-cell VMEM budget. Gating on the same conditions
+    # flash_attention checks guarantees the kernel path — never its
+    # dense O(T^2) fallback, which would lose this loop's
+    # O(T_global*chunk) memory bound.
+    from ..ops import pallas_kernels as pk
+
     t_global = ql.shape[2]
+    if pk.flash_kernel_usable(t_global, t_global, d, vl.shape[-1]):
+        out = pk.flash_attention(ql, kl, vl, causal=causal, scale=scale)
+        return heads_to_seq(out.astype(q.dtype))
+    # fallback: blockwise over key chunks with the shared flash-style
+    # LSE accumulation — peak memory O(T_global*chunk) scores per
+    # head-chunk, not O(T_global^2)
     chunk = t_local
     acc = jnp.float32
     iq = jnp.arange(t_global)[:, None]
@@ -104,8 +117,17 @@ def make_ulysses_attention(mesh, seq_axis="seq", causal=True):
     spec = P(None, None, seq_axis, None)
     fn = functools.partial(
         ulysses_attention, axis_name=seq_axis, causal=causal)
-    mapped = shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:
+        # check_vma off: the Pallas flash kernel's out_shapes carry no
+        # varying-axes annotation, which the checker (jax >= 0.7)
+        # rejects inside shard_map; correctness is pinned by the dense
+        # parity + ring cross-check tests instead
+        mapped = shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+    except TypeError:  # older jax: no check_vma parameter
+        mapped = shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
 
     def apply(q, k, v):
         shard = NamedSharding(mesh, spec)

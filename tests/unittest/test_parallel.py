@@ -205,6 +205,51 @@ def test_ulysses_matches_ring():
                                np.array(ring(q, k, v)), atol=1e-4)
 
 
+def test_ulysses_flash_kernel_path(monkeypatch):
+    """At tiling lengths the Ulysses local attention rides the Pallas
+    flash kernel (interpret mode on CPU) — parity vs dense, and the
+    custom-vjp backward flows gradients through the all-to-alls (the
+    property ring attention cannot get from the kernel: its cross-step
+    LSE combine would need the kernel's internals)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import make_ulysses_attention
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    mesh = create_mesh((2,), ("seq",))
+    B, H, T, D = 1, 2, 256, 16  # T_global=256 tiles (128-multiples)
+    assert pk.flash_kernel_usable(T, T, D, D)
+    rng = np.random.RandomState(7)
+    q = rng.randn(B, H, T, D).astype("f")
+    k = rng.randn(B, H, T, D).astype("f")
+    v = rng.randn(B, H, T, D).astype("f")
+    uly = make_ulysses_attention(mesh, seq_axis="seq", causal=True)
+    # pin the PATH, not just the numerics: the Pallas forward must fire
+    # (otherwise a gate regression would silently re-test the fallback)
+    calls = []
+    orig = pk._flash_attention_pallas
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pk, "_flash_attention_pallas", counting)
+    out = np.array(uly(q, k, v))
+    assert calls, "Ulysses did not take the Pallas kernel path"
+    monkeypatch.setattr(pk, "_flash_attention_pallas", orig)
+    ref = _dense_attention(q, k, v, causal=True)
+    assert np.allclose(out, ref, atol=2e-4), np.abs(out - ref).max()
+
+    def loss(q):
+        return jnp.sum(uly(q, jnp.asarray(k), jnp.asarray(v)) ** 2)
+
+    g = jax.grad(loss)(jnp.asarray(q))
+    assert np.isfinite(np.asarray(g)).all() and float(
+        np.abs(np.asarray(g)).max()) > 0
+
+
 def test_ulysses_head_divisibility_error():
     from mxnet_tpu.parallel import make_ulysses_attention
 
