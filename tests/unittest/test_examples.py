@@ -94,6 +94,27 @@ def test_example_smoke(script, argv, monkeypatch):
                 del sys.modules[name]
 
 
+def test_example_notebook_char_rnn(monkeypatch):
+    """The char-rnn NOTEBOOK (the reference ships this workflow as
+    example/rnn/char-rnn.ipynb) executes end to end in a fresh kernel:
+    its own in-notebook asserts (perplexity halving, sampling) run, so
+    the committed outputs can never go stale against the API."""
+    nbformat = pytest.importorskip("nbformat")
+    nbclient = pytest.importorskip("nbclient")
+    # the kernel is a fresh python process: keep it off the TPU tunnel
+    # and give it the repo on PYTHONPATH (the notebook's own bootstrap
+    # handles sys.path relative to its directory)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    monkeypatch.setenv("PYTHONPATH", ROOT)
+    nbdir = os.path.join(ROOT, "examples", "rnn")
+    nb = nbformat.read(os.path.join(nbdir, "char_rnn.ipynb"), as_version=4)
+    client = nbclient.NotebookClient(
+        nb, timeout=600, kernel_name="python3",
+        resources={"metadata": {"path": nbdir}})
+    client.execute()
+
+
 def test_example_smoke_torch(monkeypatch):
     """examples/torch runs inline like every other example: the hybrid
     executor runs TorchModule/TorchCriterion nodes eagerly between jitted
