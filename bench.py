@@ -30,13 +30,34 @@ import numpy as np
 BASELINE_IMG_S_PER_GPU = 513.0 / 4.0  # ref README.md:255, see docstring
 
 
+def _leg(fn, name):
+    """Run one flagship leg, retrying transient tunnel failures.
+
+    The axon remote-compile service occasionally drops a request
+    (HTTP 500 / truncated body seen in the wild); a failed leg would
+    silently erase that flagship from the judged BENCH_r*.json, so
+    retry up to BENCH_RETRY times before giving up. Real failures
+    (shape bugs, OOM on every attempt) still propagate."""
+    retries = max(0, int(os.environ.get("BENCH_RETRY", "2")))
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as exc:
+            if attempt >= retries:
+                raise
+            print("bench: %s leg failed (%s: %s) — retry %d/%d"
+                  % (name, type(exc).__name__, str(exc)[:160],
+                     attempt + 1, retries), file=sys.stderr)
+            time.sleep(20 * (attempt + 1))
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "")
     if model == "transformer":
         import bench_lm
 
-        return bench_lm.main()
-    _run_resnet()
+        return _leg(bench_lm.main, "transformer")
+    _leg(_run_resnet, "resnet50")
     if model != "resnet50":
         # second flagship in the same run: free the ResNet state first so
         # both programs size HBM independently
@@ -46,7 +67,7 @@ def main():
         import bench_lm
 
         sys.stdout.flush()
-        bench_lm.main()
+        _leg(bench_lm.main, "transformer")
 
 
 def _run_resnet():

@@ -39,6 +39,16 @@ class MXNetError(Exception):
 # Alias under the new framework's own name; both are importable.
 MXTPUError = MXNetError
 
+
+class InferShapeFatal(MXNetError):
+    """Shape-inference failure that is NOT "inputs not yet known".
+
+    The graph fixed point (symbol._infer_shape_impl) treats a plain
+    MXNetError from an op's infer_shape as "retry once more inputs
+    resolve"; raising this subclass instead aborts inference and
+    surfaces the message — used when an op can prove the failure is
+    real (e.g. a Custom prop raising with every input shape known)."""
+
 string_types = (str,)
 numeric_types = (float, int, _np.generic)
 

@@ -583,15 +583,23 @@ def load_frombuffer(buf, ctx=None):
     if ctx is None:
         ctx = cpu()
     f = io.BytesIO(buf)
-    magic, _, count = struct.unpack("<QQQ", f.read(24))
-    if magic != _ND_MAGIC:
-        raise MXNetError("invalid NDArray buffer")
-    num_names = struct.unpack("<Q", f.read(8))[0]
-    names = []
-    for _ in range(num_names):
-        ln = struct.unpack("<Q", f.read(8))[0]
-        names.append(f.read(ln).decode("utf-8"))
-    arrays = [_read_tensor(f, ctx) for _ in range(count)]
+    try:
+        magic, _, count = struct.unpack("<QQQ", f.read(24))
+        if magic != _ND_MAGIC:
+            raise MXNetError("invalid NDArray buffer")
+        num_names = struct.unpack("<Q", f.read(8))[0]
+        names = []
+        for _ in range(num_names):
+            ln = struct.unpack("<Q", f.read(8))[0]
+            names.append(f.read(ln).decode("utf-8"))
+        arrays = [_read_tensor(f, ctx) for _ in range(count)]
+    except MXNetError:
+        raise
+    except Exception as exc:
+        # truncated/corrupt bytes surface as struct/codec errors deep in
+        # the tensor reader; callers get the same clear error the
+        # reference's CHECK(magic) path gives (ndarray.cc Load)
+        raise MXNetError("invalid or truncated NDArray buffer: %s" % exc)
     if names:
         return dict(zip(names, arrays))
     return arrays

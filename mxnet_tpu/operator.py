@@ -19,7 +19,7 @@ import functools
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import InferShapeFatal, MXNetError
 from .ops.registry import Field, OpDef, register as _register_opdef
 
 __all__ = ["CustomOp", "CustomOpProp", "NumpyOp", "NDArrayOp", "register", "get_all_registered"]
@@ -265,10 +265,53 @@ def _norm_infer_shape(ret):
 def _custom_infer_shape(params, in_shapes):
     op_type = params["op_type"]
     prop = _CUSTOM_REGISTRY[op_type](**(params.get("__kwargs__") or {}))
-    if any(s is None for s in in_shapes):
-        raise MXNetError("Custom: all input shapes required")
-    ins, outs, auxs = _norm_infer_shape(prop.infer_shape([list(s) for s in in_shapes]))
-    return [tuple(s) for s in ins], [tuple(s) for s in outs], [tuple(s) for s in auxs]
+    # Partially-known inputs reach the user prop as empty lists (the
+    # reference passes default TShapes into the prop's InferShape,
+    # custom-inl.h:60-78) so props that derive label/output shapes from
+    # the data shape alone can back-fill them — prediction binds without
+    # a label (FeedForward._init_predictor) rely on this. A prop that
+    # indexes an entry that is still unknown raises; the fixed-point
+    # loop treats that as "not yet inferable" and retries next sweep.
+    unknown = any(s is None for s in in_shapes)
+    try:
+        ins, outs, auxs = _norm_infer_shape(prop.infer_shape(
+            [list(s) if s is not None else [] for s in in_shapes]))
+    except MXNetError as exc:
+        if unknown or isinstance(exc, InferShapeFatal):
+            raise  # retryable (or already classified) — loop decides
+        # every input was known, so the prop's complaint is a REAL
+        # error: escalate so the fixed point surfaces it verbatim
+        # instead of degrading it to "cannot determine shapes"
+        raise InferShapeFatal("Custom(%s) infer_shape: %s" % (op_type, exc))
+    except Exception:
+        if unknown:
+            # the prop indexed a not-yet-known entry: retryable — the
+            # fixed point will call again once more inputs resolve
+            raise MXNetError(
+                "Custom(%s) infer_shape needs more input shapes" % op_type)
+        raise  # real prop bug with full information: propagate as-is
+    if unknown:
+        # Under partial inputs, "not yet known" maps to None; the fixed
+        # point skips None entries but KEEPS everything the prop did
+        # fill (a back-filled label next to a still-unknown output), so
+        # partial progress is never thrown away. Sentinel rule: unknown
+        # inputs are passed to the prop as empty LISTS, so an echoed
+        # empty list (or None) means "not yet" — while an empty TUPLE
+        # () is an intentional 0-d scalar shape (mx.nd scalars exist)
+        # and passes through even on partial sweeps.
+        def _norm(s):
+            if s is None or (isinstance(s, list) and not s):
+                return None
+            return tuple(s)
+
+        ins = [_norm(s) for s in ins]
+        outs = [_norm(s) for s in outs]
+        auxs = [_norm(s) for s in auxs]
+        if not outs:
+            raise MXNetError("Custom(%s): output shapes unknown" % op_type)
+        return ins, outs, auxs
+    return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+            [tuple(s) for s in auxs])
 
 
 def _custom_arguments(params):

@@ -22,7 +22,7 @@ import json
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import InferShapeFatal, MXNetError
 from .attribute import AttrScope
 from .name import NameManager
 
@@ -299,6 +299,8 @@ class Symbol:
                 in_shapes = [shapes.get((id(s), i)) for s, i in n.inputs]
                 try:
                     ins, outs, auxs = n.op.infer_shape(n.params, in_shapes)
+                except InferShapeFatal:
+                    raise  # a proven-real failure, not "inputs not ready"
                 except MXNetError:
                     continue
                 for (src, i), s in zip(n.inputs, ins):
@@ -308,10 +310,14 @@ class Symbol:
                         if src.is_variable:
                             arg_shapes_map[src.name] = tuple(s)
                 for i, s in enumerate(outs):
+                    if s is None:  # op could not resolve this output yet
+                        continue
                     if shapes.get((id(n), i)) != tuple(s):
                         shapes[(id(n), i)] = tuple(s)
                         changed = True
                 for an, s in zip(n.op.list_auxiliary_states(n.params), auxs):
+                    if s is None:  # aux not derivable on this sweep
+                        continue
                     aux_shapes_map["%s_%s" % (n.name, an)] = tuple(s)
 
         # user-provided shapes must agree with the fixed point — silent

@@ -105,3 +105,147 @@ def test_incomplete_info_raises_with_missing_names():
     with pytest.raises(MXNetError) as e:
         lstm.infer_shape(data=(2, 4), softmax_label=(2, 4))
     assert "init" in str(e.value)  # l0_init_c / l0_init_h missing
+
+
+def test_custom_op_backfills_label_shape():
+    """A CustomOp/NumpyOp prop that derives its label shape from the data
+    shape alone must satisfy a prediction-time bind where no label shape
+    is provided — the reference feeds default TShapes into the prop's
+    InferShape and lets it back-fill (custom-inl.h:60-78); FeedForward's
+    predictor (_init_predictor -> simple_bind(data=...)) depends on it."""
+    import numpy as np
+
+    class _Softmax(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            x, y = in_data[0], out_data[0]
+            y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            lab = in_data[1].astype(int)
+            dx = in_grad[0]
+            dx[:] = out_data[0]
+            dx[np.arange(lab.shape[0]), lab] -= 1.0
+
+    net = _Softmax()(
+        data=sym.FullyConnected(sym.Variable("data"), num_hidden=10,
+                                name="fc"),
+        name="softmax")
+    # label back-filled from data alone (the predictor-bind condition)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(32, 16))
+    assert dict(zip(net.list_arguments(), arg_shapes))["softmax_label"] == (32,)
+    assert out_shapes == [(32, 10)]
+    # and a full prediction pass runs without any label anywhere
+    exe = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=(4, 16))
+    exe.arg_dict["data"][:] = np.random.rand(4, 16).astype(np.float32)
+    exe.arg_dict["fc_weight"][:] = np.random.rand(10, 16).astype(np.float32)
+    exe.forward(is_train=False)
+    p = exe.outputs[0].asnumpy()
+    assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_custom_op_scalar_output_shape():
+    """A 0-d (scalar) output shape from a custom prop is legitimate when
+    every input is known — it must not be misread as 'unknown'."""
+
+    class _ScalarLoss(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0]], [()]
+
+        def forward(self, in_data, out_data):
+            pass
+
+    net = _ScalarLoss()(data=sym.Variable("data"), name="sl")
+    _, out_shapes, _ = net.infer_shape(data=(4, 3))
+    assert out_shapes == [()]
+
+
+def test_custom_op_real_errors_surface():
+    """With every input shape known, a prop's own failure is a REAL
+    error: an MXNetError keeps its message (InferShapeFatal escalation)
+    instead of degrading to 'cannot determine shapes', and a plain
+    python exception propagates raw with its traceback."""
+
+    class _Picky(mx.operator.NumpyOp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            raise MXNetError("kernel size must be odd")
+
+        def forward(self, in_data, out_data):
+            pass
+
+    net = _Picky()(data=sym.Variable("data"), name="pk")
+    with pytest.raises(MXNetError) as e:
+        net.infer_shape(data=(2, 3))
+    assert "kernel size must be odd" in str(e.value)
+
+    class _Buggy(mx.operator.NumpyOp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            raise TypeError("real bug in user code")
+
+        def forward(self, in_data, out_data):
+            pass
+
+    net2 = _Buggy()(data=sym.Variable("data"), name="bg")
+    with pytest.raises(TypeError, match="real bug"):
+        net2.infer_shape(data=(2, 3))
+
+
+def test_custom_op_scalar_output_with_backfill():
+    """The combination: a scalar-output prop that also back-fills its
+    label from the data shape, bound with only data known (prediction).
+    The back-filled label must land in the fixed point even while the
+    () output is still treated as unresolved on that sweep."""
+
+    class _ScalarWithLabel(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [()]
+
+        def forward(self, in_data, out_data):
+            pass
+
+    net = _ScalarWithLabel()(data=sym.Variable("data"), name="sl")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(4, 3))
+    assert dict(zip(net.list_arguments(), arg_shapes))["sl_label"] == (4,)
+    assert out_shapes == [()]
