@@ -66,11 +66,18 @@ def parse_args():
     parser.add_argument('--load-epoch', type=int, default=None)
     parser.add_argument('--num-epochs', type=int, default=10)
     parser.add_argument('--kv-store', type=str, default='local')
+    parser.add_argument('--mirror', action='store_true',
+                        help='recompute cheap activations in the backward '
+                        'to cut activation memory (the reference\'s '
+                        'train_cifar10_mirroring.py memonger config; '
+                        'sets MXNET_BACKWARD_DO_MIRROR=1)')
     return parser.parse_args()
 
 
 if __name__ == '__main__':
     args = parse_args()
+    if args.mirror:
+        os.environ['MXNET_BACKWARD_DO_MIRROR'] = '1'
     if args.network == 'resnet-28-small':
         from mxnet_tpu.models.resnet import get_resnet_small
         net = get_resnet_small(num_classes=10, n=3)

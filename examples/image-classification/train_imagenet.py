@@ -53,7 +53,8 @@ def get_iterator(args, kv):
 def parse_args():
     parser = argparse.ArgumentParser(description='train an image classifier on imagenet')
     parser.add_argument('--network', type=str, default='resnet',
-                        choices=['resnet', 'resnet-101', 'resnet-152'])
+                        choices=['resnet', 'resnet-101', 'resnet-152',
+                                 'inception-bn'])
     parser.add_argument('--data-dir', type=str, default='imagenet/')
     parser.add_argument('--synthetic', action='store_true')
     parser.add_argument('--ctx', type=str, default='auto', choices=['auto', 'cpu', 'tpu'])
@@ -73,7 +74,15 @@ def parse_args():
 
 if __name__ == '__main__':
     args = parse_args()
-    from mxnet_tpu.models import get_resnet
-    layers = {'resnet': 50, 'resnet-101': 101, 'resnet-152': 152}[args.network]
-    net = get_resnet(num_classes=args.num_classes, num_layers=layers)
+    if args.network == 'inception-bn':
+        # the reference's flagship baseline net (symbol_inception-bn.py);
+        # --num-classes 21841 gives the full-ImageNet-21k config
+        # (symbol_inception-bn-full.py, imagenet_full.md)
+        from mxnet_tpu.models import get_inception_bn
+        net = get_inception_bn(num_classes=args.num_classes)
+    else:
+        from mxnet_tpu.models import get_resnet
+        layers = {'resnet': 50, 'resnet-101': 101,
+                  'resnet-152': 152}[args.network]
+        net = get_resnet(num_classes=args.num_classes, num_layers=layers)
     train_model.fit(args, net, get_iterator)

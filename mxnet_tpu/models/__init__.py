@@ -7,7 +7,7 @@ sharded parallel trainer for mesh-scale training.
 from .lenet import get_lenet
 from .mlp import get_mlp
 from .resnet import get_resnet, get_resnet_small
-from .inception_bn import get_inception_bn_small
+from .inception_bn import get_inception_bn, get_inception_bn_small
 from .classic_convnets import (
     get_alexnet, get_vgg, get_googlenet, get_inception_v3,
 )
@@ -19,7 +19,7 @@ from . import transformer
 
 __all__ = [
     "get_lenet", "get_mlp", "get_resnet", "get_resnet_small",
-    "get_inception_bn_small",
+    "get_inception_bn", "get_inception_bn_small",
     "get_alexnet", "get_vgg", "get_googlenet", "get_inception_v3",
     "get_unet",
     "lstm_unroll", "gru_unroll", "rnn_unroll", "transformer",

@@ -156,9 +156,20 @@ class BucketingModule(BaseModule):
     def forward(self, data_batch, is_train=None):
         """ref: bucketing_module.py:255."""
         assert self.binded and self.params_initialized
-        bucket_key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        bucket_key = getattr(data_batch, "bucket_key", None)
+        if bucket_key is None:
+            # batches from plain (non-bucket) iterators — e.g. an eval
+            # iterator passed to score() — run under the default key
+            bucket_key = self._default_bucket_key
+        default_mod = self._buckets[self._default_bucket_key]
         provide_data = data_batch.provide_data
+        if provide_data is None:
+            provide_data = [(n, tuple(a.shape)) for n, a in zip(
+                default_mod.data_names, data_batch.data)]
         provide_label = getattr(data_batch, "provide_label", None)
+        if provide_label is None and getattr(data_batch, "label", None):
+            provide_label = [(n, tuple(a.shape)) for n, a in zip(
+                default_mod._label_names, data_batch.label)]
         self.switch_bucket(bucket_key, provide_data, provide_label)
         # share latest params into the switched module
         if self._curr_module.params_initialized is False:

@@ -63,6 +63,10 @@ CASES = [
     ("dec/dec_clustering.py", ["--pretrain-steps", "20",
                                "--refine-epochs", "1"]),
     ("module/mnist_mlp.py", ["--epochs", "1"]),
+    # bucketing sanity check outside the rnn family (ref mnist_bucket.py):
+    # per-key executor binds at duplicated batch sizes, shared params;
+    # accuracy assert stays ACTIVE in smoke mode
+    ("image-classification/mnist_bucket.py", []),
     ("python-howto/howto.py", []),
     ("speech-demo/acoustic_dnn.py", ["--epochs", "1"]),
     ("kaggle-ndsb1/end_to_end.py", ["--epochs", "1", "--per-class", "10"]),

@@ -41,3 +41,35 @@ def test_resnet_s2d_variant_builds_and_infers():
     names = s.list_arguments()
     i = names.index("conv0_conv_weight")
     assert args[i] == (64, 12, 4, 4)
+
+
+def test_inception_bn_full_shapes():
+    """Full Inception-BN (ref symbol_inception-bn.py get_symbol): the
+    flagship baseline network behind BASELINE.md's ImageNet epoch
+    times. Stage output shapes and the parameter census pin the
+    composition; num_classes parameterizes the 21k full-ImageNet
+    variant (symbol_inception-bn-full.py)."""
+    import numpy as np
+
+    net = mx.models.get_inception_bn(num_classes=1000)
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(2, 3, 224, 224), softmax_label=(2,))
+    assert out_shapes == [(2, 1000)]
+    # 2 aux states (moving mean/var) per BatchNorm
+    n_bn = sum(1 for n in net.list_arguments() if n.endswith("_gamma"))
+    assert len(aux_shapes) == 2 * n_bn
+    n_params = sum(
+        int(np.prod(s)) for nm, s in zip(net.list_arguments(), arg_shapes)
+        if nm not in ("data", "softmax_label"))
+    assert 11e6 < n_params < 12e6, n_params  # known ~11.3M parameter count
+    # the 5b concat feeds global pool with 352+320+224+128 = 1024 ch
+    internals = net.get_internals()
+    _, pool_out, _ = internals["global_pool_output"].infer_shape(
+        data=(2, 3, 224, 224))
+    assert pool_out == [(2, 1024, 1, 1)]
+
+    # 21k-class variant only widens the classifier
+    net21k = mx.models.get_inception_bn(num_classes=21841)
+    _, out21k, _ = net21k.infer_shape(data=(2, 3, 224, 224),
+                                      softmax_label=(2,))
+    assert out21k == [(2, 21841)]
