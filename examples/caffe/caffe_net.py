@@ -1,0 +1,118 @@
+"""Train MNIST networks written as caffe layer specs (ref:
+example/caffe/caffe_net.py).
+
+Every layer is a ``mx.symbol.CaffeOp`` carrying its caffe prototxt
+string, and ``--caffe-loss`` swaps the head for ``mx.symbol.CaffeLoss``
+— the reference runs these through embedded libcaffe kernels; here the
+specs are interpreted onto native ops (mxnet_tpu/caffe_plugin.py), so
+the same script runs on TPU with no caffe installed.
+
+Run: PYTHONPATH=. python examples/caffe/caffe_net.py --network lenet
+"""
+import argparse
+import os
+
+import mxnet_tpu as mx
+
+
+def get_mlp(use_caffe_loss):
+    """Multi-layer perceptron, every layer a caffe InnerProduct/TanH."""
+    data = mx.symbol.Variable('data')
+    fc1 = mx.symbol.CaffeOp(
+        data_0=data, num_weight=2, name='fc1',
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 128} }')
+    act1 = mx.symbol.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}')
+    fc2 = mx.symbol.CaffeOp(
+        data_0=act1, num_weight=2, name='fc2',
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 64} }')
+    act2 = mx.symbol.CaffeOp(data_0=fc2, prototxt='layer{type:"TanH"}')
+    fc3 = mx.symbol.CaffeOp(
+        data_0=act2, num_weight=2, name='fc3',
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 10}}')
+    if use_caffe_loss:
+        label = mx.symbol.Variable('softmax_label')
+        return mx.symbol.CaffeLoss(
+            data=fc3, label=label, grad_scale=1, name='softmax',
+            prototxt='layer{type:"SoftmaxWithLoss"}')
+    return mx.symbol.SoftmaxOutput(data=fc3, name='softmax')
+
+
+def get_lenet(use_caffe_loss):
+    """LeNet with caffe Convolution/Pooling/TanH layers (LeCun et al.
+    1998). Note caffe's ceil-mode pooling arithmetic is preserved."""
+    data = mx.symbol.Variable('data')
+    conv1 = mx.symbol.CaffeOp(
+        data_0=data, num_weight=2,
+        prototxt='layer{type:"Convolution" convolution_param '
+                 '{ num_output: 20 kernel_size: 5 stride: 1} }')
+    act1 = mx.symbol.CaffeOp(data_0=conv1, prototxt='layer{type:"TanH"}')
+    pool1 = mx.symbol.CaffeOp(
+        data_0=act1,
+        prototxt='layer{type:"Pooling" pooling_param '
+                 '{ pool: MAX kernel_size: 2 stride: 2}}')
+    conv2 = mx.symbol.CaffeOp(
+        data_0=pool1, num_weight=2,
+        prototxt='layer{type:"Convolution" convolution_param '
+                 '{ num_output: 50 kernel_size: 5 stride: 1} }')
+    act2 = mx.symbol.CaffeOp(data_0=conv2, prototxt='layer{type:"TanH"}')
+    pool2 = mx.symbol.CaffeOp(
+        data_0=act2,
+        prototxt='layer{type:"Pooling" pooling_param '
+                 '{ pool: MAX kernel_size: 2 stride: 2}}')
+    fc1 = mx.symbol.CaffeOp(
+        data_0=pool2, num_weight=2,
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 500} }')
+    act3 = mx.symbol.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}')
+    fc2 = mx.symbol.CaffeOp(
+        data_0=act3, num_weight=2,
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 10} }')
+    if use_caffe_loss:
+        label = mx.symbol.Variable('softmax_label')
+        return mx.symbol.CaffeLoss(
+            data=fc2, label=label, grad_scale=1, name='softmax',
+            prototxt='layer{type:"SoftmaxWithLoss"}')
+    return mx.symbol.SoftmaxOutput(data=fc2, name='softmax')
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--network', type=str, default='lenet',
+                   choices=['mlp', 'lenet'])
+    p.add_argument('--caffe-loss', action='store_true',
+                   help='use CaffeLoss (SoftmaxWithLoss spec) as the head')
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--num-epochs', type=int, default=4)
+    p.add_argument('--lr', type=float, default=0.1)
+    args = p.parse_args()
+    smoke = bool(os.environ.get("MXNET_EXAMPLE_SMOKE"))
+    if smoke:
+        args.num_epochs = 2
+    mx.random.seed(0)
+
+    flat = args.network == 'mlp'
+    net = (get_mlp if flat else get_lenet)(args.caffe_loss)
+    train = mx.io.MNISTIter(batch_size=args.batch_size, num_synthetic=1600,
+                            seed=1, flat=flat)
+    val = mx.io.MNISTIter(batch_size=args.batch_size, num_synthetic=800,
+                          seed=2, flat=flat, shuffle=False)
+
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=net, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=0.00001,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    acc = model.score(val)
+    print("caffe_net(%s%s): val accuracy %.3f"
+          % (args.network, ' +CaffeLoss' if args.caffe_loss else '', acc))
+    assert acc > 0.9, acc
+    return acc
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,294 @@
+"""Caffe prototxt interpretation on native ops.
+
+Shared by the caffe plugin facade (``mxnet_tpu.caffe_plugin.CaffeOp``
+runs a single caffe layer spec as an op, ref: plugin/caffe/
+caffe_op-inl.h) and the network converter (``tools/caffe_converter.py``,
+ref: tools/caffe_converter/convert_symbol.py). The reference parses
+prototxt through caffe's generated protobuf classes and executes layers
+with libcaffe kernels; here a small self-contained text-format parser
+reads the spec directly and each layer type maps onto the native op
+registry — the TPU-native equivalent (XLA runs the math, no caffe
+runtime required).
+
+Supported layers: Input/Data, Convolution, Pooling (MAX/AVE),
+InnerProduct, ReLU, TanH, Sigmoid, Dropout, LRN, Concat, Eltwise
+(SUM/PROD/MAX), Flatten, Softmax / SoftmaxWithLoss, Accuracy (skipped).
+
+Fidelity note: Pooling maps with ``pooling_convention="full"`` (caffe
+sizes pooled maps with ceil), so spatial arithmetic matches caffe's.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["parse_prototxt", "apply_layer", "convert_symbol"]
+
+# -- minimal protobuf text-format parser --------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)\s*(?P<colon>:)?
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d*)?(?:[eE][+-]?\d+)?)
+""", re.VERBOSE)
+
+
+def _tokenize(text):
+    text = re.sub(r"#[^\n]*", "", text)  # comments
+    pos = 0
+    while pos < len(text):
+        if text[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError("prototxt parse error at %r" % text[pos:pos + 30])
+        pos = m.end()
+        yield m
+
+
+def _parse_block(tokens):
+    """Parse `key: value` / `key { ... }` pairs until '}' or EOF into a
+    dict; repeated keys accumulate into lists."""
+    out = {}
+
+    def add(key, val):
+        if key in out:
+            if not isinstance(out[key], list):
+                out[key] = [out[key]]
+            out[key].append(val)
+        else:
+            out[key] = val
+
+    for m in tokens:
+        if m.group("brace") == "}":
+            return out
+        key = m.group("name")
+        if key is None:
+            raise ValueError("expected field name, got %r" % m.group(0))
+        nxt = next(tokens)
+        if nxt.group("brace") == "{":
+            add(key, _parse_block(tokens))
+        elif nxt.group("string") is not None:
+            add(key, nxt.group("string")[1:-1])
+        elif nxt.group("number") is not None:
+            n = nxt.group("number")
+            add(key, float(n) if ("." in n or "e" in n.lower()) else int(n))
+        elif nxt.group("name") is not None:  # enum / bool literal
+            v = nxt.group("name")
+            add(key, {"true": True, "false": False}.get(v, v))
+        else:
+            raise ValueError("unexpected token %r after %s" % (nxt.group(0), key))
+    return out
+
+
+def parse_prototxt(text):
+    return _parse_block(_tokenize(text))
+
+
+# -- layer mapping ------------------------------------------------------------
+
+def _aslist(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _first(v, default):
+    lst = _aslist(v)
+    return lst[0] if lst else default
+
+
+def _dilate(p, name):
+    """dilation is a repeated field: one value applies to both axes,
+    two distinct values are anisotropic (unsupported)."""
+    vals = [int(v) for v in _aslist(p.get("dilation"))]
+    if not vals:
+        return (1, 1)
+    if len(set(vals)) > 1:
+        raise NotImplementedError(
+            "anisotropic dilation %s (%s) not supported" % (vals, name))
+    return (vals[0], vals[0])
+
+
+def _hw(p, field, default=None, required=False):
+    """Resolve caffe's square (`kernel_size`) or per-axis
+    (`kernel_h`/`kernel_w`) spatial params to an (h, w) tuple."""
+    square = "%s_size" % field if field == "kernel" else field
+    if p.get(square) is not None:
+        k = int(_first(p[square], default))
+        return (k, k)
+    h, w = p.get(field + "_h"), p.get(field + "_w")
+    if h is not None or w is not None:
+        if h is None or w is None:
+            raise ValueError("%s_h/%s_w must be given together" % (field, field))
+        return (int(h), int(w))
+    if required:
+        raise ValueError("missing %s in %r" % (square, sorted(p)))
+    return (int(default), int(default))
+
+
+def apply_layer(layer, bottoms, name=None, label=None, grad_scale=1.0):
+    """Apply ONE computational caffe layer spec to bottom symbol(s).
+
+    Returns the output symbol, or None for no-op layers (Accuracy,
+    Silence). `label` and `grad_scale` feed loss layers
+    (SoftmaxWithLoss) — the CaffeLoss surface. Raises NotImplementedError
+    for unsupported types."""
+    import mxnet_tpu as mx
+
+    ltype = str(layer.get("type", ""))
+    if name is None:
+        # keep the spec's own name when present; otherwise leave None so
+        # the NameManager generates a unique one (two anonymous
+        # `layer{type:"Convolution"}` CaffeOps must not collide)
+        name = layer.get("name")
+        name = str(name).replace("/", "_") if name is not None else None
+    data = bottoms[0] if bottoms else None
+
+    if ltype == "Convolution":
+        p = layer.get("convolution_param", {})
+        return mx.sym.Convolution(
+            data=data, name=name, num_filter=int(p["num_output"]),
+            kernel=_hw(p, "kernel", required=True),
+            stride=_hw(p, "stride", default=1),
+            pad=_hw(p, "pad", default=0),
+            dilate=_dilate(p, name),
+            no_bias=not p.get("bias_term", True),
+            num_group=int(p.get("group", 1)))
+    if ltype == "Pooling":
+        p = layer.get("pooling_param", {})
+        global_pool = bool(p.get("global_pooling", False))
+        pool_modes = {"MAX": "max", "AVE": "avg", 0: "max", 1: "avg"}
+        mode = p.get("pool", "MAX")
+        if mode not in pool_modes:
+            raise NotImplementedError(
+                "Pooling mode %r (%s) not supported" % (mode, name))
+        return mx.sym.Pooling(
+            data=data, name=name,
+            pool_type=pool_modes[mode],
+            kernel=(_hw(p, "kernel", default=1)
+                    if not global_pool else (1, 1)),
+            stride=_hw(p, "stride", default=1),
+            pad=_hw(p, "pad", default=0),
+            # caffe sizes pooled maps with ceil(): 'full' convention
+            pooling_convention="full",
+            global_pool=global_pool)
+    if ltype == "InnerProduct":
+        p = layer.get("inner_product_param", {})
+        return mx.sym.FullyConnected(
+            data=mx.sym.Flatten(data), name=name,
+            num_hidden=int(p["num_output"]),
+            no_bias=not p.get("bias_term", True))
+    if ltype == "ReLU":
+        return mx.sym.Activation(data=data, act_type="relu", name=name)
+    if ltype == "TanH":
+        return mx.sym.Activation(data=data, act_type="tanh", name=name)
+    if ltype == "Sigmoid":
+        return mx.sym.Activation(data=data, act_type="sigmoid", name=name)
+    if ltype == "Dropout":
+        p = layer.get("dropout_param", {})
+        return mx.sym.Dropout(data=data, name=name,
+                              p=float(p.get("dropout_ratio", 0.5)))
+    if ltype == "LRN":
+        p = layer.get("lrn_param", {})
+        return mx.sym.LRN(
+            data=data, name=name,
+            alpha=float(p.get("alpha", 1e-4)),
+            beta=float(p.get("beta", 0.75)),
+            knorm=float(p.get("k", 1.0)),
+            nsize=int(p.get("local_size", 5)))
+    if ltype == "Concat":
+        return mx.sym.Concat(*bottoms, num_args=len(bottoms), name=name)
+    if ltype == "Eltwise":
+        ep = layer.get("eltwise_param", {})
+        op = str(ep.get("operation", "SUM"))
+        coeffs = [float(c) for c in _aslist(ep.get("coeff"))]
+        if coeffs and op in ("SUM", "1"):
+            if len(coeffs) != len(bottoms):
+                raise ValueError(
+                    "Eltwise %s: %d coeffs for %d bottoms"
+                    % (name, len(coeffs), len(bottoms)))
+            terms = [b * c for b, c in zip(bottoms, coeffs)]
+        else:
+            if coeffs:
+                raise NotImplementedError(
+                    "Eltwise coeff only defined for SUM")
+            terms = bottoms
+        out = terms[0]
+        for b in terms[1:]:
+            if op in ("SUM", "1"):
+                out = out + b
+            elif op in ("PROD", "0"):
+                out = out * b
+            elif op in ("MAX", "2"):
+                out = mx.sym.maximum(out, b)
+            else:
+                raise NotImplementedError(
+                    "Eltwise operation %r not supported" % op)
+        return out
+    if ltype == "Flatten":
+        return mx.sym.Flatten(data=data, name=name)
+    if ltype in ("Softmax", "SoftmaxWithLoss"):
+        kwargs = {}
+        if label is not None:
+            kwargs["label"] = label
+        if grad_scale != 1.0:
+            kwargs["grad_scale"] = float(grad_scale)
+        return mx.sym.SoftmaxOutput(data=data, name=name, **kwargs)
+    if ltype in ("Accuracy", "Silence"):
+        return None
+    raise NotImplementedError(
+        "caffe layer type %r (%s) not supported" % (ltype, name))
+
+
+def convert_symbol(prototxt_text):
+    """Whole-network prototxt -> (symbol, input_name, input_dim or None)
+    (ref: convert_symbol.py proto2symbol)."""
+    import mxnet_tpu as mx
+
+    net = parse_prototxt(prototxt_text)
+    layers = _aslist(net.get("layer")) or _aslist(net.get("layers"))
+    outputs = {}  # caffe top name -> symbol
+    input_name, input_dim = None, None
+
+    if "input" in net:
+        input_name = _first(net["input"], "data")
+        dims = net.get("input_dim")
+        if dims is None and "input_shape" in net:
+            dims = _first(net["input_shape"], {}).get("dim")
+        input_dim = tuple(_aslist(dims)) if dims else None
+        outputs[input_name] = mx.sym.Variable(input_name)
+
+    sym = outputs.get(input_name)
+    for layer in layers:
+        ltype = str(layer.get("type", ""))
+        name = str(layer.get("name", ltype)).replace("/", "_")
+        bottom_names = _aslist(layer.get("bottom"))
+        if ltype not in ("Input", "Data", "MemoryData", "HDF5Data",
+                         "Accuracy", "Silence"):
+            missing = [b for b in bottom_names if b not in outputs]
+            if missing:
+                raise ValueError(
+                    "layer %r: unknown bottom blob(s) %s — not produced by "
+                    "any earlier layer or input" % (name, missing))
+        bottoms = [outputs[b] for b in bottom_names if b in outputs]
+        tops = _aslist(layer.get("top")) or [name]
+
+        if ltype in ("Input", "Data", "MemoryData", "HDF5Data"):
+            input_name = tops[0]
+            shape = layer.get("input_param", {}).get("shape")
+            if shape:
+                input_dim = tuple(_aslist(_first(_aslist(shape), {}).get("dim")))
+            sym = mx.sym.Variable(input_name)
+        else:
+            out = apply_layer(layer, bottoms, name=name)
+            if out is None:  # Accuracy / Silence
+                continue
+            sym = out
+        for t in tops:
+            outputs[t] = sym
+
+    if sym is None:
+        raise ValueError("prototxt contains no layers and no input")
+    return sym, input_name, input_dim

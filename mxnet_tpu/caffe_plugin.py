@@ -1,27 +1,36 @@
-"""Caffe plugin facade (gated): CaffeOp / CaffeLoss / CaffeDataIter.
+"""Caffe plugin: CaffeOp / CaffeLoss / CaffeDataIter.
 
-The reference can embed Caffe layers/losses/data layers as operators when
-built with the caffe plugin (ref: plugin/caffe/caffe_op-inl.h,
+The reference can embed Caffe layers/losses/data layers as operators
+when built with the caffe plugin (ref: plugin/caffe/caffe_op-inl.h,
 caffe_loss-inl.h, caffe_data_iter.cc; enabled by `CAFFE_PATH` in
-make/config.mk). Caffe is not installable in this environment (no
-pip/apt), so the TPU framework ships the same *surface* behind a runtime
-gate — exactly how the reference behaves when compiled without the
-plugin: the symbols exist only when support is present; here they exist
-and raise a clear MXNetError pointing at the supported bridges.
+make/config.mk) — each op instantiates a libcaffe layer from its
+prototxt string and runs caffe's CPU/GPU kernels in-graph.
 
-The supported migration path for caffe models is:
-- layers → native ops (Convolution/Pooling/... have full parity), or
-- arbitrary python → ``CustomOp`` (mxnet_tpu/operator.py), or
-- pytorch modules → ``TorchModule`` (mxnet_tpu/torch.py).
+TPU-native redesign: there are no foreign kernels inside an XLA
+program, so ``CaffeOp``/``CaffeLoss`` INTERPRET the layer prototxt —
+the spec is parsed (self-contained text-format parser, no caffe, no
+protobuf schema) and mapped onto the native op registry
+(``mxnet_tpu/_caffe_proto.py``), where XLA runs the math. The user
+surface is the reference's exactly (``data_0..data_k``, ``num_weight``,
+``prototxt``, ``grad_scale``), so example/caffe scripts port verbatim;
+unsupported layer types raise a clear error naming the type. Caffe's
+ceil-mode pooling arithmetic is honored (pooling_convention='full').
+
+``CaffeDataIter`` wraps caffe's LMDB data layer and genuinely needs the
+caffe runtime, which is not installable here — it stays behind the
+availability gate, like the reference compiled without CAFFE_PATH.
 """
 from __future__ import annotations
 
+from ._caffe_proto import _aslist, apply_layer, parse_prototxt
 from .base import MXNetError
 
 __all__ = ["caffe_available", "CaffeOp", "CaffeLoss", "CaffeDataIter"]
 
 
 def caffe_available():
+    """True when the real caffe python runtime is importable (only
+    CaffeDataIter still requires it; CaffeOp/CaffeLoss do not)."""
     try:
         import caffe  # noqa: F401
 
@@ -30,28 +39,121 @@ def caffe_available():
         return False
 
 
-_MSG = (
-    "%s requires the caffe python package, which is not available in this "
-    "build (ref: plugin/caffe, gated on CAFFE_PATH). For whole caffe "
-    "NETWORKS use tools/caffe_converter.py: convert_model() reads "
-    ".prototxt AND .caffemodel (self-contained wire-format reader, no "
-    "pycaffe) and runs the graph through native ops. For single layers, "
-    "port to a native op, a CustomOp (mxnet_tpu.operator), or a "
-    "TorchModule (mxnet_tpu.torch)."
-)
+def _check_counts(what, **counts):
+    """Validate the reference's blob-count params (accepted for surface
+    parity only; native ops declare their own parameters)."""
+    for label_, v in counts.items():
+        if v is None:
+            continue
+        try:
+            n = int(v)
+        except (TypeError, ValueError):
+            raise MXNetError("%s: %s must be an integer, got %r"
+                             % (what, label_, v))
+        if n < 0:
+            raise MXNetError("%s: %s must be >= 0" % (what, label_))
 
 
-def CaffeOp(*args, **kwargs):
-    """ref: plugin/caffe/caffe_op-inl.h — run a caffe layer as an op."""
-    raise MXNetError(_MSG % "CaffeOp")
+def _single_layer(prototxt, what):
+    try:
+        net = parse_prototxt(prototxt)
+    except ValueError as exc:
+        raise MXNetError("%s: bad prototxt: %s" % (what, exc))
+    layers = _aslist(net.get("layer")) or _aslist(net.get("layers"))
+    if len(layers) != 1:
+        raise MXNetError(
+            "%s expects exactly one layer{...} in prototxt, got %d"
+            % (what, len(layers)))
+    return layers[0]
 
 
-def CaffeLoss(*args, **kwargs):
-    """ref: plugin/caffe/caffe_loss-inl.h — caffe criterion as a loss op."""
-    raise MXNetError(_MSG % "CaffeLoss")
+def CaffeOp(*data, prototxt=None, name=None, num_weight=None,
+            num_data=None, num_out=None, **kwargs):
+    """Run one caffe layer spec as an operator
+    (ref: plugin/caffe/caffe_op-inl.h; python surface
+    mx.symbol.CaffeOp(data_0=..., num_weight=..., prototxt=...)).
+
+    ``num_weight``/``num_data``/``num_out`` are accepted for surface
+    parity — the reference needs them to size caffe blobs; the native
+    ops declare their own parameters, so they are validated only for
+    being non-negative when given.
+    """
+    if prototxt is None:
+        raise MXNetError("CaffeOp requires prototxt=")
+    _check_counts("CaffeOp", num_weight=num_weight, num_data=num_data,
+                  num_out=num_out)
+    # either positional data OR data_0/data_1/... keywords — mixing the
+    # two would silently reorder (or drop) bottoms
+    idx = 0
+    keyed = []
+    while "data_%d" % idx in kwargs:
+        keyed.append(kwargs.pop("data_%d" % idx))
+        idx += 1
+    if kwargs:
+        raise MXNetError("CaffeOp: unknown arguments %s" % sorted(kwargs))
+    if data and keyed:
+        raise MXNetError(
+            "CaffeOp: pass inputs either positionally or as data_0..data_%d,"
+            " not both" % (idx - 1))
+    bottoms = list(data) or keyed
+    if not bottoms:
+        raise MXNetError("CaffeOp requires at least data_0")
+    layer = _single_layer(prototxt, "CaffeOp")
+    try:
+        out = apply_layer(layer, bottoms, name=name)
+    except NotImplementedError as exc:
+        raise MXNetError("CaffeOp: %s" % exc)
+    if out is None:
+        raise MXNetError(
+            "CaffeOp: layer type %r is a no-op" % layer.get("type"))
+    return out
+
+
+def CaffeLoss(data=None, label=None, grad_scale=1.0, prototxt=None,
+              name=None, num_data=None, num_out=None, **kwargs):
+    """Run a caffe criterion spec as a loss op
+    (ref: plugin/caffe/caffe_loss-inl.h; python surface
+    mx.symbol.CaffeLoss(data=..., label=..., grad_scale=...,
+    prototxt='layer{type:"SoftmaxWithLoss"}'); num_data/num_out are
+    blob-count parity params like CaffeOp's).
+    """
+    if prototxt is None:
+        prototxt = 'layer{type:"SoftmaxWithLoss"}'
+    if data is None:
+        raise MXNetError("CaffeLoss requires data=")
+    _check_counts("CaffeLoss", num_data=num_data, num_out=num_out)
+    if kwargs:
+        raise MXNetError("CaffeLoss: unknown arguments %s" % sorted(kwargs))
+    layer = _single_layer(prototxt, "CaffeLoss")
+    try:
+        out = apply_layer(layer, [data], name=name, label=label,
+                          grad_scale=float(grad_scale))
+    except NotImplementedError as exc:
+        raise MXNetError("CaffeLoss: %s" % exc)
+    if out is None:
+        raise MXNetError(
+            "CaffeLoss: layer type %r is a no-op" % layer.get("type"))
+    return out
 
 
 def CaffeDataIter(*args, **kwargs):
-    """ref: plugin/caffe/caffe_data_iter.cc — caffe data layer as a
-    DataIter."""
-    raise MXNetError(_MSG % "CaffeDataIter")
+    """ref: plugin/caffe/caffe_data_iter.cc — caffe's LMDB data layer as
+    a DataIter; needs the real caffe runtime."""
+    raise MXNetError(
+        "CaffeDataIter requires the caffe python package, which is not "
+        "available in this build (ref: plugin/caffe, gated on "
+        "CAFFE_PATH). Pack datasets with tools/im2rec.py and read them "
+        "with mx.io.ImageRecordIter instead.")
+
+
+def _install():
+    """Expose the ops where the reference puts them: mx.symbol.CaffeOp /
+    mx.symbol.CaffeLoss (the plugin registers them into the regular op
+    namespace, plugin/caffe/caffe_op.cc MXNET_REGISTER_OP_PROPERTY)."""
+    from . import symbol as _symbol
+
+    _symbol.CaffeOp = CaffeOp
+    _symbol.CaffeLoss = CaffeLoss
+
+
+_install()

@@ -1,0 +1,135 @@
+"""CaffeOp / CaffeLoss: caffe layer specs interpreted on native ops
+(ref: plugin/caffe/caffe_op-inl.h, caffe_loss-inl.h; surface
+mx.symbol.CaffeOp(data_0=..., num_weight=..., prototxt=...))."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _bind_forward(net, feeds, label=None):
+    shapes = {k: v.shape for k, v in feeds.items()}
+    if label is not None:
+        shapes["softmax_label"] = label.shape
+    exe = net.simple_bind(ctx=mx.cpu(), grad_req="null", **shapes)
+    for k, v in feeds.items():
+        exe.arg_dict[k][:] = v
+    if label is not None:
+        exe.arg_dict["softmax_label"][:] = label
+    exe.forward(is_train=False)
+    return exe
+
+
+def test_caffeop_matches_native_ops():
+    """An InnerProduct+TanH stack written as CaffeOps computes exactly
+    what the equivalent native FullyConnected+Activation stack does,
+    given the same parameters."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 20).astype(np.float32)
+    w1 = rng.rand(8, 20).astype(np.float32)
+    b1 = rng.rand(8).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    caffe_net = mx.sym.CaffeOp(
+        data_0=data, num_weight=2, name="fc1",
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 8} }')
+    caffe_net = mx.sym.CaffeOp(data_0=caffe_net,
+                               prototxt='layer{type:"TanH"}')
+
+    native = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=8,
+                              name="fc1"),
+        act_type="tanh")
+
+    feeds = {"data": x, "fc1_weight": w1, "fc1_bias": b1}
+    out_caffe = _bind_forward(caffe_net, feeds).outputs[0].asnumpy()
+    out_native = _bind_forward(native, feeds).outputs[0].asnumpy()
+    assert np.allclose(out_caffe, out_native, atol=1e-5)
+
+
+def test_caffeop_pooling_ceil_convention():
+    """caffe sizes pooled maps with ceil(): 5x5 under 2/2 MAX pooling
+    gives 3x3 (mxnet's default floor convention would give 2x2)."""
+    pool = mx.sym.CaffeOp(
+        data_0=mx.sym.Variable("x"),
+        prototxt='layer{type:"Pooling" pooling_param '
+                 '{ pool: MAX kernel_size: 2 stride: 2}}')
+    _, outs, _ = pool.infer_shape(x=(1, 3, 5, 5))
+    assert outs == [(1, 3, 3, 3)]
+
+
+def test_caffeloss_trains_and_scales_grad():
+    """CaffeLoss(SoftmaxWithLoss) is a working loss head and grad_scale
+    multiplies the seeded gradient (ref caffe_loss-inl.h grad_scale)."""
+    rng = np.random.RandomState(1)
+    x = rng.rand(6, 10).astype(np.float32)
+    y = rng.randint(0, 10, 6).astype(np.float32)
+
+    def grads(scale):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        net = mx.sym.CaffeLoss(data=data, label=label, grad_scale=scale,
+                               name="softmax",
+                               prototxt='layer{type:"SoftmaxWithLoss"}')
+        exe = net.simple_bind(ctx=mx.cpu(), data=(6, 10),
+                              softmax_label=(6,))
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["softmax_label"][:] = y
+        exe.forward(is_train=True)
+        exe.backward()
+        return exe.grad_dict["data"].asnumpy()
+
+    g1, g3 = grads(1.0), grads(3.0)
+    assert np.allclose(3.0 * g1, g3, atol=1e-5)
+
+
+def test_caffeop_anonymous_layers_do_not_collide():
+    """Two anonymous parameterized CaffeOps get distinct auto names
+    (the NameManager path), so binding sees no duplicate arguments."""
+    d = mx.sym.Variable("data")
+    a = mx.sym.CaffeOp(
+        data_0=d, num_weight=2,
+        prototxt='layer{type:"Convolution" convolution_param '
+                 '{ num_output: 4 kernel_size: 3} }')
+    b = mx.sym.CaffeOp(
+        data_0=a, num_weight=2,
+        prototxt='layer{type:"Convolution" convolution_param '
+                 '{ num_output: 4 kernel_size: 3} }')
+    args = b.list_arguments()
+    assert len(args) == len(set(args))
+    b.infer_shape(data=(1, 3, 12, 12))
+
+
+def test_caffe_plugin_errors():
+    d = mx.sym.Variable("data")
+    with pytest.raises(MXNetError, match="prototxt"):
+        mx.sym.CaffeOp(data_0=d)
+    with pytest.raises(MXNetError, match="exactly one layer"):
+        mx.sym.CaffeOp(data_0=d, prototxt='layer{type:"TanH"} '
+                                          'layer{type:"TanH"}')
+    with pytest.raises(MXNetError, match="BatchReindex"):
+        mx.sym.CaffeOp(data_0=d, prototxt='layer{type:"BatchReindex"}')
+    with pytest.raises(MXNetError, match="caffe"):
+        mx.caffe_plugin.CaffeDataIter()
+    with pytest.raises(MXNetError, match="unknown arguments"):
+        mx.sym.CaffeOp(data_0=d, bogus=1, prototxt='layer{type:"TanH"}')
+
+
+def test_caffeop_argument_hygiene():
+    """Mixing positional and keyword inputs is rejected (it would
+    silently reorder or drop bottoms), blob-count params accept the
+    reference surface on both ops, and non-integer counts raise the
+    module's MXNetError rather than a bare ValueError."""
+    d = mx.sym.Variable("data")
+    with pytest.raises(MXNetError, match="not both"):
+        mx.sym.CaffeOp(d, data_0=d, prototxt='layer{type:"TanH"}')
+    with pytest.raises(MXNetError, match="integer"):
+        mx.sym.CaffeOp(data_0=d, num_weight="a",
+                       prototxt='layer{type:"TanH"}')
+    # the reference's CaffeLoss signature carries num_data/num_out
+    lab = mx.sym.Variable("softmax_label")
+    net = mx.sym.CaffeLoss(data=d, label=lab, num_data=2, num_out=1,
+                           prototxt='layer{type:"SoftmaxWithLoss"}')
+    net.infer_shape(data=(2, 5), softmax_label=(2,))

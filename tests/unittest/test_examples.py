@@ -67,6 +67,9 @@ CASES = [
     # per-key executor binds at duplicated batch sizes, shared params;
     # accuracy assert stays ACTIVE in smoke mode
     ("image-classification/mnist_bucket.py", []),
+    # caffe layer specs interpreted on native ops (ref example/caffe):
+    # CaffeOp MLP + CaffeLoss head; accuracy assert ACTIVE in smoke mode
+    ("caffe/caffe_net.py", ["--network", "mlp", "--caffe-loss"]),
     ("python-howto/howto.py", []),
     ("speech-demo/acoustic_dnn.py", ["--epochs", "1"]),
     ("kaggle-ndsb1/end_to_end.py", ["--epochs", "1", "--per-class", "10"]),
