@@ -1,0 +1,67 @@
+"""Execute the python code blocks in the docs.
+
+The reference runs its documentation code through a doctest leg
+(tests/python/doctest/run.py, SURVEY §4.7) so examples cannot drift
+from the API; this is that gate for docs/tutorials and docs/how_to.
+
+Per file, every ```python fence is concatenated in order and executed
+in one namespace (later blocks may use earlier blocks' variables, as
+prose tutorials naturally do), under the suite's virtual 8-device CPU
+mesh and a temp cwd. A fence preceded (within five lines) by an HTML
+comment containing ``no-run`` is skipped — for blocks that genuinely
+need external data, a real cluster, or a TPU; the marker carries the
+reason so the exemption is reviewable in the doc source.
+"""
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DOC_DIRS = ["docs/tutorials", "docs/how_to"]
+
+
+def _collect():
+    files = []
+    for d in DOC_DIRS:
+        for dirpath, _, names in os.walk(os.path.join(ROOT, d)):
+            for n in sorted(names):
+                if n.endswith(".md"):
+                    files.append(os.path.relpath(
+                        os.path.join(dirpath, n), ROOT))
+    return sorted(files)
+
+
+def _blocks(text):
+    lines = text.split("\n")
+    out, i = [], 0
+    while i < len(lines):
+        if lines[i].strip() == "```python":
+            # the marker is an HTML comment whose FIRST line reads
+            # `<!-- no-run: reason` — prose mentioning "no-run" or a
+            # flag in a nearby block must not un-gate an example
+            skip = any("<!--" in lines[j] and "no-run" in lines[j]
+                       for j in range(max(0, i - 5), i))
+            j = i + 1
+            while j < len(lines) and lines[j].strip() != "```":
+                j += 1
+            if not skip:
+                # pad with blank lines so tracebacks point at the real
+                # line numbers in the .md file
+                out.append("\n" * (i + 1) + "\n".join(lines[i + 1:j]))
+            i = j + 1
+        else:
+            i += 1
+    return out
+
+
+@pytest.mark.parametrize("relpath", _collect())
+def test_doc_python_blocks(relpath, tmp_path, monkeypatch):
+    text = open(os.path.join(ROOT, relpath)).read()
+    blocks = _blocks(text)
+    if not blocks:
+        pytest.skip("no runnable python blocks")
+    monkeypatch.chdir(tmp_path)
+    ns = {"__name__": "__doc_example__"}
+    for block in blocks:
+        exec(compile(block, os.path.join(ROOT, relpath), "exec"), ns)
