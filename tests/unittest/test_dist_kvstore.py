@@ -77,12 +77,29 @@ def test_dist_lenet_2_workers():
 
 def test_dist_liveness_3_workers():
     """Heartbeat failure detection: a rank that stops beating is counted
-    dead by get_num_dead_node on every rank (ref ps-lite heartbeats)."""
-    r = _run_launch("dist_liveness.py", 3, 29424,
-                    extra_env={"MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.3"})
-    for rank in range(3):
-        assert ("rank %d/3: liveness OK" % rank) in r.stdout, \
-            r.stdout + r.stderr
+    dead by get_num_dead_node on every rank (ref ps-lite heartbeats).
+
+    One retry: the check is wall-clock heartbeat timing across three
+    processes, and an oversubscribed host can starve a rank long enough
+    to miss the staleness window (observed under parallel CI load); a
+    real liveness regression fails both attempts."""
+    last = None
+    for attempt in (0, 1):
+        try:
+            r = _run_launch(
+                "dist_liveness.py", 3, 29424,
+                extra_env={"MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.3"})
+        except AssertionError:
+            # a starved rank fails its in-child assert and the job exits
+            # nonzero — _run_launch raises; retry covers that mode too
+            if attempt:
+                raise
+            continue
+        if all(("rank %d/3: liveness OK" % rank) in r.stdout
+               for rank in range(3)):
+            return
+        last = r
+    assert False, (last.stdout + last.stderr) if last else "no output"
 
 
 def test_dist_async_kvstore_3_workers():

@@ -2,6 +2,7 @@
 arithmetically equivalent to the reference 7x7/s2/p3 stem under the
 weight fold (models/resnet.py fold_stem_weights)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.models.resnet import _s2d_stem, fold_stem_weights, get_resnet
@@ -73,3 +74,63 @@ def test_inception_bn_full_shapes():
     _, out21k, _ = net21k.infer_shape(data=(2, 3, 224, 224),
                                       softmax_label=(2,))
     assert out21k == [(2, 21841)]
+
+
+def test_transformer_ablation_knobs(monkeypatch):
+    """MXNET_LM_ABLATE ("ln", "ce") stubs model pieces for on-chip
+    time-attribution probes (docs/perf_analysis.md). The knobs must
+    leave a trainable program: finite loss and gradients under every
+    setting, and the default (off) numerically unchanged by the knob
+    machinery."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.models import transformer as tf
+
+    cfg = tf.TransformerConfig(vocab_size=64, num_layers=2, d_model=32,
+                               num_heads=2, d_ff=64, max_seq_len=32,
+                               dtype="float32")
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    batch = {"tokens": tokens}
+
+    def loss_and_grad():
+        f = tf.loss_fn(cfg)
+        loss, grads = jax.value_and_grad(f)(params, batch, None)
+        gnorm = sum(float(jnp.sum(jnp.abs(g)))
+                    for g in jax.tree_util.tree_leaves(grads))
+        return float(loss), gnorm
+
+    monkeypatch.delenv("MXNET_LM_ABLATE", raising=False)
+    base_loss, base_gnorm = loss_and_grad()
+    assert np.isfinite(base_loss) and base_gnorm > 0
+
+    for knob in ("ln", "ce", "ln,ce"):
+        monkeypatch.setenv("MXNET_LM_ABLATE", knob)
+        loss, gnorm = loss_and_grad()
+        assert np.isfinite(loss), knob
+        assert gnorm > 0, knob
+
+    # default path is byte-identical with the knob machinery present
+    monkeypatch.setenv("MXNET_LM_ABLATE", "")
+    loss_off, _ = loss_and_grad()
+    assert loss_off == base_loss
+
+
+def test_transformer_ablate_rejects_typos(monkeypatch):
+    """A typo'd MXNET_LM_ABLATE must raise, not silently no-op — the
+    knob's output is a recorded perf table. Comma-space style parses."""
+    import jax
+
+    from mxnet_tpu.models import transformer as tf
+
+    cfg = tf.TransformerConfig(vocab_size=32, num_layers=1, d_model=16,
+                               num_heads=2, d_ff=32, max_seq_len=16,
+                               dtype="float32")
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    batch = {"tokens": jax.numpy.zeros((1, 8), "int32")}
+    monkeypatch.setenv("MXNET_LM_ABLATE", "cn")
+    with pytest.raises(ValueError, match="cn"):
+        tf.loss_fn(cfg)(params, batch, None)
+    monkeypatch.setenv("MXNET_LM_ABLATE", "ln, ce")  # whitespace tolerated
+    assert np.isfinite(float(tf.loss_fn(cfg)(params, batch, None)))
