@@ -143,11 +143,13 @@ def tpu(device_id=0):
 
 
 def current_context():
-    """Default context (ref: python/mxnet/context.py:126)."""
+    """Default context (ref: python/mxnet/context.py:126). The bottom of
+    the stack is cpu(0) unless overridden by
+    ``test_utils.set_default_context`` (ref Context.default_ctx)."""
     stack = getattr(Context._default, "stack", None)
     if stack:
         return stack[-1]
-    return Context(1, 0)
+    return getattr(Context, "_default_bottom", None) or Context(1, 0)
 
 
 def num_devices(device_type="tpu"):

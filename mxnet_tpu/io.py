@@ -14,6 +14,8 @@ import struct
 import threading
 import queue as _queue
 
+import re as _re
+
 import numpy as _np
 
 from .base import MXNetError
@@ -36,6 +38,15 @@ class DataDesc:
         self.dtype = dtype
         self.layout = layout
 
+    @staticmethod
+    def get_list(shapes, types):
+        """DataDesc list from (name, shape) and optional (name, type)
+        attribute lists (ref: io.py:629)."""
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
     def __repr__(self):
         return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype, self.layout)
 
@@ -48,6 +59,40 @@ class DataDesc:
 
     def __len__(self):
         return 2
+
+
+class LayoutMapper:
+    """Decide which axis of a named tensor is the batch axis
+    (ref: python/mxnet/io.py:24). Subclass to override."""
+
+    def get_layout_string(self, name):
+        """Layout string (e.g. "NCHW") for ``name``, or None if unknown."""
+        raise NotImplementedError()
+
+    def get_batch_axis(self, name):
+        """Index of the batch dimension for ``name``."""
+        raise NotImplementedError()
+
+
+class DefaultLayoutMapper(LayoutMapper):
+    """Layout from a ``:__layout_X__`` tag in the name, else a fixed
+    default batch axis (ref: python/mxnet/io.py:59; the
+    rnn-time-major example relies on this convention)."""
+
+    LAYOUT_PATTERN = _re.compile(r":__layout_([^_*])__")
+
+    def __init__(self, default_batch_axis=0):
+        self._default_batch_axis = default_batch_axis
+
+    def get_layout_string(self, name):
+        ret = self.LAYOUT_PATTERN.search(name)
+        return None if ret is None else ret.group(1)
+
+    def get_batch_axis(self, name):
+        layout = self.get_layout_string(name)
+        if layout is None:
+            return self._default_batch_axis
+        return layout.find("N")  # -1 when N absent, as the reference
 
 
 class DataBatch:

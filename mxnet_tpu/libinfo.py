@@ -1,0 +1,27 @@
+"""Locate the framework's native library (ref: python/mxnet/libinfo.py).
+
+The reference's find_lib_path hunts for libmxnet.so; here the native
+component is the C-ABI library (``libc_api.so``, built on demand from
+src/c_api.cc) plus the prebuilt helpers next to the package.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path"]
+
+
+def find_lib_path():
+    """Candidate paths of the native C-ABI library, existing ones first
+    (ref: libinfo.py:8 find_lib_path). Unlike the reference, the python
+    package itself never loads this library — it exists FOR foreign
+    bindings (R/JVM/C++), so an empty result is not an error here."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(pkg_dir)
+    candidates = [
+        os.path.join(pkg_dir, "_native", "libc_api.so"),
+        os.path.join(repo, "build", "libc_api.so"),
+        os.path.join(repo, "src", "libc_api.so"),
+    ]
+    found = [p for p in candidates if os.path.exists(p)]
+    return found or candidates

@@ -12,7 +12,7 @@ from .ndarray import NDArray
 
 __all__ = [
     "EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity", "MAE", "MSE",
-    "RMSE", "CrossEntropy", "CustomMetric", "CompositeEvalMetric", "np", "create",
+    "RMSE", "CrossEntropy", "CustomMetric", "Torch", "Caffe", "CompositeEvalMetric", "np", "create",
 ]
 
 
@@ -298,6 +298,26 @@ class CrossEntropy(EvalMetric):
             prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
             self.sum_metric += (-_np.log(prob + self.eps)).sum()
             self.num_inst += label.shape[0]
+
+
+class Torch(EvalMetric):
+    """Dummy metric for torch criterion outputs (ref: metric.py:301):
+    each prediction IS an already-computed loss; accumulate its mean."""
+
+    def __init__(self, name="torch"):
+        super().__init__(name)
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += pred.asnumpy().mean()
+        self.num_inst += 1
+
+
+class Caffe(Torch):
+    """Dummy metric for caffe criterion outputs (ref: metric.py:311)."""
+
+    def __init__(self):
+        super().__init__("caffe")
 
 
 class CustomMetric(EvalMetric):

@@ -629,3 +629,58 @@ def minimum(lhs, rhs):
     if isinstance(lhs, numeric_types):
         return _minimum_scalar(rhs, scalar=float(lhs))  # noqa: F821
     return _minimum(lhs, rhs)  # noqa: F821
+
+
+# -- numpy-style module-level arithmetic (ref: python/mxnet/ndarray.py
+# add:714/subtract/multiply/divide/true_divide/negative/power) — thin
+# dispatchers over the same registry ops the operators use, accepting
+# NDArray|scalar on either side like the reference.
+
+
+def add(lhs, rhs):
+    """ref: ndarray.py:714."""
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        return lhs + rhs
+    return (lhs + rhs) if isinstance(lhs, NDArray) else (rhs + lhs)
+
+
+def subtract(lhs, rhs):
+    """ref: ndarray.py:736."""
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        return lhs - rhs
+    if isinstance(lhs, NDArray):
+        return lhs - rhs
+    return rhs.__rsub__(lhs)
+
+
+def multiply(lhs, rhs):
+    """ref: ndarray.py:758."""
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        return lhs * rhs
+    return (lhs * rhs) if isinstance(lhs, NDArray) else (rhs * lhs)
+
+
+def divide(lhs, rhs):
+    """ref: ndarray.py:780."""
+    if isinstance(lhs, numeric_types) and isinstance(rhs, numeric_types):
+        return lhs / rhs
+    if isinstance(lhs, NDArray):
+        return lhs / rhs
+    return rhs.__rtruediv__(lhs)
+
+
+true_divide = divide  # ref: ndarray.py:802
+
+
+def negative(arr):
+    """ref: ndarray.py:806 (-arr)."""
+    return multiply(arr, -1.0)
+
+
+def power(base, exp):
+    """ref: ndarray.py:power — elementwise base**exp."""
+    if isinstance(base, numeric_types) and isinstance(exp, numeric_types):
+        return base ** exp
+    if isinstance(base, NDArray):
+        return base ** exp
+    return exp.__rpow__(base)

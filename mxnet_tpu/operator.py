@@ -22,7 +22,8 @@ import numpy as _np
 from .base import InferShapeFatal, MXNetError
 from .ops.registry import Field, OpDef, register as _register_opdef
 
-__all__ = ["CustomOp", "CustomOpProp", "NumpyOp", "NDArrayOp", "register", "get_all_registered"]
+__all__ = ["CustomOp", "CustomOpProp", "NumpyOp", "NDArrayOp",
+           "PythonOp", "register", "get_all_registered"]
 
 _CUSTOM_REGISTRY = {}
 
@@ -469,6 +470,7 @@ class NumpyOp:
 
 
 NDArrayOp = NumpyOp  # same user surface; arrays arrive as host views
+PythonOp = NumpyOp  # the reference's shared base (operator.py:124)
 
 # reference NumpyOp instances are called directly to build the symbol
 # (example/numpy-ops/numpy_softmax.py: mysoftmax(data=fc3, name='softmax'))

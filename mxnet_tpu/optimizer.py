@@ -73,6 +73,11 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    def set_lr_scale(self, args_lrscale):
+        """Deprecated in the reference too (optimizer.py:126): use
+        set_lr_mult."""
+        raise DeprecationWarning("set_lr_scale is deprecated; use set_lr_mult")
+
     def set_lr_mult(self, args_lr_mult):
         """ref: optimizer.py:109 — reads __lr_mult__ attrs from self.sym."""
         self.lr_mult = {}

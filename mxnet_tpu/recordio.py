@@ -201,24 +201,32 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def __init__(self, idx_path, uri, flag, key_type=int):
         self.idx_path = idx_path
-        self.idx = {}
-        self.keys = []
+        self.idx = {}  # insertion-ordered: file order for readers
         self.key_type = key_type
         super().__init__(uri, flag)
         if not self.writable and os.path.isfile(idx_path):
             with open(idx_path) as fin:
                 for line in fin:
                     line = line.strip().split("\t")
-                    key = key_type(line[0])
-                    self.idx[key] = int(line[1])
-                    self.keys.append(key)
+                    self.idx[key_type(line[0])] = int(line[1])
 
     def close(self):
         if self.writable and self.is_open:
             with open(self.idx_path, "w") as fout:
-                for k in self.keys:
-                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+                for k, v in self.idx.items():
+                    fout.write("%s\t%d\n" % (str(k), v))
         super().close()
+
+    def keys(self):
+        """All keys, in index order (ref: recordio.py:167 keys())."""
+        return list(self.idx)
+
+    def reset(self):
+        """Writer: truncate record and index; reader: rewind
+        (ref: recordio.py:137)."""
+        if self.writable:
+            self.idx = {}
+        super().reset()
 
     def seek(self, idx):
         assert not self.writable
@@ -233,7 +241,6 @@ class MXIndexedRecordIO(MXRecordIO):
         key = self.key_type(idx)
         pos = self.tell()
         self.write(buf)
-        self.keys.append(key)
         self.idx[key] = pos
 
 

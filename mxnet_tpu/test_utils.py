@@ -11,9 +11,6 @@ from .context import cpu, Context
 from .ndarray import array, zeros, NDArray
 from .symbol import Symbol
 
-default_dtype = _np.float32
-
-
 def default_context():
     from .context import current_context
 
@@ -41,7 +38,7 @@ def assert_almost_equal(a, b, threshold=None):
 
 
 def random_arrays(*shapes):
-    arrays = [_np.random.randn(*s).astype(default_dtype) for s in shapes]
+    arrays = [_np.random.randn(*s).astype(default_dtype()) for s in shapes]
     if len(arrays) == 1:
         return arrays[0]
     return arrays
@@ -283,3 +280,102 @@ def check_consistency(sym, ctx_list, scale=1.0, type_dict=None, grad_req="write"
                     ref_grads[k].astype(_np.float64), t,
                 )
     return outputs
+
+
+def default_dtype():
+    """Default dtype for regression tests (ref: test_utils.py:27)."""
+    return _np.float32
+
+
+def default_numerical_threshold():
+    """Default comparison threshold (ref: test_utils.py:33)."""
+    return 1e-6
+
+
+def set_default_context(ctx):
+    """Make ``ctx`` the process default (ref: test_utils.py:23 sets
+    Context.default_ctx): the bottom of the with-scope stack, consulted
+    by current_context() whenever no `with ctx:` scope is active."""
+    from .context import Context
+
+    Context._default_bottom = ctx
+
+
+def almost_equal(a, b, threshold=None):
+    """True iff reldiff(a, b) <= threshold (ref: test_utils.py:110)."""
+    rel = reldiff(a, b)
+    return not _np.isnan(rel) and rel <= (threshold or
+                                          default_numerical_threshold())
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Reduce ``dat`` over ``axis`` with numpy semantics — the oracle the
+    reduction-op tests compare against (ref: test_utils.py:49)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Forward a symbol on numpy inputs, returning numpy outputs —
+    the doctest convenience (ref: test_utils.py:138)."""
+    from .ndarray import array
+
+    ctx = ctx or default_context()
+    args = {k: array(v, ctx=ctx) for k, v in inputs.items()}
+    exe = sym.bind(ctx, args=args)
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Average seconds per forward(+backward) over N runs
+    (ref: test_utils.py:537). typ='whole' times fwd+bwd, 'forward' only
+    the inference pass."""
+    import time as _time
+
+    from .ndarray import waitall
+
+    ctx = ctx or default_context()
+    grad_req = grad_req or "write"
+    if location is None:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx, **kwargs)
+        rng = _np.random.RandomState(17)
+        location = {k: rng.normal(size=arr.shape, scale=1.0)
+                    for k, arr in exe.arg_dict.items()}
+    else:
+        if not isinstance(location, dict):
+            raise TypeError("location must be a dict of name->np.ndarray")
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx,
+                              **{k: v.shape for k, v in location.items()})
+    for name, iarr in location.items():
+        exe.arg_dict[name][:] = iarr.astype(exe.arg_dict[name].dtype)
+
+    def run_once(train):
+        exe.forward(is_train=train)
+        if train:
+            exe.backward(out_grads=exe.outputs)
+        for output in exe.outputs:
+            output.wait_to_read()
+
+    if typ not in ("whole", "forward"):
+        raise ValueError("typ can only be whole or forward")
+    train = typ == "whole"
+    run_once(train)  # warm up / compile
+    tic = _time.time()
+    for _ in range(N):
+        run_once(train)
+    waitall()
+    return (_time.time() - tic) / N

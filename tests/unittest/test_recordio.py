@@ -102,7 +102,7 @@ def test_indexed_recordio(tmp_path):
     r = recordio.MXIndexedRecordIO(idx, path, "r")
     assert r.read_idx(13) == b"rec013"
     assert r.read_idx(2) == b"rec002"
-    assert r.keys == list(range(20))
+    assert r.keys() == list(range(20))  # ref keys() method
     r.close()
 
 

@@ -160,3 +160,62 @@ def test_dead_node_one_shot_and_no_flap():
         assert kv.get_num_dead_node(timeout=60) == 1
     finally:
         type(kv).num_workers = old
+
+
+def test_frontend_long_tail_parity():
+    """Small reference-API surfaces found by a function-level sweep of
+    python/mxnet vs this package (r5): module-level nd arithmetic,
+    Torch/Caffe dummy metrics, PythonOp alias, set_lr_scale deprecation,
+    LayoutMapper/DataDesc.get_list, indexed-recordio keys()/reset(),
+    test_utils oracles, libinfo.find_lib_path, misc scheduler aliases."""
+    import warnings
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import libinfo, test_utils as tu
+
+    a = mx.nd.array([[1.0, 2.0]])
+    assert np.allclose(mx.nd.add(1, a).asnumpy(), 1 + a.asnumpy())
+    assert np.allclose(mx.nd.true_divide(a, 2).asnumpy(), a.asnumpy() / 2)
+    assert np.allclose(mx.nd.negative(a).asnumpy(), -a.asnumpy())
+    assert np.allclose(mx.nd.power(2, a).asnumpy(), 2 ** a.asnumpy())
+
+    m = mx.metric.Torch()
+    m.update(None, [mx.nd.array([1.0, 3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+    assert mx.metric.Caffe().get()[0] == "caffe"
+
+    assert mx.operator.PythonOp is mx.operator.NumpyOp
+    import pytest
+
+    with pytest.raises(DeprecationWarning):
+        mx.optimizer.SGD().set_lr_scale({})
+
+    lm = mx.io.DefaultLayoutMapper()
+    assert lm.get_batch_axis("data") == 0
+    assert lm.get_layout_string("x:__layout_T__") == "T"
+    assert lm.get_batch_axis("x:__layout_T__") == -1
+    d = mx.io.DataDesc.get_list([("data", (2, 3))], [("data", np.float16)])
+    assert d[0].dtype == np.float16 and tuple(d[0].shape) == (2, 3)
+
+    assert tu.almost_equal(np.ones(3), np.ones(3) + 1e-9)
+    dat = np.arange(24.0).reshape(2, 3, 4)
+    assert np.allclose(tu.np_reduce(dat, (0, 2), True, np.sum),
+                       dat.sum(axis=(0, 2), keepdims=True))
+    relu = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+    assert np.allclose(
+        tu.simple_forward(relu, x=np.array([[-1.0, 2.0]], np.float32)),
+        [[0.0, 2.0]])
+
+    tu.set_default_context(mx.cpu(0))
+    assert mx.context.current_context() == mx.cpu(0)
+
+    assert libinfo.find_lib_path()  # candidate list, never empty
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        from mxnet_tpu import misc
+
+        sch = misc.FactorScheduler(step=2, factor=0.5)
+    sch.base_lr = 1.0
+    assert sch(0) <= 1.0
