@@ -79,7 +79,11 @@ class DefaultLayoutMapper(LayoutMapper):
     default batch axis (ref: python/mxnet/io.py:59; the
     rnn-time-major example relies on this convention)."""
 
-    LAYOUT_PATTERN = _re.compile(r":__layout_([^_*])__")
+    # NB: the reference's pattern (io.py:70, `([^_*])`) matches exactly
+    # ONE character, so its own documented multi-char tags (NCHW, TNC)
+    # can never match and always fall back to the default axis — an
+    # upstream bug, not a spec. Multi-char capture here.
+    LAYOUT_PATTERN = _re.compile(r":__layout_([^_]+?)__")
 
     def __init__(self, default_batch_axis=0):
         self._default_batch_axis = default_batch_axis

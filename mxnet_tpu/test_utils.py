@@ -329,8 +329,6 @@ def np_reduce(dat, axis, keepdims, numpy_reduce_func):
 def simple_forward(sym, ctx=None, is_train=False, **inputs):
     """Forward a symbol on numpy inputs, returning numpy outputs —
     the doctest convenience (ref: test_utils.py:138)."""
-    from .ndarray import array
-
     ctx = ctx or default_context()
     args = {k: array(v, ctx=ctx) for k, v in inputs.items()}
     exe = sym.bind(ctx, args=args)

@@ -1,0 +1,113 @@
+"""Shape-inference fuzzer: infer_shape vs the bound reality.
+
+Random small DAGs (chains with branches, residual adds, concats, a
+softmax head) are built from a mixed op set; for each graph the
+fixed-point inference (symbol._infer_shape_impl — the code path that
+also hosts the custom-op back-fill semantics) must agree exactly with
+what simple_bind allocates and what forward actually produces. The
+same spirit as the engine fuzz test (SURVEY §4.1): generated workloads
+checked against ground truth, seeds fixed for reproducibility.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _rand_graph(rng):
+    """Build (symbol, input_shape). Ops keep 4-D NCHW until a Flatten,
+    after which the graph is 2-D dense."""
+    n = int(rng.randint(1, 5))
+    c = int(rng.choice([1, 3, 4]))
+    hw = int(rng.choice([6, 8, 9]))
+    shape = (n, c, hw, hw)
+    x = sym.Variable("data")
+    is_4d = True
+    branches = []  # stashed same-shape tensors for residual/concat
+    cur_shape = shape  # tracked only for legality decisions, not values
+
+    depth = int(rng.randint(3, 9))
+    for i in range(depth):
+        choice = rng.rand()
+        if is_4d:
+            if choice < 0.25:
+                nf = int(rng.choice([2, 4, 6]))
+                x = sym.Convolution(x, kernel=(3, 3), pad=(1, 1),
+                                    num_filter=nf, name="conv%d" % i)
+                cur_shape = (cur_shape[0], nf) + cur_shape[2:]
+                branches = []
+            elif choice < 0.4:
+                x = sym.BatchNorm(x, name="bn%d" % i)
+            elif choice < 0.5:
+                x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                pool_type=str(rng.choice(["max", "avg"])),
+                                name="pool%d" % i)
+                cur_shape = cur_shape[:2] + (cur_shape[2] // 2,
+                                             cur_shape[3] // 2)
+                branches = []
+            elif choice < 0.6 and branches:
+                x = x + branches[int(rng.randint(len(branches)))]
+            elif choice < 0.7 and branches:
+                other = branches[int(rng.randint(len(branches)))]
+                x = sym.Concat(x, other, num_args=2, name="cc%d" % i)
+                cur_shape = (cur_shape[0], cur_shape[1] * 2) + cur_shape[2:]
+                branches = []
+            elif choice < 0.8:
+                x = sym.Activation(x, act_type=str(
+                    rng.choice(["relu", "tanh", "sigmoid"])))
+            else:
+                x = sym.Flatten(x, name="flat%d" % i)
+                cur_shape = (cur_shape[0],
+                             int(np.prod(cur_shape[1:])))
+                is_4d = False
+                branches = []
+        else:
+            if choice < 0.5:
+                nh = int(rng.choice([4, 8, 10]))
+                x = sym.FullyConnected(x, num_hidden=nh, name="fc%d" % i)
+                cur_shape = (cur_shape[0], nh)
+                branches = []
+            elif choice < 0.65 and branches:
+                x = x + branches[int(rng.randint(len(branches)))]
+            elif choice < 0.8:
+                x = sym.Activation(x, act_type="relu")
+            else:
+                x = sym.Dropout(x, p=0.3, name="drop%d" % i)
+        branches.append(x)
+
+    if is_4d:
+        x = sym.Flatten(x)
+    head = sym.SoftmaxOutput(
+        sym.FullyConnected(x, num_hidden=5, name="fc_out"), name="softmax")
+    return head, shape
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_infer_shape_matches_bound_executor(seed):
+    rng = np.random.RandomState(seed)
+    net, in_shape = _rand_graph(rng)
+    label_shape = (in_shape[0],)
+
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=in_shape, softmax_label=label_shape)
+    assert all(s is not None for s in arg_shapes + out_shapes + aux_shapes)
+
+    exe = net.simple_bind(ctx=mx.cpu(), data=in_shape,
+                          softmax_label=label_shape)
+    # every allocated arg/aux matches the inferred fixed point
+    for name, s in zip(net.list_arguments(), arg_shapes):
+        assert exe.arg_dict[name].shape == tuple(s), (seed, name)
+    for name, s in zip(net.list_auxiliary_states(), aux_shapes):
+        assert exe.aux_dict[name].shape == tuple(s), (seed, name)
+
+    # and the executed forward produces exactly the inferred outputs
+    exe.arg_dict["data"][:] = rng.rand(*in_shape).astype(np.float32)
+    for name in net.list_arguments():
+        if name not in ("data", "softmax_label") and name.endswith("weight"):
+            exe.arg_dict[name][:] = rng.rand(
+                *exe.arg_dict[name].shape).astype(np.float32) * 0.1
+    exe.forward(is_train=False)
+    for out, s in zip(exe.outputs, out_shapes):
+        assert out.shape == tuple(s), seed
+        assert np.isfinite(out.asnumpy()).all(), seed

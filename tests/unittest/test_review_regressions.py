@@ -196,6 +196,11 @@ def test_frontend_long_tail_parity():
     assert lm.get_batch_axis("data") == 0
     assert lm.get_layout_string("x:__layout_T__") == "T"
     assert lm.get_batch_axis("x:__layout_T__") == -1
+    # multi-char tags (the reference's own single-char pattern could
+    # never match these — fixed here): TNC is time-major, batch axis 1
+    assert lm.get_layout_string("x:__layout_TNC__") == "TNC"
+    assert lm.get_batch_axis("x:__layout_TNC__") == 1
+    assert lm.get_batch_axis("img:__layout_NCHW__") == 0
     d = mx.io.DataDesc.get_list([("data", (2, 3))], [("data", np.float16)])
     assert d[0].dtype == np.float16 and tuple(d[0].shape) == (2, 3)
 
