@@ -21,7 +21,17 @@ from __future__ import annotations
 
 import re
 
-__all__ = ["parse_prototxt", "apply_layer", "convert_symbol"]
+from .base import MXNetError
+
+__all__ = ["ProtoParseError", "parse_prototxt", "apply_layer",
+           "convert_symbol"]
+
+
+class ProtoParseError(MXNetError, ValueError):
+    """Malformed prototxt (truncation, stray braces, bad tokens, missing
+    required fields). Subclasses both MXNetError (the framework error
+    contract) and ValueError (the historical parse-error type), so either
+    catch handles every malformed-spec path uniformly."""
 
 # -- minimal protobuf text-format parser --------------------------------------
 
@@ -42,14 +52,15 @@ def _tokenize(text):
             continue
         m = _TOKEN.match(text, pos)
         if m is None:
-            raise ValueError("prototxt parse error at %r" % text[pos:pos + 30])
+            raise ProtoParseError("prototxt parse error at %r" % text[pos:pos + 30])
         pos = m.end()
         yield m
 
 
-def _parse_block(tokens):
-    """Parse `key: value` / `key { ... }` pairs until '}' or EOF into a
-    dict; repeated keys accumulate into lists."""
+def _parse_block(tokens, top=False):
+    """Parse `key: value` / `key { ... }` pairs until '}' (or, for the
+    top-level block only, EOF) into a dict; repeated keys accumulate into
+    lists. A nested block running out of tokens is a truncated prototxt."""
     out = {}
 
     def add(key, val):
@@ -62,11 +73,21 @@ def _parse_block(tokens):
 
     for m in tokens:
         if m.group("brace") == "}":
+            if top:
+                # an unmatched top-level '}' would otherwise silently
+                # drop every layer after it
+                raise ProtoParseError("unmatched '}' at top level of prototxt")
             return out
         key = m.group("name")
         if key is None:
-            raise ValueError("expected field name, got %r" % m.group(0))
-        nxt = next(tokens)
+            raise ProtoParseError("expected field name, got %r" % m.group(0))
+        try:
+            nxt = next(tokens)
+        except StopIteration:
+            # a truncated prototxt must fail loudly, not leak a bare
+            # StopIteration out of the generator protocol (ADVICE r5)
+            raise ProtoParseError(
+                "unexpected end of prototxt after field %r" % key) from None
         if nxt.group("brace") == "{":
             add(key, _parse_block(tokens))
         elif nxt.group("string") is not None:
@@ -78,12 +99,14 @@ def _parse_block(tokens):
             v = nxt.group("name")
             add(key, {"true": True, "false": False}.get(v, v))
         else:
-            raise ValueError("unexpected token %r after %s" % (nxt.group(0), key))
+            raise ProtoParseError("unexpected token %r after %s" % (nxt.group(0), key))
+    if not top:
+        raise ProtoParseError("unexpected end of prototxt: unclosed block")
     return out
 
 
 def parse_prototxt(text):
-    return _parse_block(_tokenize(text))
+    return _parse_block(_tokenize(text), top=True)
 
 
 # -- layer mapping ------------------------------------------------------------
@@ -121,10 +144,10 @@ def _hw(p, field, default=None, required=False):
     h, w = p.get(field + "_h"), p.get(field + "_w")
     if h is not None or w is not None:
         if h is None or w is None:
-            raise ValueError("%s_h/%s_w must be given together" % (field, field))
+            raise ProtoParseError("%s_h/%s_w must be given together" % (field, field))
         return (int(h), int(w))
     if required:
-        raise ValueError("missing %s in %r" % (square, sorted(p)))
+        raise ProtoParseError("missing %s in %r" % (square, sorted(p)))
     return (int(default), int(default))
 
 
@@ -167,7 +190,10 @@ def apply_layer(layer, bottoms, name=None, label=None, grad_scale=1.0):
         return mx.sym.Pooling(
             data=data, name=name,
             pool_type=pool_modes[mode],
-            kernel=(_hw(p, "kernel", default=1)
+            # non-global pooling with no kernel spec is a broken prototxt:
+            # caffe requires kernel_size/kernel_h+w, and silently pooling
+            # with a (1, 1) kernel is a no-op that trains wrong (ADVICE r5)
+            kernel=(_hw(p, "kernel", required=True)
                     if not global_pool else (1, 1)),
             stride=_hw(p, "stride", default=1),
             pad=_hw(p, "pad", default=0),
@@ -206,7 +232,7 @@ def apply_layer(layer, bottoms, name=None, label=None, grad_scale=1.0):
         coeffs = [float(c) for c in _aslist(ep.get("coeff"))]
         if coeffs and op in ("SUM", "1"):
             if len(coeffs) != len(bottoms):
-                raise ValueError(
+                raise ProtoParseError(
                     "Eltwise %s: %d coeffs for %d bottoms"
                     % (name, len(coeffs), len(bottoms)))
             terms = [b * c for b, c in zip(bottoms, coeffs)]
@@ -269,7 +295,7 @@ def convert_symbol(prototxt_text):
                          "Accuracy", "Silence"):
             missing = [b for b in bottom_names if b not in outputs]
             if missing:
-                raise ValueError(
+                raise ProtoParseError(
                     "layer %r: unknown bottom blob(s) %s — not produced by "
                     "any earlier layer or input" % (name, missing))
         bottoms = [outputs[b] for b in bottom_names if b in outputs]
@@ -290,5 +316,5 @@ def convert_symbol(prototxt_text):
             outputs[t] = sym
 
     if sym is None:
-        raise ValueError("prototxt contains no layers and no input")
+        raise ProtoParseError("prototxt contains no layers and no input")
     return sym, input_name, input_dim

@@ -1,0 +1,33 @@
+"""mxlint: static-analysis subsystem over the framework's three IRs.
+
+Three coordinated passes, one Finding model (findings.py):
+
+- ``graph_lint``    — compiler-style checks over the Symbol DAG
+  (dtype edges, grad_req discipline, dead JSON nodes, TPU 128-lane
+  padding waste).
+- ``engine_verify`` — record/verify the dependency engine's
+  read/write-var discipline (hazards, use-after-free, wait-cycles).
+  Live recording hooks live in ``mxnet_tpu/engine.py`` behind
+  ``MXNET_ENGINE_VERIFY=1``.
+- ``ast_lint``      — tracer-leak lint over jitted op bodies
+  (np-on-tracer, tracer branches, host syncs).
+
+CLI: ``tools/mxlint.py`` / the ``mxlint`` console script (cli.py).
+
+This package imports neither jax nor the compute stack at module level:
+the engine attaches a trace recorder during early interpreter states,
+and CI wants the AST pass runnable without devices.
+"""
+from __future__ import annotations
+
+from .findings import Finding, max_severity, summarize
+from .engine_verify import EngineTrace, recording, verify as verify_trace
+from .ast_lint import lint_file, lint_package, lint_source
+from .graph_lint import lint_json, lint_symbol
+
+__all__ = [
+    "Finding", "max_severity", "summarize",
+    "EngineTrace", "recording", "verify_trace",
+    "lint_file", "lint_package", "lint_source",
+    "lint_json", "lint_symbol",
+]

@@ -1,0 +1,290 @@
+"""Tracer-leak lint: find host-side impurities inside jitted op bodies.
+
+Every registered op's ``forward`` runs under ``jax.jit`` tracing (the
+Executor compiles the whole graph into one XLA program). Three bug
+classes silently break that contract and de-jit hot paths:
+
+- ``np-on-tracer`` — calling ``np.*`` (or ``math.*``) on a traced
+  value. NumPy eagerly materializes the tracer via ``__array__``,
+  forcing a host round-trip per call — or crashes under jit.
+- ``tracer-branch`` — a Python ``if``/``while``/``assert`` whose test
+  depends on a traced value: jit raises TracerBoolConversionError, or
+  worse, the branch freezes to the tracing-time value.
+- ``host-sync`` — ``float(x)`` / ``int(x)`` / ``bool(x)`` /
+  ``x.item()`` / ``x.tolist()`` on a traced value: a blocking
+  device->host sync inside the compiled region.
+
+The pass is a static AST walk with a small taint analysis — no import,
+no execution, so it also lints fixture files that must never pollute
+the live op registry. Taint seeds are the ``inputs``/``aux``/``rng``
+parameters of functions identified as jitted op bodies:
+
+- the ``forward`` argument of any ``OpDef(...)`` call (positional or
+  keyword) — unless that OpDef also declares ``host_apply``, which
+  marks a host op the executor deliberately runs eagerly;
+- callables handed to ``simple_unary``/``simple_binary``/``scalar_op``;
+- any function literally named ``forward`` (the registry factories).
+
+Static metadata access (``.shape``, ``.dtype``, ``.ndim``, ``len()``,
+``x is None``) escapes taint: those are concrete at trace time, and the
+ops package legitimately builds ``np``-side constants from them.
+
+A line ending in ``# mxlint: disable`` suppresses findings on it.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_package"]
+
+# attribute reads that yield trace-time-static metadata, not tracers
+_ESCAPE_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "weak_type"}
+# calls whose results are static regardless of argument taint
+_PRUNE_CALLS = {"len", "isinstance", "type", "id", "repr", "str"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+_FACTORY_FUNCS = {"simple_unary", "simple_binary", "scalar_op"}
+_HOST_MODULES = {"numpy", "math"}
+_PRAGMA = "mxlint: disable"
+
+
+def _host_aliases(tree):
+    """Names bound to numpy/math in this module: 'np', '_np', 'math', and
+    any ``from numpy import x`` members."""
+    aliases, members = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] in _HOST_MODULES:
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] in _HOST_MODULES:
+                for a in node.names:
+                    members.add(a.asname or a.name)
+    return aliases, members
+
+
+def _attr_root(expr):
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _resolve_forward(expr, funcdefs):
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Name):
+        return funcdefs.get(expr.id)
+    return None
+
+
+def _jit_roots(tree):
+    """(function node, seed param names) pairs for every jitted op body."""
+    funcdefs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcdefs.setdefault(node.name, node)
+    roots = {}
+
+    def add(fn):
+        if fn is None or id(fn) in roots:
+            return
+        args = [a.arg for a in fn.args.args]
+        if len(args) >= 3 and args[0] == "params":
+            # the OpDef forward contract: (params, inputs, aux, is_train, rng)
+            seeds = set(args[1:3]) | set(args[4:5])
+        else:
+            seeds = set(args)  # bare kernel callable: every arg is traced
+        roots[id(fn)] = (fn, seeds)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = node.func.id if isinstance(node.func, ast.Name) else None
+        if fname == "OpDef":
+            if any(kw.arg == "host_apply" for kw in node.keywords):
+                continue  # host op: runs eagerly between jitted segments
+            fwd = node.args[1] if len(node.args) > 1 else None
+            if fwd is None:
+                for kw in node.keywords:
+                    if kw.arg == "forward":
+                        fwd = kw.value
+            add(_resolve_forward(fwd, funcdefs))
+        elif fname in _FACTORY_FUNCS and len(node.args) > 1:
+            add(_resolve_forward(node.args[1], funcdefs))
+    for name, fn in funcdefs.items():
+        if name == "forward":
+            add(fn)
+    return list(roots.values())
+
+
+class _Taint:
+    """Name-level taint over one function body (nested defs included)."""
+
+    def __init__(self, seeds):
+        self.names = set(seeds)
+
+    def expr(self, e):
+        """Whether ``e`` may evaluate to (or contain) a traced value."""
+        if e is None:
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.names
+        if isinstance(e, ast.Attribute):
+            if e.attr in _ESCAPE_ATTRS:
+                return False
+            return self.expr(e.value)
+        if isinstance(e, ast.Call):
+            if isinstance(e.func, ast.Name) and e.func.id in _PRUNE_CALLS:
+                return False
+            return (self.expr(e.func)
+                    or any(self.expr(a) for a in e.args)
+                    or any(self.expr(kw.value) for kw in e.keywords))
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False  # identity tests are host-legal on tracers
+            return self.expr(e.left) or any(self.expr(c) for c in e.comparators)
+        if isinstance(e, ast.Lambda):
+            return False  # defining a lambda evaluates nothing
+        if isinstance(e, ast.Starred):
+            return self.expr(e.value)
+        return any(self.expr(c) for c in ast.iter_child_nodes(e)
+                   if isinstance(c, ast.expr))
+
+    def _add_target(self, t):
+        if isinstance(t, ast.Name):
+            if t.id not in self.names:
+                self.names.add(t.id)
+                return True
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            return any([self._add_target(e) for e in t.elts])
+        elif isinstance(t, ast.Starred):
+            return self._add_target(t.value)
+        return False
+
+    def propagate(self, fn):
+        for _ in range(10):  # fixed point over out-of-order definitions
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and self.expr(node.value):
+                    changed |= any([self._add_target(t) for t in node.targets])
+                elif isinstance(node, ast.AnnAssign) and self.expr(node.value):
+                    changed |= self._add_target(node.target)
+                elif isinstance(node, ast.AugAssign) and self.expr(node.value):
+                    changed |= self._add_target(node.target)
+                elif isinstance(node, ast.NamedExpr) and self.expr(node.value):
+                    changed |= self._add_target(node.target)
+                elif isinstance(node, ast.For) and self.expr(node.iter):
+                    it = node.iter
+                    if (isinstance(it, ast.Call)
+                            and isinstance(it.func, ast.Name)
+                            and it.func.id == "enumerate"
+                            and isinstance(node.target, ast.Tuple)
+                            and len(node.target.elts) == 2):
+                        # enumerate index is a static Python int; only the
+                        # yielded element carries taint
+                        changed |= self._add_target(node.target.elts[1])
+                    else:
+                        changed |= self._add_target(node.target)
+            if not changed:
+                return
+
+
+def _lint_function(fn, seeds, aliases, members, filename, src_lines):
+    taint = _Taint(seeds)
+    taint.propagate(fn)
+    findings = []
+
+    def suppressed(node):
+        line = src_lines[node.lineno - 1] if node.lineno <= len(src_lines) else ""
+        return _PRAGMA in line
+
+    def report(node, code, message):
+        if suppressed(node):
+            return
+        findings.append(Finding(
+            "tracer", code, "error",
+            "%s:%d" % (filename, node.lineno), message))
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            if taint.expr(node.test):
+                kind = {"If": "if", "While": "while",
+                        "IfExp": "conditional expression"}[type(node).__name__]
+            else:
+                continue
+            report(node, "tracer-branch",
+                   "Python %s branches on a traced value: jit raises "
+                   "TracerBoolConversionError or freezes the branch at trace "
+                   "time — use jnp.where / lax.cond" % kind)
+        elif isinstance(node, ast.Assert):
+            if taint.expr(node.test):
+                report(node, "tracer-branch",
+                       "assert on a traced value forces a host sync under "
+                       "jit — use checkify or assert on static metadata")
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                               ast.DictComp)):
+            for gen in node.generators:
+                for cond in gen.ifs:
+                    if taint.expr(cond):
+                        report(node, "tracer-branch",
+                               "comprehension filter on a traced value")
+        elif isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_CASTS
+                    and any(taint.expr(a) for a in node.args)):
+                report(node, "host-sync",
+                       "%s() on a traced value is a blocking device->host "
+                       "sync (ConcretizationTypeError under jit)"
+                       % node.func.id)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_METHODS
+                    and taint.expr(node.func.value)):
+                report(node, "host-sync",
+                       ".%s() on a traced value is a blocking device->host "
+                       "sync" % node.func.attr)
+            else:
+                root = _attr_root(node.func) if isinstance(
+                    node.func, ast.Attribute) else None
+                is_host = (root in aliases) or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in members)
+                if is_host and (any(taint.expr(a) for a in node.args)
+                                or any(taint.expr(kw.value)
+                                       for kw in node.keywords)):
+                    report(node, "np-on-tracer",
+                           "host numpy/math call on a traced value "
+                           "materializes the tracer (silent de-jit) — use "
+                           "the jnp equivalent")
+    return findings
+
+
+def lint_source(src, filename="<string>"):
+    tree = ast.parse(src, filename=filename)
+    aliases, members = _host_aliases(tree)
+    src_lines = src.splitlines()
+    findings = []
+    for fn, seeds in _jit_roots(tree):
+        findings.extend(
+            _lint_function(fn, seeds, aliases, members, filename, src_lines))
+    return findings
+
+
+def lint_file(path):
+    with open(path, "r") as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_package(path):
+    """Lint every .py under ``path`` (a directory) or the single file."""
+    if os.path.isfile(path):
+        return lint_file(path)
+    findings = []
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, fname)))
+    return findings

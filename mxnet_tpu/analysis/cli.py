@@ -1,0 +1,197 @@
+"""mxlint CLI: run the three analysis passes from the command line.
+
+Entry points: ``tools/mxlint.py`` (repo checkout) and the ``mxlint``
+console script (pyproject). Typical invocations::
+
+    mxlint --all                      # model zoo + ops package + engine
+    mxlint --model mlp                # one zoo symbol
+    mxlint --graph net.json           # a serialized symbol (dead nodes too)
+    mxlint --ops mxnet_tpu/ops        # tracer-leak lint a file or package
+    mxlint --engine-trace trace.json  # verify a recorded engine trace
+    mxlint --all --fail-on warning    # strict mode: warnings also fail
+
+Exit codes: 0 clean (no finding at/above --fail-on), 1 findings,
+2 usage or load errors.
+
+The linter is static: it must never touch an accelerator, so it pins
+JAX_PLATFORMS=cpu for the symbol builders (override: MXLINT_PLATFORM).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .findings import SEVERITIES, summarize
+
+__all__ = ["main", "zoo_models"]
+
+
+def zoo_models():
+    """name -> nullary symbol builder for every lintable zoo model.
+    (transformer is jax-native — no symbol graph to lint.)"""
+    from .. import models
+
+    return {
+        "mlp": models.get_mlp,
+        "lenet": models.get_lenet,
+        "resnet_small": lambda: models.get_resnet_small(n=1),
+        "inception_bn_small": models.get_inception_bn_small,
+        "alexnet": models.get_alexnet,
+        "googlenet": models.get_googlenet,
+        "vgg": models.get_vgg,
+        "unet": models.get_unet,
+        "lstm": lambda: models.lstm_unroll(1, 4, 64, 256, 128, 64),
+        "gru": lambda: models.gru_unroll(1, 4, 64, 256, 128, 64),
+        "rnn": lambda: models.rnn_unroll(1, 4, 64, 256, 128, 64),
+    }
+
+
+def _engine_selftest():
+    """Record a small live workload through the real engine hooks and
+    verify it — proves the record path end-to-end without a device."""
+    from .. import engine as eng
+    from .engine_verify import recording, verify
+
+    e = eng.Engine(engine_type="NaiveEngine")
+    try:
+        with recording(e) as trace:
+            hvars = [e.new_variable() for _ in range(4)]
+            sink = []
+            for i in range(8):
+                e.push(lambda i=i: sink.append(i),
+                       const_vars=[hvars[i % 2]],
+                       mutable_vars=[hvars[2 + i % 2]])
+            e.wait_for_all()
+            e.delete_variable(hvars[0])
+        return verify(trace)
+    finally:
+        e.close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="mxlint",
+        description="Static analysis for mxnet_tpu: symbol-graph lint, "
+                    "engine hazard verification, tracer-leak lint.")
+    p.add_argument("--all", action="store_true",
+                   help="lint the model zoo, the ops package, and run the "
+                        "engine record/verify selftest")
+    p.add_argument("--model", action="append", default=[],
+                   help="lint a model-zoo symbol by name (repeatable)")
+    p.add_argument("--graph", action="append", default=[],
+                   help="lint a serialized symbol JSON file (repeatable)")
+    p.add_argument("--ops", action="append", default=[],
+                   help="tracer-leak lint a .py file or package dir")
+    p.add_argument("--engine-trace", action="append", default=[],
+                   help="verify a recorded engine trace JSON file")
+    p.add_argument("--fail-on", choices=list(SEVERITIES), default="error",
+                   help="lowest severity that makes the exit code nonzero "
+                        "(default: error)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as a JSON array")
+    p.add_argument("--list-models", action="store_true",
+                   help="list lintable zoo model names and exit")
+    args = p.parse_args(argv)
+
+    # static analysis must not grab the chip (or pay TPU init latency)
+    os.environ["JAX_PLATFORMS"] = os.environ.get("MXLINT_PLATFORM", "cpu")
+
+    if args.list_models:
+        for name in sorted(zoo_models()):
+            print(name)
+        return 0
+    if not (args.all or args.model or args.graph or args.ops
+            or args.engine_trace):
+        p.print_usage(sys.stderr)
+        print("mxlint: nothing to do (try --all)", file=sys.stderr)
+        return 2
+
+    findings, n_targets = [], 0
+
+    graph_files = list(args.graph)
+    trace_files = list(args.engine_trace)
+    ops_paths = list(args.ops)
+    model_names = list(args.model)
+    run_selftest = False
+    if args.all:
+        model_names.extend(sorted(zoo_models()))
+        from .. import ops as _ops_pkg
+
+        ops_paths.append(os.path.dirname(os.path.abspath(_ops_pkg.__file__)))
+        run_selftest = True
+
+    def _load_error(path, e):
+        print("mxlint: %s: %s: %s" % (path, type(e).__name__, e),
+              file=sys.stderr)
+        return 2
+
+    # only per-input load/parse errors map to the documented exit code
+    # 2 (each pass declares them: OSError/ValueError for graphs and
+    # traces, OSError/SyntaxError for .py sources). Any other exception
+    # is a linter bug and must crash with its traceback, not be
+    # misreported as a bad input file — zoo building and the model lint
+    # run outside any except for the same reason.
+    zoo = zoo_models() if model_names else {}
+    for name in model_names:
+        if name not in zoo:
+            print("mxlint: unknown model %r (see --list-models)" % name,
+                  file=sys.stderr)
+            return 2
+        from .graph_lint import lint_symbol
+
+        findings.extend(lint_symbol(zoo[name]()))
+        n_targets += 1
+    for path in graph_files:
+        from .graph_lint import lint_json
+
+        try:
+            with open(path, "r") as f:
+                findings.extend(lint_json(f.read()))
+        except (OSError, ValueError) as e:
+            # ValueError: bad JSON text or bad graph structure —
+            # lint_json validates the input upfront and raises
+            # ValueError for both, so anything else escaping here is a
+            # linter bug and crashes with its traceback
+            return _load_error(path, e)
+        n_targets += 1
+    for path in ops_paths:
+        from .ast_lint import lint_package
+
+        try:
+            findings.extend(lint_package(path))
+        except (OSError, SyntaxError) as e:  # unreadable / unparsable .py
+            return _load_error(path, e)
+        n_targets += 1
+    for path in trace_files:
+        from .engine_verify import EngineTrace, verify
+
+        try:
+            with open(path, "r") as f:
+                trace = EngineTrace.from_json(f.read())
+        except (OSError, ValueError) as e:
+            return _load_error(path, e)
+        findings.extend(verify(trace))
+        n_targets += 1
+    if run_selftest:
+        findings.extend(_engine_selftest())
+        n_targets += 1
+
+    findings.sort(key=lambda f: (f.severity != "error", f.pass_name, f.where))
+    if args.json:
+        import json as _json
+
+        print(_json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print("mxlint: checked %d target(s): %s"
+              % (n_targets, summarize(findings)))
+
+    threshold = SEVERITIES.index(args.fail_on)
+    bad = any(SEVERITIES.index(f.severity) >= threshold for f in findings)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

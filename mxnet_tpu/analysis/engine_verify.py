@@ -1,0 +1,319 @@
+"""Engine hazard detector: record push traces, verify the dependency
+discipline statically.
+
+The dependency engine (mxnet_tpu/engine.py, src/engine.cc) orders host
+tasks by read/write var sets: reads on a var run concurrently, a write
+waits for prior accesses to drain and runs alone. That discipline is
+only as good as the var sets the pushing code declares — a task that
+mutates a buffer it never declared races silently, and a WaitForVar
+issued from *inside* an engine op can deadlock the worker pool. The
+reference only ever fuzz-tested this at runtime
+(tests/cpp/threaded_engine_test.cc); here we record every push's
+read/write var sets and check the trace statically.
+
+Checks (all 'engine' pass):
+
+- ``use-after-free`` (error) — an op pushed, or a wait issued, after
+  ``delete_variable`` on one of its vars. Deferred deletion of vars
+  with *pending* ops is legal (ref: engine.h:148-160); touching the var
+  in a *later* push is not.
+- ``ww-hazard`` / ``rw-hazard`` (error) — two ops touch the same data
+  tag (at least one writing) with NO happens-before path between them
+  in the var-dependency graph: the scheduler is free to interleave
+  them. Data tags name what a task actually touches (buffers, files)
+  and come from the programmatic API — the engine's var sets alone
+  cannot reveal an undeclared write, which is exactly why this is a
+  lint and not a runtime assert.
+- ``wait-cycle`` (error) — a wait recorded inside engine op A on a var
+  whose pending ops include A itself or any op that (transitively)
+  depends on A: A waits on work that cannot start until A completes.
+  ``wait_for_all`` inside any engine op is an immediate cycle.
+
+Record mode is engaged by ``MXNET_ENGINE_VERIFY=1`` (the engine then
+self-verifies on every wait and raises on findings) or programmatically:
+
+    from mxnet_tpu.analysis import engine_verify
+    with engine_verify.recording(engine) as trace:
+        ... push work ...
+    findings = engine_verify.verify(trace)
+
+Synthetic traces can be built directly with the same ``EngineTrace``
+builder methods the engine hooks call, and round-trip through
+``to_json``/``from_json`` for the mxlint CLI (--engine-trace).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from contextlib import contextmanager
+
+from .findings import Finding
+
+__all__ = ["TraceOp", "EngineTrace", "verify", "recording"]
+
+
+class TraceOp:
+    """One recorded push."""
+
+    __slots__ = ("seq", "name", "const", "mutable", "reads_data", "writes_data")
+
+    def __init__(self, seq, name, const, mutable, reads_data=(), writes_data=()):
+        self.seq = seq
+        self.name = name
+        self.const = tuple(const)
+        self.mutable = tuple(mutable)
+        self.reads_data = tuple(reads_data)
+        self.writes_data = tuple(writes_data)
+
+    def vars(self):
+        return self.const + self.mutable
+
+    def label(self):
+        return "op#%d(%s)" % (self.seq, self.name)
+
+    def __repr__(self):
+        return "<TraceOp %s const=%s mutable=%s>" % (
+            self.label(), list(self.const), list(self.mutable))
+
+
+class EngineTrace:
+    """Append-only record of pushes / deletes / waits, with one shared
+    monotonic seq so the three streams interleave deterministically.
+    Thread-safe: the engine records from pushing threads and workers."""
+
+    def __init__(self):
+        self.events = []    # [TraceOp]
+        self.deletes = []   # [(seq, var)]
+        self.waits = []     # [(seq, var-or-None, ctx-op-seq-or-None)]
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tls = threading.local()
+        # live-verify progress, owned by the engine that records into
+        # this trace (kept here so detaching/re-attaching a trace — the
+        # recording() save/restore — carries its progress with it)
+        self.verify_seq = 0
+        self.verify_reported = set()
+
+    def _next_seq(self):
+        self._seq += 1
+        return self._seq
+
+    # -- builders (engine hooks AND synthetic-trace construction) -------------
+    def push(self, name, const=(), mutable=(), reads_data=(), writes_data=()):
+        with self._lock:
+            ev = TraceOp(self._next_seq(), name, const, mutable,
+                         reads_data, writes_data)
+            self.events.append(ev)
+        return ev
+
+    def discard(self, ev):
+        """Roll back a recorded push whose submission to the native
+        engine failed — the op never ran and must not contribute
+        happens-before edges."""
+        with self._lock:
+            try:
+                self.events.remove(ev)
+            except ValueError:
+                pass
+
+    def delete_var(self, var):
+        with self._lock:
+            self.deletes.append((self._next_seq(), var))
+
+    def wait(self, var=None, inside=None):
+        """Record wait_for_var (or wait_for_all when var is None).
+        ``inside`` is the TraceOp (or seq) of the engine op the wait was
+        issued from; defaults to the recorded thread context."""
+        if inside is None:
+            inside = self.current_op()
+        ctx = inside.seq if isinstance(inside, TraceOp) else inside
+        with self._lock:
+            self.waits.append((self._next_seq(), var, ctx))
+
+    # -- executing-op context (set by the engine around fn execution) ----------
+    @contextmanager
+    def op_context(self, op):
+        prev = getattr(self._tls, "op", None)
+        self._tls.op = op
+        try:
+            yield
+        finally:
+            self._tls.op = prev
+
+    def current_op(self):
+        return getattr(self._tls, "op", None)
+
+    # -- serialization ---------------------------------------------------------
+    def to_json(self):
+        return json.dumps({
+            "events": [{
+                "seq": e.seq, "name": e.name,
+                "const": list(e.const), "mutable": list(e.mutable),
+                "reads_data": list(e.reads_data),
+                "writes_data": list(e.writes_data),
+            } for e in self.events],
+            "deletes": [[s, v] for s, v in self.deletes],
+            "waits": [[s, v, c] for s, v, c in self.waits],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, json_str):
+        """Raises ValueError on malformed input (bad JSON text or bad
+        trace structure) — the CLI's load-error contract."""
+        data = json.loads(json_str)
+        t = cls()
+        try:
+            for je in data.get("events", []):
+                ev = TraceOp(int(je["seq"]), je.get("name", "fn"),
+                             je.get("const", ()), je.get("mutable", ()),
+                             je.get("reads_data", ()), je.get("writes_data", ()))
+                t.events.append(ev)
+                t._seq = max(t._seq, ev.seq)
+            for s, v in data.get("deletes", []):
+                t.deletes.append((int(s), v))
+                t._seq = max(t._seq, int(s))
+            for w in data.get("waits", []):
+                s, v, c = (list(w) + [None, None])[:3]
+                t.waits.append((int(s), v, c))
+                t._seq = max(t._seq, int(s))
+        except (KeyError, TypeError, AttributeError) as e:
+            raise ValueError(
+                "malformed trace JSON: %s: %s" % (type(e).__name__, e)) \
+                from None
+        return t
+
+
+def _happens_before(events):
+    """Adjacency seq -> set(succ seq) from the reference queue semantics:
+    a write depends on the previous write and every read granted since;
+    a read depends on the previous write."""
+    adj = {e.seq: set() for e in events}
+    last_write = {}   # var -> TraceOp
+    readers = {}      # var -> [TraceOp] since last write
+    for e in sorted(events, key=lambda x: x.seq):
+        for v in e.const:
+            w = last_write.get(v)
+            if w is not None:
+                adj[w.seq].add(e.seq)
+            readers.setdefault(v, []).append(e)
+        for v in e.mutable:
+            w = last_write.get(v)
+            if w is not None:
+                adj[w.seq].add(e.seq)
+            for r in readers.get(v, ()):
+                adj[r.seq].add(e.seq)
+            last_write[v] = e
+            readers[v] = []
+    return adj
+
+
+def _reachable(adj, src, dst):
+    if src == dst:
+        return True
+    seen, stack = {src}, [src]
+    while stack:
+        n = stack.pop()
+        for m in adj.get(n, ()):
+            if m == dst:
+                return True
+            if m not in seen:
+                seen.add(m)
+                stack.append(m)
+    return False
+
+
+def verify(trace, since_seq=0):
+    """Statically check a trace; returns findings whose triggering event
+    has seq >= since_seq (for incremental live verification)."""
+    findings = []
+    events = sorted(trace.events, key=lambda e: e.seq)
+    by_seq = {e.seq: e for e in events}
+    adj = _happens_before(events)
+
+    # -- use-after-free --------------------------------------------------------
+    first_delete = {}
+    for s, v in trace.deletes:
+        if v not in first_delete:
+            first_delete[v] = s
+    for e in events:
+        if e.seq < since_seq:
+            continue
+        for v in e.vars():
+            d = first_delete.get(v)
+            if d is not None and e.seq > d:
+                findings.append(Finding(
+                    "engine", "use-after-free", "error", e.label(),
+                    "references var %r deleted at seq %d (push after "
+                    "delete_variable)" % (v, d)))
+    for s, v, _ctx in trace.waits:
+        if s < since_seq or v is None:
+            continue
+        d = first_delete.get(v)
+        if d is not None and s > d:
+            findings.append(Finding(
+                "engine", "use-after-free", "error", "wait#%d" % s,
+                "wait_for_var on var %r deleted at seq %d" % (v, d)))
+
+    # -- data hazards (need data tags; live var-only traces skip) --------------
+    tag_acc = {}
+    for e in events:
+        for t in e.reads_data:
+            tag_acc.setdefault(t, []).append((e, False))
+        for t in e.writes_data:
+            tag_acc.setdefault(t, []).append((e, True))
+    for tag, acc in tag_acc.items():
+        for i in range(len(acc)):
+            for j in range(i + 1, len(acc)):
+                (a, aw), (b, bw) = acc[i], acc[j]
+                if a is b or not (aw or bw):
+                    continue
+                if max(a.seq, b.seq) < since_seq:
+                    continue
+                if (_reachable(adj, a.seq, b.seq)
+                        or _reachable(adj, b.seq, a.seq)):
+                    continue
+                code = "ww-hazard" if (aw and bw) else "rw-hazard"
+                findings.append(Finding(
+                    "engine", code, "error",
+                    "%s <-> %s" % (a.label(), b.label()),
+                    "both touch data %r (%s) but share no engine var: no "
+                    "ordering edge exists and the scheduler may interleave "
+                    "them" % (tag, "write/write" if aw and bw
+                              else "read/write")))
+
+    # -- wait cycles -----------------------------------------------------------
+    for s, v, ctx in trace.waits:
+        if s < since_seq or ctx is None or ctx not in by_seq:
+            continue
+        waiter = by_seq[ctx]
+        if v is None:
+            findings.append(Finding(
+                "engine", "wait-cycle", "error", waiter.label(),
+                "wait_for_all issued inside an engine op: the op waits for "
+                "its own completion"))
+            continue
+        pending = [e for e in events if e.seq < s and v in e.vars()]
+        for e in pending:
+            if e is waiter:
+                findings.append(Finding(
+                    "engine", "wait-cycle", "error", waiter.label(),
+                    "waits on var %r which it reads/writes itself: the op "
+                    "waits for its own completion" % (v,)))
+            elif _reachable(adj, waiter.seq, e.seq):
+                findings.append(Finding(
+                    "engine", "wait-cycle", "error",
+                    "%s -> %s" % (waiter.label(), e.label()),
+                    "waits on var %r pending in %s, which depends on the "
+                    "waiter — deadlock" % (v, e.label())))
+    return findings
+
+
+@contextmanager
+def recording(engine):
+    """Attach a fresh trace to ``engine`` for the duration of the block."""
+    trace = EngineTrace()
+    prev = engine.attach_trace(trace)
+    try:
+        yield trace
+    finally:
+        engine.attach_trace(prev)

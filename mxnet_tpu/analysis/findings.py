@@ -1,0 +1,71 @@
+"""Shared finding model for the mxlint analysis passes.
+
+Every pass (graph_lint, engine_verify, ast_lint) reports a flat list of
+``Finding`` objects so the CLI, the test suite and programmatic callers
+consume one shape. Severity is two-level on purpose:
+
+- ``error``   — a proven defect (dtype clash on an elementwise edge, a
+  write-write race, a tracer leak): the CLI exits nonzero on these.
+- ``warning`` — correct-but-costly or suspicious (sub-128 matmul dims
+  whose XLA padding is the honest price of a small layer, dead graph
+  nodes in a serialized JSON): reported, exit 0 unless --fail-on warning.
+
+The module stays dependency-free (no jax, no mxnet_tpu imports) so the
+engine can record/verify without dragging the compute stack in.
+"""
+from __future__ import annotations
+
+__all__ = ["Finding", "SEVERITIES", "max_severity", "summarize"]
+
+SEVERITIES = ("warning", "error")
+
+
+class Finding:
+    """One diagnostic from an analysis pass."""
+
+    __slots__ = ("pass_name", "code", "severity", "where", "message")
+
+    def __init__(self, pass_name, code, severity, where, message):
+        if severity not in SEVERITIES:
+            raise ValueError("bad severity %r" % (severity,))
+        self.pass_name = pass_name  # 'graph' | 'engine' | 'tracer'
+        self.code = code            # e.g. 'dtype-mismatch', 'ww-hazard'
+        self.severity = severity
+        self.where = where          # node name / op seq / file:line
+        self.message = message
+
+    def key(self):
+        """Stable identity, used to avoid re-raising the same finding on
+        every wait in live engine-verify mode."""
+        return (self.pass_name, self.code, self.where, self.message)
+
+    def to_dict(self):
+        return {
+            "pass": self.pass_name,
+            "code": self.code,
+            "severity": self.severity,
+            "where": self.where,
+            "message": self.message,
+        }
+
+    def __str__(self):
+        return "[%s] %s/%s %s: %s" % (
+            self.severity, self.pass_name, self.code, self.where, self.message)
+
+    def __repr__(self):
+        return "<Finding %s>" % self
+
+
+def max_severity(findings):
+    """Highest severity present, or None for an empty list."""
+    worst = None
+    for f in findings:
+        if worst is None or SEVERITIES.index(f.severity) > SEVERITIES.index(worst):
+            worst = f.severity
+    return worst
+
+
+def summarize(findings):
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    return "%d error(s), %d warning(s)" % (n_err, n_warn)

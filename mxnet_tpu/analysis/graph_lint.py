@@ -1,0 +1,310 @@
+"""Symbol-graph linter: compiler-style static checks over the Symbol DAG.
+
+Walks a ``symbol.py`` node graph (or its serialized JSON) and reports:
+
+- ``dtype-mismatch``  (error)   — an op with default elementwise type
+  inference fed inputs of different declared dtypes. The runtime would
+  silently upcast (or worse, jit a mixed-precision graph the author
+  never intended); the reference CHECKs this in InferType.
+- ``grad-req``        (error)   — ``__grad_req__`` attrs outside
+  {write, add, null}, or an aux-state variable marked to receive
+  gradient (aux states carry no gradient by contract,
+  ref: OpReqType kNullOp semantics).
+- ``duplicate-arg``   (error)   — two distinct variable nodes sharing a
+  name: binding maps arrays by name, so one of them silently aliases
+  the other.
+- ``duplicate-name``  (warning) — two op nodes sharing a name
+  (save/load and attr_dict collide).
+- ``tpu-pad``         (error/warning) — matmul-feeding dimensions that
+  are not multiples of 128. The MXU lane width is 128 and the Pallas
+  kernels in ops/pallas_kernels.py are hard-gated on 128-multiples
+  (off-128 shapes fall back to the dense path), so every off-128 dim
+  forces XLA padding. Severity encodes intent: a dim within
+  ``PAD_ERROR_DEFICIT`` lanes of the next multiple (127, 1016, ...)
+  is almost certainly a fence-post bug — rounding up is nearly free —
+  and is an error; honest small layers (10-class heads, 64-wide
+  bottlenecks) get a warning with the measured waste.
+- ``dead-node``       (warning, JSON input only) — nodes in the
+  serialized graph unreachable from any head. A live Symbol can only
+  hold reachable nodes, but hand-edited / converted JSON can ship dead
+  weight that still costs load time and confuses diffing.
+
+No jax import: everything here is host-side metadata walking, safe to
+run in CI before any device is touched.
+"""
+from __future__ import annotations
+
+import ast
+import json
+
+import numpy as _np
+
+from .findings import Finding
+
+__all__ = ["lint_symbol", "lint_json", "PAD_ERROR_DEFICIT", "LANE"]
+
+LANE = 128  # MXU lane width; the proven block rule in ops/pallas_kernels.py
+PAD_ERROR_DEFICIT = 8  # within this many lanes of aligned => fence-post error
+
+# params that become matmul/contraction dimensions on the MXU
+_PARAM_DIMS = {
+    "FullyConnected": ("num_hidden",),
+    "Convolution": ("num_filter",),
+    "Deconvolution": ("num_filter",),
+    "Embedding": ("output_dim",),
+}
+
+# ops whose inputs are legitimately mixed-dtype (indices + table, ...)
+_MIXED_DTYPE_OK = {"Embedding", "Cast", "SequenceLast", "SequenceMask",
+                   "SequenceReverse", "BatchNorm"}
+
+_GRAD_REQS = ("write", "add", "null")
+
+
+def _var_attr_shape(node):
+    s = node.attrs.get("__shape__")
+    if not s:
+        return None
+    try:
+        return tuple(int(d) for d in ast.literal_eval(str(s)))
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+def _var_attr_dtype(node):
+    t = node.attrs.get("__dtype__")
+    if not t:
+        return None
+    try:
+        return _np.dtype(str(t))
+    except TypeError:
+        return None
+
+
+def _pad_findings(node_name, dim_label, d):
+    """Classify one off-128 dimension; returns [] when aligned."""
+    d = int(d)
+    if d <= 0 or d % LANE == 0:
+        return []
+    aligned = ((d + LANE - 1) // LANE) * LANE
+    deficit = aligned - d
+    waste = 100.0 * deficit / aligned
+    if deficit <= PAD_ERROR_DEFICIT:
+        return [Finding(
+            "graph", "tpu-pad", "error", node_name,
+            "%s=%d is %d short of the %d-lane multiple %d; XLA pads every "
+            "tile (%.1f%% waste) and the Pallas kernels fall back to the "
+            "dense path. Round the dimension up to %d."
+            % (dim_label, d, deficit, LANE, aligned, waste, aligned))]
+    return [Finding(
+        "graph", "tpu-pad", "warning", node_name,
+        "%s=%d is not a multiple of %d: XLA pads %d->%d on this axis "
+        "(%.1f%% of the padded tile is waste)."
+        % (dim_label, d, LANE, d, aligned, waste))]
+
+
+def _propagate_shapes(nodes, seed):
+    """Forward shape sweep over the DAG; ``seed`` maps (id(node), idx) ->
+    shape. Best-effort: unknown stays None, op infer errors are skipped
+    (lint must not die on a partially-specified graph)."""
+    shapes = dict(seed)
+    for _ in range(3):  # bidirectional infer needs a couple of sweeps
+        changed = False
+        for n in nodes:
+            if n.is_variable:
+                continue
+            in_shapes = [shapes.get((id(s), i)) for s, i in n.inputs]
+            try:
+                ins, outs, _aux = n.op.infer_shape(n.params, in_shapes)
+            except Exception:
+                continue
+            for (src, i), s in zip(n.inputs, ins):
+                if s is not None and shapes.get((id(src), i)) != tuple(s):
+                    shapes[(id(src), i)] = tuple(s)
+                    changed = True
+            for i, s in enumerate(outs):
+                if s is not None and shapes.get((id(n), i)) != tuple(s):
+                    shapes[(id(n), i)] = tuple(s)
+                    changed = True
+        if not changed:
+            break
+    return shapes
+
+
+def lint_symbol(sym, input_shapes=None, input_types=None):
+    """Lint a live Symbol. ``input_shapes``/``input_types`` optionally map
+    argument names to shapes/dtypes, augmenting any ``__shape__`` /
+    ``__dtype__`` attrs stored on the variables themselves."""
+    findings = []
+    nodes = sym.nodes
+    input_shapes = dict(input_shapes or {})
+    input_types = dict(input_types or {})
+
+    # -- structural: duplicate names, grad_req discipline ----------------------
+    seen_vars, seen_ops = {}, {}
+    for n in nodes:
+        table = seen_vars if n.is_variable else seen_ops
+        if n.name in table:
+            if n.is_variable:
+                findings.append(Finding(
+                    "graph", "duplicate-arg", "error", n.name,
+                    "two distinct variable nodes share this name; binding "
+                    "maps arrays by name, so one silently aliases the other"))
+            else:
+                findings.append(Finding(
+                    "graph", "duplicate-name", "warning", n.name,
+                    "two op nodes share this name (save/load and attr_dict "
+                    "collide)"))
+        else:
+            table[n.name] = n
+        if n.is_variable:
+            gr = n.attrs.get("__grad_req__")
+            if gr is not None and gr not in _GRAD_REQS:
+                findings.append(Finding(
+                    "graph", "grad-req", "error", n.name,
+                    "__grad_req__=%r is not one of %s" % (gr, list(_GRAD_REQS))))
+            elif gr in ("write", "add") and n.attrs.get("__aux__"):
+                findings.append(Finding(
+                    "graph", "grad-req", "error", n.name,
+                    "auxiliary state marked __grad_req__=%r; aux states "
+                    "carry no gradient (kNullOp contract)" % gr))
+
+    # -- dtype propagation + elementwise agreement -----------------------------
+    dtypes = {}
+    for n in nodes:
+        if not n.is_variable:
+            continue
+        t = _var_attr_dtype(n)
+        if n.name in input_types:
+            t = _np.dtype(input_types[n.name])
+        if t is not None:
+            dtypes[(id(n), 0)] = t
+    for n in nodes:
+        if n.is_variable:
+            continue
+        in_dtypes = [dtypes.get((id(s), i)) for s, i in n.inputs]
+        known = [t for t in in_dtypes if t is not None]
+        uses_default_infer = getattr(n.op, "_infer_type", None) is None
+        if (uses_default_infer and n.op.name not in _MIXED_DTYPE_OK
+                and len({t.name for t in known}) > 1):
+            detail = ", ".join(
+                "%s[%d]:%s" % (s.name, i, t)
+                for (s, i), t in zip(n.inputs, in_dtypes) if t is not None)
+            findings.append(Finding(
+                "graph", "dtype-mismatch", "error", n.name,
+                "op %s mixes input dtypes (%s); elementwise type inference "
+                "assumes one dtype — insert an explicit Cast"
+                % (n.op.name, detail)))
+            continue  # don't propagate a dtype we know is ambiguous
+        try:
+            _ins, outs, _aux = n.op.infer_type(n.params, in_dtypes)
+        except Exception:
+            continue
+        for i, t in enumerate(outs):
+            if t is not None:
+                dtypes[(id(n), i)] = _np.dtype(t)
+
+    # -- TPU padding: param-declared matmul dims -------------------------------
+    for n in nodes:
+        if n.is_variable:
+            continue
+        for pname in _PARAM_DIMS.get(n.op.name, ()):
+            d = (n.params or {}).get(pname)
+            if isinstance(d, int):
+                findings.extend(_pad_findings(n.name, pname, d))
+
+    # -- TPU padding: shape-derived matmul dims (dot / batch_dot /
+    #    FullyConnected contraction), where shapes are recoverable ------------
+    seed = {}
+    for n in nodes:
+        if n.is_variable:
+            s = _var_attr_shape(n)
+            if n.name in input_shapes:
+                s = tuple(input_shapes[n.name])
+            if s is not None:
+                seed[(id(n), 0)] = s
+    if seed:
+        shapes = _propagate_shapes(nodes, seed)
+        for n in nodes:
+            if n.is_variable:
+                continue
+            if n.op.name in ("dot", "batch_dot"):
+                for (src, i), side in zip(n.inputs, ("lhs", "rhs")):
+                    s = shapes.get((id(src), i))
+                    if s is None:
+                        continue
+                    for ax, d in enumerate(s[-2:]):
+                        findings.extend(_pad_findings(
+                            n.name, "%s.shape[%d]" % (side, len(s) - 2 + ax), d))
+            elif n.op.name == "FullyConnected" and n.inputs:
+                s = shapes.get((id(n.inputs[0][0]), n.inputs[0][1]))
+                if s is not None and len(s) >= 2:
+                    flat = 1
+                    for d in s[1:]:
+                        flat *= int(d)
+                    findings.extend(_pad_findings(
+                        n.name, "contraction dim %d" % flat, flat))
+    return findings
+
+
+def _validate_graph_json(data):
+    """Structural validation of untrusted graph JSON; raises ValueError
+    (a CLI 'load error') so malformed inputs are distinguishable from
+    linter bugs, which crash with a traceback."""
+    jnodes = data.get("nodes", [])
+    heads = data.get("heads", [])
+    if not isinstance(jnodes, list) or not isinstance(heads, list):
+        raise ValueError("malformed graph JSON: 'nodes'/'heads' not lists")
+    for i, jn in enumerate(jnodes):
+        if not isinstance(jn, dict) or "op" not in jn or "name" not in jn:
+            raise ValueError(
+                "malformed graph JSON: node %d lacks op/name" % i)
+        for ref in jn.get("inputs", []):
+            if (not isinstance(ref, (list, tuple)) or len(ref) < 2
+                    or not 0 <= int(ref[0]) < len(jnodes)):
+                raise ValueError(
+                    "malformed graph JSON: node %d has bad input ref %r"
+                    % (i, ref))
+    for h in heads:
+        if (not isinstance(h, (list, tuple)) or not h
+                or not 0 <= int(h[0]) < len(jnodes)):
+            raise ValueError("malformed graph JSON: bad head ref %r" % (h,))
+
+
+def lint_json(json_str):
+    """Lint a serialized graph: dead-node reachability over the raw node
+    table, then the full symbol lint over the loaded heads. Raises
+    ValueError on malformed input (bad JSON or bad graph structure)."""
+    findings = []
+    data = json.loads(json_str)
+    _validate_graph_json(data)
+    jnodes = data.get("nodes", [])
+    heads = data.get("heads", [])
+    reach = set()
+    stack = [int(h[0]) for h in heads]
+    while stack:
+        i = stack.pop()
+        if i in reach:
+            continue
+        reach.add(i)
+        for src, _idx in jnodes[i].get("inputs", []):
+            stack.append(int(src))
+    for i, jn in enumerate(jnodes):
+        if i not in reach:
+            findings.append(Finding(
+                "graph", "dead-node", "warning",
+                jn.get("name", "#%d" % i),
+                "node (op=%s) is unreachable from every graph head — dead "
+                "weight in the serialized graph" % jn.get("op", "?")))
+
+    from ..base import MXNetError as _MXNetError
+    from ..symbol import load_json as _load_json
+
+    try:
+        sym = _load_json(json_str)
+    except (_MXNetError, KeyError) as e:
+        # unknown op name, missing 'heads', ... — input badness, not a
+        # linter bug: keep the raises-ValueError load contract
+        raise ValueError("malformed graph JSON: %s" % e) from None
+    findings.extend(lint_symbol(sym))
+    return findings

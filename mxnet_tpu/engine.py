@@ -21,9 +21,11 @@ from __future__ import annotations
 
 import atexit
 import ctypes
+import itertools
 import logging
 import os
 import threading
+from contextlib import nullcontext as _null_context
 
 from . import _native
 from .base import MXNetError
@@ -62,13 +64,18 @@ def _engine_lib():
 
 
 class VarHandle:
-    """Opaque engine variable (ref: engine.h VarHandle)."""
+    """Opaque engine variable (ref: engine.h VarHandle). ``_uid`` is a
+    stable process-wide id used by the verify/record trace (the native
+    pointer is recycled by the allocator, uids never are)."""
 
-    __slots__ = ("_ptr", "_engine")
+    __slots__ = ("_ptr", "_engine", "_uid")
+
+    _uids = itertools.count(1)
 
     def __init__(self, ptr, engine):
         self._ptr = ptr
         self._engine = engine
+        self._uid = next(VarHandle._uids)
 
 
 class Engine:
@@ -87,6 +94,16 @@ class Engine:
         # MXNET_ENGINE_INFO: log each push (ref: threaded_engine.h:253)
         self._verbose = os.environ.get("MXNET_ENGINE_INFO", "").strip() \
             not in ("", "0", "false")
+        # MXNET_ENGINE_VERIFY: record every push's read/write var sets and
+        # statically verify the trace (use-after-free, wait-cycles) on each
+        # wait, raising on findings — see analysis/engine_verify.py
+        self._verify = os.environ.get("MXNET_ENGINE_VERIFY", "").strip() \
+            not in ("", "0", "false")
+        self._trace = None
+        if self._verify:
+            from .analysis.engine_verify import EngineTrace
+
+            self._trace = EngineTrace()
         threaded = 0 if engine_type == "NaiveEngine" else 1
         self._lib = _engine_lib()
         self._handle = None
@@ -103,7 +120,13 @@ class Engine:
         def _trampoline(argp, token):
             key = argp  # void* cast back to the int key
             with self._live_lock:
-                fn, is_async = self._live.pop(key)
+                fn, is_async, ev, ev_trace = self._live.pop(key)
+            # pair ev with the trace it was recorded into at push time:
+            # if a recording() block ended while this op was in flight,
+            # the now-attached trace must not adopt a foreign seq as its
+            # op context (waits would misattribute their waiter)
+            ctx = ev_trace.op_context(ev) if ev is not None \
+                else _null_context()
             if is_async:
                 called = [False]
 
@@ -113,14 +136,16 @@ class Engine:
                         lib.EngineOprComplete(_tok)
 
                 try:
-                    fn(on_complete)
+                    with ctx:
+                        fn(on_complete)
                 except BaseException as e:  # surface on next wait()
                     with self._live_lock:
                         self._errors.append(e)
                     on_complete()
             else:
                 try:
-                    fn()
+                    with ctx:
+                        fn()
                 except BaseException as e:
                     with self._live_lock:
                         self._errors.append(e)
@@ -179,10 +204,52 @@ class Engine:
 
     def delete_variable(self, var):
         """Deferred deletion after all pending ops (ref: engine.h:148-160)."""
+        trace = self._trace
+        if trace is not None:
+            trace.delete_var(var._uid)
         h = self._handle_snapshot()
         if h is not None and var._ptr:
             self._lib.EngineDeleteVariable(h, var._ptr)
             var._ptr = None
+
+    # -- record / verify -------------------------------------------------------
+    def attach_trace(self, trace):
+        """Attach an analysis.engine_verify.EngineTrace (or None) for
+        recording; returns the previously attached trace. Programmatic
+        counterpart of MXNET_ENGINE_VERIFY=1 — prefer the
+        ``engine_verify.recording(engine)`` context manager. Verify
+        progress lives ON the trace (verify_seq/verify_reported), so
+        re-attaching a previous trace — recording() restoring it — must
+        not re-raise hazards that were already reported once."""
+        prev, self._trace = self._trace, trace
+        return prev
+
+    def _maybe_verify(self):
+        """In MXNET_ENGINE_VERIFY mode, statically check the trace on
+        each wait and raise the first new findings as MXNetError. Runs
+        BEFORE the blocking native wait so a wait-cycle raises instead
+        of deadlocking the worker pool."""
+        trace = self._trace
+        if not self._verify or trace is None:
+            return
+        from .analysis.engine_verify import verify
+
+        # snapshot before verifying: a worker pushing concurrently must
+        # not land inside [since_seq, verify_seq) unchecked. Taken under
+        # the trace lock — an unlocked read could observe a seq whose
+        # event is not yet appended, and that event would then be
+        # skipped by every later incremental verify.
+        with trace._lock:
+            snap = trace._seq
+        findings = verify(trace, since_seq=trace.verify_seq)
+        trace.verify_seq = snap + 1
+        new = [f for f in findings if f.key() not in trace.verify_reported]
+        if not new:
+            return
+        trace.verify_reported.update(f.key() for f in new)
+        raise MXNetError(
+            "engine verify: %d hazard(s) detected:\n%s"
+            % (len(new), "\n".join(str(f) for f in new)))
 
     # -- push ------------------------------------------------------------------
     def _check_dup(self, const_vars, mutable_vars):
@@ -216,18 +283,26 @@ class Engine:
         for v in list(const_vars) + list(mutable_vars):
             if handle is not None and not v._ptr:
                 raise MXNetError("engine variable used after delete_variable")
+        trace = self._trace
+        ev = None
+        if trace is not None:
+            ev = trace.push(getattr(fn, "__name__", None) or "fn",
+                            [v._uid for v in const_vars],
+                            [v._uid for v in mutable_vars])
         if handle is None:  # NaiveEngine fallback: run inline
-            if is_async:
-                done = threading.Event()
-                fn(done.set)
-                done.wait()
-            else:
-                fn()
+            ctx = trace.op_context(ev) if ev is not None else _null_context()
+            with ctx:
+                if is_async:
+                    done = threading.Event()
+                    fn(done.set)
+                    done.wait()
+                else:
+                    fn()
             return
         with self._live_lock:
             key = self._next_key
             self._next_key += 1
-            self._live[key] = (fn, is_async)
+            self._live[key] = (fn, is_async, ev, trace)
         n_c, n_m = len(const_vars), len(mutable_vars)
         c_arr = (ctypes.c_void_p * max(n_c, 1))(
             *[v._ptr for v in const_vars])
@@ -239,12 +314,20 @@ class Engine:
         if rc != 0:
             with self._live_lock:
                 self._live.pop(key, None)
+            if trace is not None and ev is not None:
+                # roll back the recorded push: a phantom op that never
+                # ran must not create happens-before edges in the trace
+                trace.discard(ev)
             raise MXNetError(
                 self._lib.EngineLastError(handle).decode())
 
     # -- sync ------------------------------------------------------------------
     def wait_for_var(self, var):
         """ref: engine.h:166 WaitForVar."""
+        trace = self._trace
+        if trace is not None:
+            trace.wait(var._uid)
+        self._maybe_verify()
         h = self._handle_snapshot()
         if h is not None and var._ptr:
             self._lib.EngineWaitForVar(h, var._ptr)
@@ -252,6 +335,10 @@ class Engine:
 
     def wait_for_all(self):
         """ref: engine.h:170 WaitForAll."""
+        trace = self._trace
+        if trace is not None:
+            trace.wait(None)
+        self._maybe_verify()
         h = self._handle_snapshot()
         if h is not None:
             self._lib.EngineWaitForAll(h)

@@ -673,8 +673,21 @@ true_divide = divide  # ref: ndarray.py:802
 
 
 def negative(arr):
-    """ref: ndarray.py:806 (-arr)."""
-    return multiply(arr, -1.0)
+    """Elementwise negation, equivalent to ``-arr``
+    (ref: ndarray.py:806).
+
+    Parameters
+    ----------
+    arr : NDArray
+        Input array.
+
+    Returns
+    -------
+    NDArray
+        Array with every element negated, same dtype as the input
+        (``multiply(arr, -1.0)`` would silently promote ints to float).
+    """
+    return -arr
 
 
 def power(base, exp):

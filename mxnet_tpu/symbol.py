@@ -406,6 +406,17 @@ class Symbol:
         are produced by Executor.backward (jax.vjp)."""
         raise MXNetError("Symbol.grad is superseded by Executor.backward in this framework")
 
+    # -- static analysis -------------------------------------------------------
+    def lint(self, input_shapes=None, input_types=None):
+        """Run the mxlint symbol-graph pass over this DAG: dtype-edge
+        agreement, grad_req discipline, duplicate names, and TPU 128-lane
+        padding waste. Returns a list of analysis.Finding; see
+        docs/how_to/static_analysis.md and ``tools/mxlint.py``."""
+        from .analysis.graph_lint import lint_symbol
+
+        return lint_symbol(self, input_shapes=input_shapes,
+                           input_types=input_types)
+
     # -- serialization ---------------------------------------------------------
     def tojson(self):
         """ref: symbolic.h:227 Symbol JSON; format mirrors the reference's

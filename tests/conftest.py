@@ -13,6 +13,15 @@ import sys
 # tunnel), so a plain setdefault would leave tests running on the single
 # real chip. Tests must run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Run the whole suite under the engine hazard verifier (mxlint's engine
+# pass): every push's read/write var sets are recorded and statically
+# checked on each wait — use-after-free and wait-cycle deadlocks in any
+# test's engine usage fail that test instead of hanging CI. The full
+# trace is kept in memory and re-checked per wait: fine at test scale
+# (measured no-op on this suite), a debug mode, not a production one —
+# see docs/how_to/static_analysis.md.
+os.environ.setdefault("MXNET_ENGINE_VERIFY", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

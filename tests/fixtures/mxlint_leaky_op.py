@@ -1,0 +1,26 @@
+"""Seeded-bad fixture for the mxlint tracer-leak pass (test_mxlint.py).
+
+A deliberately broken op forward exhibiting every host-impurity class
+the AST lint must catch: a ``np.*`` call on a traced value, a Python
+branch on tracer truthiness, and ``float()``/``.item()`` host syncs.
+The linter parses this file statically — it is NEVER imported, and the
+OpDef below is never registered, so the live registry stays clean.
+"""
+import numpy as np
+
+from mxnet_tpu.ops.registry import OpDef
+
+
+def _leaky_fwd(params, inputs, aux, is_train, rng):
+    x = inputs[0]
+    y = np.tanh(x)                    # np-on-tracer: materializes the tracer
+    if x.sum() > 0:                   # tracer-branch: TracerBoolConversionError
+        y = y * 2.0
+    scale = float(x[0])               # host-sync: blocking device->host
+    peek = x.mean().item()            # host-sync: .item()
+    clean = np.float32(params["eps"])  # fine: params are static
+    return [y * scale + peek + clean], []
+
+
+LEAKY_OPDEF = OpDef("MxlintLeaky", _leaky_fwd,
+                    arguments=("data",), imperative=False)

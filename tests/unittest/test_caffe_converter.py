@@ -252,3 +252,42 @@ def test_input_only_prototxt():
         'input_dim: 8\ninput_dim: 8\n')
     assert name == "data" and dim == (1, 3, 8, 8)
     assert sym.list_arguments() == ["data"]
+
+
+def test_truncated_prototxt_raises_mxnet_error():
+    """A truncated spec must raise MXNetError, not leak a bare
+    StopIteration out of the tokenizer generator (ADVICE r5)."""
+    from mxnet_tpu._caffe_proto import parse_prototxt
+
+    for text in ('layer { name:', 'layer { convolution_param {', 'name:'):
+        with pytest.raises(MXNetError, match="unexpected end of prototxt"):
+            parse_prototxt(text)
+
+
+def test_stray_top_level_brace_rejected():
+    """An unmatched '}' at top level used to silently drop every layer
+    after it — the same trains-wrong class as truncation."""
+    from mxnet_tpu._caffe_proto import parse_prototxt
+
+    with pytest.raises(MXNetError, match="unmatched"):
+        parse_prototxt('input: "data"\n}\nlayer { name: "c" type: "ReLU" '
+                       'bottom: "data" top: "c" }')
+
+
+def test_pooling_without_kernel_rejected():
+    """Non-global Pooling with no kernel spec used to silently default to
+    a (1,1) kernel — a no-op layer that trains wrong (ADVICE r5)."""
+    proto = ('input: "data"\n'
+             'layer { name: "p" type: "Pooling" bottom: "data" top: "p" '
+             'pooling_param { pool: MAX stride: 2 } }')
+    with pytest.raises(ValueError, match="kernel"):
+        caffe_converter.convert_symbol(proto)
+
+
+def test_global_pooling_needs_no_kernel():
+    proto = ('input: "data"\n'
+             'layer { name: "p" type: "Pooling" bottom: "data" top: "p" '
+             'pooling_param { pool: AVE global_pooling: true } }')
+    sym, _, _ = caffe_converter.convert_symbol(proto)
+    _, outs, _ = sym.infer_shape(data=(1, 3, 8, 8))
+    assert outs == [(1, 3, 1, 1)]

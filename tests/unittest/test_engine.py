@@ -169,6 +169,30 @@ def test_fuzz_engines_agree():
             np.testing.assert_allclose(got, expect, err_msg=etype)
 
 
+def test_fuzz_traces_verify_clean():
+    """The random fuzz workloads, re-run under the mxlint engine
+    recorder: the captured read/write-var traces must verify hazard-free
+    (the static counterpart of the result-equivalence check above)."""
+    from mxnet_tpu.analysis import engine_verify as ev
+
+    rng = np.random.RandomState(7)
+    n_vars = 8
+    ops = []
+    for _ in range(100):
+        w = int(rng.randint(n_vars))
+        nreads = int(rng.randint(0, 4))
+        reads = [int(r) for r in rng.choice(
+            [i for i in range(n_vars) if i != w],
+            size=nreads, replace=False)]
+        ops.append((reads, w))
+    for etype in ["NaiveEngine", "ThreadedEngine"]:
+        e = make_engine(etype)
+        with ev.recording(e) as trace:
+            _run_workload(e, n_vars, ops)
+        assert len(trace.events) == len(ops), etype
+        assert ev.verify(trace) == [], etype
+
+
 def test_engine_singleton_and_module_api():
     e1 = eng.get()
     e2 = eng.Engine.get()

@@ -1,0 +1,366 @@
+"""mxlint static-analysis subsystem tests (mxnet_tpu/analysis/).
+
+Covers the three passes end to end: seeded known-bad inputs must each
+be caught (dtype clash, dead node, 127-wide matmul, engine write-write
+hazard, wait-cycle, tracer leak), and the repo's own model zoo + ops
+package must lint clean — the CLI contract CI relies on.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine as eng
+from mxnet_tpu.analysis import ast_lint, engine_verify, graph_lint
+from mxnet_tpu.analysis.cli import main as mxlint_main, zoo_models
+from mxnet_tpu.base import MXNetError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+# -- graph pass ----------------------------------------------------------------
+
+def test_dtype_clash_detected():
+    a = mx.sym.Variable("a", dtype="float32")
+    b = mx.sym.Variable("b", dtype="float16")
+    fs = graph_lint.lint_symbol(a + b)
+    assert codes(errors(fs)) == ["dtype-mismatch"]
+
+
+def test_dtype_uniform_is_clean():
+    a = mx.sym.Variable("a", dtype="float16")
+    b = mx.sym.Variable("b", dtype="float16")
+    assert graph_lint.lint_symbol(a + b) == []
+
+
+def test_pad_127_matmul_is_error():
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                               name="fc", num_hidden=127)
+    fs = [f for f in graph_lint.lint_symbol(fc) if f.code == "tpu-pad"]
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "128" in fs[0].message
+
+
+def test_pad_small_dim_is_warning_with_waste():
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                               name="fc", num_hidden=64)
+    fs = [f for f in graph_lint.lint_symbol(fc) if f.code == "tpu-pad"]
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert "50.0%" in fs[0].message  # 64 -> 128 pads half the tile
+
+
+def test_pad_aligned_is_clean():
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                               name="fc", num_hidden=256)
+    assert [f for f in graph_lint.lint_symbol(fc) if f.code == "tpu-pad"] == []
+
+
+def test_pad_dot_shapes_from_var_attrs():
+    lhs = mx.sym.Variable("l", shape=(256, 127))
+    rhs = mx.sym.Variable("r", shape=(127, 256))
+    fs = [f for f in graph_lint.lint_symbol(mx.sym.dot(lhs, rhs))
+          if f.code == "tpu-pad"]
+    assert fs and all(f.severity == "error" for f in fs)
+
+
+def test_dead_node_in_json():
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                               name="fc", num_hidden=256)
+    g = json.loads(fc.tojson())
+    g["nodes"].append({"op": "null", "name": "orphan", "param": {},
+                       "inputs": [], "attr": {}})
+    fs = [f for f in graph_lint.lint_json(json.dumps(g))
+          if f.code == "dead-node"]
+    assert len(fs) == 1 and fs[0].where == "orphan"
+    # the same graph without the orphan is clean
+    assert [f for f in graph_lint.lint_json(fc.tojson())
+            if f.code == "dead-node"] == []
+
+
+def test_grad_req_checks():
+    bad = mx.sym.Variable("w", grad_req="wriet")
+    aux = mx.sym.Variable("mv", aux=1, grad_req="write")
+    fs = errors(graph_lint.lint_symbol(bad + aux))
+    assert codes(fs) == ["grad-req", "grad-req"]
+    ok = mx.sym.Variable("w2", grad_req="add")
+    assert graph_lint.lint_symbol(ok + mx.sym.Variable("x")) == []
+
+
+def test_duplicate_arg_name_is_error():
+    fs = graph_lint.lint_symbol(mx.sym.Variable("x") + mx.sym.Variable("x"))
+    assert codes(errors(fs)) == ["duplicate-arg"]
+
+
+def test_symbol_lint_method():
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                               name="fc", num_hidden=127)
+    fs = fc.lint()
+    assert codes(errors(fs)) == ["tpu-pad"]
+
+
+@pytest.mark.parametrize("name", sorted(zoo_models()))
+def test_model_zoo_lints_clean(name):
+    """The shipped zoo must carry zero errors (warnings — honest small
+    layers paying the 128-lane padding price — are allowed)."""
+    sym = zoo_models()[name]()
+    assert errors(graph_lint.lint_symbol(sym)) == []
+
+
+# -- engine pass ---------------------------------------------------------------
+
+def test_ww_hazard_detected():
+    t = engine_verify.EngineTrace()
+    t.push("a", mutable=["v1"], writes_data=["buf"])
+    t.push("b", mutable=["v2"], writes_data=["buf"])
+    fs = engine_verify.verify(t)
+    assert codes(fs) == ["ww-hazard"]
+
+
+def test_shared_var_orders_data_access():
+    t = engine_verify.EngineTrace()
+    t.push("a", mutable=["v"], writes_data=["buf"])
+    t.push("b", mutable=["v"], writes_data=["buf"])  # ordered by v's queue
+    assert engine_verify.verify(t) == []
+
+
+def test_rw_hazard_detected():
+    t = engine_verify.EngineTrace()
+    t.push("a", mutable=["v1"], writes_data=["buf"])
+    t.push("b", mutable=["v2"], reads_data=["buf"])
+    assert codes(engine_verify.verify(t)) == ["rw-hazard"]
+
+
+def test_wait_cycle_detected():
+    t = engine_verify.EngineTrace()
+    a = t.push("A", mutable=["v1"])
+    t.push("B", const=["v1"], mutable=["v2"])  # B depends on A
+    t.wait("v2", inside=a)                     # A waits on B -> cycle
+    fs = engine_verify.verify(t)
+    assert codes(fs) == ["wait-cycle"]
+
+
+def test_wait_without_cycle_is_clean():
+    t = engine_verify.EngineTrace()
+    t.push("A", mutable=["v1"])
+    b = t.push("B", mutable=["v2"])            # independent of A
+    t.wait("v1", inside=b)                     # no path B -> A
+    assert engine_verify.verify(t) == []
+
+
+def test_wait_for_all_inside_op_is_cycle():
+    t = engine_verify.EngineTrace()
+    a = t.push("A", mutable=["v1"])
+    t.wait(None, inside=a)
+    assert codes(engine_verify.verify(t)) == ["wait-cycle"]
+
+
+def test_use_after_free_detected():
+    t = engine_verify.EngineTrace()
+    t.push("a", mutable=["v"])
+    t.delete_var("v")
+    t.push("b", const=["v"])
+    assert codes(engine_verify.verify(t)) == ["use-after-free"]
+
+
+def test_delete_with_pending_ops_is_legal():
+    t = engine_verify.EngineTrace()
+    t.push("a", mutable=["v"])
+    t.delete_var("v")  # deferred deletion contract (engine.h:148-160)
+    assert engine_verify.verify(t) == []
+
+
+def test_trace_json_roundtrip():
+    t = engine_verify.EngineTrace()
+    a = t.push("A", mutable=["v1"], writes_data=["buf"])
+    t.push("B", const=["v1"], mutable=["v2"])
+    t.wait("v2", inside=a)
+    t.delete_var("v1")
+    t2 = engine_verify.EngineTrace.from_json(t.to_json())
+    assert codes(engine_verify.verify(t2)) == codes(engine_verify.verify(t))
+
+
+def test_live_recording_via_engine_hooks():
+    e = eng.Engine(engine_type="NaiveEngine")
+    try:
+        with engine_verify.recording(e) as trace:
+            v1, v2 = e.new_variable(), e.new_variable()
+            out = []
+            e.push(lambda: out.append(1), const_vars=[v1], mutable_vars=[v2])
+            e.push(lambda: out.append(2), mutable_vars=[v1])
+            e.wait_for_all()
+            e.delete_variable(v2)
+        assert out == [1, 2]
+        assert len(trace.events) == 2
+        assert trace.events[0].const and trace.events[0].mutable
+        assert engine_verify.verify(trace) == []
+    finally:
+        e.close()
+
+
+def test_env_verify_raises_on_self_wait():
+    """MXNET_ENGINE_VERIFY=1 (set suite-wide by conftest): a wait on a
+    var from inside an op that touches it is a self-deadlock; the
+    verifier raises instead of hanging."""
+    e = eng.Engine(engine_type="NaiveEngine")
+    e.close()  # force the pure-Python inline path so the wait returns
+    assert e._verify and e._trace is not None
+    v = e.new_variable()
+    with pytest.raises(MXNetError, match="wait-cycle"):
+        e.push(lambda: e.wait_for_var(v), mutable_vars=[v])
+
+
+def test_recording_block_does_not_resurface_reported_hazards():
+    """A hazard raised once under MXNET_ENGINE_VERIFY must stay reported
+    after a recording() block swaps the trace out and back in — stale
+    findings must not re-raise on later unrelated waits."""
+    e = eng.Engine(engine_type="NaiveEngine")
+    e.close()  # pure-Python inline path
+    assert e._verify and e._trace is not None
+    v = e.new_variable()
+    with pytest.raises(MXNetError, match="wait-cycle"):
+        e.push(lambda: e.wait_for_var(v), mutable_vars=[v])
+    with engine_verify.recording(e):
+        pass  # swaps in a fresh trace, then restores the env-verify one
+    e.wait_for_all()  # must NOT re-raise the already-reported cycle
+
+    # every recording() block starts with fresh verify progress: a
+    # hazard in a SECOND block must still be caught (state lives on the
+    # trace, so no stale verify_seq can mask it)
+    for _ in range(2):
+        with engine_verify.recording(e):
+            w = e.new_variable()
+            with pytest.raises(MXNetError, match="wait-cycle"):
+                e.push(lambda: e.wait_for_var(w), mutable_vars=[w])
+
+
+# -- tracer pass ---------------------------------------------------------------
+
+def test_leaky_fixture_catches_every_class():
+    fs = ast_lint.lint_file(os.path.join(FIXTURES, "mxlint_leaky_op.py"))
+    assert set(codes(fs)) == {"np-on-tracer", "tracer-branch", "host-sync"}
+    assert all(f.severity == "error" for f in fs)
+    # np.float32(params["eps"]) is static and must NOT be flagged
+    assert codes(fs).count("np-on-tracer") == 1
+
+
+def test_ops_package_lints_clean():
+    import mxnet_tpu.ops as ops_pkg
+
+    pkg_dir = os.path.dirname(os.path.abspath(ops_pkg.__file__))
+    assert ast_lint.lint_package(pkg_dir) == []
+
+
+def test_static_metadata_escapes_taint():
+    src = (
+        "import numpy as np\n"
+        "def forward(params, inputs, aux, is_train, rng):\n"
+        "    x = inputs[0]\n"
+        "    n = float(np.prod(x.shape))\n"   # static: shape escapes
+        "    if rng is None:\n"               # identity test is host-legal
+        "        n += 1\n"
+        "    return [x / n], []\n")
+    assert ast_lint.lint_source(src) == []
+
+
+def test_pragma_suppresses():
+    src = (
+        "import numpy as np\n"
+        "def forward(params, inputs, aux, is_train, rng):\n"
+        "    return [np.tanh(inputs[0])], []  # mxlint: disable\n")
+    assert ast_lint.lint_source(src) == []
+    assert codes(ast_lint.lint_source(src.replace("  # mxlint: disable", ""))) \
+        == ["np-on-tracer"]
+
+
+def test_host_op_forward_is_exempt():
+    src = (
+        "import numpy as np\n"
+        "def _apply(params, ins, is_train, cache=None):\n"
+        "    return [np.tanh(ins[0])], None\n"
+        "OpDef('HostThing', None, host_apply=_apply)\n")
+    assert ast_lint.lint_source(src) == []
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def test_cli_all_is_clean_on_repo():
+    """`mxlint --all` over the model zoo + ops package + engine selftest
+    exits 0: the repo's own artifacts carry no errors."""
+    assert mxlint_main(["--all"]) == 0
+
+
+def test_cli_nonzero_on_each_seeded_fixture(tmp_path, capsys):
+    # 1. dtype clash
+    clash = (mx.sym.Variable("a", dtype="float32")
+             + mx.sym.Variable("b", dtype="float16"))
+    p = tmp_path / "clash.json"
+    p.write_text(clash.tojson())
+    assert mxlint_main(["--graph", str(p)]) == 1
+
+    # 2. 128-misalignment (127-wide matmul)
+    fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                               name="fc", num_hidden=127)
+    p = tmp_path / "pad127.json"
+    p.write_text(fc.tojson())
+    assert mxlint_main(["--graph", str(p)]) == 1
+
+    # 3. engine write-write hazard
+    t = engine_verify.EngineTrace()
+    t.push("a", mutable=["v1"], writes_data=["buf"])
+    t.push("b", mutable=["v2"], writes_data=["buf"])
+    p = tmp_path / "ww.json"
+    p.write_text(t.to_json())
+    assert mxlint_main(["--engine-trace", str(p)]) == 1
+
+    # 4. wait-cycle
+    t = engine_verify.EngineTrace()
+    a = t.push("A", mutable=["v1"])
+    t.push("B", const=["v1"], mutable=["v2"])
+    t.wait("v2", inside=a)
+    p = tmp_path / "cycle.json"
+    p.write_text(t.to_json())
+    assert mxlint_main(["--engine-trace", str(p)]) == 1
+
+    # 5. tracer leak
+    assert mxlint_main(
+        ["--ops", os.path.join(FIXTURES, "mxlint_leaky_op.py")]) == 1
+
+    out = capsys.readouterr().out
+    for code in ("dtype-mismatch", "tpu-pad", "ww-hazard", "wait-cycle",
+                 "np-on-tracer"):
+        assert code in out
+
+
+def test_cli_fail_on_warning_strictness():
+    # mlp carries pad warnings: clean by default, nonzero under --fail-on
+    assert mxlint_main(["--model", "mlp"]) == 0
+    assert mxlint_main(["--model", "mlp", "--fail-on", "warning"]) == 1
+
+
+def test_cli_usage_errors():
+    assert mxlint_main([]) == 2
+    assert mxlint_main(["--model", "no_such_model"]) == 2
+
+
+def test_cli_end_to_end_subprocess():
+    """The checkout-tree launcher over the mlp symbol — the exact CI
+    invocation (fast: one model, no zoo sweep)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+         "--model", "mlp"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    assert "0 error(s)" in res.stdout

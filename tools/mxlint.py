@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""mxlint: static analysis for mxnet_tpu (symbol-graph lint, engine
+hazard verification, tracer-leak lint).
+
+Thin checkout-tree launcher for ``mxnet_tpu.analysis.cli`` — installed
+wheels get the same thing as the ``mxlint`` console script. Run
+``python tools/mxlint.py --help`` for usage; ``--all`` lints the model
+zoo and the ops package and self-tests the engine record path.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
