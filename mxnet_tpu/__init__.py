@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 
 from .base import MXNetError, MXTPUError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
+from . import resilience
 from . import engine
 from . import storage
 from . import resource

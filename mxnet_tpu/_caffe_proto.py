@@ -151,13 +151,16 @@ def _hw(p, field, default=None, required=False):
     return (int(default), int(default))
 
 
-def apply_layer(layer, bottoms, name=None, label=None, grad_scale=1.0):
+def apply_layer(layer, bottoms, name=None, label=None, grad_scale=1.0,
+                emit_loss=False):
     """Apply ONE computational caffe layer spec to bottom symbol(s).
 
     Returns the output symbol, or None for no-op layers (Accuracy,
     Silence). `label` and `grad_scale` feed loss layers
-    (SoftmaxWithLoss) — the CaffeLoss surface. Raises NotImplementedError
-    for unsupported types."""
+    (SoftmaxWithLoss) — the CaffeLoss surface. ``emit_loss`` makes
+    SoftmaxWithLoss also emit the per-example NLL loss blob (the
+    reference CaffeLoss's output) as a second, gradient-blocked head —
+    see CaffeLoss. Raises NotImplementedError for unsupported types."""
     import mxnet_tpu as mx
 
     ltype = str(layer.get("type", ""))
@@ -257,11 +260,31 @@ def apply_layer(layer, bottoms, name=None, label=None, grad_scale=1.0):
         return mx.sym.Flatten(data=data, name=name)
     if ltype in ("Softmax", "SoftmaxWithLoss"):
         kwargs = {}
+        if emit_loss and ltype == "SoftmaxWithLoss" and label is None:
+            # the NLL head below must read the SAME label the softmax
+            # grad uses, so materialize the variable SoftmaxOutput would
+            # have auto-created
+            label = mx.sym.Variable(
+                "%s_label" % (name if name is not None else "softmax"))
         if label is not None:
             kwargs["label"] = label
         if grad_scale != 1.0:
             kwargs["grad_scale"] = float(grad_scale)
-        return mx.sym.SoftmaxOutput(data=data, name=name, **kwargs)
+        prob = mx.sym.SoftmaxOutput(data=data, name=name, **kwargs)
+        if not (emit_loss and ltype == "SoftmaxWithLoss"):
+            return prob
+        # Reference CaffeLoss outputs the loss blob (caffe_loss-inl.h);
+        # emit it alongside the softmax head as per-example NLL with the
+        # gradient blocked — mx.metric.Caffe() then reports the loss
+        # while the training gradient stays exactly SoftmaxOutput's
+        # (ADVICE r5 item 1). The tiny floor keeps an underflowed
+        # probability from turning the METRIC into inf; it is orders of
+        # magnitude below f32 resolution for any trainable loss value.
+        picked = mx.sym.choose_element_0index(prob, label)
+        nll = 0.0 - mx.sym.log(picked + 1e-30)
+        loss_name = "%s_loss" % (name if name is not None else "softmax")
+        loss = mx.sym.BlockGrad(nll, name=loss_name)
+        return mx.sym.Group([prob, loss])
     if ltype in ("Accuracy", "Silence"):
         return None
     raise NotImplementedError(

@@ -116,6 +116,12 @@ def CaffeLoss(data=None, label=None, grad_scale=1.0, prototxt=None,
     mx.symbol.CaffeLoss(data=..., label=..., grad_scale=...,
     prototxt='layer{type:"SoftmaxWithLoss"}'); num_data/num_out are
     blob-count parity params like CaffeOp's).
+
+    Outputs: ``[softmax_probabilities, per_example_nll]`` for
+    SoftmaxWithLoss specs — the reference CaffeLoss's output is the
+    loss blob, so a verbatim-ported script's ``mx.metric.Caffe()``
+    reports the loss (the metric reads the loss head); the NLL head is
+    gradient-blocked, so training gradients are exactly SoftmaxOutput's.
     """
     if prototxt is None:
         prototxt = 'layer{type:"SoftmaxWithLoss"}'
@@ -127,7 +133,7 @@ def CaffeLoss(data=None, label=None, grad_scale=1.0, prototxt=None,
     layer = _single_layer(prototxt, "CaffeLoss")
     try:
         out = apply_layer(layer, [data], name=name, label=label,
-                          grad_scale=float(grad_scale))
+                          grad_scale=float(grad_scale), emit_loss=True)
     except NotImplementedError as exc:
         raise MXNetError("CaffeLoss: %s" % exc)
     if out is None:

@@ -10,16 +10,23 @@ import math
 import time
 
 
-def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpoint callback (ref: callback.py:10)."""
+def do_checkpoint(prefix, period=1, keep_n=None):
+    """Epoch-end checkpoint callback (ref: callback.py:10).
+
+    ``keep_n`` enables rolling retention (only the newest ``keep_n``
+    epochs stay on disk). The returned closure carries ``.prefix`` so
+    ``FeedForward.fit(..., resume=True)`` can discover where the run's
+    checkpoints live (docs/how_to/fault_tolerance.md)."""
     from .model import save_checkpoint
 
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux,
+                            keep_n=keep_n)
 
+    _callback.prefix = prefix
     return _callback
 
 

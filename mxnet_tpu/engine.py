@@ -25,14 +25,32 @@ import itertools
 import logging
 import os
 import threading
+import time
 from contextlib import nullcontext as _null_context
 
 from . import _native
 from .base import MXNetError
+from .resilience import faults as _faults
 
 __all__ = ["Engine", "get", "push", "wait_for_all"]
 
 _ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+
+
+def _wait_timeout():
+    """MXNET_ENGINE_WAIT_TIMEOUT in seconds, or None when the watchdog
+    is off. Read per wait so tests (and operators attaching to a hung
+    job) can arm it at any time."""
+    raw = os.environ.get("MXNET_ENGINE_WAIT_TIMEOUT", "").strip()
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        raise MXNetError(
+            "MXNET_ENGINE_WAIT_TIMEOUT must be a number of seconds, "
+            "got %r" % raw)
+    return t if t > 0 else None
 
 
 def _engine_lib():
@@ -115,12 +133,17 @@ class Engine:
         self._live_lock = threading.Lock()
         self._next_key = 1
         self._errors = []
+        # key -> fn name for ops dispatched to a worker but not yet
+        # completed (the wait watchdog's "in-flight" dump; _live alone
+        # cannot name them — its entry is popped at dispatch)
+        self._inflight = {}
         lib = self._lib
 
         def _trampoline(argp, token):
             key = argp  # void* cast back to the int key
             with self._live_lock:
                 fn, is_async, ev, ev_trace = self._live.pop(key)
+                self._inflight[key] = getattr(fn, "__name__", None) or "fn"
             # pair ev with the trace it was recorded into at push time:
             # if a recording() block ended while this op was in flight,
             # the now-attached trace must not adopt a foreign seq as its
@@ -130,13 +153,16 @@ class Engine:
             if is_async:
                 called = [False]
 
-                def on_complete(_tok=token):
+                def on_complete(_tok=token, _key=key):
                     if not called[0]:
                         called[0] = True
+                        with self._live_lock:
+                            self._inflight.pop(_key, None)
                         lib.EngineOprComplete(_tok)
 
                 try:
                     with ctx:
+                        _faults.point("engine.task")
                         fn(on_complete)
                 except BaseException as e:  # surface on next wait()
                     with self._live_lock:
@@ -145,10 +171,14 @@ class Engine:
             else:
                 try:
                     with ctx:
+                        _faults.point("engine.task")
                         fn()
                 except BaseException as e:
                     with self._live_lock:
                         self._errors.append(e)
+                finally:
+                    with self._live_lock:
+                        self._inflight.pop(key, None)
 
         self._trampoline = _ENGINE_FN(_trampoline) if lib is not None else None
 
@@ -292,6 +322,7 @@ class Engine:
         if handle is None:  # NaiveEngine fallback: run inline
             ctx = trace.op_context(ev) if ev is not None else _null_context()
             with ctx:
+                _faults.point("engine.task")
                 if is_async:
                     done = threading.Event()
                     fn(done.set)
@@ -323,32 +354,105 @@ class Engine:
 
     # -- sync ------------------------------------------------------------------
     def wait_for_var(self, var):
-        """ref: engine.h:166 WaitForVar."""
+        """ref: engine.h:166 WaitForVar. With MXNET_ENGINE_WAIT_TIMEOUT
+        set, a sentinel read op on the var bounds the wait: if it has
+        not run by the deadline, raise the pending-op dump instead of
+        blocking forever behind a task that never completes."""
         trace = self._trace
         if trace is not None:
             trace.wait(var._uid)
         self._maybe_verify()
         h = self._handle_snapshot()
         if h is not None and var._ptr:
-            self._lib.EngineWaitForVar(h, var._ptr)
+            timeout = _wait_timeout()
+            if timeout is None:
+                self._lib.EngineWaitForVar(h, var._ptr)
+            else:
+                reached = threading.Event()
+
+                def __engine_wait_sentinel__():
+                    reached.set()
+
+                # ordinary read push: runs once every op queued on the
+                # var before this wait has drained — exactly WaitForVar's
+                # contract (ref: threaded_engine.cc:300)
+                self.push(__engine_wait_sentinel__, const_vars=[var],
+                          priority=1 << 20)
+                if not reached.wait(timeout):
+                    # a deferred task error is the likely ROOT CAUSE of
+                    # the wedge (fn raised before calling on_complete);
+                    # surface it in preference to the generic timeout
+                    self._raise_pending()
+                    raise MXNetError(
+                        "engine wait_for_var exceeded "
+                        "MXNET_ENGINE_WAIT_TIMEOUT=%gs\n%s"
+                        % (timeout, self.pending_dump()))
         self._raise_pending()
 
     def wait_for_all(self):
-        """ref: engine.h:170 WaitForAll."""
+        """ref: engine.h:170 WaitForAll. With MXNET_ENGINE_WAIT_TIMEOUT
+        set, polls the pending count with a deadline and raises the
+        pending-op dump instead of deadlocking."""
         trace = self._trace
         if trace is not None:
             trace.wait(None)
         self._maybe_verify()
         h = self._handle_snapshot()
         if h is not None:
-            self._lib.EngineWaitForAll(h)
+            timeout = _wait_timeout()
+            if timeout is None:
+                self._lib.EngineWaitForAll(h)
+            elif not self._poll_pending(h, timeout):
+                self._raise_pending()  # root cause beats generic timeout
+                raise MXNetError(
+                    "engine wait_for_all exceeded "
+                    "MXNET_ENGINE_WAIT_TIMEOUT=%gs\n%s"
+                    % (timeout, self.pending_dump()))
         self._raise_pending()
+
+    def _poll_pending(self, h, timeout):
+        """Watchdog wait body: poll the native pending count until it
+        drains (True) or the deadline passes (False)."""
+        deadline = time.monotonic() + timeout
+        while self._lib.EnginePendingCount(h) > 0:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+        return True
 
     def pending_count(self):
         h = self._handle_snapshot()
         if h is None:
             return 0
         return self._lib.EnginePendingCount(h)
+
+    def pending_dump(self):
+        """Diagnostic snapshot for the wait watchdog: how many ops the
+        native engine still counts pending, which tasks are queued
+        (pushed, not yet dispatched), which are in flight (dispatched,
+        on_complete never called), and — when a verify/record trace is
+        attached (MXNET_ENGINE_VERIFY=1) — the trace tail with each
+        op's declared var sets, which names the dependency chain the
+        wait is stuck behind."""
+        with self._live_lock:
+            queued = [getattr(fn, "__name__", None) or "fn"
+                      for fn, _a, _e, _t in self._live.values()]
+            inflight = list(self._inflight.values())
+        lines = ["pending ops: %d native; queued: %s; in-flight: %s"
+                 % (self.pending_count(),
+                    ", ".join(queued) or "(none)",
+                    ", ".join(inflight) or "(none)")]
+        trace = self._trace
+        if trace is not None and trace.events:
+            tail = sorted(trace.events, key=lambda e: e.seq)[-8:]
+            lines.append("verify-trace tail:")
+            lines.extend("  %s const=%s mutable=%s"
+                         % (e.label(), list(e.const), list(e.mutable))
+                         for e in tail)
+        lines.append(
+            "likely cause: an async task never invoked on_complete, or a "
+            "host task is blocked; see docs/how_to/fault_tolerance.md")
+        return "\n".join(lines)
 
     def _raise_pending(self):
         with self._live_lock:
@@ -368,12 +472,21 @@ class Engine:
 @atexit.register
 def _drain_at_exit():
     """Fence pending host tasks (async checkpoints etc.) at interpreter
-    exit; a swallowed worker-thread error must not vanish silently."""
+    exit; a swallowed worker-thread error must not vanish silently.
+    Honors MXNET_ENGINE_WAIT_TIMEOUT: a task wedged at exit logs the
+    pending-op dump instead of hanging interpreter shutdown forever."""
     e = Engine._instance
     if e is None or e._handle is None:
         return
     try:
-        e._lib.EngineWaitForAll(e._handle)
+        timeout = _wait_timeout()
+        if timeout is None:
+            e._lib.EngineWaitForAll(e._handle)
+        elif not e._poll_pending(e._handle, timeout):
+            logging.error(
+                "engine: exit drain exceeded "
+                "MXNET_ENGINE_WAIT_TIMEOUT=%gs\n%s",
+                timeout, e.pending_dump())
     except Exception:
         return
     for err in e._errors:

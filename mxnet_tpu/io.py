@@ -523,11 +523,13 @@ class ImageRecordIter(DataIter):
                  num_parts=1, part_index=0, preprocess_threads=4,
                  max_random_scale=1.0, min_random_scale=1.0,
                  max_aspect_ratio=0.0, random_h=0, random_s=0, random_l=0,
-                 **kwargs):
+                 corrupt="raise", **kwargs):
         super().__init__()
         from . import recordio as _recordio
 
-        self.rec = _recordio.MXRecordIO(path_imgrec, "r")
+        # corrupt="skip": resync past damaged records instead of killing
+        # the epoch (resilience subsystem; docs/how_to/fault_tolerance.md)
+        self.rec = _recordio.MXRecordIO(path_imgrec, "r", corrupt=corrupt)
         self.data_shape = tuple(data_shape)
         if len(self.data_shape) != 3 or self.data_shape[0] not in (1, 3):
             raise MXNetError(

@@ -36,8 +36,39 @@ import numpy as _np
 from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray
+from .resilience import faults as _faults
+from .resilience.retry import DeadlineExceeded, RetryPolicy, run_with_deadline
 
 __all__ = ["KVStore", "create"]
+
+
+# one shared policy per MXNET_KV_RETRIES value: _coord_call sits on
+# fence/pull polling paths, and rebuilding a policy (Random() init,
+# env parse) per RPC is pure churn — the policy is configuration, its
+# RNG only feeds jitter (benign under concurrent use)
+_COORD_POLICIES = {}
+
+
+def _coord_call(fn, what="kv-coordinator op"):
+    """Run one coordination-service RPC under the resilience discipline:
+    the ``kv.coord`` injection point, then MXNET_KV_RETRIES attempts of
+    exponential backoff with jitter. A transient coordinator hiccup (an
+    expected event on a busy multi-host job, SURVEY §5.8) heals here
+    instead of failing the train step; a persistent outage still
+    surfaces after the attempt budget. Retries log via RetryPolicy's
+    default warning, which names `what` through the wrapper."""
+    def _op():
+        _faults.point("kv.coord")
+        return fn()
+
+    _op.__name__ = what
+    attempts = max(1, int(os.environ.get("MXNET_KV_RETRIES", "4")))
+    policy = _COORD_POLICIES.get(attempts)
+    if policy is None:
+        policy = _COORD_POLICIES[attempts] = RetryPolicy(
+            max_attempts=attempts, base_delay=0.05, max_delay=1.0,
+            jitter=0.25)
+    return policy.call(_op)
 
 
 def _ctypes_key(key):
@@ -74,7 +105,7 @@ class KVStore:
         self._hb_stop = threading.Event()
         rank = self.rank
 
-        def _set(ts):
+        def _publish(ts):
             try:
                 client.key_value_set("mxtpu_hb/%d" % rank, repr(ts),
                                      allow_overwrite=True)
@@ -83,8 +114,15 @@ class KVStore:
                 # client without allow_overwrite can only ever write the
                 # key once — repeated beats would fail and a silent
                 # beat-thread death reads as the whole cluster dying.
-                # Degrade to no-heartbeat instead.
+                # Degrade to no-heartbeat. Caught HERE, inside the
+                # retried callable: a missing capability is definitive,
+                # not a transient to burn the backoff budget on.
                 return False
+
+        def _set(ts):
+            try:
+                return _coord_call(lambda: _publish(ts),
+                                   what="heartbeat publish")
             except Exception:
                 return False
 
@@ -340,16 +378,58 @@ class KVStore:
     # -- cluster control -------------------------------------------------------
     def barrier(self):
         """ref: kvstore.h:190 Barrier. Multi-process dist: a real global
-        rendezvous over jax.distributed; single-process: no-op."""
+        rendezvous over jax.distributed; single-process: no-op. With
+        ``MXNET_KV_BARRIER_TIMEOUT=<secs>`` set, a rendezvous that does
+        not complete in time raises a diagnostic MXNetError naming the
+        unresponsive ranks (via heartbeat ages) instead of hanging the
+        healthy ranks forever."""
         self._barrier_count += 1
         if self.type.startswith("dist"):
             import jax
 
             if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
+                self._barrier_rendezvous()
 
-                multihost_utils.sync_global_devices(
-                    "mxnet_kvstore_barrier_%d" % self._barrier_count)
+    def _barrier_sync(self):
+        """The blocking rendezvous body (separated so the deadline
+        wrapper — and tests — can intercept it)."""
+        from jax.experimental import multihost_utils
+
+        _faults.point("kv.barrier")
+        multihost_utils.sync_global_devices(
+            "mxnet_kvstore_barrier_%d" % self._barrier_count)
+
+    def _barrier_rendezvous(self):
+        raw = os.environ.get("MXNET_KV_BARRIER_TIMEOUT", "0") or "0"
+        try:
+            timeout = float(raw)
+        except ValueError:
+            raise MXNetError(
+                "MXNET_KV_BARRIER_TIMEOUT must be a number of seconds, "
+                "got %r" % raw)
+        if timeout <= 0:
+            self._barrier_sync()
+            return
+        try:
+            run_with_deadline(self._barrier_sync, timeout,
+                              what="kvstore barrier #%d" % self._barrier_count)
+        except DeadlineExceeded:
+            hb_to = max(1.0, 3.0 * float(
+                os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2")))
+            if getattr(self, "_hb_client", None) is None:
+                who = "unknown (heartbeats unavailable)"
+            else:
+                dead = self.dead_ranks(timeout=hb_to)
+                who = ("ranks %s (heartbeat older than %.0fs)"
+                       % (sorted(dead), hb_to)) if dead else \
+                    "none dead by heartbeat — likely a straggler or a " \
+                    "rank that skipped this barrier"
+            raise MXNetError(
+                "kvstore barrier #%d timed out after %.1fs on rank %d of "
+                "%d; unresponsive: %s (MXNET_KV_BARRIER_TIMEOUT; see "
+                "docs/how_to/fault_tolerance.md)"
+                % (self._barrier_count, timeout, self.rank,
+                   self.num_workers, who))
 
     def send_command_to_servers(self, head, body):
         """ref: kvstore.py:318. No server processes exist on TPU; commands
@@ -373,9 +453,14 @@ class KVStore:
         queries the whole group. Returns 0 for non-dist stores (no
         cluster, nothing can be dead — matches single-process reference
         behavior)."""
+        return len(self.dead_ranks(node_id=node_id, timeout=timeout))
+
+    def dead_ranks(self, node_id=-1, timeout=60):
+        """The rank ids behind :meth:`get_num_dead_node`'s count — the
+        barrier-timeout diagnostic needs *names*, not a number."""
         client = getattr(self, "_hb_client", None)
         if client is None:
-            return 0
+            return []
         # Staleness is judged by VALUE CHANGE against the local clock,
         # not by comparing the sender's embedded wall time — cross-host
         # clock skew would otherwise fabricate dead/alive verdicts.
@@ -383,7 +468,7 @@ class KVStore:
         seen = getattr(self, "_hb_seen", None)
         if seen is None:
             seen = self._hb_seen = {}
-        dead = 0
+        dead = []
         for r in range(self.num_workers):
             try:
                 v = client.key_value_try_get("mxtpu_hb/%d" % r)
@@ -413,13 +498,13 @@ class KVStore:
                 if sent is not None:
                     age = time.time() - sent
                     if age > max(2 * timeout, 30.0):
-                        dead += 1
+                        dead.append(r)
                         base = now - age
                 seen[r] = (v, base)
             elif prev[0] != v:
                 seen[r] = (v, now)  # state change observed locally
             elif now - prev[1] > timeout:
-                dead += 1
+                dead.append(r)
         return dead
 
     @property
@@ -800,12 +885,24 @@ class _AsyncDistKVStore(KVStore):
             merged = self._reduce(list(vals), self._store[k])
             group.append((k, merged.asnumpy()))
         self._seq += 1
-        # payload first, then the sequence bump that makes it visible
-        self._client.key_value_set(
-            "%s/g/%d/%d" % (self._ns, self._rank, self._seq), _b64(group))
-        self._client.key_value_set(
-            "%s/s/%d" % (self._ns, self._rank), str(self._seq),
-            allow_overwrite=True)
+        # payload first, then the sequence bump that makes it visible;
+        # both retried — a transient coordinator error on a push must
+        # not kill the step (and a payload that landed without its seq
+        # bump is invisible, so the retry cannot double-apply).
+        # allow_overwrite makes the payload retry idempotent when the
+        # first set committed but its ack was lost — the value for a
+        # given (rank, seq) is deterministic, and this store type only
+        # exists when the client supports overwrite (_async_transport_ok)
+        _coord_call(
+            lambda: self._client.key_value_set(
+                "%s/g/%d/%d" % (self._ns, self._rank, self._seq),
+                _b64(group), allow_overwrite=True),
+            what="async push payload")
+        _coord_call(
+            lambda: self._client.key_value_set(
+                "%s/s/%d" % (self._ns, self._rank), str(self._seq),
+                allow_overwrite=True),
+            what="async push seq bump")
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
@@ -900,12 +997,21 @@ class _AsyncDistKVStore(KVStore):
 
     def _read_kv(self, k):
         """('ok', value) | ('absent', None) — only on NOT_FOUND — |
-        ('error', None) for transient coordinator failures."""
+        ('error', None) once the retry budget is exhausted. NOT_FOUND
+        is a definitive answer and is never retried (fence/init loops
+        poll absent keys at high frequency); anything else is a
+        transient coordinator failure and backs off under
+        MXNET_KV_RETRIES before becoming 'error'."""
+        def _get():
+            try:
+                return "ok", self._client.key_value_try_get(k)
+            except Exception as e:
+                if "NOT_FOUND" in str(e):
+                    return "absent", None
+                raise
         try:
-            return "ok", self._client.key_value_try_get(k)
-        except Exception as e:
-            if "NOT_FOUND" in str(e):
-                return "absent", None
+            return _coord_call(_get, what="coordinator get %s" % k)
+        except Exception:
             return "error", None
 
     def _wait_key(self, k, timeout=60.0):

@@ -18,9 +18,17 @@ __all__ = [
 
 def check_label_shapes(labels, preds, shape=0):
     if shape == 0:
+        # more prediction heads than labels is legal: grouped nets carry
+        # gradient-blocked auxiliary heads (CaffeLoss's NLL blob, MakeLoss
+        # monitors) after the scored output, and the pairwise zip ignores
+        # the surplus. Fewer preds than labels is a real wiring error.
         label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
+        if label_shape > pred_shape:
+            raise ValueError(
+                "%d labels but only %d prediction outputs"
+                % (label_shape, pred_shape))
+        return
+    label_shape, pred_shape = labels.shape, preds.shape
     if label_shape != pred_shape:
         raise ValueError(
             "Shape of labels %s does not match shape of predictions %s"
@@ -314,10 +322,19 @@ class Torch(EvalMetric):
 
 
 class Caffe(Torch):
-    """Dummy metric for caffe criterion outputs (ref: metric.py:311)."""
+    """Dummy metric for caffe criterion outputs (ref: metric.py:311).
+
+    CaffeLoss emits ``[softmax, per_example_nll]`` (the reference
+    emitted only the loss blob), so the loss is the LAST head; reading
+    preds[-1] reports the loss either way instead of averaging
+    probabilities into ~1/num_classes (ADVICE r5)."""
 
     def __init__(self):
         super().__init__("caffe")
+
+    def update(self, _, preds):
+        self.sum_metric += preds[-1].asnumpy().mean()
+        self.num_inst += 1
 
 
 class CustomMetric(EvalMetric):

@@ -8,6 +8,8 @@ metric host-side. Checkpoints are `prefix-symbol.json` +
 from __future__ import annotations
 
 import logging
+import os
+import re
 import threading
 import time
 from collections import namedtuple
@@ -15,6 +17,7 @@ from collections import namedtuple
 import numpy as _np
 
 from .base import MXNetError
+from .resilience import faults as _faults
 from .context import Context, cpu, current_context
 from .ndarray import NDArray, zeros, load as nd_load, save as nd_save
 from . import io
@@ -440,8 +443,160 @@ def fence_checkpoint(prefix):
         _engine.Engine.get().wait_for_var(var)
 
 
+def _write_params_atomic(param_name, save_dict):
+    """Crash-safe params write: tmp file → fsync → atomic rename →
+    best-effort directory fsync. At every instant `param_name` is either
+    absent, the previous complete file, or the new complete file — a
+    crash (or an injected ``ckpt.write`` fault) can strand a ``.tmp-*``
+    leftover but can never tear the ``.params`` file in place. Stream
+    URIs (s3:// etc.) have no rename; they keep the plain write."""
+    if "://" in param_name:
+        nd_save(param_name, save_dict)
+        return
+    tmp = "%s.tmp-%d" % (param_name, os.getpid())
+    nd_save(tmp, save_dict)
+    # the injected crash window: tmp written, final name untouched —
+    # recovery must see the previous epoch, never a torn file
+    _faults.point("ckpt.write")
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    os.replace(tmp, param_name)
+    dirfd = None
+    try:  # durability of the rename itself
+        dirfd = os.open(os.path.dirname(os.path.abspath(param_name)),
+                        os.O_RDONLY)
+        os.fsync(dirfd)
+    except OSError:
+        pass
+    finally:
+        if dirfd is not None:
+            os.close(dirfd)
+
+
+_CKPT_RE = re.compile(r"-(\d{4,})\.params")
+
+
+def _checkpoint_epochs(prefix):
+    """Epochs with an existing `prefix-NNNN.params`, newest first.
+    The suffix is FULL-matched so a sibling run's longer prefix
+    ('model-ft-0006.params' while scanning 'model') can neither inject
+    phantom epochs nor get its files pruned by this run."""
+    d = os.path.dirname(os.path.abspath(prefix)) or "."
+    base = os.path.basename(prefix)
+    epochs = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for fn in names:
+        if not fn.startswith(base + "-"):
+            continue
+        m = _CKPT_RE.fullmatch(fn[len(base):])
+        if m is not None:
+            epochs.append(int(m.group(1)))
+    return sorted(set(epochs), reverse=True)
+
+
+def _prune_checkpoints(prefix, keep_n):
+    """Rolling retention: keep the newest `keep_n` epochs of `prefix`,
+    delete the rest — including stranded tmp siblings from crashed
+    writes and the epoch's optimizer `.states` sidecar (an orphaned
+    states file has no matching params to resume with). Best-effort —
+    retention must never fail a training step."""
+    import glob as _glob
+
+    for epoch in _checkpoint_epochs(prefix)[keep_n:]:
+        path = "%s-%04d.params" % (prefix, epoch)
+        try:
+            os.remove(path)
+            logging.info('Pruned old checkpoint "%s"', path)
+        except OSError:
+            pass
+        stale = _glob.glob(_glob.escape(path) + ".tmp-*")
+        stale.append("%s-%04d.states" % (prefix, epoch))
+        for s in stale:
+            try:
+                os.remove(s)
+            except OSError:
+                pass
+
+
+def _params_file_ok(path):
+    """Structurally validate a .params file WITHOUT materializing its
+    tensors: header, names, and every tensor record must land exactly
+    on EOF. The resume scan runs this over possibly-multi-GB files; a
+    full nd_load here would double resume I/O (the winner is loaded
+    once, by load_checkpoint)."""
+    import struct as _struct
+
+    from .base import _DTYPE_MX_TO_NP
+    from .ndarray import _ND_MAGIC
+
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            head = f.read(24)
+            if len(head) < 24:
+                return False
+            magic, _res, count = _struct.unpack("<QQQ", head)
+            if magic != _ND_MAGIC:
+                return False
+            raw = f.read(8)
+            if len(raw) < 8:
+                return False
+            (n_names,) = _struct.unpack("<Q", raw)
+            for _ in range(n_names):
+                raw = f.read(8)
+                if len(raw) < 8:
+                    return False
+                f.seek(_struct.unpack("<Q", raw)[0], 1)
+            for _ in range(count):
+                raw = f.read(4)
+                if len(raw) < 4:
+                    return False
+                (ndim,) = _struct.unpack("<I", raw)
+                dims_raw = f.read(4 * ndim)
+                if len(dims_raw) < 4 * ndim:
+                    return False
+                dims = _struct.unpack("<%dI" % ndim, dims_raw) if ndim else ()
+                raw = f.read(4)
+                if len(raw) < 4:
+                    return False
+                (code,) = _struct.unpack("<I", raw)
+                if code not in _DTYPE_MX_TO_NP:
+                    return False
+                n = 1
+                for d in dims:
+                    n *= d
+                f.seek(n * _np.dtype(_DTYPE_MX_TO_NP[code]).itemsize, 1)
+            # seeks past EOF don't error; the final position check is
+            # what catches truncation (and trailing garbage)
+            return f.tell() == size
+    except (OSError, ValueError):
+        return False
+
+
+def find_latest_checkpoint(prefix):
+    """Newest epoch whose ``prefix-NNNN.params`` loads cleanly, or None.
+
+    Corrupt or partial files (a torn write from a pre-atomic-rename
+    build, a truncated copy) are skipped with a warning and the scan
+    falls back to the next older epoch — the resume path after a
+    preemption must land on the newest VALID state, not die on the
+    newest file."""
+    fence_checkpoint(prefix)
+    for epoch in _checkpoint_epochs(prefix):
+        path = "%s-%04d.params" % (prefix, epoch)
+        if not _params_file_ok(path):
+            logging.warning(
+                'Skipping corrupt/partial checkpoint "%s"', path)
+            continue
+        return epoch
+    return None
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
-                    sync=False):
+                    sync=False, keep_n=None):
     """ref: python/mxnet/model.py:311.
 
     Async by default: the file write is pushed to the dependency engine
@@ -449,7 +604,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     prefix serialize; different prefixes overlap) so the training loop
     keeps stepping while the params hit disk — the TPU-era async
     checkpoint pattern, fenced by ``nd.waitall()``. ``sync=True`` (or a
-    NaiveEngine / non-native build) writes inline."""
+    NaiveEngine / non-native build) writes inline.
+
+    The params file lands via tmp + fsync + atomic rename (crash-safe;
+    see docs/how_to/fault_tolerance.md). ``keep_n`` enables rolling
+    retention: after a successful write, only the newest ``keep_n``
+    epochs of this prefix are kept on disk."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     # snapshot device buffers now: later mutations must not leak into
@@ -460,8 +620,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     param_name = "%s-%04d.params" % (prefix, epoch)
 
     def _write():
-        nd_save(param_name, save_dict)
+        _write_params_atomic(param_name, save_dict)
         logging.info('Saved checkpoint to "%s"', param_name)
+        if keep_n is not None and keep_n >= 1:
+            _prune_checkpoints(prefix, int(keep_n))
 
     from . import engine as _engine
 
@@ -745,11 +907,52 @@ class FeedForward(BASE_ESTIMATOR):
                 _multiple_callbacks(batch_end_callback, batch_end_params)
         return eval_metric.get()[1]
 
+    def _resume_from_checkpoint(self, resume, epoch_end_callback, logger):
+        """Preemption-safe restart: locate the newest VALID checkpoint
+        and continue from it. ``resume`` is the checkpoint prefix, or
+        True to discover the prefix from a ``do_checkpoint`` epoch-end
+        callback (which stamps ``.prefix`` on its closure). A fresh run
+        (no checkpoint yet) starts from scratch — resume is idempotent
+        under kill/rerun loops."""
+        prefix = resume if isinstance(resume, str) else None
+        if prefix is None:
+            cbs = epoch_end_callback if isinstance(epoch_end_callback, list) \
+                else [epoch_end_callback]
+            for cb in cbs:
+                p = getattr(cb, "prefix", None)
+                if isinstance(p, str):
+                    prefix = p
+                    break
+        if prefix is None:
+            raise MXNetError(
+                "fit(resume=True) needs a checkpoint prefix: pass "
+                "resume='<prefix>' or a callback.do_checkpoint(prefix) "
+                "epoch_end_callback")
+        epoch = find_latest_checkpoint(prefix)
+        if epoch is None:
+            logger.info("resume: no valid checkpoint under prefix %r; "
+                        "starting fresh", prefix)
+            return
+        _sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = epoch
+        logger.info("resume: restarting from checkpoint %r epoch %d",
+                    prefix, epoch)
+
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_batch_end_callback=None):
-        """ref: python/mxnet/model.py:708."""
+            eval_batch_end_callback=None, resume=False):
+        """ref: python/mxnet/model.py:708. TPU extension: ``resume`` —
+        True (or a checkpoint prefix string) reloads the newest valid
+        checkpoint and continues from its epoch, skipping corrupt or
+        partial files, so a preempted run restarts with one flag (see
+        docs/how_to/fault_tolerance.md)."""
+        if logger is None:
+            logger = logging
+        if resume:
+            self._resume_from_checkpoint(resume, epoch_end_callback, logger)
         data = self._init_iter(X, y, is_train=True)
         eval_data = self._init_eval_iter(eval_data)
 

@@ -62,10 +62,21 @@ class Module(BaseModule):
         self._slices = None
 
     @staticmethod
-    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
-        """ref: module.py:86."""
-        from ..model import load_checkpoint
+    def load(prefix, epoch=None, load_optimizer_states=False, **kwargs):
+        """ref: module.py:86. TPU extension: ``epoch=None`` resumes from
+        the newest VALID checkpoint of the prefix (corrupt/partial
+        epochs skipped — see model.find_latest_checkpoint and
+        docs/how_to/fault_tolerance.md)."""
+        from ..model import find_latest_checkpoint, load_checkpoint
 
+        if epoch is None:
+            epoch = find_latest_checkpoint(prefix)
+            if epoch is None:
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    "Module.load(%r, epoch=None): no valid checkpoint found"
+                    % (prefix,))
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
         mod._arg_params = args
@@ -75,13 +86,16 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """ref: module.py:119."""
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        keep_n=None):
+        """ref: module.py:119. The params file lands crash-safely (tmp +
+        fsync + atomic rename); ``keep_n`` keeps only the newest N
+        epochs on disk (rolling retention)."""
         from ..model import save_checkpoint as _save_ckpt
 
         self._sync_params_from_devices()
         _save_ckpt(prefix, epoch, self.symbol, *self.get_params()[:1],
-                   self.get_params()[1], sync=True)
+                   self.get_params()[1], sync=True, keep_n=keep_n)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
             self.save_optimizer_states(state_name)

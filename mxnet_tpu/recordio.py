@@ -14,6 +14,7 @@ import struct
 import numpy as _np
 
 from .base import MXNetError
+from .resilience import faults as _faults
 
 __all__ = [
     "MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
@@ -37,6 +38,16 @@ class MXRecordIO:
     the dmlc::ThreadedIter role (ref: src/io/iter_prefetcher.h:72) — and
     writes through buffered C stdio; otherwise a pure-Python file path
     with identical on-disk framing is used.
+
+    ``corrupt`` (readers) selects the bad-record policy: ``"raise"``
+    (default) fails on the first invalid magic/truncated payload;
+    ``"skip"`` resyncs to the next 4-byte-aligned magic marker and keeps
+    going, counting each resync in ``num_skipped`` — one flipped sector
+    must not kill a whole epoch. Resync is sound under the dmlc framing:
+    payload bytes never contain the magic (the writer splits them into
+    multipart records), so the next magic is a real record boundary.
+    The skip policy reads through the pure-Python path — the native
+    prefetcher fails hard by design.
     """
 
     #: records read ahead by the native producer thread (dmlc ThreadedIter
@@ -44,12 +55,21 @@ class MXRecordIO:
     PREFETCH_DEPTH = 16
     _USE_NATIVE = True
 
-    def __init__(self, uri, flag):
+    def __init__(self, uri, flag, corrupt="raise"):
+        if corrupt not in ("raise", "skip"):
+            raise ValueError('corrupt must be "raise" or "skip", got %r'
+                             % (corrupt,))
         self.uri = uri
         self.flag = flag
+        self.corrupt = corrupt
+        #: resyncs performed under corrupt="skip" (≈ records lost)
+        self.num_skipped = 0
         self.handle = None
         self._nlib = None
         self._nh = None
+        # open() can fail partway (bad path/permissions); close() and
+        # __del__ must already be safe to call at that point
+        self.is_open = False
         self.open()
 
     def open(self):
@@ -61,7 +81,9 @@ class MXRecordIO:
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
-        lib = _native.recordio_lib() if self._USE_NATIVE else None
+        use_native = self._USE_NATIVE and \
+            (self.writable or self.corrupt == "raise")
+        lib = _native.recordio_lib() if use_native else None
         if lib is not None:
             uri = self.uri.encode()
             h = (lib.rio_writer_open(uri) if self.writable
@@ -76,17 +98,21 @@ class MXRecordIO:
         self.is_open = True
 
     def close(self):
-        if self.is_open:
-            if self._nh is not None:
-                if self.writable:
-                    self._nlib.rio_writer_close(self._nh)
-                else:
-                    self._nlib.rio_reader_close(self._nh)
-                self._nh = None
-            if self.handle is not None:
-                self.handle.close()
-                self.handle = None
-            self.is_open = False
+        # getattr-guarded: a constructor that failed before (or inside)
+        # open() leaves a partially initialized object, and close() /
+        # __del__ on it must be a no-op, not a second exception
+        if not getattr(self, "is_open", False):
+            return
+        if getattr(self, "_nh", None) is not None:
+            if self.writable:
+                self._nlib.rio_writer_close(self._nh)
+            else:
+                self._nlib.rio_reader_close(self._nh)
+            self._nh = None
+        if getattr(self, "handle", None) is not None:
+            self.handle.close()
+            self.handle = None
+        self.is_open = False
 
     def __del__(self):
         try:
@@ -146,8 +172,35 @@ class MXRecordIO:
             if pad:
                 self.handle.write(b"\x00" * pad)
 
+    def _resync(self, from_pos):
+        """corrupt="skip" recovery: scan forward from `from_pos` for the
+        next 4-byte-aligned magic marker, seek there, and count the
+        resync. Returns False at EOF (nothing left to recover)."""
+        self.num_skipped += 1
+        # next aligned offset strictly AFTER the bad header start, so a
+        # magic with a corrupt length word cannot re-match forever
+        pos = (from_pos + 4) & ~3
+        self.handle.seek(pos)
+        tail = b""
+        while True:
+            chunk = self.handle.read(1 << 16)
+            if not chunk:
+                return False
+            buf = tail + chunk
+            base = pos - len(tail)
+            i = buf.find(_MAGIC_BYTES)
+            while i != -1:
+                if (base + i) % 4 == 0:
+                    self.handle.seek(base + i)
+                    return True
+                i = buf.find(_MAGIC_BYTES, i + 1)
+            # keep 3 bytes: a magic straddling the chunk boundary
+            tail = buf[-3:]
+            pos += len(chunk)
+
     def read(self):
         assert not self.writable
+        _faults.point("rio.read")
         if self._nh is not None:
             import ctypes
 
@@ -160,30 +213,76 @@ class MXRecordIO:
             if status < 0:
                 raise MXNetError("invalid record magic in %s" % self.uri)
             return ctypes.string_at(data, length.value)
+        skip = self.corrupt == "skip"
         out = None  # accumulates multipart records (cflag 1..3)
+        # resync can land on the continuation (cflag 2/3) of the record
+        # whose head was destroyed; those parts belong to the loss the
+        # resync already counted, so they are dropped without re-counting
+        dropping = False
         while True:
+            start = self.handle.tell()
             head = self.handle.read(8)
             if len(head) < 8:
                 if out is not None:
+                    if skip:  # torn tail: drop the partial multipart
+                        self.num_skipped += 1
+                        return None
                     raise MXNetError("truncated multipart record in %s" % self.uri)
                 return None
             magic, lrec = struct.unpack("<II", head)
             if magic != _kMagic:
+                if skip:
+                    out = None
+                    if self._resync(start):
+                        dropping = True
+                        continue
+                    return None
                 raise MXNetError("invalid record magic in %s" % self.uri)
             length = lrec & _kLenMask
             cflag = lrec >> 29
             data = self.handle.read(length)
             if len(data) < length:
+                if skip:
+                    # short payload: either true EOF truncation or a
+                    # corrupt LENGTH word that ran past the next records
+                    # — resync rather than treating it as EOF, so one
+                    # flipped length byte cannot drop the rest of the
+                    # epoch (_resync counts the loss; at real EOF it
+                    # finds nothing and we return None below)
+                    out = None
+                    if self._resync(start):
+                        dropping = True
+                        continue
+                    return None
                 raise MXNetError("truncated record payload in %s" % self.uri)
             pad = (4 - length % 4) % 4
             if pad:
                 self.handle.read(pad)
             if cflag == 0:
+                if out is not None and skip:
+                    # a fresh single-part record while a multipart was
+                    # open means the multipart's tail was lost
+                    self.num_skipped += 1
                 return data
             if cflag == 1:
+                if out is not None and skip:
+                    self.num_skipped += 1
                 out = data
             else:  # 2 = middle, 3 = end: re-insert the split-out magic
-                out = (out or b"") + _MAGIC_BYTES + data
+                if out is None:
+                    # continuation with no head: its record is already
+                    # lost — fabricating a value from the tail parts
+                    # would feed garbage to the caller
+                    if not skip:
+                        raise MXNetError(
+                            "orphan multipart continuation in %s" % self.uri)
+                    if not dropping:
+                        self.num_skipped += 1
+                        dropping = True
+                    if cflag == 3:
+                        dropping = False
+                    continue
+                out = out + _MAGIC_BYTES + data
                 if cflag == 3:
                     return out
 
@@ -211,7 +310,7 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.idx[key_type(line[0])] = int(line[1])
 
     def close(self):
-        if self.writable and self.is_open:
+        if getattr(self, "writable", False) and getattr(self, "is_open", False):
             with open(self.idx_path, "w") as fout:
                 for k, v in self.idx.items():
                     fout.write("%s\t%d\n" % (str(k), v))

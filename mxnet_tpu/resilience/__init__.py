@@ -1,0 +1,44 @@
+"""Resilience subsystem: deterministic fault injection + recovery.
+
+The north star is a production-scale system, where transient failure is
+an *expected event*, not an error: a preempted host mid-checkpoint, a
+bad record in a multi-TB dataset, a coordinator hiccup during a
+multi-host rendezvous, a dropped ``on_complete`` wedging the engine.
+TensorFlow (Abadi et al., 2016) treats coordinated checkpointing plus
+bounded-retry recovery as a first-class subsystem; this package is that
+layer for mxnet_tpu — and, crucially, every recovery path is
+*exercisable on one host* through seeded fault injection, so CI proves
+the recovery code instead of hoping it works at 3am on a pod.
+
+Pieces (see docs/how_to/fault_tolerance.md):
+
+- ``faults`` — deterministic injection points (``faults.point(name)``)
+  registered at recordio reads, checkpoint writes, KVStore coordinator
+  ops, engine task bodies; driven by the seeded ``MXNET_FAULT_SPEC``
+  env spec or the programmatic ``inject()`` API.
+- ``retry`` — exponential-backoff-with-jitter ``RetryPolicy`` (max
+  attempts, deadline, retryable filter) used by the KVStore coordinator
+  paths, plus ``run_with_deadline`` for turning indefinite blocking
+  calls (dist barriers) into diagnosable timeouts.
+
+Consumers wired through the rest of the tree:
+
+- ``engine.py`` — ``MXNET_ENGINE_WAIT_TIMEOUT`` wait watchdog raising a
+  pending-op dump instead of deadlocking.
+- ``model.py`` — crash-safe checkpoints (tmp + fsync + atomic rename,
+  rolling retention), ``find_latest_checkpoint``, ``fit(resume=...)``.
+- ``recordio.py`` — ``corrupt="skip"`` record resync policy.
+- ``kvstore.py`` — retried coordinator ops, barrier timeout naming the
+  unresponsive ranks via heartbeat ages.
+"""
+from __future__ import annotations
+
+from . import faults, retry
+from .faults import FaultInjected, clear, inject, parse_spec, point
+from .retry import DeadlineExceeded, RetryPolicy, run_with_deadline
+
+__all__ = [
+    "faults", "retry",
+    "FaultInjected", "point", "inject", "clear", "parse_spec",
+    "RetryPolicy", "DeadlineExceeded", "run_with_deadline",
+]

@@ -43,3 +43,18 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 from mxnet_tpu.parallel import mesh as _mesh  # noqa: E402
 
 _mesh.set_default_devices(jax.devices("cpu"))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_specs():
+    """No fault-spec leakage across tests: rules armed by a test (the
+    `faulty` marker) or left over from a chaos run's MXNET_FAULT_SPEC
+    are dropped after every test; the env spec re-arms with fresh RNG
+    state on the next injection-point hit, so chaos runs replay the
+    same seeded pattern per test instead of a drifting global one."""
+    yield
+    from mxnet_tpu.resilience import faults
+
+    faults.clear()

@@ -133,3 +133,51 @@ def test_caffeop_argument_hygiene():
     net = mx.sym.CaffeLoss(data=d, label=lab, num_data=2, num_out=1,
                            prototxt='layer{type:"SoftmaxWithLoss"}')
     net.infer_shape(data=(2, 5), softmax_label=(2,))
+
+
+def test_caffeloss_emits_loss_blob_for_caffe_metric():
+    """The reference CaffeLoss outputs the loss blob, so verbatim-ported
+    scripts pass mx.metric.Caffe() and expect the loss value (ADVICE r5
+    item 1): CaffeLoss emits a gradient-blocked per-example NLL head
+    alongside the softmax, the metric reports its mean, and the data
+    gradient is bit-for-bit the plain SoftmaxOutput gradient."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 10).astype(np.float32)
+    y = rng.randint(0, 10, 6).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    net = mx.sym.CaffeLoss(data=data, label=label, name="softmax")
+    assert len(net.list_outputs()) == 2
+    exe = net.simple_bind(ctx=mx.cpu(), data=(6, 10), softmax_label=(6,))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["softmax_label"][:] = y
+    exe.forward(is_train=True)
+    exe.backward()
+
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    ref_nll = -np.log(p[np.arange(6), y.astype(int)])
+    assert np.allclose(exe.outputs[0].asnumpy(), p, atol=1e-5)
+    assert np.allclose(exe.outputs[1].asnumpy(), ref_nll, atol=1e-4)
+
+    # the metric reads the loss head, not the probabilities
+    m = mx.metric.Caffe()
+    m.update([mx.nd.array(y)], list(exe.outputs))
+    assert abs(m.get()[1] - ref_nll.mean()) < 1e-4
+    # a single-output (reference-style) loss blob still works
+    m2 = mx.metric.Caffe()
+    m2.update([mx.nd.array(y)], [exe.outputs[1]])
+    assert abs(m2.get()[1] - ref_nll.mean()) < 1e-4
+
+    # gradients are unchanged vs the bare softmax head (loss is blocked)
+    bare = mx.sym.SoftmaxOutput(data=data, label=label, name="softmax")
+    exe0 = bare.simple_bind(ctx=mx.cpu(), data=(6, 10), softmax_label=(6,))
+    exe0.arg_dict["data"][:] = x
+    exe0.arg_dict["softmax_label"][:] = y
+    exe0.forward(is_train=True)
+    exe0.backward()
+    assert np.allclose(exe.grad_dict["data"].asnumpy(),
+                       exe0.grad_dict["data"].asnumpy(), atol=1e-6)
+    assert np.allclose(exe.grad_dict["softmax_label"].asnumpy(),
+                       exe0.grad_dict["softmax_label"].asnumpy())

@@ -181,3 +181,29 @@ def test_multipart_magic_payload(tmp_path, monkeypatch):
                 assert r.read() == pay, (read_native, path, pay)
             assert r.read() is None
             r.close()
+
+
+def test_close_safe_after_failed_open(tmp_path):
+    """MXRecordIO.__del__/close() must not raise when open() failed
+    partway (ISSUE 2 satellite): constructing against an unwritable
+    path raises the IO error once, and the half-built object's close()
+    and finalizer are clean no-ops."""
+    bad = str(tmp_path / "no_such_dir" / "x.rec")
+    for cls, args in ((recordio.MXRecordIO, (bad, "w")),
+                      (recordio.MXIndexedRecordIO,
+                       (bad + ".idx", bad, "w"))):
+        holder = []
+
+        class Probe(cls):
+            def __init__(self, *a):
+                holder.append(self)
+                super().__init__(*a)
+
+        with pytest.raises(OSError):
+            Probe(*args)
+        obj = holder[0]
+        obj.close()   # explicit close: no AttributeError, no re-raise
+        obj.__del__()  # finalizer path likewise
+    # invalid flag fails before 'writable' exists; close still safe
+    with pytest.raises(ValueError):
+        recordio.MXRecordIO(str(tmp_path / "y.rec"), "rw")

@@ -1,0 +1,692 @@
+"""Resilience subsystem: deterministic fault injection, retry/backoff,
+engine wait watchdog, crash-safe checkpoints, record resync
+(mxnet_tpu/resilience/; docs/how_to/fault_tolerance.md).
+
+Every recovery path here is driven by seeded injection — no real
+hardware faults, fully deterministic, single host."""
+import logging
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu import model as model_mod
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import faults, retry
+from mxnet_tpu.resilience.faults import FaultInjected
+
+
+# -- fault spec parsing + determinism -----------------------------------------
+
+def test_fault_spec_parse_roundtrip():
+    rules = faults.parse_spec(
+        "ckpt.write:error:p=0.5:seed=7;rio.read:delay=0.05:count=3")
+    assert len(rules) == 2
+    a, b = rules
+    assert (a.point, a.mode, a.p, a.seed) == ("ckpt.write", "error", 0.5, 7)
+    assert (b.point, b.mode, b.delay, b.count) == ("rio.read", "delay", 0.05, 3)
+
+
+@pytest.mark.parametrize("bad", [
+    "noseparator", "pt:", "pt:wat", ":error", "pt:error:p=x",
+    "pt:error:frob=1", "pt:p=0.5",
+])
+def test_fault_spec_malformed_raises(bad):
+    with pytest.raises(MXNetError):
+        faults.parse_spec(bad)
+
+
+def test_fault_pattern_deterministic():
+    """Same seed -> same fire pattern; different seed -> (almost surely)
+    different pattern; p is honored in aggregate."""
+    p1 = faults.fire_pattern("x:error:p=0.5:seed=7", 64)
+    p2 = faults.fire_pattern("x:error:p=0.5:seed=7", 64)
+    p3 = faults.fire_pattern("x:error:p=0.5:seed=8", 64)
+    assert p1 == p2
+    assert p1 != p3
+    assert 10 < sum(p1) < 54  # ~Binomial(64, .5); bounds are 6-sigma
+
+
+@pytest.mark.faulty
+def test_fault_point_deterministic_through_registry():
+    """The live point() path fires the same pattern as fire_pattern for
+    the same spec — the registry adds no hidden RNG state."""
+    expect = faults.fire_pattern("pt:error:p=0.5:seed=3", 32)
+    for _ in range(2):
+        faults.clear()
+        faults.inject("pt:error:p=0.5:seed=3")
+        got = []
+        for _i in range(32):
+            try:
+                faults.point("pt")
+                got.append(False)
+            except FaultInjected:
+                got.append(True)
+        assert got == expect
+
+
+@pytest.mark.faulty
+def test_fault_count_and_skip():
+    faults.inject("pt:error:skip=2:count=1")
+    outcomes = []
+    for _ in range(6):
+        try:
+            faults.point("pt")
+            outcomes.append("ok")
+        except FaultInjected:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "ok", "boom", "ok", "ok", "ok"]
+
+
+@pytest.mark.faulty
+def test_fault_delay_mode_and_env_spec(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "pt:delay=0.05:count=1")
+    faults.clear()  # re-arm the env read
+    t0 = time.monotonic()
+    faults.point("pt")  # sleeps 50ms
+    took = time.monotonic() - t0
+    assert took >= 0.045, took
+    t0 = time.monotonic()
+    faults.point("pt")  # count exhausted: instant
+    assert time.monotonic() - t0 < 0.045
+    assert "pt" in faults.active()
+
+
+@pytest.mark.faulty
+def test_fault_clear_isolates():
+    faults.inject("pt:error")
+    faults.clear()
+    faults.point("pt")  # must be a no-op again
+
+
+# -- retry policy --------------------------------------------------------------
+
+def test_retry_backoff_schedule_monotone_and_jittered():
+    naps = []
+    pol = retry.RetryPolicy(max_attempts=6, base_delay=0.1, multiplier=2.0,
+                            max_delay=0.9, jitter=0.25, seed=11,
+                            sleep=naps.append)
+    sched = pol.schedule()
+    assert len(sched) == 5
+    # jitter bounds around the monotone, capped envelope
+    envelope = [0.1, 0.2, 0.4, 0.8, 0.9]
+    for got, raw in zip(sched, envelope):
+        assert raw * 0.75 <= got <= raw * 1.25, (got, raw)
+    # same seed -> same schedule (reproducible chaos)
+    assert sched == retry.RetryPolicy(
+        max_attempts=6, base_delay=0.1, multiplier=2.0, max_delay=0.9,
+        jitter=0.25, seed=11).schedule()
+    # a real run consumes the same schedule
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        pol2 = retry.RetryPolicy(max_attempts=6, base_delay=0.1,
+                                 multiplier=2.0, max_delay=0.9, jitter=0.25,
+                                 seed=11, sleep=naps.append)
+        pol2.call(always_fails)
+    assert len(calls) == 6
+    assert naps == sched
+
+
+def test_retry_succeeds_midway_and_filters():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    pol = retry.RetryPolicy(max_attempts=5, base_delay=0.001,
+                            sleep=lambda s: None)
+    assert pol.call(flaky) == "ok"
+    assert len(attempts) == 3
+
+    # non-retryable exceptions propagate on the FIRST attempt
+    def typeerr():
+        attempts.append(1)
+        raise TypeError("not transient")
+
+    attempts.clear()
+    pol = retry.RetryPolicy(max_attempts=5, base_delay=0.001,
+                            retryable=(OSError,), sleep=lambda s: None)
+    with pytest.raises(TypeError):
+        pol.call(typeerr)
+    assert len(attempts) == 1
+
+
+def test_retry_deadline_respected():
+    """The policy never sleeps past its deadline: when the next backoff
+    would cross it, the last error re-raises immediately."""
+    naps = []
+    pol = retry.RetryPolicy(max_attempts=10, base_delay=5.0, jitter=0.0,
+                            deadline=1.0, sleep=naps.append)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        pol.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert time.monotonic() - t0 < 1.0
+    assert naps == []  # first 5s backoff would cross the 1s deadline
+
+
+def test_run_with_deadline():
+    assert retry.run_with_deadline(lambda: 42, 5.0) == 42
+    with pytest.raises(ValueError):
+        retry.run_with_deadline(
+            lambda: (_ for _ in ()).throw(ValueError("inner")), 5.0)
+    with pytest.raises(retry.DeadlineExceeded):
+        retry.run_with_deadline(lambda: time.sleep(3), 0.1, what="nap")
+
+
+# -- kvstore: dead-rank naming + barrier timeout -------------------------------
+
+class _FakeHBClient:
+    """Coordination-service stand-in: mxtpu_hb/<rank> keys only."""
+
+    def __init__(self, beats):
+        self.kv = {"mxtpu_hb/%d" % r: repr(ts) for r, ts in beats.items()}
+
+    def key_value_try_get(self, k):
+        if k not in self.kv:
+            raise RuntimeError("NOT_FOUND: %s" % k)
+        return self.kv[k]
+
+
+class _ThreeRankKV(mx.kvstore.KVStore):
+    num_workers = property(lambda self: 3)
+    rank = property(lambda self: 0)
+
+
+def _kv_with_dead_rank_1():
+    kv = _ThreeRankKV("local")
+    now = time.time()
+    # ranks 0/2 beat recently; rank 1 stopped beating 1000s ago —
+    # first-observation staleness fallback (value-change detection has
+    # no baseline yet) flags it via the embedded send time
+    kv._hb_client = _FakeHBClient({0: now, 1: now - 1000.0, 2: now})
+    return kv
+
+
+def test_dead_ranks_names_stale_rank():
+    kv = _kv_with_dead_rank_1()
+    assert kv.dead_ranks(timeout=5) == [1]
+    assert kv.get_num_dead_node(timeout=5) == 1
+
+
+@pytest.mark.faulty
+def test_barrier_timeout_names_dead_ranks(monkeypatch):
+    """A hung dist barrier raises a diagnostic naming the unresponsive
+    ranks (by heartbeat age) instead of hanging forever. The hang is an
+    injected kv.barrier delay — the same seeded-injection discipline a
+    chaos run uses."""
+    kv = _kv_with_dead_rank_1()
+    faults.inject("kv.barrier:delay=30")
+    monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "0.2")
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError, match=r"unresponsive: ranks \[1\]"):
+        kv._barrier_rendezvous()
+    assert time.monotonic() - t0 < 5.0  # raised at the deadline, no hang
+
+
+def test_barrier_no_timeout_configured_runs_sync(monkeypatch):
+    monkeypatch.delenv("MXNET_KV_BARRIER_TIMEOUT", raising=False)
+    kv = _ThreeRankKV("local")
+    ran = []
+    kv._barrier_sync = lambda: ran.append(1)
+    kv._barrier_rendezvous()
+    assert ran == [1]
+
+
+@pytest.mark.faulty
+def test_kv_coord_retry_heals_transient_faults():
+    """A kv.coord fault that fires once is absorbed by the retry policy;
+    a persistent one surfaces after the attempt budget."""
+    calls = []
+    faults.inject("kv.coord:error:count=1")
+    assert mx.kvstore._coord_call(lambda: calls.append(1) or "ok") == "ok"
+    assert len(calls) == 1  # failed before fn on attempt 1, ran on attempt 2
+    faults.clear()
+    faults.inject("kv.coord:error")  # persistent
+    with pytest.raises(FaultInjected):
+        mx.kvstore._coord_call(lambda: "ok")
+
+
+# -- engine: task faults + wait watchdog ---------------------------------------
+
+@pytest.mark.faulty
+def test_engine_task_fault_surfaces_on_wait():
+    eng = mx.engine.Engine.get()
+    faults.inject("engine.task:error:count=1")
+    # native engine: the worker hits the fault and defers it to the next
+    # wait; NaiveEngine fallback: the inline push raises directly —
+    # either way the fault surfaces on the caller thread
+    with pytest.raises(FaultInjected):
+        eng.push(lambda: None)
+        eng.wait_for_all()
+    eng.push(lambda: None)  # next task is clean
+    eng.wait_for_all()
+
+
+def test_engine_watchdog_raises_pending_dump(monkeypatch):
+    """A native push whose on_complete is never invoked must not
+    deadlock wait_for_all/wait_for_var: with MXNET_ENGINE_WAIT_TIMEOUT
+    armed they raise a pending-op dump naming the in-flight task."""
+    eng = mx.engine.Engine.get()
+    if not eng.is_native:
+        pytest.skip("needs the native engine")
+    eng.wait_for_all()  # drain anything earlier tests queued
+    var = eng.new_variable()
+    stuck = []
+
+    def never_completes(on_complete):
+        stuck.append(on_complete)
+
+    eng.push_async(never_completes, mutable_vars=[var])
+    monkeypatch.setenv("MXNET_ENGINE_WAIT_TIMEOUT", "0.3")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(MXNetError, match="never_completes"):
+            eng.wait_for_all()
+        assert time.monotonic() - t0 < 10.0
+        with pytest.raises(MXNetError, match="wait_for_var"):
+            eng.wait_for_var(var)
+    finally:
+        # un-wedge: complete the op so later tests (and interpreter
+        # exit) can wait cleanly
+        assert stuck
+        stuck[0]()
+    monkeypatch.delenv("MXNET_ENGINE_WAIT_TIMEOUT")
+    eng.wait_for_all()
+    eng.delete_variable(var)
+
+
+def test_engine_watchdog_passes_when_work_completes(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_WAIT_TIMEOUT", "30")
+    eng = mx.engine.Engine.get()
+    done = []
+    eng.push(lambda: done.append(1))
+    eng.wait_for_all()
+    assert done == [1]
+
+
+# -- recordio: corrupt-record skip with resync ---------------------------------
+
+def _write_rec(uri, recs):
+    w = recordio.MXRecordIO(uri, "w")
+    for r in recs:
+        w.write(r)
+    w.close()
+    offs, off = [], 0
+    for r in recs:
+        offs.append(off)
+        off += 8 + len(r) + ((4 - len(r) % 4) % 4)
+    return offs
+
+
+def test_recordio_corrupt_skip_resyncs_and_counts(tmp_path):
+    uri = str(tmp_path / "t.rec")
+    recs = [("rec%03d" % i).encode() * (3 + i % 5) for i in range(12)]
+    offs = _write_rec(uri, recs)
+    data = bytearray(open(uri, "rb").read())
+    data[offs[3]] ^= 0xFF   # torn magic
+    data[offs[7] + 1] ^= 0xFF  # second torn record
+    open(uri, "wb").write(bytes(data))
+
+    # default policy: first bad record kills the epoch
+    r = recordio.MXRecordIO(uri, "r")
+    got = []
+    with pytest.raises(MXNetError, match="invalid record magic"):
+        while True:
+            s = r.read()
+            if s is None:
+                break
+            got.append(s)
+    assert got == recs[:3]
+    r.close()
+
+    # skip policy: resync past both, count them
+    r = recordio.MXRecordIO(uri, "r", corrupt="skip")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    assert got == recs[:3] + recs[4:7] + recs[8:]
+    assert r.num_skipped == 2
+    r.close()
+
+
+def test_recordio_corrupt_skip_truncated_tail(tmp_path):
+    uri = str(tmp_path / "t.rec")
+    recs = [b"payload-%d" % i for i in range(5)]
+    offs = _write_rec(uri, recs)
+    with open(uri, "r+b") as f:  # cut the last record's payload short
+        f.truncate(offs[-1] + 10)
+    r = recordio.MXRecordIO(uri, "r", corrupt="skip")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    assert got == recs[:4]
+    assert r.num_skipped == 1
+    r.close()
+
+
+def test_recordio_corrupt_policy_validated(tmp_path):
+    with pytest.raises(ValueError):
+        recordio.MXRecordIO(str(tmp_path / "x.rec"), "w", corrupt="mangle")
+
+
+@pytest.mark.faulty
+def test_recordio_read_fault_point(tmp_path):
+    uri = str(tmp_path / "t.rec")
+    _write_rec(uri, [b"abc", b"defg"])
+    faults.inject("rio.read:error:count=1")
+    r = recordio.MXRecordIO(uri, "r")
+    with pytest.raises(FaultInjected):
+        r.read()
+    assert r.read() in (b"abc", b"defg")  # native prefetcher may not replay
+    r.close()
+
+
+# -- checkpoints: atomicity, retention, resume ---------------------------------
+
+def _toy_net():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _toy_params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"fc_w": mx.nd.array(rng.rand(4, 6).astype("f")),
+            "fc_b": mx.nd.array(rng.rand(4).astype("f"))}
+
+
+@pytest.mark.faulty
+def test_checkpoint_crash_leaves_no_torn_file(tmp_path):
+    """An injected crash mid-save leaves the previous epoch intact and
+    NO half-written .params under the final name (tmp + atomic rename);
+    find_latest_checkpoint lands on the newest valid epoch."""
+    prefix = str(tmp_path / "toy")
+    net, params = _toy_net(), _toy_params()
+    model_mod.save_checkpoint(prefix, 1, net, params, {}, sync=True)
+    model_mod.save_checkpoint(prefix, 2, net, params, {}, sync=True)
+    faults.inject("ckpt.write:error:count=1")
+    with pytest.raises(FaultInjected):
+        model_mod.save_checkpoint(prefix, 3, net, params, {}, sync=True)
+    assert not os.path.exists(prefix + "-0003.params")
+    files = os.listdir(str(tmp_path))
+    assert any(".tmp-" in f for f in files), files  # the stranded tmp
+    assert model_mod.find_latest_checkpoint(prefix) == 2
+    # every surviving .params parses fully
+    for ep in (1, 2):
+        mx.nd.load("%s-%04d.params" % (prefix, ep))
+
+
+def test_find_latest_skips_corrupt_epochs(tmp_path):
+    prefix = str(tmp_path / "toy")
+    net, params = _toy_net(), _toy_params()
+    for ep in (1, 2, 3):
+        model_mod.save_checkpoint(prefix, ep, net, params, {}, sync=True)
+    with open(prefix + "-0003.params", "r+b") as f:
+        f.truncate(17)  # torn (as if written in place by a crash)
+    with open(prefix + "-0002.params", "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 8)  # bad magic
+    assert model_mod.find_latest_checkpoint(prefix) == 1
+    assert model_mod.find_latest_checkpoint(str(tmp_path / "nothing")) is None
+    # hand-torn fixtures must not trip the chaos harness's torn-file
+    # scan (a leftover torn .params means a REAL atomicity violation)
+    os.remove(prefix + "-0002.params")
+    os.remove(prefix + "-0003.params")
+
+
+def test_checkpoint_rolling_retention(tmp_path):
+    prefix = str(tmp_path / "toy")
+    net, params = _toy_net(), _toy_params()
+    for ep in range(1, 7):
+        model_mod.save_checkpoint(prefix, ep, net, params, {}, sync=True,
+                                  keep_n=2)
+    kept = sorted(f for f in os.listdir(str(tmp_path))
+                  if f.endswith(".params"))
+    assert kept == ["toy-0005.params", "toy-0006.params"], kept
+
+
+def _toy_task(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 20).astype("f")
+    Y = (X[:, 0] + 2 * X[:, 1] > 1.2).astype("f")
+    return X, Y
+
+
+def _small_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+@pytest.mark.faulty
+def test_fit_resume_after_killed_checkpoint(tmp_path):
+    """Acceptance path: a fit() killed by an injected fault during the
+    epoch-3 checkpoint reruns with resume=True and restarts from the
+    newest valid epoch with matching params."""
+    mx.random.seed(5)
+    np.random.seed(5)
+    prefix = str(tmp_path / "toy")
+    X, Y = _toy_task()
+    ckpt = mx.callback.do_checkpoint(prefix)
+    m1 = mx.FeedForward(_small_mlp(), ctx=mx.cpu(), num_epoch=3,
+                        learning_rate=0.1)
+    faults.inject("ckpt.write:error:skip=2:count=1")  # kill the 3rd save
+    with pytest.raises(MXNetError):
+        m1.fit(X=mx.io.NDArrayIter(X, Y, batch_size=32),
+               epoch_end_callback=ckpt)
+    faults.clear()
+    assert model_mod.find_latest_checkpoint(prefix) == 2
+    assert not os.path.exists(prefix + "-0003.params")
+
+    # resume discovers the prefix from the do_checkpoint callback,
+    # reloads epoch 2's params exactly, and continues from there
+    _sym, arg2, _aux2 = model_mod.load_checkpoint(prefix, 2)
+    m2 = mx.FeedForward(_small_mlp(), ctx=mx.cpu(), num_epoch=3,
+                        learning_rate=0.1)
+    m2._resume_from_checkpoint(True, ckpt, logging)
+    assert m2.begin_epoch == 2
+    for k, v in arg2.items():
+        assert np.allclose(m2.arg_params[k].asnumpy(), v.asnumpy()), k
+
+    m2.fit(X=mx.io.NDArrayIter(X, Y, batch_size=32),
+           epoch_end_callback=ckpt, resume=True)
+    assert model_mod.find_latest_checkpoint(prefix) == 3
+    mx.nd.load(prefix + "-0003.params")  # fully valid
+
+
+def test_fit_resume_fresh_run_starts_from_scratch(tmp_path):
+    prefix = str(tmp_path / "none")
+    X, Y = _toy_task(64)
+    m = mx.FeedForward(_small_mlp(), ctx=mx.cpu(), num_epoch=1,
+                       learning_rate=0.1)
+    m.fit(X=mx.io.NDArrayIter(X, Y, batch_size=32), resume=prefix)
+    assert m.begin_epoch == 0
+
+
+def test_fit_resume_needs_a_prefix():
+    X, Y = _toy_task(64)
+    m = mx.FeedForward(_small_mlp(), ctx=mx.cpu(), num_epoch=1,
+                       learning_rate=0.1)
+    with pytest.raises(MXNetError, match="prefix"):
+        m.fit(X=mx.io.NDArrayIter(X, Y, batch_size=32), resume=True)
+
+
+# -- chaos tool ---------------------------------------------------------------
+
+def _load_chaos():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "tools",
+                        "chaos.py")
+    spec = importlib.util.spec_from_file_location("chaos", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_spec_is_seeded_and_parseable():
+    chaos = _load_chaos()
+    s1 = chaos.build_spec(0, ["ckpt.write", "rio.read"], "error")
+    s2 = chaos.build_spec(0, ["ckpt.write", "rio.read"], "error")
+    s3 = chaos.build_spec(1, ["ckpt.write", "rio.read"], "error")
+    assert s1 == s2 != s3
+    rules = faults.parse_spec(s1)
+    assert [r.point for r in rules] == ["ckpt.write", "rio.read"]
+
+
+def test_chaos_torn_params_scan(tmp_path):
+    chaos = _load_chaos()
+    net, params = _toy_net(), _toy_params()
+    prefix = str(tmp_path / "m")
+    model_mod.save_checkpoint(prefix, 1, net, params, {}, sync=True)
+    assert chaos.scan_torn_params(str(tmp_path)) == []
+    torn = str(tmp_path / "bad-0002.params")
+    good = open(prefix + "-0001.params", "rb").read()
+    open(torn, "wb").write(good[:len(good) // 2])  # in-place half write
+    assert chaos.scan_torn_params(str(tmp_path)) == [torn]
+    os.remove(torn)  # fixture, not a real violation (see chaos scan)
+
+
+def test_module_load_latest_valid_epoch(tmp_path):
+    """Module.load(prefix, epoch=None) resumes from the newest VALID
+    checkpoint, skipping a torn newer one."""
+    prefix = str(tmp_path / "mod")
+    X, Y = _toy_task(64)
+    mod = mx.module.Module(_small_mlp(), context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(X, Y, batch_size=32), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1})
+    mod.save_checkpoint(prefix, 1)
+    mod.save_checkpoint(prefix, 2)
+    with open(prefix + "-0002.params", "r+b") as f:
+        f.truncate(9)  # torn
+    m2 = mx.module.Module.load(prefix, epoch=None)
+    _sym, args, _ = model_mod.load_checkpoint(prefix, 1)
+    for k, v in args.items():
+        assert np.allclose(m2._arg_params[k].asnumpy(), v.asnumpy()), k
+    with pytest.raises(MXNetError, match="no valid checkpoint"):
+        mx.module.Module.load(str(tmp_path / "nope"), epoch=None)
+    os.remove(prefix + "-0002.params")  # hand-torn fixture (chaos scan)
+
+
+def test_prune_ignores_sibling_prefix_checkpoints(tmp_path):
+    """A sibling run with a longer prefix ('model-ft') must neither
+    inject phantom epochs into 'model' nor lose files to its pruning."""
+    net, params = _toy_net(), _toy_params()
+    a, b = str(tmp_path / "model"), str(tmp_path / "model-ft")
+    for ep in (1, 2):
+        model_mod.save_checkpoint(a, ep, net, params, {}, sync=True)
+    for ep in (5, 6):
+        model_mod.save_checkpoint(b, ep, net, params, {}, sync=True)
+    assert model_mod._checkpoint_epochs(a) == [2, 1]
+    assert model_mod._checkpoint_epochs(b) == [6, 5]
+    model_mod._prune_checkpoints(a, 1)
+    kept = sorted(f for f in os.listdir(str(tmp_path))
+                  if f.endswith(".params"))
+    assert kept == ["model-0002.params", "model-ft-0005.params",
+                    "model-ft-0006.params"], kept
+
+
+def test_recordio_skip_drops_orphan_multipart_tail(tmp_path):
+    """Resync landing on a multipart continuation (its head destroyed)
+    must DROP the tail parts, not fabricate a record from them."""
+    uri = str(tmp_path / "mp.rec")
+    magic = struct.pack("<I", 0xCED7230A)
+    multipart = b"head" + magic + b"mid" + magic + b"tail"  # 3 parts
+    recs = [b"first-record", multipart, b"last-record"]
+    offs = _write_rec(uri, recs)
+    data = bytearray(open(uri, "rb").read())
+    data[offs[1]] ^= 0xFF  # destroy the multipart's cflag-1 head magic
+    open(uri, "wb").write(bytes(data))
+
+    r = recordio.MXRecordIO(uri, "r", corrupt="skip")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    assert got == [b"first-record", b"last-record"], got
+    assert r.num_skipped == 1  # one record lost, counted once
+    r.close()
+
+    # strict mode on a file that STARTS with an orphan continuation
+    orphan = str(tmp_path / "orphan.rec")
+    whole = open(uri, "rb").read()
+    # part 2 of the multipart starts right after the corrupted head:
+    # header(8) + len("head")=4 (4-aligned) bytes in
+    open(orphan, "wb").write(whole[offs[1] + 12:])
+    r2 = recordio.MXRecordIO(orphan, "r")
+    r2._nh = None if r2._nh is None else r2._nh  # keep native if built
+    if r2._nh is None:  # strict-orphan detail is a python-path contract
+        with pytest.raises(MXNetError, match="orphan multipart"):
+            r2.read()
+    r2.close()
+
+
+def test_recordio_skip_survives_corrupt_length_word(tmp_path):
+    """A bit-flipped LENGTH word (magic intact) must resync to the next
+    record, not read as EOF and drop the rest of the epoch."""
+    uri = str(tmp_path / "len.rec")
+    recs = [b"alpha-record", b"beta-record!", b"gamma-record"]
+    offs = _write_rec(uri, recs)
+    data = bytearray(open(uri, "rb").read())
+    # blow up record 1's length field (header bytes 4..8), keep magic
+    data[offs[1] + 6] = 0x0F  # ~ hundreds of KB: runs past EOF
+    open(uri, "wb").write(bytes(data))
+    r = recordio.MXRecordIO(uri, "r", corrupt="skip")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    assert got == [b"alpha-record", b"gamma-record"], got
+    assert r.num_skipped == 1
+    r.close()
+
+
+def test_retention_prunes_optimizer_states(tmp_path):
+    prefix = str(tmp_path / "toy")
+    net, params = _toy_net(), _toy_params()
+    for ep in (1, 2, 3):
+        model_mod.save_checkpoint(prefix, ep, net, params, {}, sync=True)
+        open("%s-%04d.states" % (prefix, ep), "wb").write(b"opt-state")
+    model_mod._prune_checkpoints(prefix, 1)
+    left = sorted(f for f in os.listdir(str(tmp_path))
+                  if f.endswith((".params", ".states")))
+    assert left == ["toy-0003.params", "toy-0003.states"], left
+
+
+def test_barrier_timeout_env_typo_is_named(monkeypatch):
+    kv = _ThreeRankKV("local")
+    monkeypatch.setenv("MXNET_KV_BARRIER_TIMEOUT", "30s")
+    with pytest.raises(MXNetError, match="MXNET_KV_BARRIER_TIMEOUT"):
+        kv._barrier_rendezvous()
+
+
+def test_engine_wait_timeout_env_typo_is_named(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_WAIT_TIMEOUT", "soon")
+    with pytest.raises(MXNetError, match="MXNET_ENGINE_WAIT_TIMEOUT"):
+        mx.engine.Engine.get().wait_for_all()
