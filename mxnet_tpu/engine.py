@@ -29,6 +29,7 @@ import time
 from contextlib import nullcontext as _null_context
 
 from . import _native
+from . import telemetry as _tel
 from .base import MXNetError
 from .resilience import faults as _faults
 
@@ -150,6 +151,7 @@ class Engine:
             # op context (waits would misattribute their waiter)
             ctx = ev_trace.op_context(ev) if ev is not None \
                 else _null_context()
+            t0 = time.monotonic() if _tel.ENABLED else 0.0
             if is_async:
                 called = [False]
 
@@ -179,6 +181,11 @@ class Engine:
                 finally:
                     with self._live_lock:
                         self._inflight.pop(key, None)
+            if _tel.ENABLED:
+                # async latency covers fn's dispatch body (durability is
+                # on_complete's clock, which may outlive this frame)
+                _tel.histogram("engine.task_secs").observe(
+                    time.monotonic() - t0)
 
         self._trampoline = _ENGINE_FN(_trampoline) if lib is not None else None
 
@@ -319,7 +326,10 @@ class Engine:
             ev = trace.push(getattr(fn, "__name__", None) or "fn",
                             [v._uid for v in const_vars],
                             [v._uid for v in mutable_vars])
+        if _tel.ENABLED:
+            _tel.counter("engine.push_total").inc()
         if handle is None:  # NaiveEngine fallback: run inline
+            t0 = time.monotonic() if _tel.ENABLED else 0.0
             ctx = trace.op_context(ev) if ev is not None else _null_context()
             with ctx:
                 _faults.point("engine.task")
@@ -329,6 +339,9 @@ class Engine:
                     done.wait()
                 else:
                     fn()
+            if _tel.ENABLED:
+                _tel.histogram("engine.task_secs").observe(
+                    time.monotonic() - t0)
             return
         with self._live_lock:
             key = self._next_key
@@ -342,6 +355,9 @@ class Engine:
         rc = self._lib.EnginePush(
             handle, self._trampoline, ctypes.c_void_p(key),
             c_arr, n_c, m_arr, n_m, priority, 0 if is_async else 1)
+        if _tel.ENABLED and rc == 0:
+            _tel.gauge("engine.queue_depth").set(
+                self._lib.EnginePendingCount(handle))
         if rc != 0:
             with self._live_lock:
                 self._live.pop(key, None)
@@ -361,6 +377,8 @@ class Engine:
         trace = self._trace
         if trace is not None:
             trace.wait(var._uid)
+        if _tel.ENABLED:
+            _tel.counter("engine.waits_total").inc()
         self._maybe_verify()
         h = self._handle_snapshot()
         if h is not None and var._ptr:
@@ -379,6 +397,8 @@ class Engine:
                 self.push(__engine_wait_sentinel__, const_vars=[var],
                           priority=1 << 20)
                 if not reached.wait(timeout):
+                    if _tel.ENABLED:
+                        _tel.counter("engine.watchdog_fires_total").inc()
                     # a deferred task error is the likely ROOT CAUSE of
                     # the wedge (fn raised before calling on_complete);
                     # surface it in preference to the generic timeout
@@ -396,6 +416,8 @@ class Engine:
         trace = self._trace
         if trace is not None:
             trace.wait(None)
+        if _tel.ENABLED:
+            _tel.counter("engine.waits_total").inc()
         self._maybe_verify()
         h = self._handle_snapshot()
         if h is not None:
@@ -403,6 +425,8 @@ class Engine:
             if timeout is None:
                 self._lib.EngineWaitForAll(h)
             elif not self._poll_pending(h, timeout):
+                if _tel.ENABLED:
+                    _tel.counter("engine.watchdog_fires_total").inc()
                 self._raise_pending()  # root cause beats generic timeout
                 raise MXNetError(
                     "engine wait_for_all exceeded "
@@ -477,20 +501,27 @@ def _drain_at_exit():
     pending-op dump instead of hanging interpreter shutdown forever."""
     e = Engine._instance
     if e is None or e._handle is None:
+        _tel.flush_at_exit()  # journal final flush rides the drain hook
         return
     try:
         timeout = _wait_timeout()
         if timeout is None:
             e._lib.EngineWaitForAll(e._handle)
         elif not e._poll_pending(e._handle, timeout):
+            if _tel.ENABLED:
+                _tel.counter("engine.watchdog_fires_total").inc()
             logging.error(
                 "engine: exit drain exceeded "
                 "MXNET_ENGINE_WAIT_TIMEOUT=%gs\n%s",
                 timeout, e.pending_dump())
     except Exception:
+        _tel.flush_at_exit()
         return
     for err in e._errors:
         logging.error("engine: pending task failed: %r", err)
+    # metrics recorded by tasks that completed during the drain are now
+    # final — flush them before the interpreter tears the journal down
+    _tel.flush_at_exit()
 
 
 def get():

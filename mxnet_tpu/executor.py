@@ -27,9 +27,11 @@ grad_req semantics (write/add/null) follow OpReqType kWriteTo/kAddTo/kNullOp
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray, zeros
@@ -185,6 +187,10 @@ class Executor:
             self._fwd_infer = jax.jit(functools.partial(self._run, is_train=False))
             self._fwd_train = jax.jit(functools.partial(self._run, is_train=True))
             self._fwd_bwd = jax.jit(self._fwd_bwd_impl)
+            if _tel.ENABLED:
+                # each bind builds fresh programs — under bucketing /
+                # reshape this is the recompile stream worth watching
+                _tel.counter("executor.jit_builds_total").inc(3)
 
         self._outputs_nd = None
         self._grad_cache = None  # (arg_versions, grads)
@@ -307,6 +313,8 @@ class Executor:
                 key = (idx, is_train)
                 if key not in self._seg_jit:
                     self._seg_jit[key] = jax.jit(self._seg_fn(item, is_train))
+                    if _tel.ENABLED:
+                        _tel.counter("executor.jit_builds_total").inc()
                 ext_vals = [env[k] for k in ext_keys]
                 aux_in = [new_aux[j] for j in aux_ids]
                 rngs = ([jax.random.fold_in(rng, s) for s in rng_serials]
@@ -352,6 +360,8 @@ class Executor:
             return ext_cts
 
         self._seg_bwd_jit[idx] = jax.jit(bwd)
+        if _tel.ENABLED:
+            _tel.counter("executor.jit_builds_total").inc()
         return self._seg_bwd_jit[idx]
 
     def _hybrid_backward(self, head_grads):
@@ -705,7 +715,19 @@ class Executor:
         return dict(zip(self._output_names, self.outputs))
 
     def forward(self, is_train=False, **kwargs):
-        """ref: python/mxnet/executor.py:118 / GraphExecutor::Forward."""
+        """ref: python/mxnet/executor.py:118 / GraphExecutor::Forward.
+        mxtel: per-call walltime lands in ``executor.forward_secs``
+        (all binds aggregate into one process histogram)."""
+        if not _tel.ENABLED:
+            return self._forward_impl(is_train, **kwargs)
+        t0 = _time.monotonic()
+        try:
+            return self._forward_impl(is_train, **kwargs)
+        finally:
+            _tel.histogram("executor.forward_secs").observe(
+                _time.monotonic() - t0)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         if kwargs:
             arg_dict = self.arg_dict
             for k, v in kwargs.items():
@@ -764,7 +786,18 @@ class Executor:
     def backward(self, out_grads=None):
         """ref: python/mxnet/executor.py:148 / GraphExecutor::Backward.
         With no out_grads, heads must be loss ops (no_head_grad) — the
-        reference asserts the same (graph_executor.cc head_grad handling)."""
+        reference asserts the same (graph_executor.cc head_grad handling).
+        mxtel: per-call walltime lands in ``executor.backward_secs``."""
+        if not _tel.ENABLED:
+            return self._backward_impl(out_grads)
+        t0 = _time.monotonic()
+        try:
+            return self._backward_impl(out_grads)
+        finally:
+            _tel.histogram("executor.backward_secs").observe(
+                _time.monotonic() - t0)
+
+    def _backward_impl(self, out_grads=None):
         import jax.numpy as jnp
 
         if not self._grad_idx:

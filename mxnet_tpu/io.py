@@ -12,12 +12,14 @@ import gzip
 import os
 import struct
 import threading
+import time as _time
 import queue as _queue
 
 import re as _re
 
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray, array
@@ -254,6 +256,15 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
+        if not _tel.ENABLED:
+            return self._next_impl()
+        t0 = _time.monotonic()
+        batch = self._next_impl()  # StopIteration is not a fetch
+        _tel.histogram("io.batch_fetch_secs").observe(
+            _time.monotonic() - t0)
+        return batch
+
+    def _next_impl(self):
         if self.iter_next():
             return DataBatch(
                 data=self.getdata(), label=self.getlabel(),
@@ -470,7 +481,16 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _fetch(self):
-        batches = self._queue.get()
+        if _tel.ENABLED:
+            # occupancy BEFORE the get: depth==0 means the consumer is
+            # about to stall on the producer (the signal that matters)
+            _tel.gauge("io.prefetch_queue_depth").set(self._queue.qsize())
+            t0 = _time.monotonic()
+            batches = self._queue.get()
+            _tel.histogram("io.batch_fetch_secs").observe(
+                _time.monotonic() - t0)
+        else:
+            batches = self._queue.get()
         if batches is None:
             return None
         if self.n_iter == 1:
@@ -796,6 +816,15 @@ class ImageRecordIter(DataIter):
         return out, labels
 
     def next(self):
+        if not _tel.ENABLED:
+            return self._next_impl()
+        t0 = _time.monotonic()
+        batch = self._next_impl()
+        _tel.histogram("io.batch_fetch_secs").observe(
+            _time.monotonic() - t0)
+        return batch
+
+    def _next_impl(self):
         if not self.iter_next():
             raise StopIteration
         recs = [self._records[self._order[self.cursor + i]]

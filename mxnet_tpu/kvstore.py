@@ -33,6 +33,7 @@ import warnings
 
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray
@@ -73,6 +74,11 @@ def _coord_call(fn, what="kv-coordinator op"):
 
 def _ctypes_key(key):
     return key
+
+
+def _nd_bytes(arr):
+    """Payload size of one NDArray/numpy value (telemetry byte counters)."""
+    return int(_np.prod(arr.shape)) * _np.dtype(arr.dtype).itemsize
 
 
 class KVStore:
@@ -121,10 +127,13 @@ class KVStore:
 
         def _set(ts):
             try:
-                return _coord_call(lambda: _publish(ts),
-                                   what="heartbeat publish")
+                ok = _coord_call(lambda: _publish(ts),
+                                 what="heartbeat publish")
             except Exception:
                 return False
+            if ok and _tel.ENABLED:
+                _tel.counter("kvstore.heartbeat_publish_total").inc()
+            return ok
 
         if not _set(time.time()):
             self._hb_client = None
@@ -211,6 +220,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %s has not been inited" % k)
             merged_list.append(self._reduce(vals, self._store[k]))
+        if _tel.ENABLED:
+            _tel.counter("kvstore.push_total").inc()
+            _tel.counter("kvstore.push_bytes_total").inc(
+                sum(_nd_bytes(m) for m in merged_list))
         merged_list = self._global_reduce_many(merged_list)
         for k, merged in zip(order, merged_list):
             if self._updater is not None:
@@ -228,6 +241,12 @@ class KVStore:
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 self._store[k].copyto(t)
+        if _tel.ENABLED:
+            _tel.counter("kvstore.pull_total").inc()
+            _tel.counter("kvstore.pull_bytes_total").inc(sum(
+                _nd_bytes(self._store[k])
+                * (len(o) if isinstance(o, (list, tuple)) else 1)
+                for k, o in zip(keys, outs)))
 
     def _reduce(self, vals, stored):
         """Sum values (possibly on different devices) onto the first value's
@@ -388,7 +407,15 @@ class KVStore:
             import jax
 
             if jax.process_count() > 1:
-                self._barrier_rendezvous()
+                if _tel.ENABLED:
+                    t0 = time.monotonic()
+                    try:
+                        self._barrier_rendezvous()
+                    finally:
+                        _tel.histogram("kvstore.barrier_wait_secs").observe(
+                            time.monotonic() - t0)
+                else:
+                    self._barrier_rendezvous()
 
     def _barrier_sync(self):
         """The blocking rendezvous body (separated so the deadline
@@ -884,6 +911,10 @@ class _AsyncDistKVStore(KVStore):
             vals = v if isinstance(v, (list, tuple)) else [v]
             merged = self._reduce(list(vals), self._store[k])
             group.append((k, merged.asnumpy()))
+        if _tel.ENABLED:
+            _tel.counter("kvstore.push_total").inc()
+            _tel.counter("kvstore.push_bytes_total").inc(
+                sum(arr.nbytes for _k, arr in group))
         self._seq += 1
         # payload first, then the sequence bump that makes it visible;
         # both retried — a transient coordinator error on a push must
@@ -907,6 +938,7 @@ class _AsyncDistKVStore(KVStore):
     def pull(self, key, out=None, priority=0):
         assert out is not None
         keys, outs = self._key_value(key, out, allow_list_per_key=True)
+        pulled_bytes = 0
         for k, o in zip(keys, outs):
             k = str(k)
             if k not in self._store:
@@ -923,6 +955,11 @@ class _AsyncDistKVStore(KVStore):
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 nd.copyto(t)
+            pulled_bytes += arr.nbytes * len(targets)
+        if _tel.ENABLED:
+            # one inc per CALL, matching the sync store's semantics
+            _tel.counter("kvstore.pull_total").inc()
+            _tel.counter("kvstore.pull_bytes_total").inc(pulled_bytes)
 
     def set_optimizer(self, optimizer):
         """Ship the pickled optimizer to the server (the reference's

@@ -16,6 +16,7 @@ from collections import namedtuple
 
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .resilience import faults as _faults
 from .context import Context, cpu, current_context
@@ -129,9 +130,12 @@ def _buffer_batch(data_batch, input_names):
 def _scan_flush(trainer, buf, epoch, nbatch0):
     """Dispatch one K-batch chunk; returns the pending record drained
     after the NEXT chunk is in flight (shared by FeedForward's
-    _train_scanned and Module._try_scanned_fit)."""
-    staged = trainer.stage_chunk(buf)
-    return (trainer.run_chunk(staged), buf, epoch, nbatch0)
+    _train_scanned and Module._try_scanned_fit). mxtel: the "chunk"
+    span covers staging + dispatch (the async device work completes
+    later — the drain's metric fence is its clock)."""
+    with _tel.span("chunk"):
+        staged = trainer.stage_chunk(buf)
+        return (trainer.run_chunk(staged), buf, epoch, nbatch0)
 
 
 def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
@@ -191,8 +195,7 @@ def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
 
     label_names = [_desc_name(d) for d in train_data.provide_label]
 
-    train_data.reset()
-    for epoch in range(begin_epoch, end_epoch):
+    def _scanned_one_epoch(epoch):
         tic = time.time()
         eval_metric.reset()
         nbatch = 0
@@ -224,6 +227,11 @@ def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
         _drain(pending, eval_metric)
         toc = time.time()
         logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+
+    train_data.reset()
+    for epoch in range(begin_epoch, end_epoch):
+        with _tel.span("epoch"):
+            _scanned_one_epoch(epoch)
 
         trainer.write_back(arg_params, aux_params, aux_names)
         _multiple_callbacks(epoch_end_callback, epoch, symbol, arg_params,
@@ -348,37 +356,56 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
     if update_on_kvstore:
         kvstore.set_optimizer(optimizer)
 
-    train_data.reset()
-    for epoch in range(begin_epoch, end_epoch):
+    def _train_one_batch(data_batch, epoch, nbatch, eval_metric):
+        """One optimizer step (mxtel: wrapped in a "batch" span nested
+        under the epoch span; step walltime and samples/sec feed the
+        train.* metrics)."""
+        with _tel.span("batch"):
+            step_tic = time.monotonic() if _tel.ENABLED else 0.0
+            executor_manager.load_data_batch(data_batch)
+            if monitor is not None:
+                monitor.tic()
+            executor_manager.forward(is_train=True)
+            executor_manager.backward()
+            if update_on_kvstore:
+                _update_params_on_kvstore(
+                    executor_manager.param_arrays, executor_manager.grad_arrays, kvstore
+                )
+            else:
+                _update_params(
+                    executor_manager.param_arrays, executor_manager.grad_arrays,
+                    updater=updater, num_device=len(ctx), kvstore=kvstore,
+                )
+            if monitor is not None:
+                monitor.toc_print()
+            executor_manager.update_metric(eval_metric, data_batch.label)
+            if _tel.ENABLED:
+                dt = time.monotonic() - step_tic
+                _tel.histogram("train.step_secs").observe(dt)
+                if dt > 0:
+                    _tel.gauge("train.samples_per_sec").set(
+                        train_data.batch_size / dt)
+            if batch_end_callback is not None:
+                # locals() here is the helper's scope; merge the outer
+                # training-loop objects callbacks historically read via
+                # param.locals (executor_manager and friends are closure
+                # free vars, so they already appear)
+                batch_end_params = BatchEndParam(
+                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                    locals=dict(locals(), symbol=symbol,
+                                arg_params=arg_params,
+                                aux_params=aux_params))
+                _multiple_callbacks(batch_end_callback, batch_end_params)
+
+    def _train_one_epoch(epoch):
         tic = time.time()
         eval_metric.reset()
         nbatch = 0
         while True:
             do_reset = True
             for data_batch in train_data:
-                executor_manager.load_data_batch(data_batch)
-                if monitor is not None:
-                    monitor.tic()
-                executor_manager.forward(is_train=True)
-                executor_manager.backward()
-                if update_on_kvstore:
-                    _update_params_on_kvstore(
-                        executor_manager.param_arrays, executor_manager.grad_arrays, kvstore
-                    )
-                else:
-                    _update_params(
-                        executor_manager.param_arrays, executor_manager.grad_arrays,
-                        updater=updater, num_device=len(ctx), kvstore=kvstore,
-                    )
-                if monitor is not None:
-                    monitor.toc_print()
-                executor_manager.update_metric(eval_metric, data_batch.label)
                 nbatch += 1
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
-                    )
-                    _multiple_callbacks(batch_end_callback, batch_end_params)
+                _train_one_batch(data_batch, epoch, nbatch, eval_metric)
                 if epoch_size is not None and nbatch >= epoch_size:
                     do_reset = False
                     break
@@ -410,6 +437,11 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
             for name, value in name_value:
                 logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
             eval_data.reset()
+
+    train_data.reset()
+    for epoch in range(begin_epoch, end_epoch):
+        with _tel.span("epoch"):
+            _train_one_epoch(epoch)
 
     # fence host tasks (async epoch checkpoints): a failed write must
     # surface here, at the training call site, not be swallowed

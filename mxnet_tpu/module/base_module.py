@@ -11,6 +11,7 @@ import numpy as _np
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import io as io_mod
+from .. import telemetry as _tel
 from ..model import BatchEndParam, _multiple_callbacks
 
 
@@ -243,10 +244,11 @@ class BaseModule:
                 eval_batch_end_callback, begin_epoch, num_epoch, monitor):
             return
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+        def _fit_one_batch(epoch, nbatch, data_batch):
+            # mxtel: "batch" span nests under the epoch span; step
+            # walltime + samples/sec feed the train.* metrics
+            with _tel.span("batch"):
+                step_tic = time.monotonic() if _tel.ENABLED else 0.0
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
@@ -254,11 +256,24 @@ class BaseModule:
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
+                if _tel.ENABLED:
+                    dt = time.monotonic() - step_tic
+                    _tel.histogram("train.step_secs").observe(dt)
+                    if dt > 0 and getattr(train_data, "batch_size", 0):
+                        _tel.gauge("train.samples_per_sec").set(
+                            train_data.batch_size / dt)
                 if batch_end_callback is not None:
                     batch_end_params = BatchEndParam(
                         epoch=epoch, nbatch=nbatch, eval_metric=eval_metric, locals=locals()
                     )
                     _multiple_callbacks(batch_end_callback, batch_end_params)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            with _tel.span("epoch"):
+                for nbatch, data_batch in enumerate(train_data):
+                    _fit_one_batch(epoch, nbatch, data_batch)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()

@@ -13,6 +13,7 @@ import struct
 
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .resilience import faults as _faults
 
@@ -172,11 +173,19 @@ class MXRecordIO:
             if pad:
                 self.handle.write(b"\x00" * pad)
 
+    def _note_skip(self):
+        """Count one lost record (corrupt="skip" policy) — locally in
+        ``num_skipped`` and, when telemetry is on, in the process-wide
+        ``io.records_skipped_total`` counter."""
+        self.num_skipped += 1
+        if _tel.ENABLED:
+            _tel.counter("io.records_skipped_total").inc()
+
     def _resync(self, from_pos):
         """corrupt="skip" recovery: scan forward from `from_pos` for the
         next 4-byte-aligned magic marker, seek there, and count the
         resync. Returns False at EOF (nothing left to recover)."""
-        self.num_skipped += 1
+        self._note_skip()
         # next aligned offset strictly AFTER the bad header start, so a
         # magic with a corrupt length word cannot re-match forever
         pos = (from_pos + 4) & ~3
@@ -225,7 +234,7 @@ class MXRecordIO:
             if len(head) < 8:
                 if out is not None:
                     if skip:  # torn tail: drop the partial multipart
-                        self.num_skipped += 1
+                        self._note_skip()
                         return None
                     raise MXNetError("truncated multipart record in %s" % self.uri)
                 return None
@@ -262,11 +271,11 @@ class MXRecordIO:
                 if out is not None and skip:
                     # a fresh single-part record while a multipart was
                     # open means the multipart's tail was lost
-                    self.num_skipped += 1
+                    self._note_skip()
                 return data
             if cflag == 1:
                 if out is not None and skip:
-                    self.num_skipped += 1
+                    self._note_skip()
                 out = data
             else:  # 2 = middle, 3 = end: re-insert the split-out magic
                 if out is None:
@@ -277,7 +286,7 @@ class MXRecordIO:
                         raise MXNetError(
                             "orphan multipart continuation in %s" % self.uri)
                     if not dropping:
-                        self.num_skipped += 1
+                        self._note_skip()
                         dropping = True
                     if cflag == 3:
                         dropping = False

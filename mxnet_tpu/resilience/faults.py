@@ -218,6 +218,16 @@ def point(name):
                     naps.append(r.delay)
                 elif boom is None:
                     boom = r
+    if naps or boom is not None:
+        # mxtel: count fires so chaos runs can prove which injection
+        # points actually exercised (cold path — only on a fire)
+        from .. import telemetry as _tel
+
+        if _tel.ENABLED:
+            _tel.counter("faults.fired_total").inc(
+                len(naps) + (1 if boom is not None else 0))
+            _tel.counter("faults.fired.%s" % name).inc(
+                len(naps) + (1 if boom is not None else 0))
     for d in naps:
         time.sleep(d)
     if boom is not None:

@@ -100,6 +100,13 @@ class RetryPolicy:
                 if self.deadline is not None and \
                         time.monotonic() + delay - start > self.deadline:
                     raise
+                # mxtel: every healed transient is an event operators
+                # want counted (lazy import — telemetry must stay
+                # import-independent of resilience)
+                from .. import telemetry as _tel
+
+                if _tel.ENABLED:
+                    _tel.counter("retry.retries_total").inc()
                 if self.on_retry is not None:
                     self.on_retry(attempt, delay, exc)
                 else:

@@ -1,0 +1,122 @@
+"""mxtel: runtime observability — metrics registry, span tracer, journal.
+
+The reference framework's observability story is host-side EvalMetric
+updates plus LOG(INFO) lines (SURVEY §5.5); its only timeline hook is
+the profiler's xplane capture. mxtel adds the third leg production
+runtimes rely on: an always-available, *off-by-default* structured
+record of what the runtime actually did — counters/gauges/histograms
+per layer (registry.py), nested spans (tracing.py), and a JSONL run
+journal + Prometheus/console exporters (export.py). The engine, kvstore,
+executor, IO pipeline, and the training loops all report in; the
+resilience layer's retries, fault fires, and watchdogs do too, so a
+chaos run can *prove* which recovery paths exercised
+(tools/chaos.py).
+
+Enablement contract::
+
+    MXNET_TELEMETRY=1                 # master switch (off by default)
+    MXNET_TELEMETRY_JOURNAL=run.jsonl # optional JSONL run journal
+    MXNET_TELEMETRY_FLUSH_SECS=10     # journal flush cadence
+
+Instrumented hot paths guard on the module attribute ``ENABLED``::
+
+    from . import telemetry as _tel
+    ...
+    if _tel.ENABLED:
+        _tel.counter("engine.push_total").inc()
+
+so the disabled cost is one attribute read + truth test per site and
+``span()`` returns a shared null context. ``reload()`` re-reads the
+environment (tests toggle via monkeypatch.setenv + reload()).
+
+Render a journal with ``tools/telemetry_report.py``; the metrics
+catalog lives in docs/how_to/observability.md.
+"""
+from __future__ import annotations
+
+import os
+
+from . import registry as _registry_mod
+from . import tracing
+from . import export
+from .registry import Counter, Gauge, Histogram, Registry, default_registry
+from .tracing import span, current_span, span_aggregates, span_tail
+from .export import (
+    console_summary, flush_at_exit, journal_path, prometheus_text,
+)
+
+__all__ = [
+    "ENABLED", "enabled", "reload", "reset", "flush",
+    "counter", "gauge", "histogram", "span", "current_span",
+    "span_aggregates", "span_tail", "snapshot",
+    "Counter", "Gauge", "Histogram", "Registry", "default_registry",
+    "console_summary", "prometheus_text", "journal_path", "flush_at_exit",
+]
+
+#: Master switch. Instrumentation reads this ONE attribute; everything
+#: else in the subsystem sits behind it.
+ENABLED = False
+
+
+def enabled():
+    return ENABLED
+
+
+def _env_on(name):
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
+def reload():
+    """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_JOURNAL /
+    MXNET_TELEMETRY_FLUSH_SECS and apply them. Called once at import;
+    tests call it after mutating the environment."""
+    global ENABLED
+    ENABLED = _env_on("MXNET_TELEMETRY")
+    path = os.environ.get("MXNET_TELEMETRY_JOURNAL", "").strip() or None
+    if not ENABLED:
+        path = None
+    raw = os.environ.get("MXNET_TELEMETRY_FLUSH_SECS", "").strip()
+    try:
+        flush_secs = float(raw) if raw else None
+    except ValueError:
+        flush_secs = None
+    export.configure(path, flush_secs)
+    return ENABLED
+
+
+def counter(name):
+    """Process-wide named Counter (created on first use)."""
+    return _registry_mod.default_registry().counter(name)
+
+
+def gauge(name):
+    """Process-wide named Gauge."""
+    return _registry_mod.default_registry().gauge(name)
+
+
+def histogram(name, capacity=Histogram.DEFAULT_CAPACITY):
+    """Process-wide named Histogram (ring-buffer reservoir)."""
+    return _registry_mod.default_registry().histogram(name, capacity)
+
+
+def snapshot():
+    """Plain-data snapshot of every registered metric."""
+    return _registry_mod.default_registry().snapshot()
+
+
+def flush(mark=None):
+    """Flush buffered journal records (plus a metrics snapshot when
+    ``mark`` is given). No-op without an active journal."""
+    export.flush(mark=mark)
+
+
+def reset():
+    """Drop all metric and finished-span state (test isolation — the
+    suite fixture calls this between tests). Does not touch the
+    enable flag or the journal file."""
+    _registry_mod.default_registry().reset()
+    tracing.reset()
+
+
+reload()

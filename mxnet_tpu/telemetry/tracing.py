@@ -1,0 +1,149 @@
+"""Nested span tracer: monotonic-clock durations, thread-local nesting.
+
+``span("name")`` opens a scope; on exit the finished span (name, start,
+duration, id, parent id, thread) is recorded into a bounded in-memory
+tail, folded into a per-name aggregate (count / total / max — the
+"top spans by total time" table), and appended to the run journal when
+one is active (export.py).
+
+Parent ids propagate through a thread-local stack: spans opened on the
+same thread nest naturally. Work handed to another thread (the prefetch
+producer, an engine worker) inherits by *explicit* capture — the
+dispatching side reads :func:`current_span` and passes it as
+``span(name, parent=...)`` on the worker; an implicit ambient-context
+hand-off would misattribute unrelated threads' work the moment two jobs
+share a pool.
+
+Spans also forward into :func:`mxnet_tpu.profiler.scope` while the
+profiler is capturing, so the same names land in the xplane timeline —
+mxtel is the always-on record, xplane stays the deep-dive view.
+
+When telemetry is disabled ``span()`` hands back one shared
+null context: a single flag check, no allocation.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from contextlib import nullcontext as _nullcontext
+
+__all__ = ["span", "current_span", "span_aggregates", "span_tail", "reset"]
+
+_NULL = _nullcontext()
+_ids = itertools.count(1)
+_tls = threading.local()
+
+# finished spans, newest last (bounded: tooling reads the journal for the
+# full stream; this tail serves console summaries and tests)
+_TAIL_MAX = 4096
+_tail = collections.deque(maxlen=_TAIL_MAX)
+# name -> [count, total_secs, max_secs]
+_agg = {}
+_lock = threading.Lock()
+
+
+def _stack():
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_span():
+    """Id of the innermost open span on this thread, or None. Capture
+    this before dispatching work to another thread and pass it as
+    ``span(..., parent=...)`` there to keep the nesting chain."""
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
+
+
+class _Span:
+    __slots__ = ("name", "id", "parent", "_t0", "_wall", "_prof")
+
+    def __init__(self, name, parent):
+        self.name = name
+        self.id = next(_ids)
+        self.parent = parent
+        self._t0 = 0.0
+        self._wall = 0.0
+        self._prof = None
+
+    def __enter__(self):
+        stack = _stack()
+        if self.parent is None and stack:
+            self.parent = stack[-1]
+        stack.append(self.id)
+        # forward into the xplane timeline only while a capture runs —
+        # TraceAnnotation costs a jax call per span otherwise
+        from .. import profiler as _profiler
+
+        if _profiler.state() == "run":
+            self._prof = _profiler.scope(self.name)
+            self._prof.__enter__()
+        self._wall = time.time()
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.monotonic() - self._t0
+        if self._prof is not None:
+            self._prof.__exit__(exc_type, exc, tb)
+            self._prof = None
+        stack = _stack()
+        if stack and stack[-1] == self.id:
+            stack.pop()
+        rec = {
+            "kind": "span", "name": self.name, "id": self.id,
+            "parent": self.parent, "t": self._wall, "dur": dur,
+            "thread": threading.current_thread().name,
+        }
+        with _lock:
+            _tail.append(rec)
+            a = _agg.get(self.name)
+            if a is None:
+                _agg[self.name] = [1, dur, dur]
+            else:
+                a[0] += 1
+                a[1] += dur
+                if dur > a[2]:
+                    a[2] = dur
+        from . import export as _export
+
+        _export.emit(rec)
+        return False
+
+
+def span(name, parent=None):
+    """Open a named span. A context manager; cheap no-op when telemetry
+    is off. ``parent`` overrides the thread-local nesting (cross-thread
+    propagation — see module docstring)."""
+    from . import ENABLED
+
+    if not ENABLED:
+        return _NULL
+    return _Span(name, parent)
+
+
+def span_aggregates():
+    """{name: {"count": n, "total": secs, "max": secs}} over every
+    finished span since the last reset — the top-spans table's data."""
+    with _lock:
+        return {k: {"count": v[0], "total": v[1], "max": v[2]}
+                for k, v in _agg.items()}
+
+
+def span_tail(n=None):
+    """The newest ``n`` finished span records (all retained if None)."""
+    with _lock:
+        recs = list(_tail)
+    return recs if n is None else recs[-n:]
+
+
+def reset():
+    """Drop finished-span state (test isolation). Open spans on live
+    threads are untouched — they complete into the fresh tables."""
+    with _lock:
+        _tail.clear()
+        _agg.clear()

@@ -58,3 +58,20 @@ def _clear_fault_specs():
     from mxnet_tpu.resilience import faults
 
     faults.clear()
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """mxtel isolation: metrics/spans recorded by one test must not leak
+    into the next. When a journal is active (chaos runs set
+    MXNET_TELEMETRY process-wide) the teardown first flushes a
+    ``mark="test_end"`` snapshot — tools/chaos.py sums exactly those
+    marks to total counters across per-test resets — then resets the
+    registry and re-reads the env (dropping any monkeypatched
+    MXNET_TELEMETRY*, which pytest restored before this teardown)."""
+    yield
+    from mxnet_tpu import telemetry
+
+    telemetry.flush(mark="test_end")
+    telemetry.reset()
+    telemetry.reload()

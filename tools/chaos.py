@@ -20,10 +20,18 @@ Usage::
 The spec is derived deterministically from --seed: per point, a fire
 probability in [0.02, 0.15] and a per-point RNG seed. Same seed, same
 spec, same casualty list — a chaos failure is bisectable.
+
+The suite runs with mxtel enabled (MXNET_TELEMETRY=1 + a journal in the
+scratch dir); the survival report folds the journal's fault-fire /
+retry / watchdog counters in, so a chaos run *proves* the resilience
+paths actually exercised — "0 injected faults surfaced" with a non-zero
+fire counter means failures were healed silently (retries), which is
+the success story, not a blind spot.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import random
 import re
@@ -113,6 +121,35 @@ def build_spec(seed, points, mode):
     return ";".join(rules)
 
 
+def fold_telemetry(journal_path):
+    """Sum counters across the journal's per-test snapshots.
+
+    The suite's conftest fixture flushes a ``mark="test_end"`` metrics
+    record before resetting the registry between tests, and the final
+    ``mark="exit"`` record covers activity after the last teardown —
+    summing exactly those marks totals each window once (periodic
+    snapshots are cumulative within a window and must not be summed)."""
+    totals = {}
+    try:
+        with open(journal_path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") != "metrics" or \
+                        rec.get("mark") not in ("test_end", "exit"):
+                    continue
+                for name, v in rec.get("counters", {}).items():
+                    totals[name] = totals.get(name, 0) + v
+    except OSError:
+        return {}
+    return totals
+
+
 def scan_torn_params(root):
     """Find .params files that do not parse past their header — a torn
     in-place write. .tmp leftovers from injected crashes are EXPECTED
@@ -151,11 +188,16 @@ def main(argv=None):
                if os.path.exists(os.path.join(REPO, t)) or args.tests]
 
     scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-")
+    journal = os.path.join(scratch, "chaos-journal.jsonl")
     env = dict(os.environ)
     env.update({
         "MXNET_FAULT_SPEC": spec,
         "JAX_PLATFORMS": "cpu",
         "TMPDIR": scratch,  # checkpoint/tmp artifacts land here for the scan
+        # mxtel on: the journal's fault/retry/watchdog counters prove
+        # which resilience paths the run exercised (folded in below)
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": journal,
     })
 
     cmd = [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
@@ -185,6 +227,7 @@ def main(argv=None):
     errors = int(m[-1]) if m else 0
     injected = out.count("injected fault at point")
     torn = scan_torn_params(scratch)
+    counters = fold_telemetry(journal)
 
     print("\n=== chaos survival report ===")
     print("spec            : %s" % spec)
@@ -195,6 +238,23 @@ def main(argv=None):
           % (passed, failed, errors))
     print("injected faults : %d surfaced in output" % injected)
     print("torn .params    : %d %s" % (len(torn), torn if torn else ""))
+    print("-- resilience counters (mxtel journal) --")
+    if counters:
+        fired = {k: v for k, v in sorted(counters.items())
+                 if k.startswith("faults.fired.")}
+        for k, v in fired.items():
+            print("%-16s: %d fires at %s"
+                  % ("fault fired", v, k[len("faults.fired."):]))
+        if not fired:
+            print("fault fires     : 0 (no armed point hit)")
+        print("retries         : %d healed transients (retry.retries_total)"
+              % counters.get("retry.retries_total", 0))
+        print("watchdog fires  : %d (engine.watchdog_fires_total)"
+              % counters.get("engine.watchdog_fires_total", 0))
+        print("records skipped : %d (io.records_skipped_total)"
+              % counters.get("io.records_skipped_total", 0))
+    else:
+        print("(no journal counters — telemetry produced no snapshots)")
     if hung:
         print("\nRESULT: FAIL — the suite hung under faults (a watchdog "
               "or deadline is missing). Last output:\n%s" % out[-2000:])

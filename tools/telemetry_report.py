@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Render an mxtel run journal: throughput timeline, top spans,
+percentile tables.
+
+The journal (MXNET_TELEMETRY=1 + MXNET_TELEMETRY_JOURNAL=<path>,
+docs/how_to/observability.md) is JSONL: ``span`` records for every
+finished trace scope and ``metrics`` records snapshotting the counter/
+gauge/histogram registry. This tool turns one into the three views a
+run post-mortem starts from:
+
+1. throughput timeline — train.samples_per_sec across the run's metric
+   snapshots (an ASCII bar per snapshot; spots warmup, stalls, decay);
+2. top spans by total time — where the wall clock actually went,
+   with count / total / mean / max per span name;
+3. percentile tables — p50/p95/p99/max for every histogram in the final
+   snapshot (per-task engine latency, batch fetch, step time, ...),
+   plus the final counter and gauge values.
+
+Usage::
+
+    python tools/telemetry_report.py run.jsonl
+    python tools/telemetry_report.py run.jsonl --top 20
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+THROUGHPUT_GAUGE = "train.samples_per_sec"
+BAR_WIDTH = 40
+
+
+def load(path):
+    """Parse a journal into a list of records (bad lines are counted,
+    not fatal: a run killed mid-write leaves a torn last line)."""
+    records, bad = [], 0
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    if bad:
+        print("telemetry_report: skipped %d unparseable line(s) in %s"
+              % (bad, path), file=sys.stderr)
+    return records
+
+
+def span_table(records, top=10):
+    """Aggregate span records: name -> count/total/mean/max, ranked by
+    total time."""
+    agg = {}
+    for r in records:
+        if r.get("kind") != "span":
+            continue
+        a = agg.setdefault(r["name"], [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += r.get("dur", 0.0)
+        a[2] = max(a[2], r.get("dur", 0.0))
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    return [
+        {"name": name, "count": c, "total": t, "mean": t / c, "max": mx}
+        for name, (c, t, mx) in ranked
+    ]
+
+
+def metrics_records(records):
+    return [r for r in records if r.get("kind") == "metrics"]
+
+
+def final_metrics(records):
+    """The last metrics snapshot — counters are cumulative, so the
+    newest record carries the run's final values."""
+    ms = metrics_records(records)
+    return ms[-1] if ms else None
+
+
+def throughput_timeline(records):
+    """[(t, samples_per_sec)] across metric snapshots that carry the
+    throughput gauge."""
+    out = []
+    for r in metrics_records(records):
+        v = r.get("gauges", {}).get(THROUGHPUT_GAUGE)
+        if v is not None:
+            out.append((r.get("t", 0.0), float(v)))
+    return out
+
+
+def _bar(v, vmax):
+    if vmax <= 0:
+        return ""
+    return "#" * max(1, int(round(BAR_WIDTH * v / vmax)))
+
+
+def render_report(records, top=10):
+    lines = ["=== mxtel run report ==="]
+    n_spans = sum(1 for r in records if r.get("kind") == "span")
+    lines.append("records: %d (%d spans, %d metric snapshots)"
+                 % (len(records), n_spans, len(metrics_records(records))))
+
+    timeline = throughput_timeline(records)
+    lines.append("")
+    lines.append("-- throughput timeline (%s) --" % THROUGHPUT_GAUGE)
+    if timeline:
+        t0 = timeline[0][0]
+        vmax = max(v for _, v in timeline)
+        for t, v in timeline:
+            lines.append("  t+%8.1fs %12.2f %s" % (t - t0, v, _bar(v, vmax)))
+    else:
+        lines.append("  (no throughput samples in journal)")
+
+    lines.append("")
+    lines.append("-- top spans by total time --")
+    spans = span_table(records, top=top)
+    if spans:
+        lines.append("  %-30s %8s %12s %12s %12s" % (
+            "span", "count", "total_s", "mean_s", "max_s"))
+        for s in spans:
+            lines.append("  %-30s %8d %12.6g %12.6g %12.6g" % (
+                s["name"], s["count"], s["total"], s["mean"], s["max"]))
+    else:
+        lines.append("  (no spans in journal)")
+
+    lines.append("")
+    lines.append("-- percentile tables (final snapshot) --")
+    final = final_metrics(records)
+    if final is None:
+        lines.append("  (no metrics snapshot in journal)")
+        return "\n".join(lines)
+    hists = final.get("histograms", {})
+    if hists:
+        lines.append("  %-42s %8s %10s %10s %10s %10s" % (
+            "histogram", "count", "p50", "p95", "p99", "max"))
+        for name in sorted(hists):
+            s = hists[name]
+            lines.append("  %-42s %8d %10.6g %10.6g %10.6g %10.6g" % (
+                name, s.get("count", 0), s.get("p50") or 0,
+                s.get("p95") or 0, s.get("p99") or 0, s.get("max") or 0))
+    else:
+        lines.append("  (no histograms)")
+    counters = final.get("counters", {})
+    if counters:
+        lines.append("")
+        lines.append("-- counters (final) --")
+        for name in sorted(counters):
+            lines.append("  %-42s %d" % (name, counters[name]))
+    gauges = final.get("gauges", {})
+    if gauges:
+        lines.append("")
+        lines.append("-- gauges (final) --")
+        for name in sorted(gauges):
+            lines.append("  %-42s %g" % (name, gauges[name]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render an mxtel run journal (JSONL)")
+    ap.add_argument("journal", help="path written via MXNET_TELEMETRY_JOURNAL")
+    ap.add_argument("--top", type=int, default=10,
+                    help="span rows in the top-spans table (default 10)")
+    args = ap.parse_args(argv)
+    records = load(args.journal)
+    if not records:
+        print("telemetry_report: %s has no records" % args.journal,
+              file=sys.stderr)
+        return 1
+    print(render_report(records, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
