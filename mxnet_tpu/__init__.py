@@ -16,6 +16,7 @@ from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_de
 # engine's exit drain so (LIFO) the final flush runs after the drain
 from . import telemetry
 from . import resilience
+from . import elastic
 from . import engine
 from . import storage
 from . import resource

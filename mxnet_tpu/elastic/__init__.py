@@ -1,0 +1,29 @@
+"""Elastic distributed training: membership epochs, eviction + rejoin,
+degraded-world aggregation, coordinator snapshots.
+
+The reference's ps-lite KVStore could only *count* dead nodes
+(kvstore.h:235 get_num_dead_node); this package makes worker failure a
+recoverable membership event, the property TensorFlow gets from
+coordinated membership + state restore (Abadi et al., 2016). It is the
+server half of ``kvstore.create("dist_sync")`` under
+``MXNET_KV_ELASTIC=1``:
+
+- :class:`GroupView` — live-rank set + monotonically increasing
+  membership epoch (evictions and admissions each bump it).
+- :class:`Aggregator` — server-side sync gradient rounds that complete
+  against the *current* live set, rescaling by ``world/contributors``
+  when the group is degraded.
+- :class:`ElasticCoordinator` — the TCP service hosting both, plus
+  epoch-aware barriers, the ``MXNET_KV_EVICT_AFTER`` eviction sweeper,
+  and ``MXNET_KV_SNAPSHOT_SECS`` crash-safe snapshots.
+- :class:`ElasticClient` — the worker-side RPC handle.
+
+Run a standalone coordinator with ``python -m mxnet_tpu.elastic``;
+``tools/launch.py --elastic`` does it for you. docs/how_to/
+elastic_training.md covers the lifecycle end to end.
+"""
+from .client import ElasticClient, parse_addr
+from .server import Aggregator, ElasticCoordinator, GroupView
+
+__all__ = ["Aggregator", "ElasticClient", "ElasticCoordinator",
+           "GroupView", "parse_addr"]

@@ -1,0 +1,49 @@
+"""Standalone elastic coordinator: ``python -m mxnet_tpu.elastic``.
+
+tools/launch.py --elastic spawns exactly this; run it by hand to host
+the coordinator somewhere other than the launch machine (ssh jobs), or
+to resume a crashed coordinator from its snapshot prefix.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+# the coordinator never needs an accelerator, and grabbing one would
+# steal it from a co-located worker
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="elastic training coordinator (see "
+                    "docs/how_to/elastic_training.md)")
+    ap.add_argument("--world", type=int, required=True,
+                    help="nominal worker count (the rescale target)")
+    ap.add_argument("--bind", default="127.0.0.1:9877",
+                    help="host:port to listen on (port 0 = ephemeral). "
+                         "TRUSTED NETWORKS ONLY: the wire protocol is "
+                         "pickle, so an open port is remote code "
+                         "execution — keep it loopback/cluster-private")
+    ap.add_argument("--evict-after", type=float, default=None,
+                    help="heartbeat lapse (secs) before eviction "
+                         "(default: MXNET_KV_EVICT_AFTER or 10)")
+    ap.add_argument("--snapshot-prefix", default=None,
+                    help="path prefix for crash-safe state snapshots "
+                         "(<prefix>.params + <prefix>.meta); restores "
+                         "from them if present")
+    ap.add_argument("--snapshot-secs", type=float, default=None,
+                    help="snapshot cadence (default: "
+                         "MXNET_KV_SNAPSHOT_SECS or off)")
+    args = ap.parse_args(argv)
+
+    from .client import parse_addr
+    from .server import serve
+
+    serve(args.world, parse_addr(args.bind), evict_after=args.evict_after,
+          snapshot_prefix=args.snapshot_prefix,
+          snapshot_secs=args.snapshot_secs)
+
+
+if __name__ == "__main__":
+    main()

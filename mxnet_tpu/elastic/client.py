@@ -1,0 +1,97 @@
+"""Worker-side client for the elastic coordinator.
+
+Thin RPC wrapper: every call is one connection-per-request round trip
+(protocol.py) run under the same resilience discipline as the dist
+KVStore's coordination RPCs — the ``kv.coord`` injection point followed
+by ``MXNET_KV_RETRIES`` attempts of exponential backoff. A transient
+coordinator hiccup (or restart — the server is stateless per
+connection) heals here; a persistent outage surfaces after the budget.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..base import MXNetError
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy
+from . import protocol
+
+__all__ = ["ElasticClient", "parse_addr"]
+
+
+def parse_addr(spec):
+    """'host:port' -> (host, port). The MXNET_ELASTIC_COORD format."""
+    host, sep, port = str(spec).rpartition(":")
+    if not sep or not host:
+        raise MXNetError(
+            "elastic coordinator address must be host:port, got %r" % spec)
+    try:
+        return host, int(port)
+    except ValueError:
+        raise MXNetError(
+            "elastic coordinator port must be an integer, got %r" % spec)
+
+
+class ElasticClient:
+    """One worker's handle on the coordinator. Stateless between calls
+    (survives coordinator restarts); holds only the address, the rank,
+    and the retry policy."""
+
+    def __init__(self, addr, rank, timeout=30.0):
+        self.addr = parse_addr(addr) if isinstance(addr, str) else tuple(addr)
+        self.rank = int(rank)
+        self.timeout = float(timeout)
+        attempts = max(1, int(os.environ.get("MXNET_KV_RETRIES", "4")))
+        self._policy = RetryPolicy(max_attempts=attempts, base_delay=0.05,
+                                   max_delay=1.0, jitter=0.25)
+
+    def call(self, op, check=True, **fields):
+        """One RPC. Transport errors retry under the policy; an
+        ``error`` status raises MXNetError (when ``check``); other
+        non-ok statuses ('pending', 'evicted', 'stale') are protocol
+        answers the caller dispatches on."""
+        req = dict(fields)
+        req["op"] = op
+        req["rank"] = self.rank
+
+        def _rpc():
+            _faults.point("kv.coord")
+            return protocol.call(self.addr, req, timeout=self.timeout)
+
+        _rpc.__name__ = "elastic %s" % op
+        resp = self._policy.call(_rpc)
+        if check and resp.get("status") == "error":
+            raise MXNetError("elastic coordinator rejected %s: %s"
+                             % (op, resp.get("message", "(no message)")))
+        return resp
+
+    # -- conveniences ----------------------------------------------------------
+    def register(self):
+        return self.call("register")
+
+    def beat(self):
+        return self.call("beat")
+
+    def view(self):
+        return self.call("view")
+
+    def leave(self):
+        return self.call("leave")
+
+    def stats(self):
+        return self.call("stats")
+
+    def wait_ready(self, deadline=30.0):
+        """Block until the coordinator answers (launcher/test startup)."""
+        end = time.monotonic() + deadline
+        last = None
+        while time.monotonic() < end:
+            try:
+                return self.view()
+            except Exception as e:  # noqa: BLE001 - startup polling
+                last = e
+                time.sleep(0.05)
+        raise MXNetError("elastic coordinator at %s:%d not ready after "
+                         "%.0fs: %s" % (self.addr[0], self.addr[1],
+                                        deadline, last))

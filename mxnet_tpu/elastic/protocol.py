@@ -1,0 +1,81 @@
+"""Wire protocol for the elastic coordination service.
+
+One request/response pair per TCP connection, each message a 4-byte
+big-endian length prefix followed by a pickled dict. Connection-per-
+request is deliberate: a SIGKILLed worker leaves no half-open stream to
+poison, and a restarted coordinator serves the very next request without
+any session re-establishment — the property the whole elastic layer
+exists for. Throughput is bounded by the coordinator's Python loop, not
+the handshake (measured ample for heartbeats + per-key round polling on
+a training job; bulk tensor traffic stays on this path only for modest
+parameter sets, mirroring the dist_async transport note in kvstore.py).
+
+Pickle is the payload codec for the same reason the reference ships its
+optimizer as a pickle to the ps-lite server (python/mxnet/kvstore.py:231):
+the peers are the job's own cooperating processes.
+
+SECURITY: unpickling executes code, so anyone who can reach the
+coordinator port owns the job. Bind the coordinator to a loopback or
+cluster-private interface only (the 127.0.0.1 default), exactly as the
+reference's ps-lite/ZMQ endpoints and jax.distributed's coordinator
+assume a trusted network.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+from ..base import MXNetError
+
+__all__ = ["send_msg", "recv_msg", "call", "ProtocolError"]
+
+_LEN = struct.Struct(">I")
+MAX_MSG = 1 << 30  # a torn/garbage length prefix must not OOM the server
+
+
+class ProtocolError(MXNetError):
+    """Malformed frame on the elastic coordination socket."""
+
+
+def send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed mid-frame (e.g. SIGKILLed worker)
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock):
+    """One framed message, or None on a clean/early close."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > MAX_MSG:
+        raise ProtocolError("elastic frame length %d exceeds limit" % n)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def call(addr, req, timeout=30.0):
+    """One request/response round trip to ``addr`` = (host, port).
+
+    Raises OSError subclasses on transport failure — callers wrap this
+    in the resilience retry discipline (kvstore._coord_call analog)."""
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        send_msg(sock, req)
+        resp = recv_msg(sock)
+    if resp is None:
+        raise ConnectionError("elastic coordinator closed the connection")
+    return resp

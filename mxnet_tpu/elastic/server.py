@@ -1,0 +1,625 @@
+"""Elastic coordination server: group view, degraded-world aggregation,
+epoch-aware barriers, crash-safe snapshots.
+
+This is the server half of the elastic dist KVStore (kvstore.py,
+``MXNET_KV_ELASTIC=1``). The reference's ps-lite stack could only
+*detect* a dead node (kvstore.h:235 get_num_dead_node); here worker
+failure is a recoverable membership event, the property TensorFlow's
+coordinated membership + state restore gives (Abadi et al., 2016):
+
+- **GroupView** — the live-rank set plus a monotonically increasing
+  membership epoch. A heartbeat lapse past ``MXNET_KV_EVICT_AFTER``
+  evicts the rank and bumps the epoch; a (re-)registration admits the
+  rank at the boundary the bump creates.
+- **Aggregator** — server-side sync parameter aggregation (the role of
+  the reference's sync UpdateBuf, kvstore_dist_server.h:164-198). Each
+  live rank contributes one gradient per key per round; a round
+  completes when every live rank has contributed. An eviction drops the
+  dead rank's in-flight contributions and re-checks pending rounds
+  against the reduced group, rescaling the sum by
+  ``world / contributors`` so the update magnitude matches the
+  fault-free run (a *degraded step*).
+- **Barriers** — generation-counted arrival sets re-checked on every
+  view change, so survivors rendezvous on the reduced group instead of
+  deadlocking on a corpse.
+- **Snapshots** — every ``MXNET_KV_SNAPSHOT_SECS`` the full server
+  state (weights via model._write_params_atomic, optimizer pickle +
+  membership + round counters via the same tmp→fsync→rename discipline)
+  lands on disk, so a restarted coordinator resumes where it died.
+
+The server is deliberately jax-free at import time (stdlib + numpy);
+the optimizer updater and the .params codec are imported lazily so a
+standalone coordinator (``python -m mxnet_tpu.elastic``) starts fast
+and never touches an accelerator.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..resilience import faults as _faults
+from . import protocol
+
+__all__ = ["GroupView", "Aggregator", "ElasticCoordinator"]
+
+
+class GroupView:
+    """Live-rank set + membership epoch. Pure state machine (no IO, no
+    clock of its own — callers pass ``now``), so membership logic is
+    unit-testable without sockets or sleeps."""
+
+    def __init__(self, world, evict_after=10.0):
+        if world < 1:
+            raise MXNetError("GroupView world size must be >= 1")
+        self.world = int(world)          # nominal size (rescale target)
+        self.evict_after = float(evict_after)
+        self.epoch = 0
+        self.live = set()
+        self.evicted = set()
+        self.departed = set()            # graceful leave(): not a failure
+        self.beats = {}                  # rank -> last beat (caller clock)
+        self.seen = set()                # every rank ever registered
+        self.evictions_total = 0
+        self.rejoins_total = 0
+
+    def register(self, rank, now):
+        """Admit ``rank`` into the view (initial join or rejoin). Any
+        membership change bumps the epoch — the boundary at which the
+        joiner enters. Returns (epoch, rejoined). A rejoin is a
+        RE-ADMISSION (seen before, not currently live): a duplicated or
+        retried register RPC from a live rank must not inflate
+        rejoins_total — chaos legs treat that counter as proof of a
+        real recovery."""
+        rank = int(rank)
+        rejoined = rank in self.seen and rank not in self.live
+        self.seen.add(rank)
+        self.beats[rank] = now
+        if rank not in self.live:
+            self.live.add(rank)
+            self.evicted.discard(rank)
+            self.departed.discard(rank)
+            self.epoch += 1
+        if rejoined:
+            self.rejoins_total += 1
+        return self.epoch, rejoined
+
+    def beat(self, rank, now):
+        """Record liveness; beats from non-members are ignored (a zombie
+        evictee learns its fate from its next real op, not here)."""
+        if rank in self.live:
+            self.beats[rank] = now
+
+    def lapsed(self, now):
+        """Ranks whose heartbeat is older than evict_after."""
+        return [r for r in sorted(self.live)
+                if now - self.beats.get(r, now) > self.evict_after]
+
+    def evict(self, rank):
+        """Remove a dead rank; bumps the epoch. Idempotent."""
+        if rank not in self.live:
+            return False
+        self.live.discard(rank)
+        self.evicted.add(rank)
+        self.epoch += 1
+        self.evictions_total += 1
+        return True
+
+    def leave(self, rank):
+        """Graceful departure (end of training): the rank exits the
+        view — and so exits every completion condition — without being
+        counted as a casualty."""
+        if rank not in self.live:
+            return False
+        self.live.discard(rank)
+        self.departed.add(rank)
+        self.epoch += 1
+        return True
+
+    def snapshot_state(self):
+        return {
+            "world": self.world, "epoch": self.epoch,
+            "live": sorted(self.live), "evicted": sorted(self.evicted),
+            "departed": sorted(self.departed), "seen": sorted(self.seen),
+            "evictions_total": self.evictions_total,
+            "rejoins_total": self.rejoins_total,
+        }
+
+    def restore_state(self, st, now):
+        self.world = int(st["world"])
+        self.epoch = int(st["epoch"])
+        # a restarted coordinator cannot know which of its former live
+        # ranks survived the outage: give them all a fresh grace period
+        # and let heartbeats (or their absence) sort it out
+        self.live = set(st["live"])
+        self.evicted = set(st["evicted"])
+        self.departed = set(st["departed"])
+        self.seen = set(st["seen"])
+        self.beats = {r: now for r in self.live}
+        self.evictions_total = int(st["evictions_total"])
+        self.rejoins_total = int(st["rejoins_total"])
+
+
+class Aggregator:
+    """Per-key round aggregation with degraded-world rescaling.
+
+    Sync workers push key k's round r+1 only after pulling round r, so
+    at most one round per key is ever open — ``pending[key]`` holds the
+    contributions for round ``done[key] + 1``. Completion is checked
+    against the *current* live set: contributors ⊇ live completes the
+    round (contributions from since-departed ranks still count; an
+    evicted rank's are dropped by ``drop_rank`` first, per the
+    in-flight-loss contract)."""
+
+    def __init__(self, world):
+        self.world = int(world)
+        self.weights = {}        # key -> numpy array (authoritative copy)
+        self.done = {}           # key -> completed round count
+        self.pending = {}        # key -> {rank: numpy grad}
+        self.opt_blob = None     # pickled optimizer, as shipped
+        self._updater = None
+        self.degraded_steps_total = 0
+        self.updates_total = 0
+
+    # -- optimizer -------------------------------------------------------------
+    def set_optimizer(self, blob):
+        """First optimizer wins: set_optimizer is SPMD (every worker
+        ships the same pickle) and a rejoiner's re-ship must not reset
+        the server's accumulated optimizer state (momentum etc.)."""
+        if self.opt_blob is not None:
+            return False
+        from .. import optimizer as opt  # lazy: needs the jax stack
+
+        self._updater = opt.get_updater(pickle.loads(blob))
+        self.opt_blob = blob
+        return True
+
+    # -- keys ------------------------------------------------------------------
+    def init_key(self, key, arr):
+        """First init wins; later inits (other ranks, rejoiners) adopt
+        the server copy — the reference server's init semantics."""
+        if key not in self.weights:
+            self.weights[key] = _np.array(arr, copy=True)
+            self.done[key] = 0
+        return self.weights[key], self.done[key]
+
+    # -- gradient rounds -------------------------------------------------------
+    def contribute(self, key, rank, rnd, arr):
+        """Record rank's gradient for round ``rnd`` of ``key``.
+        Returns 'ok' | 'stale' (round already completed — an idempotent
+        retry after a lost ack, or a pre-eviction zombie catching up) |
+        'resync' (the pusher is AHEAD of the server: a coordinator that
+        restarted from a snapshot older than the group's progress; the
+        lost rounds are lost — snapshot-cadence data loss — and the
+        pusher must fast-BACKWARD to the restored round and replay)."""
+        if key not in self.weights:
+            raise MXNetError("elastic push of uninitialized key %r" % key)
+        cur = self.done[key]
+        if rnd <= cur:
+            return "stale"
+        if rnd != cur + 1:
+            logging.warning(
+                "elastic: rank %s pushed key %r round %d but server is at "
+                "%d — resyncing the pusher (coordinator restarted from an "
+                "older snapshot?)", rank, key, rnd, cur)
+            return "resync"
+        self.pending.setdefault(key, {})[int(rank)] = arr
+        return "ok"
+
+    def drop_rank(self, rank):
+        """Drop an evicted rank's in-flight contributions."""
+        for contribs in self.pending.values():
+            contribs.pop(int(rank), None)
+
+    def complete_ready(self, live):
+        """Finish every pending round whose contributors cover ``live``.
+        Returns the list of completed keys. With live empty (everyone
+        gone) nothing completes — there is nobody left to pull."""
+        if not live:
+            return []
+        from ..context import cpu       # lazy: jax-backed
+        from ..kvstore import _key_int
+        from ..ndarray import NDArray
+
+        finished = []
+        for key in list(self.pending):
+            contribs = self.pending[key]
+            if not contribs or not live.issubset(contribs.keys()):
+                continue
+            total = None
+            for arr in contribs.values():
+                total = arr.astype(_np.float64) if total is None \
+                    else total + arr
+            scale = self.world / float(len(contribs))
+            if len(contribs) < self.world:
+                self.degraded_steps_total += 1
+            merged = (total * scale).astype(
+                self.weights[key].dtype, copy=False)
+            if self._updater is not None:
+                w = NDArray(self.weights[key], cpu(0))
+                self._updater(_key_int(key), NDArray(merged, cpu(0)), w)
+                self.weights[key] = w.asnumpy()
+            else:
+                self.weights[key] = merged
+            # contributions are consumed only once the update LANDED: an
+            # updater exception must leave the round pending (retryable
+            # on the next recheck) instead of wedging it forever
+            del self.pending[key]
+            self.done[key] += 1
+            self.updates_total += 1
+            finished.append(key)
+        return finished
+
+    def snapshot_state(self):
+        return {
+            "done": dict(self.done), "opt_blob": self.opt_blob,
+            "degraded_steps_total": self.degraded_steps_total,
+            "updates_total": self.updates_total,
+        }
+
+    def restore_state(self, st, weights):
+        self.weights = {k: _np.array(v, copy=True)
+                        for k, v in weights.items()}
+        self.done = {k: int(v) for k, v in st["done"].items()}
+        # weights without a recorded round (snapshot raced an init):
+        # treat as round 0
+        for k in self.weights:
+            self.done.setdefault(k, 0)
+        self.pending = {}  # in-flight contributions do not survive a crash
+        self.degraded_steps_total = int(st["degraded_steps_total"])
+        self.updates_total = int(st["updates_total"])
+        if st["opt_blob"] is not None:
+            self.set_optimizer(st["opt_blob"])
+
+
+def _key_to_name(k):
+    """KVStore keys are ints (Module key indices) or strings; the
+    .params container wants names. 'i:'/'s:' prefixes keep the round
+    trip lossless."""
+    return ("i:%d" % k) if isinstance(k, int) else ("s:%s" % k)
+
+
+def _name_to_key(name):
+    return int(name[2:]) if name.startswith("i:") else name[2:]
+
+
+def _atomic_pickle(path, obj):
+    """Same tmp → fsync → rename discipline as model._write_params_atomic,
+    for the non-tensor half of a snapshot."""
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    with open(tmp, "wb") as f:
+        f.write(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = protocol.recv_msg(self.request)
+            if req is None:
+                return
+            try:
+                resp = self.server.coordinator._dispatch(req)
+            except MXNetError as e:
+                # a semantic rejection (round ahead, uninited key) must
+                # reach the caller as a reply — a dropped connection
+                # reads as a transient and would be retried verbatim
+                resp = {"status": "error", "message": str(e)}
+            protocol.send_msg(self.request, resp)
+        except (OSError, protocol.ProtocolError):
+            pass  # a dying client mid-frame must not log-spam the server
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ElasticCoordinator:
+    """The coordinator process/thread: socket front-end over GroupView +
+    Aggregator + barrier state, plus the eviction sweeper and snapshot
+    writer threads. Thread-safe via one state lock (the workload is
+    coordination, not bandwidth)."""
+
+    def __init__(self, world, bind=("127.0.0.1", 0), evict_after=None,
+                 snapshot_prefix=None, snapshot_secs=None):
+        if evict_after is None:
+            evict_after = float(os.environ.get("MXNET_KV_EVICT_AFTER", "10"))
+        if snapshot_secs is None:
+            snapshot_secs = float(
+                os.environ.get("MXNET_KV_SNAPSHOT_SECS", "0") or "0")
+        self._lock = threading.Lock()
+        self.view = GroupView(world, evict_after)
+        self.agg = Aggregator(world)
+        self.barrier_gen = 0
+        self._barrier_waiters = {}   # rank -> that rank's barrier count
+        self._barrier_done = {}      # rank -> highest completed count
+        self.snapshot_prefix = snapshot_prefix
+        self.snapshot_secs = float(snapshot_secs)
+        self.snapshots_total = 0
+        self._stop = threading.Event()
+        if snapshot_prefix and os.path.exists(snapshot_prefix + ".meta"):
+            self._restore_snapshot()
+        self._srv = _Server(bind, _Handler)
+        self._srv.coordinator = self
+        self.addr = self._srv.server_address[:2]
+        self._threads = []
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self):
+        for name, target in (
+                ("mxtpu-elastic-serve", self._srv.serve_forever),
+                ("mxtpu-elastic-sweep", self._sweep_loop),
+                ("mxtpu-elastic-snap", self._snapshot_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self.snapshot_prefix:
+            try:
+                self.save_snapshot()
+            except Exception:
+                logging.exception("elastic: final snapshot failed")
+
+    # -- background loops ------------------------------------------------------
+    def _sweep_loop(self):
+        interval = max(0.05, self.view.evict_after / 4.0)
+        while not self._stop.wait(interval):
+            try:
+                self.sweep()
+            except _faults.FaultInjected:
+                # an injected kv.evict fault aborts THIS sweep; the dead
+                # rank is still dead and the next sweep retries — the
+                # delayed-eviction failure mode, on demand
+                logging.warning("elastic: eviction sweep aborted by "
+                                "injected kv.evict fault")
+            except Exception:
+                logging.exception("elastic: eviction sweep failed")
+
+    def sweep(self, now=None):
+        """One eviction pass: evict every heartbeat-lapsed rank, drop its
+        in-flight gradients, re-check rounds and barriers against the
+        reduced group. Returns the evicted ranks."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            lapsed = self.view.lapsed(now)
+            evicted = []
+            for r in lapsed:
+                _faults.point("kv.evict")
+                if self.view.evict(r):
+                    self.agg.drop_rank(r)
+                    evicted.append(r)
+            if evicted:
+                logging.warning(
+                    "elastic: evicted rank(s) %s (heartbeat lapse > %.1fs) "
+                    "-> epoch %d, live %s", evicted, self.view.evict_after,
+                    self.view.epoch, sorted(self.view.live))
+                self._recheck_locked()
+        return evicted
+
+    def _snapshot_loop(self):
+        if not self.snapshot_prefix or self.snapshot_secs <= 0:
+            return
+        while not self._stop.wait(self.snapshot_secs):
+            try:
+                self.save_snapshot()
+            except Exception:
+                logging.exception("elastic: periodic snapshot failed")
+
+    # -- snapshots -------------------------------------------------------------
+    def save_snapshot(self):
+        """Crash-safe state dump: weights through the same atomic .params
+        writer checkpoints use (model._write_params_atomic), membership +
+        rounds + optimizer pickle through the same rename discipline."""
+        from ..model import _write_params_atomic  # lazy: heavy import
+
+        with self._lock:
+            weights = {_key_to_name(k): _np.array(v, copy=True)
+                       for k, v in self.agg.weights.items()}
+            meta = {
+                "view": self.view.snapshot_state(),
+                "agg": self.agg.snapshot_state(),
+                "barrier_gen": self.barrier_gen,
+            }
+        _write_params_atomic(self.snapshot_prefix + ".params", weights)
+        _atomic_pickle(self.snapshot_prefix + ".meta", meta)
+        with self._lock:
+            self.snapshots_total += 1
+
+    def _restore_snapshot(self):
+        from ..context import cpu
+        from ..ndarray import load as nd_load
+
+        with open(self.snapshot_prefix + ".meta", "rb") as f:
+            meta = pickle.loads(f.read())
+        weights = {}
+        params_path = self.snapshot_prefix + ".params"
+        if os.path.exists(params_path):
+            loaded = nd_load(params_path, cpu(0))
+            weights = {_name_to_key(k): v.asnumpy()
+                       for k, v in loaded.items()}
+        now = time.monotonic()
+        self.view.restore_state(meta["view"], now)
+        self.agg.restore_state(meta["agg"], weights)
+        self.barrier_gen = int(meta["barrier_gen"])
+        logging.info("elastic: restored snapshot %s (epoch %d, %d keys)",
+                     self.snapshot_prefix, self.view.epoch, len(weights))
+
+    # -- request dispatch ------------------------------------------------------
+    def _counters_locked(self):
+        return {
+            "evictions": self.view.evictions_total,
+            "rejoins": self.view.rejoins_total,
+            "degraded": self.agg.degraded_steps_total,
+            "updates": self.agg.updates_total,
+            "snapshots": self.snapshots_total,
+        }
+
+    def _recheck_locked(self):
+        """After any view change or contribution: complete coverable
+        rounds and release coverable barriers."""
+        self.agg.complete_ready(self.view.live)
+        if self._barrier_waiters and \
+                self.view.live.issubset(self._barrier_waiters.keys()):
+            self.barrier_gen += 1
+            for r, c in self._barrier_waiters.items():
+                self._barrier_done[r] = max(self._barrier_done.get(r, 0), c)
+            self._barrier_waiters.clear()
+
+    def _require_live(self, rank):
+        """None when rank is a member; an 'evicted' reply otherwise —
+        the signal that sends a zombie or restarted worker into the
+        rejoin path."""
+        if rank in self.view.live:
+            return None
+        return {"status": "evicted", "epoch": self.view.epoch}
+
+    def _dispatch(self, req):
+        op = req.get("op")
+        rank = int(req.get("rank", -1))
+        now = time.monotonic()
+        with self._lock:
+            if op == "register":
+                epoch, rejoined = self.view.register(rank, now)
+                # a restarted incarnation's barrier count restarts at 1;
+                # the old incarnation's completed counts must not make
+                # its fresh arrivals look already-done
+                self._barrier_done.pop(rank, None)
+                self._barrier_waiters.pop(rank, None)
+                self._recheck_locked()  # the new member may cover a barrier
+                return {"status": "ok", "epoch": epoch,
+                        "rejoined": rejoined,
+                        "live": sorted(self.view.live),
+                        "world": self.view.world,
+                        "rounds": dict(self.agg.done),
+                        "opt": self.agg.opt_blob,
+                        "counters": self._counters_locked()}
+            if op == "beat":
+                self.view.beat(rank, now)
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": rank in self.view.live}
+            if op == "view":
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": sorted(self.view.live),
+                        "evicted": sorted(self.view.evicted),
+                        "world": self.view.world,
+                        "counters": self._counters_locked()}
+            if op == "init":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                value, rnd = self.agg.init_key(req["key"], req["value"])
+                return {"status": "ok", "value": value, "round": rnd}
+            if op == "push":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                st = self.agg.contribute(
+                    req["key"], rank, int(req["round"]), req["value"])
+                if st == "ok":
+                    self._recheck_locked()
+                # round lets a stale pusher (rejoiner whose retried push
+                # raced the group) fast-forward its counter to the
+                # server's, instead of trailing stale for several steps
+                return {"status": st,
+                        "round": self.agg.done.get(req["key"], 0)}
+            if op == "pull":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                key, min_round = req["key"], int(req["min_round"])
+                if key not in self.agg.done:
+                    return {"status": "error",
+                            "message": "key %r not initialized" % (key,)}
+                if self.agg.done[key] < min_round:
+                    return {"status": "pending",
+                            "round": self.agg.done[key],
+                            "epoch": self.view.epoch}
+                return {"status": "ok", "value": self.agg.weights[key],
+                        "round": self.agg.done[key],
+                        "epoch": self.view.epoch,
+                        "counters": self._counters_locked()}
+            if op == "set_optimizer":
+                installed = self.agg.set_optimizer(req["blob"])
+                return {"status": "ok", "installed": installed}
+            if op == "barrier":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                count = int(req.get("count", 0))
+                if count and count <= self._barrier_done.get(rank, 0):
+                    # idempotent retry of an arrival whose barrier
+                    # already completed (lost ack): re-queueing it would
+                    # strand the rank waiting on the NEXT generation
+                    return {"status": "ok", "gen": self.barrier_gen - 1,
+                            "done": True}
+                gen = self.barrier_gen
+                self._barrier_waiters[rank] = count
+                self._recheck_locked()
+                return {"status": "ok", "gen": gen,
+                        "done": self.barrier_gen > gen}
+            if op == "barrier_wait":
+                return {"status": "ok",
+                        "done": self.barrier_gen > int(req["gen"]),
+                        "epoch": self.view.epoch}
+            if op == "leave":
+                if self.view.leave(rank):
+                    self._recheck_locked()
+                return {"status": "ok", "epoch": self.view.epoch}
+            if op == "evict":
+                # admin/test hook: force an eviction without waiting for
+                # the heartbeat lapse
+                _faults.point("kv.evict")
+                if self.view.evict(rank):
+                    self.agg.drop_rank(rank)
+                    self._recheck_locked()
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": sorted(self.view.live)}
+            if op == "stats":
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": sorted(self.view.live),
+                        "evicted": sorted(self.view.evicted),
+                        "world": self.view.world,
+                        "rounds": dict(self.agg.done),
+                        "barrier_gen": self.barrier_gen,
+                        "counters": self._counters_locked()}
+        if op == "snapshot":
+            if not self.snapshot_prefix:
+                return {"status": "error",
+                        "message": "coordinator has no snapshot prefix"}
+            self.save_snapshot()  # takes the lock itself
+            return {"status": "ok"}
+        return {"status": "error", "message": "unknown op %r" % (op,)}
+
+
+def serve(world, bind, evict_after=None, snapshot_prefix=None,
+          snapshot_secs=None, ready_fd=None):
+    """Run a coordinator in the foreground (the ``python -m
+    mxnet_tpu.elastic`` entry point). Blocks until SIGTERM/KeyboardInterrupt."""
+    coord = ElasticCoordinator(
+        world, bind=bind, evict_after=evict_after,
+        snapshot_prefix=snapshot_prefix, snapshot_secs=snapshot_secs)
+    coord.start()
+    print("elastic coordinator: serving %d-worker group on %s:%d"
+          % (world, coord.addr[0], coord.addr[1]), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        coord.stop()

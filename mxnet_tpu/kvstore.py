@@ -427,13 +427,7 @@ class KVStore:
             "mxnet_kvstore_barrier_%d" % self._barrier_count)
 
     def _barrier_rendezvous(self):
-        raw = os.environ.get("MXNET_KV_BARRIER_TIMEOUT", "0") or "0"
-        try:
-            timeout = float(raw)
-        except ValueError:
-            raise MXNetError(
-                "MXNET_KV_BARRIER_TIMEOUT must be a number of seconds, "
-                "got %r" % raw)
+        timeout = _barrier_timeout()
         if timeout <= 0:
             self._barrier_sync()
             return
@@ -565,6 +559,18 @@ def _key_int(k):
         return k
 
 
+def _barrier_timeout():
+    """MXNET_KV_BARRIER_TIMEOUT in seconds (0 = no deadline), validated
+    once for both the collective and the elastic barrier paths."""
+    raw = os.environ.get("MXNET_KV_BARRIER_TIMEOUT", "0") or "0"
+    try:
+        return float(raw)
+    except ValueError:
+        raise MXNetError(
+            "MXNET_KV_BARRIER_TIMEOUT must be a number of seconds, "
+            "got %r" % raw)
+
+
 def create(name="local"):
     """Create a KVStore (ref: python/mxnet/kvstore.py:349, factory
     src/kvstore/kvstore.cc:17-45). Types: local / local_allreduce_cpu /
@@ -578,6 +584,20 @@ def create(name="local"):
     if name not in known:
         raise MXNetError("unknown KVStore type %s (known: %s)" % (name, known))
     if name.startswith("dist"):
+        if os.environ.get("MXNET_KV_ELASTIC", "0") not in ("", "0"):
+            if os.environ.get("MXNET_ELASTIC_COORD"):
+                if "async" in name:
+                    warnings.warn(
+                        "MXNET_KV_ELASTIC=1: elastic aggregation is "
+                        "synchronous; %s degrades to dist_sync semantics "
+                        "(docs/how_to/elastic_training.md)" % name,
+                        stacklevel=2)
+                return _ElasticDistKVStore(name)
+            warnings.warn(
+                "MXNET_KV_ELASTIC=1 but MXNET_ELASTIC_COORD is unset; "
+                "falling back to the non-elastic %s store (tools/launch.py "
+                "--elastic exports the coordinator address)" % name,
+                stacklevel=2)
         _maybe_init_distributed()
     if name.startswith("dist_async"):
         import jax
@@ -1060,6 +1080,336 @@ class _AsyncDistKVStore(KVStore):
         raise MXNetError("timed out waiting for %s" % k)
 
 
+class _ElasticDistKVStore(KVStore):
+    """dist_sync with elastic membership (``MXNET_KV_ELASTIC=1``).
+
+    The synchronous dist store reduces over **all** jax processes with
+    an XLA collective — a program that can never survive a dead member.
+    This store replaces the collective with the elastic coordinator
+    (mxnet_tpu.elastic): a server-side parameter service holding the
+    authoritative weights and optimizer, a live-rank **group view** with
+    a monotonically increasing membership epoch, and per-key gradient
+    rounds that complete against the *current* live set. A worker whose
+    heartbeat lapses past ``MXNET_KV_EVICT_AFTER`` is evicted (epoch
+    bump, in-flight contributions dropped, aggregation rescaled by
+    ``world/contributors``); survivors' pulls and barriers re-evaluate
+    on the reduced group instead of deadlocking. A restarted worker
+    re-registers, adopts the server's current weights + pickled
+    optimizer, resyncs its round counters, and participates from the
+    next round — the rejoin path. jax.distributed is never initialized:
+    elastic workers are independent processes (``MXNET_PROC_ID`` /
+    ``MXNET_NUM_PROCS`` name the rank and nominal world size).
+    """
+
+    def __init__(self, kv_type):
+        from .elastic import ElasticClient
+
+        addr = os.environ.get("MXNET_ELASTIC_COORD")
+        if not addr:
+            raise MXNetError(
+                "MXNET_KV_ELASTIC=1 requires MXNET_ELASTIC_COORD=host:port "
+                "(tools/launch.py --elastic exports it)")
+        self._rank = int(os.environ.get("MXNET_PROC_ID", "0"))
+        self._world = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+        self._client = ElasticClient(addr, self._rank)
+        self._rounds = {}        # key -> last round this worker synced to
+        self._epoch = 0
+        self._last_counters = {}
+        self._left = False
+        super().__init__(kv_type)
+        resp = self._client.register()
+        self._absorb_view(resp)
+        self._rounds = self._aligned_rounds(resp)
+
+    # -- identity (env-derived: no jax.distributed in elastic mode) ------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        """Nominal world size — data sharding and the dist_sync
+        batch-size rescale stay stable across evictions; the *live*
+        count is group_view()."""
+        return self._world
+
+    def group_view(self):
+        """(membership epoch, live rank list) from the coordinator."""
+        resp = self._client.view()
+        self._absorb_view(resp)
+        return resp["epoch"], list(resp["live"])
+
+    # -- view/counter bookkeeping ----------------------------------------------
+    def _absorb_view(self, resp):
+        """Track the epoch and mirror the coordinator's eviction/rejoin/
+        degraded totals into this worker's telemetry counters (delta
+        increments — counters are monotonic on both sides)."""
+        self._epoch = max(self._epoch, int(resp.get("epoch", 0)))
+        counters = resp.get("counters")
+        if not counters:
+            return
+        for src, name in (("evictions", "kvstore.evictions_total"),
+                          ("rejoins", "kvstore.rejoins_total"),
+                          ("degraded", "kvstore.degraded_steps_total")):
+            cur = int(counters.get(src, 0))
+            delta = cur - self._last_counters.get(src, 0)
+            if delta > 0:
+                self._last_counters[src] = cur
+                if _tel.ENABLED:
+                    _tel.counter(name).inc(delta)
+
+    @staticmethod
+    def _aligned_rounds(resp):
+        """Round counters for a (re)joiner: the MINIMUM done round across
+        keys, for every key. Admission can land mid-step, when the
+        server's per-key rounds are non-uniform (keys before the group's
+        frontier already at R+1, the frontier key still at R). Starting
+        from the per-key map would let the joiner's sweep pull a round
+        ahead of the frontier before it ever contributes the frontier
+        key — a distributed deadlock (joiner waits on survivors, the
+        survivors on the joiner). From the minimum, the sweep
+        fast-forwards through completed rounds via idempotent 'stale'
+        pushes and lands exactly on the frontier, unblocking the group."""
+        rounds = resp.get("rounds", {})
+        if not rounds:
+            return {}
+        floor = min(rounds.values())
+        return {k: floor for k in rounds}
+
+    def _rejoin(self):
+        """Re-enter the group after the coordinator reports this rank
+        evicted (a zombie that outlived its heartbeat lapse, or any op
+        racing a restart): re-register, adopt the server's weights and
+        round counters, and continue at the next round. Runs under the
+        ``kv.rejoin`` fault point + retry policy, so an injected or
+        transient rejoin failure backs off instead of dying."""
+        def _do():
+            _faults.point("kv.rejoin")
+            return self._client.register()
+
+        _do.__name__ = "elastic rejoin (rank %d)" % self._rank
+        resp = self._client._policy.call(_do)
+        self._absorb_view(resp)
+        self._rounds = self._aligned_rounds(resp)
+        # refresh any locally-held weights: the group trained on while
+        # this rank was out
+        for k in list(self._store):
+            got = self._client.call("pull", key=k, min_round=0)
+            if got.get("status") == "ok":
+                self._store[k] = NDArray(got["value"], self._store[k].context)
+        warnings.warn(
+            "elastic kvstore: rank %d rejoined the group at epoch %d"
+            % (self._rank, self._epoch), stacklevel=3)
+
+    def _op(self, op, **fields):
+        """One coordinator op with transparent rejoin-on-eviction."""
+        resp = self._client.call(op, **fields)
+        if resp.get("status") == "evicted":
+            self._rejoin()
+            resp = self._client.call(op, **fields)
+            if resp.get("status") == "evicted":
+                raise MXNetError(
+                    "elastic kvstore: rank %d evicted and rejoin did not "
+                    "restore membership (op %s)" % (self._rank, op))
+        self._absorb_view(resp)
+        return resp
+
+    # -- liveness --------------------------------------------------------------
+    def _start_heartbeat(self):
+        """Beat through the elastic coordinator instead of the
+        jax.distributed KV. Same discipline as the base store: capture
+        locals (not self), stop on finalize."""
+        self._hb_client = self._client
+        interval = float(
+            os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2"))
+        self._hb_stop = threading.Event()
+        client, stop = self._client, self._hb_stop
+
+        def _beat():
+            while not stop.wait(interval):
+                try:
+                    client.beat()
+                    if _tel.ENABLED:
+                        _tel.counter(
+                            "kvstore.heartbeat_publish_total").inc()
+                except Exception:
+                    # transient coordinator outage: keep beating; the
+                    # eviction clock is the coordinator's problem
+                    pass
+
+        self._hb_thread = threading.Thread(
+            target=_beat, name="mxtpu-elastic-heartbeat", daemon=True)
+        self._hb_thread.start()
+        import weakref
+
+        weakref.finalize(self, stop.set)
+
+    def dead_ranks(self, node_id=-1, timeout=None):
+        """Evicted ranks per the coordinator's group view (the heartbeat
+        staleness judgment moved server-side with the membership)."""
+        resp = self._client.view()
+        self._absorb_view(resp)
+        return sorted(resp.get("evicted", []))
+
+    def get_num_dead_node(self, node_id=-1, timeout=60):
+        return len(self.dead_ranks())
+
+    # -- data plane ------------------------------------------------------------
+    def init(self, key, value):
+        """First init wins server-side; every other rank (and every
+        rejoiner) adopts the server copy — the reference dist server's
+        init semantics, which is also what makes restart-with-current-
+        weights automatic."""
+        keys, values = self._key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % k)
+            resp = self._op("init", key=k, value=v.asnumpy())
+            self._store[k] = NDArray(resp["value"], v.context)
+            self._rounds.setdefault(k, int(resp["round"]))
+
+    def push(self, key, value, priority=0):
+        keys, values = self._key_value(key, value, allow_list_per_key=True)
+        # duplicate keys in one call merge locally first, exactly like
+        # the base store's grouped push — two contributions for one
+        # round would otherwise collide server-side
+        grouped, order = {}, []
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            if k not in grouped:
+                grouped[k] = []
+                order.append(k)
+            if isinstance(v, (list, tuple)):
+                grouped[k].extend(v)
+            else:
+                grouped[k].append(v)
+        push_bytes = 0
+        for k in order:
+            merged = self._reduce(grouped[k], self._store[k])
+            arr = merged.asnumpy()
+            push_bytes += arr.nbytes
+            rnd = self._rounds.get(k, 0) + 1
+            resp = self._op("push", key=k, round=rnd, value=arr)
+            status = resp.get("status")
+            if status == "stale":
+                # round already completed (idempotent retry, or a rejoin
+                # raced the group forward): adopt the server's round so
+                # the next push contributes instead of trailing stale
+                rnd = max(rnd, int(resp.get("round", rnd)))
+            elif status == "resync":
+                # coordinator restarted from a snapshot behind our
+                # progress: fall back to its round and replay this
+                # step's gradient there (the gap is snapshot-cadence
+                # data loss, accepted by the restart-resume contract)
+                rnd = int(resp.get("round", 0)) + 1
+                resp = self._op("push", key=k, round=rnd, value=arr)
+            self._rounds[k] = rnd
+        if _tel.ENABLED:
+            _tel.counter("kvstore.push_total").inc()
+            _tel.counter("kvstore.push_bytes_total").inc(push_bytes)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = self._key_value(key, out, allow_list_per_key=True)
+        pulled_bytes = 0
+        evict_after = float(os.environ.get("MXNET_KV_EVICT_AFTER", "10"))
+        deadline = time.monotonic() + max(60.0, 6.0 * evict_after)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been inited" % k)
+            while True:
+                # re-read the floor every poll: a rejoin inside _op
+                # resyncs _rounds, and the pre-eviction floor may name a
+                # round whose only missing contribution was OURS (dropped
+                # at eviction) — a floor that can never be satisfied
+                min_round = self._rounds.get(k, 0)
+                resp = self._op("pull", key=k, min_round=min_round)
+                if resp.get("status") == "ok":
+                    break
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        "elastic pull of key %s round %d timed out on rank "
+                        "%d (epoch %d) — no eviction unblocked the round; "
+                        "check the coordinator (docs/how_to/"
+                        "elastic_training.md)"
+                        % (k, min_round, self._rank, self._epoch))
+                time.sleep(0.005)
+            # rejoin may have advanced our floor past min_round
+            self._rounds[k] = max(self._rounds.get(k, 0), int(resp["round"]))
+            nd = NDArray(resp["value"], self._store[k].context)
+            self._store[k] = nd
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                nd.copyto(t)
+            pulled_bytes += resp["value"].nbytes * len(targets)
+        if _tel.ENABLED:
+            _tel.counter("kvstore.pull_total").inc()
+            _tel.counter("kvstore.pull_bytes_total").inc(pulled_bytes)
+
+    # -- control plane ---------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Ship the pickled optimizer to the coordinator (the reference's
+        kController command) — the server runs the updater, which is
+        what lets a rejoiner pull optimizer state it never had."""
+        blob = pickle.dumps(optimizer)
+        pickle.loads(blob)  # fail early if unpicklable, like the reference
+        self._optimizer = optimizer
+        self._op("set_optimizer", blob=blob)
+
+    def barrier(self):
+        """Epoch-aware rendezvous on the *live* group: arrivals are a
+        server-side generation set re-checked on every membership
+        change, so survivors pass when the dead rank is evicted instead
+        of waiting for a corpse. ``MXNET_KV_BARRIER_TIMEOUT`` keeps its
+        base-store meaning."""
+        self._barrier_count += 1
+        timeout = _barrier_timeout()
+        _faults.point("kv.barrier")
+        t0 = time.monotonic()
+        try:
+            resp = self._op("barrier", count=self._barrier_count)
+            gen = int(resp["gen"])
+            done = bool(resp.get("done"))
+            while not done:
+                if timeout > 0 and time.monotonic() - t0 > timeout:
+                    raise MXNetError(
+                        "elastic kvstore barrier #%d timed out after %.1fs "
+                        "on rank %d (epoch %d, dead: %s) — "
+                        "MXNET_KV_BARRIER_TIMEOUT"
+                        % (self._barrier_count, timeout, self._rank,
+                           self._epoch, self.dead_ranks()))
+                time.sleep(0.005)
+                wait = self._client.call("barrier_wait", gen=gen)
+                done = bool(wait.get("done"))
+        finally:
+            # observed on EVERY outcome: the pathological waits are the
+            # percentiles this histogram exists to expose
+            if _tel.ENABLED:
+                _tel.histogram("kvstore.barrier_wait_secs").observe(
+                    time.monotonic() - t0)
+
+    def leave(self):
+        """Graceful exit from the group view (end of training): the
+        departing rank leaves every completion condition without being
+        counted as a casualty, so stragglers/rejoiners still training
+        are not blocked on a finished worker. Idempotent."""
+        if self._left:
+            return
+        self._left = True
+        self.stop_heartbeat()
+        try:
+            self._client.leave()
+        except Exception:
+            pass  # coordinator already gone — nothing left to leave
+
+    def __del__(self):
+        try:
+            self.leave()
+        except Exception:
+            pass
+
+
 def _maybe_init_distributed():
     """Rendezvous through jax.distributed using the env exported by
     tools/launch.py — the role the dmlc tracker's DMLC_PS_ROOT_URI env
@@ -1074,7 +1424,15 @@ def _maybe_init_distributed():
 
     # NB: must not touch jax.process_count()/devices() here — that would
     # initialize the local backend and make distributed init impossible.
-    if jax.distributed.is_initialized():
+    # jax.distributed.is_initialized() only exists on newer jax; on older
+    # releases (0.4.x) the coordination-service client being present is
+    # the same fact — and _coordination_client reads it without touching
+    # the backend.
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return
+    elif _coordination_client() is not None:
         return
     jax.distributed.initialize(
         coordinator_address=os.environ.get("MXNET_COORDINATOR", "127.0.0.1:9876"),

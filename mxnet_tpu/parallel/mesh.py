@@ -39,6 +39,19 @@ def mark_varying(x, axis_name):
     return x
 
 
+def axis_size(axis_name):
+    """Static size of a mapped mesh axis inside a shard_map/pmap body.
+    ``lax.axis_size`` only exists on newer jax; on older releases
+    ``lax.psum(1, axis)`` of a literal constant-folds to the same
+    concrete int (the pre-axis_size idiom), so loop bounds built from it
+    stay static."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def local_devices(platform=None):
     import jax
 

@@ -28,7 +28,9 @@ def pipeline_apply(stage_fn, stage_params, x, axis_name, n_microbatches):
     import jax.numpy as jnp
     from jax import lax
 
-    stages = lax.axis_size(axis_name)
+    from .mesh import axis_size
+
+    stages = axis_size(axis_name)
     stage_id = lax.axis_index(axis_name)
     if x.shape[0] != n_microbatches:
         raise ValueError(

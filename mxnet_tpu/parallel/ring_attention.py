@@ -58,7 +58,9 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
 
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    ring = lax.axis_size(axis_name)
+    from .mesh import axis_size
+
+    ring = axis_size(axis_name)
     my_rank = lax.axis_index(axis_name)
     tq = q.shape[2]
     tk = k.shape[2]

@@ -34,7 +34,9 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    from .mesh import axis_size
+
+    n = axis_size(axis_name)
     b, h, t_local, d = q.shape
     if h % n != 0:
         raise ValueError(
@@ -117,17 +119,19 @@ def make_ulysses_attention(mesh, seq_axis="seq", causal=True):
     spec = P(None, None, seq_axis, None)
     fn = functools.partial(
         ulysses_attention, axis_name=seq_axis, causal=causal)
+    # replication checking off: the Pallas flash kernel's out_shapes
+    # carry no varying-axes annotation, which the checker rejects inside
+    # shard_map (jax >= 0.7 spells the knob check_vma, 0.4.x spells it
+    # check_rep and has no pallas replication rule at all); correctness
+    # is pinned by the dense parity + ring cross-check tests instead
+    kw = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     try:
-        # check_vma off: the Pallas flash kernel's out_shapes carry no
-        # varying-axes annotation, which the checker (jax >= 0.7)
-        # rejects inside shard_map; correctness is pinned by the dense
-        # parity + ring cross-check tests instead
-        mapped = shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
-    except TypeError:  # older jax: no check_vma parameter
-        mapped = shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mapped = shard_map(fn, check_vma=False, **kw)
+    except TypeError:
+        try:
+            mapped = shard_map(fn, check_rep=False, **kw)
+        except TypeError:  # neither knob: checker not present
+            mapped = shard_map(fn, **kw)
 
     def apply(q, k, v):
         shard = NamedSharding(mesh, spec)

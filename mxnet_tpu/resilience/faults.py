@@ -27,8 +27,12 @@ Registered points (grep ``faults.point(`` for the live list):
 
     rio.read     -- MXRecordIO.read record fetch
     ckpt.write   -- model.save_checkpoint, after tmp write, before rename
-    kv.coord     -- KVStore coordination-service get/set RPCs
+    kv.coord     -- KVStore coordination-service get/set RPCs (incl.
+                    every elastic-coordinator RPC)
     kv.barrier   -- KVStore dist barrier rendezvous body
+    kv.evict     -- elastic coordinator eviction path (error aborts the
+                    sweep — the eviction retries on the next pass)
+    kv.rejoin    -- elastic worker rejoin/re-register path
     engine.task  -- dependency-engine task body, before fn runs
 
 The registry is process-global and thread-safe. ``clear()`` removes

@@ -1,0 +1,82 @@
+"""Elastic distributed Module.fit, one worker of a multi-process job.
+
+The chaos/acceptance workload for MXNET_KV_ELASTIC=1 (ISSUE 4): an MLP
+trained through Module.fit on rank-sharded synthetic MNIST via the
+elastic dist_sync store. Controlled self-destruction makes the eviction
+and rejoin legs deterministic:
+
+  MXNET_ELASTIC_TEST_DIE_RANK   rank that SIGKILLs itself mid-fit
+  MXNET_ELASTIC_TEST_DIE_AT     batch count at which it dies
+  MXNET_ELASTIC_TEST_MARK       marker dir: die only if no marker yet
+                                (so a restarted incarnation survives —
+                                the rejoin leg)
+
+Launch (docs/how_to/elastic_training.md)::
+
+    python tools/launch.py -n 4 --launcher local --elastic --tolerate 1 \\
+        python tests/nightly/dist_elastic_fit.py
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _maybe_die_callback(rank):
+    die_rank = int(os.environ.get("MXNET_ELASTIC_TEST_DIE_RANK", "-1"))
+    die_at = int(os.environ.get("MXNET_ELASTIC_TEST_DIE_AT", "0"))
+    mark_dir = os.environ.get("MXNET_ELASTIC_TEST_MARK", "")
+    if rank != die_rank or die_at <= 0:
+        return None
+    marker = os.path.join(mark_dir, "died-rank-%d" % rank) if mark_dir else ""
+    state = {"batches": 0}
+
+    def _cb(param):
+        state["batches"] += 1
+        if state["batches"] < die_at:
+            return
+        if marker and os.path.exists(marker):
+            return  # second incarnation: survive and rejoin
+        if marker:
+            with open(marker, "w") as f:
+                f.write("died at batch %d\n" % state["batches"])
+        sys.stderr.write("rank %d: SIGKILLing self mid-fit (batch %d)\n"
+                         % (rank, state["batches"]))
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    return _cb
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    assert type(kv).__name__ == "_ElasticDistKVStore", \
+        "elastic env not exported (launch with --elastic)"
+    rank, nworker = kv.rank, kv.num_workers
+    mx.random.seed(0)
+    train = mx.io.MNISTIter(
+        batch_size=32, num_synthetic=960, seed=3, flat=True,
+        num_parts=nworker, part_index=rank)
+    val = mx.io.MNISTIter(batch_size=32, num_synthetic=320, seed=4,
+                          flat=True, shuffle=False)
+    mod = mx.module.Module(mx.models.get_mlp(), context=mx.cpu(0))
+    cbs = [cb for cb in [_maybe_die_callback(rank)] if cb]
+    mod.fit(
+        train, num_epoch=int(os.environ.get("MXNET_ELASTIC_TEST_EPOCHS", "3")),
+        kvstore=kv, optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        initializer=mx.initializer.Xavier(),
+        batch_end_callback=cbs or None,
+    )
+    epoch, live = kv.group_view()
+    kv.leave()  # finished: exit the completion conditions gracefully
+    acc = mod.score(val, "acc")[0][1]
+    print("rank %d/%d: elastic fit OK acc=%.4f epoch=%d live=%s"
+          % (rank, nworker, acc, epoch, live), flush=True)
+
+
+if __name__ == "__main__":
+    main()

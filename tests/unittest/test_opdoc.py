@@ -45,6 +45,25 @@ def test_all_ndarray_docstrings_nontrivial():
             assert ("%s : " % pname) in doc, (key, pname)
 
 
+def test_negative_alias_docstring_and_dtype():
+    """Pin the `negative` alias fix (the once-red doc gate): the alias
+    is a registered imperative op, so the sweep above really exercises
+    it; its docstring is the real one from ndarray.py (not the
+    generated fallback); and it stays dtype-preserving (``-arr``, not
+    ``multiply(arr, -1.0)``)."""
+    import numpy as np
+
+    assert "negative" in REGISTRY
+    assert REGISTRY["negative"].imperative  # covered by the nd sweep
+    doc = mx.nd.negative.__doc__ or ""
+    assert "equivalent to ``-arr``" in doc
+    assert "Parameters" in doc and "arr : " in doc
+    assert "Imperative function for op" not in doc
+    out = mx.nd.negative(mx.nd.array(np.array([1, -2], np.int32)))
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), [-1, 2])
+
+
 def test_param_docs_have_prose():
     """Every schema Field carries human text (not just type info) after
     registration applies the opdoc table."""

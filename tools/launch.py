@@ -20,6 +20,14 @@ Env exported to workers (consumed by mxnet_tpu.kvstore / jax.distributed):
 The reference's DMLC_ROLE/DMLC_PS_ROOT_URI scheme (ref:
 include/mxnet/kvstore.h:173-214) has no server/scheduler roles here:
 all processes are workers.
+
+Elastic mode (--elastic; docs/how_to/elastic_training.md): the launcher
+additionally hosts the elastic coordinator (python -m mxnet_tpu.elastic)
+on --coordinator and exports MXNET_KV_ELASTIC=1 + MXNET_ELASTIC_COORD,
+so dist stores run through membership epochs instead of jax.distributed
+collectives. A worker that dies is restarted up to --max-restarts times
+(it rejoins the group); --tolerate N lets the job succeed with up to N
+workers lost (the survivors-finish contract).
 """
 from __future__ import annotations
 
@@ -27,29 +35,112 @@ import argparse
 import os
 import shlex
 import signal
+import socket
 import subprocess
 import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker_env(args, rank):
+    env = dict(os.environ)
+    env.update({
+        "MXNET_COORDINATOR": args.coordinator,
+        "MXNET_NUM_PROCS": str(args.num_workers),
+        "MXNET_PROC_ID": str(rank),
+    })
+    if args.elastic:
+        env["MXNET_KV_ELASTIC"] = "1"
+        env["MXNET_ELASTIC_COORD"] = args.coordinator
+    # per-rank telemetry journals: N processes appending to one JSONL
+    # file would interleave mid-line; a {rank} placeholder fans them out
+    journal = env.get("MXNET_TELEMETRY_JOURNAL", "")
+    if "{rank}" in journal:
+        env["MXNET_TELEMETRY_JOURNAL"] = journal.format(rank=rank)
+    return env
+
+
+def _start_coordinator(args):
+    """Spawn the elastic coordinator on --coordinator and wait until it
+    accepts connections (plain socket poll — the launcher must not pay
+    the framework import just to supervise)."""
+    host, port = args.coordinator.rsplit(":", 1)
+    coord_cmd = [sys.executable, "-m", "mxnet_tpu.elastic",
+                 "--world", str(args.num_workers),
+                 "--bind", args.coordinator]
+    if args.evict_after is not None:
+        coord_cmd += ["--evict-after", str(args.evict_after)]
+    if args.snapshot_prefix:
+        coord_cmd += ["--snapshot-prefix", args.snapshot_prefix]
+    if args.snapshot_secs is not None:
+        coord_cmd += ["--snapshot-secs", str(args.snapshot_secs)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(coord_cmd, env=env)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("elastic coordinator exited with code %d "
+                               "during startup" % proc.returncode)
+        try:
+            with socket.create_connection((host, int(port)), timeout=1.0):
+                return proc
+        except OSError:
+            time.sleep(0.1)
+    proc.terminate()
+    raise RuntimeError("elastic coordinator did not open %s within 30s"
+                       % args.coordinator)
 
 
 def launch_local(args, cmd):
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            "MXNET_COORDINATOR": args.coordinator,
-            "MXNET_NUM_PROCS": str(args.num_workers),
-            "MXNET_PROC_ID": str(rank),
-        })
-        procs.append(subprocess.Popen(cmd, env=env))
-    code = 0
+    coordinator = _start_coordinator(args) if args.elastic else None
+    procs = {r: subprocess.Popen(cmd, env=_worker_env(args, r))
+             for r in range(args.num_workers)}
+    # restarts only make sense in elastic mode: a respawned worker can
+    # rejoin the elastic group, but a formed jax.distributed job can
+    # never re-admit it — the restart would just wedge the collectives
+    restarts_left = args.max_restarts if args.elastic else 0
+    failed = {}  # rank -> exit code of its FINAL incarnation
     try:
-        for p in procs:
-            code = p.wait() or code
+        while procs:
+            time.sleep(0.2)
+            for rank, p in list(procs.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del procs[rank]
+                if rc == 0:
+                    failed.pop(rank, None)
+                    continue
+                if restarts_left > 0:
+                    restarts_left -= 1
+                    print("launch: worker %d exited %d — restarting "
+                          "(%d restart(s) left)" % (rank, rc, restarts_left),
+                          file=sys.stderr)
+                    procs[rank] = subprocess.Popen(
+                        cmd, env=_worker_env(args, rank))
+                else:
+                    failed[rank] = rc
     except KeyboardInterrupt:
-        for p in procs:
+        for p in procs.values():
             p.send_signal(signal.SIGTERM)
-        code = 1
-    return code
+        for p in procs.values():
+            p.wait()
+        return 1
+    finally:
+        if coordinator is not None:
+            coordinator.terminate()
+            coordinator.wait()
+    if failed and len(failed) > args.tolerate:
+        print("launch: worker(s) %s failed (exit codes %s), tolerate=%d"
+              % (sorted(failed), failed, args.tolerate), file=sys.stderr)
+        return max(1, max(abs(c) for c in failed.values()) % 256 or 1)
+    if failed:
+        print("launch: worker(s) %s lost but within --tolerate %d — "
+              "job succeeded on the surviving group"
+              % (sorted(failed), args.tolerate), file=sys.stderr)
+    return 0
 
 
 def launch_ssh(args, cmd):
@@ -61,11 +152,17 @@ def launch_ssh(args, cmd):
         return 1
     procs = []
     for rank in range(args.num_workers):
-        envs = " ".join([
+        env_pairs = [
             "MXNET_COORDINATOR=%s" % args.coordinator,
             "MXNET_NUM_PROCS=%d" % args.num_workers,
             "MXNET_PROC_ID=%d" % rank,
-        ])
+        ]
+        if args.elastic:
+            # ssh mode assumes the coordinator is already serving on
+            # --coordinator (python -m mxnet_tpu.elastic on that host)
+            env_pairs += ["MXNET_KV_ELASTIC=1",
+                          "MXNET_ELASTIC_COORD=%s" % args.coordinator]
+        envs = " ".join(env_pairs)
         remote = "cd %s && %s %s" % (
             shlex.quote(args.workdir) if args.workdir else "~", envs,
             " ".join(shlex.quote(c) for c in cmd))
@@ -85,6 +182,20 @@ def main():
     p.add_argument("--coordinator", default="127.0.0.1:9876",
                    help="jax.distributed coordinator ip:port")
     p.add_argument("--workdir", help="remote working dir (ssh mode)")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic membership: host the coordinator (local "
+                        "mode), export MXNET_KV_ELASTIC/MXNET_ELASTIC_COORD")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="total respawns of dead workers (elastic rejoin)")
+    p.add_argument("--tolerate", type=int, default=0,
+                   help="failed workers allowed before the job fails "
+                        "(survivors-finish contract)")
+    p.add_argument("--evict-after", type=float, default=None,
+                   help="coordinator heartbeat-lapse eviction threshold")
+    p.add_argument("--snapshot-prefix", default=None,
+                   help="coordinator crash-safe snapshot path prefix")
+    p.add_argument("--snapshot-secs", type=float, default=None,
+                   help="coordinator snapshot cadence in seconds")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
     # drop only the single leading '--' separating launcher args from the
