@@ -167,6 +167,8 @@ class Aggregator:
         self._updater = None
         self.degraded_steps_total = 0
         self.updates_total = 0
+        self.guard_skips_total = 0      # poisoned rounds nobody applied
+        self.guard_nonfinite_total = 0  # of those, non-finite merges
 
     # -- optimizer -------------------------------------------------------------
     def set_optimizer(self, blob):
@@ -242,6 +244,25 @@ class Aggregator:
                 self.degraded_steps_total += 1
             merged = (total * scale).astype(
                 self.weights[key].dtype, copy=False)
+            if self._guard_poisoned(merged):
+                # Training-run guardian, server half (docs/how_to/
+                # guardrails.md): a poisoned merged gradient — one NaN
+                # contribution poisons the whole sum — is SKIPPED for
+                # the entire group at once: the round completes with
+                # the weights untouched, every live rank pulls the same
+                # unchanged value, and the skip is counted (mirrored to
+                # guardian.skipped_steps in every worker's journal).
+                # This IS the any-rank-poisons→all-ranks-skip vote,
+                # riding the round protocol with zero extra RPCs.
+                del self.pending[key]
+                self.done[key] += 1
+                self.guard_skips_total += 1
+                finished.append(key)
+                logging.warning(
+                    "elastic guardian: skipped poisoned round %d of key "
+                    "%r for the whole group (%d skips total)",
+                    self.done[key], key, self.guard_skips_total)
+                continue
             if self._updater is not None:
                 w = NDArray(self.weights[key], cpu(0))
                 self._updater(_key_int(key), NDArray(merged, cpu(0)), w)
@@ -257,11 +278,31 @@ class Aggregator:
             finished.append(key)
         return finished
 
+    def _guard_poisoned(self, merged):
+        """Server half of the guardian sentinel, gated on the same
+        MXNET_GUARDIAN switch (the coordinator inherits the launcher's
+        env). Non-finite always poisons; MXNET_GUARDIAN_GRADNORM_MAX
+        adds an absolute merged-norm ceiling."""
+        from ..resilience import guardian as _grd
+
+        if not _grd.enabled():
+            return False
+        if not _np.all(_np.isfinite(merged)):
+            self.guard_nonfinite_total += 1
+            return True
+        max_norm = _grd._env_float("MXNET_GUARDIAN_GRADNORM_MAX", 0.0)
+        if max_norm > 0.0:
+            gsq = float(_np.sum(_np.square(merged.astype(_np.float64))))
+            return gsq > max_norm * max_norm
+        return False
+
     def snapshot_state(self):
         return {
             "done": dict(self.done), "opt_blob": self.opt_blob,
             "degraded_steps_total": self.degraded_steps_total,
             "updates_total": self.updates_total,
+            "guard_skips_total": self.guard_skips_total,
+            "guard_nonfinite_total": self.guard_nonfinite_total,
         }
 
     def restore_state(self, st, weights):
@@ -275,6 +316,9 @@ class Aggregator:
         self.pending = {}  # in-flight contributions do not survive a crash
         self.degraded_steps_total = int(st["degraded_steps_total"])
         self.updates_total = int(st["updates_total"])
+        # pre-guardian snapshots lack the guard counters
+        self.guard_skips_total = int(st.get("guard_skips_total", 0))
+        self.guard_nonfinite_total = int(st.get("guard_nonfinite_total", 0))
         if st["opt_blob"] is not None:
             self.set_optimizer(st["opt_blob"])
 
@@ -467,6 +511,8 @@ class ElasticCoordinator:
             "degraded": self.agg.degraded_steps_total,
             "updates": self.agg.updates_total,
             "snapshots": self.snapshots_total,
+            "guard_skips": self.agg.guard_skips_total,
+            "guard_nonfinite": self.agg.guard_nonfinite_total,
         }
 
     def _recheck_locked(self):

@@ -528,6 +528,61 @@ class KVStore:
                 dead.append(r)
         return dead
 
+    def guardian_vote(self, step, poisoned):
+        """Group skip verdict for one optimizer step (the training-run
+        guardian's coordinated skip: docs/how_to/guardrails.md). True
+        when ANY rank voted poisoned — every rank then skips the same
+        step, so replicas never diverge. Single-process stores answer
+        with the local verdict. The multi-process dist implementation
+        rides the jax.distributed coordination KV under the usual
+        ``kv.coord`` + retry discipline: publish this rank's vote, read
+        everyone else's (votes are write-once per round, so reads are
+        race-free)."""
+        if not self.type.startswith("dist"):
+            return bool(poisoned)
+        import jax
+
+        if jax.process_count() <= 1:
+            return bool(poisoned)
+        client = _coordination_client()
+        if client is None:
+            warnings.warn(
+                "guardian_vote: no coordination client; falling back to "
+                "the local verdict (ranks may diverge)", stacklevel=2)
+            return bool(poisoned)
+        self._guard_round = getattr(self, "_guard_round", 0) + 1
+        base = "mxtpu_guard/%d" % self._guard_round
+        # GC: the vote is a collective, so every rank reaching round R
+        # has finished reading round R-1 — round R-2's keys are dead on
+        # every rank and this rank can free its own (bounded KV growth:
+        # at most 2 rounds x world keys live at any time). Best-effort:
+        # a failed delete only delays the free to a later round.
+        if self._guard_round > 2:
+            try:
+                client.key_value_delete(
+                    "mxtpu_guard/%d/%d" % (self._guard_round - 2, self.rank))
+            except Exception:
+                pass
+        _coord_call(
+            lambda: client.key_value_set(
+                "%s/%d" % (base, self.rank), "1" if poisoned else "0"),
+            what="guardian vote publish")
+        timeout_ms = int(max(_barrier_timeout() or 300.0, 1.0) * 1000)
+        any_poisoned = bool(poisoned)
+        for r in range(self.num_workers):
+            if r == self.rank:
+                continue
+            try:
+                v = client.blocking_key_value_get(
+                    "%s/%d" % (base, r), timeout_ms)
+            except Exception as e:
+                raise MXNetError(
+                    "guardian_vote: rank %d's vote for step %s unreadable "
+                    "on rank %d (%s) — cannot skip consistently"
+                    % (r, step, self.rank, e))
+            any_poisoned = any_poisoned or v == "1"
+        return any_poisoned
+
     @property
     def barrier_before_exit(self):
         """ref: kvstore.h:194 — settable via MXKVStoreSetBarrierBeforeExit."""
@@ -1150,7 +1205,16 @@ class _ElasticDistKVStore(KVStore):
             return
         for src, name in (("evictions", "kvstore.evictions_total"),
                           ("rejoins", "kvstore.rejoins_total"),
-                          ("degraded", "kvstore.degraded_steps_total")):
+                          ("degraded", "kvstore.degraded_steps_total"),
+                          # the coordinator's guardian skips surface in
+                          # every worker's journal. Unit: KEY-ROUNDS —
+                          # the aggregator guards per key per round, so
+                          # one poisoned step on a P-key model counts up
+                          # to P skipped rounds (hence the *_rounds
+                          # names; the step-granular guardian.*_steps
+                          # counters stay strictly step-denominated)
+                          ("guard_skips", "guardian.skipped_rounds"),
+                          ("guard_nonfinite", "guardian.nonfinite_rounds")):
             cur = int(counters.get(src, 0))
             delta = cur - self._last_counters.get(src, 0)
             if delta > 0:
@@ -1346,6 +1410,22 @@ class _ElasticDistKVStore(KVStore):
         if _tel.ENABLED:
             _tel.counter("kvstore.pull_total").inc()
             _tel.counter("kvstore.pull_bytes_total").inc(pulled_bytes)
+
+    # the guardian reads this: coordinator guard totals already mirror
+    # into this worker's guardian.* counters (_absorb_view), so local
+    # vote-path accounting must not double-count the same round
+    _guardian_mirrors_skips = True
+
+    def guardian_vote(self, step, poisoned):
+        """Elastic skip coordination is SERVER-side: every rank's
+        gradient rides the aggregation round, and the coordinator's
+        guard skips applying a poisoned merged round for the whole
+        group at once (Aggregator guard; mirrored into
+        ``guardian.skipped_steps`` via the view counters). A unilateral
+        local skip would leave the round waiting for this rank's
+        contribution until the eviction sweeper fired — so the local
+        verdict never suppresses a push here."""
+        return False
 
     # -- control plane ---------------------------------------------------------
     def set_optimizer(self, optimizer):

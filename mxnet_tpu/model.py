@@ -19,6 +19,7 @@ import numpy as _np
 from . import telemetry as _tel
 from .base import MXNetError
 from .resilience import faults as _faults
+from .resilience import guardian as _guardian
 from .context import Context, cpu, current_context
 from .ndarray import NDArray, zeros, load as nd_load, save as nd_save
 from . import io
@@ -73,6 +74,15 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
+        # grad.nan/loss.spike chaos points (no-op unless armed): only
+        # for stores with no in-process updater (the elastic path,
+        # where the update runs server-side and the poison must ride
+        # the aggregation round into the server guard) — a store with a
+        # local updater injects inside get_updater already, and firing
+        # here too would double-draw the seeded pattern per step
+        if getattr(kvstore, "_updater", None) is None:
+            grad_list = [g if g is None else _guardian.corrupt_grad(g)
+                         for g in grad_list]
         kvstore.push(index, grad_list, priority=-index)
         kvstore.pull(index, arg_list, priority=-index)
 
@@ -127,21 +137,36 @@ def _buffer_batch(data_batch, input_names):
     return dict(zip(input_names, arrs))
 
 
-def _scan_flush(trainer, buf, epoch, nbatch0):
+def _scan_flush(trainer, buf, epoch, nbatch0, guardian=None):
     """Dispatch one K-batch chunk; returns the pending record drained
     after the NEXT chunk is in flight (shared by FeedForward's
     _train_scanned and Module._try_scanned_fit). mxtel: the "chunk"
     span covers staging + dispatch (the async device work completes
-    later — the drain's metric fence is its clock)."""
+    later — the drain's metric fence is its clock). The trainer's
+    guardian verdicts for the chunk ride the pending record.
+
+    Guardian snapshots are captured HERE, before the dispatch mutates
+    the trainer state: at flush time the state is the previous chunk's
+    result, which the drain interleaved with this flush verifies — the
+    payload is committed to the last-good ring only after that
+    verification passes (commit_snapshot). Snapshotting at drain time
+    instead would capture state the in-flight chunk has already
+    advanced (and possibly poisoned) past the verified steps."""
     with _tel.span("chunk"):
+        snap = None
+        if guardian is not None and guardian.snapshot_due():
+            snap = trainer.snapshot_state()
         staged = trainer.stage_chunk(buf)
-        return (trainer.run_chunk(staged), buf, epoch, nbatch0)
+        outs = trainer.run_chunk(staged)
+        return (outs, trainer.take_step_flags(), snap, buf, epoch, nbatch0)
 
 
 def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
-                nbatch_base):
+                nbatch_base, guardian=None):
     """Metric updates + per-batch callbacks for a completed chunk.
     nbatch_base: FeedForward numbers batches from 1, Module from 0.
+    Returns the guardian's chunk verdict ("ok"/"skip"/"rollback"; "ok"
+    when unguarded) — the caller owns acting on a rollback.
 
     D2H minimisation: Accuracy only needs the argmax class id per
     sample — reduce [K,N,C] probabilities to [K,N] ids ON DEVICE before
@@ -149,8 +174,13 @@ def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
     ~30% of a ResNet chunk's wall time). Accuracy already accepts 1-D
     predicted labels."""
     if pending is None:
-        return
-    outs, bufs, epoch, nbatch0 = pending
+        return "ok"
+    outs, flags, snap, bufs, epoch, nbatch0 = pending
+    if guardian is not None:
+        # the snapshot captured at this chunk's flush is the PREVIOUS
+        # chunk's result, verified by the drain that ran alongside that
+        # flush — commit it before accounting this chunk's flags
+        guardian.commit_snapshot(snap)
     if (type(eval_metric) is metric_mod.Accuracy and len(outs) == 1
             and getattr(outs[0], "ndim", 0) == 3):
         import jax.numpy as jnp
@@ -158,22 +188,29 @@ def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
         host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
     else:
         host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
+    losses = [] if guardian is not None else None
     for k, b in enumerate(bufs):
         labels = [NDArray(_np.asarray(
             b[n].asnumpy() if isinstance(b[n], NDArray) else b[n]),
             cpu(0)) for n in label_names]
         preds = [NDArray(h[k], cpu(0)) for h in host_outs]
         eval_metric.update(labels, preds)
+        if losses is not None:
+            losses.append(guardian.metric_step_loss())
         if batch_end_callback is not None:
             _multiple_callbacks(batch_end_callback, BatchEndParam(
                 epoch=epoch, nbatch=nbatch0 + k + nbatch_base,
                 eval_metric=eval_metric, locals=locals()))
+    if guardian is not None:
+        return guardian.drain_chunk(flags, losses)
+    return "ok"
 
 
 def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
                    aux_params, begin_epoch, end_epoch, epoch_size, optimizer,
                    train_data, eval_data, eval_metric, epoch_end_callback,
-                   batch_end_callback, logger, eval_batch_end_callback, K):
+                   batch_end_callback, logger, eval_batch_end_callback, K,
+                   guardian=None):
     """K-step-scanned single-device training loop: same observable
     semantics as _train_multi_device's per-batch loop (metrics, per-batch
     callbacks, epoch checkpointing), but the step itself is a compiled
@@ -187,11 +224,16 @@ def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
     eval_exe = None
 
     def _flush(buf, epoch, nbatch0):
-        return _scan_flush(trainer, buf, epoch, nbatch0)
+        return _scan_flush(trainer, buf, epoch, nbatch0, guardian=guardian)
 
     def _drain(pending, eval_metric):
-        _scan_drain(pending, eval_metric, label_names, batch_end_callback,
-                    nbatch_base=1)
+        action = _scan_drain(pending, eval_metric, label_names,
+                             batch_end_callback, nbatch_base=1,
+                             guardian=guardian)
+        if guardian is not None and action == "rollback":
+            guardian.rollback(trainer.restore_state,
+                              disk_restore_fn=trainer.load_params,
+                              data_iter=train_data)
 
     label_names = [_desc_name(d) for d in train_data.provide_label]
 
@@ -225,6 +267,8 @@ def _train_scanned(trainer, symbol, ctx0, param_names, aux_names, arg_params,
             pending = new_pending
             buf = []
         _drain(pending, eval_metric)
+        if guardian is not None:
+            guardian.end_epoch()  # no chunk in flight across the boundary
         toc = time.time()
         logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
@@ -288,6 +332,12 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
     """Core DP training loop (ref: python/mxnet/model.py:117-310)."""
     if logger is None:
         logger = logging
+    # training-run guardian (MXNET_GUARDIAN=1; docs/how_to/guardrails.md):
+    # None when off — every hook below reduces to a None check
+    guard = _guardian.TrainingGuardian.create(
+        kvstore=kvstore, epoch_end_callback=epoch_end_callback, logger=logger)
+    if guard is not None and eval_metric is not None:
+        guard.attach_metric(eval_metric)  # loss-like metrics only
     K = _scan_k()
     _scan_attempted = False
     if (K > 1 and len(ctx) == 1 and kvstore is None and not update_on_kvstore
@@ -325,7 +375,7 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
                     arg_params, aux_params, begin_epoch, end_epoch,
                     epoch_size, optimizer, train_data, eval_data,
                     eval_metric, epoch_end_callback, batch_end_callback,
-                    logger, eval_batch_end_callback, K)
+                    logger, eval_batch_end_callback, K, guardian=guard)
             _scan_attempted = True
     if compute_dtype is not None:
         # mixed precision rides the scanned trainer; the per-batch loop
@@ -356,6 +406,39 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
     if update_on_kvstore:
         kvstore.set_optimizer(optimizer)
 
+    # the updater whose device sentinel the guardian reads per step:
+    # the local closure, or the one kvstore.set_optimizer installed
+    guard_updater = None
+    if guard is not None:
+        guard_updater = getattr(kvstore, "_updater", None) \
+            if update_on_kvstore else updater
+
+    def _guard_snapshot():
+        executor_manager.copy_to(arg_params, aux_params)
+        return ({k: v.asnumpy().copy() for k, v in arg_params.items()},
+                {k: v.asnumpy().copy() for k, v in aux_params.items()},
+                _guardian.snapshot_updater_states(guard_updater))
+
+    def _guard_restore(payload):
+        args, auxs, opt_states = payload
+        for k, v in args.items():
+            arg_params[k][:] = v
+        for k, v in auxs.items():
+            aux_params[k][:] = v
+        executor_manager.set_params(arg_params, aux_params)
+        _guardian.restore_updater_states(guard_updater, opt_states)
+
+    def _guard_disk_restore(args, auxs):
+        for k, v in args.items():
+            if k in arg_params:
+                arg_params[k][:] = v.asnumpy()
+        for k, v in auxs.items():
+            if k in aux_params:
+                aux_params[k][:] = v.asnumpy()
+        executor_manager.set_params(arg_params, aux_params)
+        # no optimizer state in a .params checkpoint: drop the momenta
+        _guardian.zero_updater_states(guard_updater)
+
     def _train_one_batch(data_batch, epoch, nbatch, eval_metric):
         """One optimizer step (mxtel: wrapped in a "batch" span nested
         under the epoch span; step walltime and samples/sec feed the
@@ -367,18 +450,43 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
                 monitor.tic()
             executor_manager.forward(is_train=True)
             executor_manager.backward()
-            if update_on_kvstore:
-                _update_params_on_kvstore(
-                    executor_manager.param_arrays, executor_manager.grad_arrays, kvstore
-                )
+
+            def _do_update():
+                if update_on_kvstore:
+                    _update_params_on_kvstore(
+                        executor_manager.param_arrays,
+                        executor_manager.grad_arrays, kvstore)
+                else:
+                    _update_params(
+                        executor_manager.param_arrays,
+                        executor_manager.grad_arrays,
+                        updater=updater, num_device=len(ctx),
+                        kvstore=kvstore)
+
+            if guard is None:
+                _do_update()
+                if monitor is not None:
+                    monitor.toc_print()
+                executor_manager.update_metric(eval_metric, data_batch.label)
             else:
-                _update_params(
-                    executor_manager.param_arrays, executor_manager.grad_arrays,
-                    updater=updater, num_device=len(ctx), kvstore=kvstore,
-                )
-            if monitor is not None:
-                monitor.toc_print()
-            executor_manager.update_metric(eval_metric, data_batch.label)
+                # metric BEFORE the guarded update: outputs don't
+                # depend on it, and the guardian's loss feed reads this
+                # batch's metric delta for the z-score channel
+                executor_manager.update_metric(eval_metric, data_batch.label)
+                action = guard.guard_batch(
+                    _do_update,
+                    grad_arrays_fn=lambda: [
+                        g[0] for g in executor_manager.grad_arrays
+                        if g and g[0] is not None],
+                    updater=guard_updater)
+                if action == "rollback":
+                    guard.rollback(_guard_restore,
+                                   disk_restore_fn=_guard_disk_restore,
+                                   data_iter=train_data)
+                else:
+                    guard.maybe_snapshot(_guard_snapshot)
+                if monitor is not None:
+                    monitor.toc_print()
             if _tel.ENABLED:
                 dt = time.monotonic() - step_tic
                 _tel.histogram("train.step_secs").observe(dt)

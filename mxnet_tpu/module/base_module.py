@@ -13,6 +13,7 @@ from .. import metric as metric_mod
 from .. import io as io_mod
 from .. import telemetry as _tel
 from ..model import BatchEndParam, _multiple_callbacks
+from ..resilience import guardian as _guardian
 
 
 class BaseModule:
@@ -204,6 +205,41 @@ class BaseModule:
         loop unconditionally."""
         return False
 
+    # -- guardian plumbing (docs/how_to/guardrails.md) -------------------------
+    def _guardian_updater(self):
+        """The updater whose device sentinel carries this module's
+        per-step verdicts: the local one, or the kvstore-installed one."""
+        upd = getattr(self, "_updater", None)
+        if upd is not None:
+            return upd
+        kv = getattr(self, "_kvstore", None)
+        return getattr(kv, "_updater", None) if kv is not None else None
+
+    def _guardian_grads(self):
+        """First-device gradient NDArrays (vote-path stats); [] when the
+        module kind exposes no grad arrays."""
+        fn = getattr(self, "_grad_arrays", None)
+        if fn is None:
+            return []
+        return [g[0] for g in fn() if g and g[0] is not None]
+
+    def _guardian_snapshot(self):
+        arg_params, aux_params = self.get_params()
+        return ({k: v.asnumpy().copy() for k, v in arg_params.items()},
+                {k: v.asnumpy().copy() for k, v in aux_params.items()},
+                _guardian.snapshot_updater_states(self._guardian_updater()))
+
+    def _guardian_restore(self, payload):
+        args, auxs, opt_states = payload
+        self.set_params(args, auxs)
+        _guardian.restore_updater_states(self._guardian_updater(), opt_states)
+
+    def _guardian_disk_restore(self, args, auxs):
+        self.set_params(args, auxs)
+        # a .params checkpoint has no optimizer state; stale (possibly
+        # poisoned) momenta must not survive the rollback
+        _guardian.zero_updater_states(self._guardian_updater())
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -235,13 +271,24 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # training-run guardian (MXNET_GUARDIAN=1): non-finite sentinel,
+        # skip-steps, rollback-to-last-good — None when off
+        guard = _guardian.TrainingGuardian.create(
+            kvstore=getattr(self, "_kvstore", None),
+            epoch_end_callback=epoch_end_callback, logger=self.logger)
+        if guard is not None:
+            # loss z-score channel: live when the eval metric is
+            # loss-like (ce/perplexity/mse/...), inert for accuracy
+            guard.attach_metric(eval_metric)
+
         # K-step-scanned fast path (parallel/fit_trainer.py) — plain
         # single-device Module only; returns False and falls through to
         # the per-batch loop otherwise
         if self._try_scanned_fit(
                 train_data, eval_data, eval_metric, validation_metric,
                 epoch_end_callback, batch_end_callback, eval_end_callback,
-                eval_batch_end_callback, begin_epoch, num_epoch, monitor):
+                eval_batch_end_callback, begin_epoch, num_epoch, monitor,
+                guardian=guard):
             return
 
         def _fit_one_batch(epoch, nbatch, data_batch):
@@ -252,8 +299,24 @@ class BaseModule:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
+                if guard is None:
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                else:
+                    # metric BEFORE the guarded update: the outputs do
+                    # not depend on the update, and the guardian's loss
+                    # feed reads this batch's metric delta
+                    self.update_metric(eval_metric, data_batch.label)
+                    action = guard.guard_batch(
+                        self.update, grad_arrays_fn=self._guardian_grads,
+                        updater=self._guardian_updater())
+                    if action == "rollback":
+                        guard.rollback(
+                            self._guardian_restore,
+                            disk_restore_fn=self._guardian_disk_restore,
+                            data_iter=train_data)
+                    else:
+                        guard.maybe_snapshot(self._guardian_snapshot)
                 if monitor is not None:
                     monitor.toc_print()
                 if _tel.ENABLED:

@@ -427,7 +427,7 @@ class Module(BaseModule):
                          validation_metric, epoch_end_callback,
                          batch_end_callback, eval_end_callback,
                          eval_batch_end_callback, begin_epoch, num_epoch,
-                         monitor):
+                         monitor, guardian=None):
         """Run fit() as K-step compiled scans when eligible (the same
         fast path FeedForward uses, model._train_scanned): single
         device, local updates (no kvstore), scannable optimizer, no
@@ -477,8 +477,13 @@ class Module(BaseModule):
         label_names = [_desc_name(d) for d in train_data.provide_label]
 
         def _drain(pending):
-            _scan_drain(pending, eval_metric, label_names,
-                        batch_end_callback, nbatch_base=0)
+            action = _scan_drain(pending, eval_metric, label_names,
+                                 batch_end_callback, nbatch_base=0,
+                                 guardian=guardian)
+            if guardian is not None and action == "rollback":
+                guardian.rollback(trainer.restore_state,
+                                  disk_restore_fn=trainer.load_params,
+                                  data_iter=train_data)
 
         # while the scanned loop is live, get_params() syncs from the
         # trainer (a batch_end_callback that checkpoints mid-epoch must
@@ -496,17 +501,22 @@ class Module(BaseModule):
                     nbatch += 1
                     if len(buf) == K:
                         new_pending = _scan_flush(trainer, buf, epoch,
-                                                  nbatch - K)
+                                                  nbatch - K,
+                                                  guardian=guardian)
                         _drain(pending)
                         pending = new_pending
                         buf = []
                 if buf:
                     new_pending = _scan_flush(trainer, buf, epoch,
-                                              nbatch - len(buf))
+                                              nbatch - len(buf),
+                                              guardian=guardian)
                     _drain(pending)
                     pending = new_pending
                     buf = []
                 _drain(pending)
+                if guardian is not None:
+                    # no chunk in flight across the epoch boundary
+                    guardian.end_epoch()
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
                                      val)

@@ -24,7 +24,8 @@ class Monitor:
     training runs; the interval only limits how often stats PRINT, not
     the replay cost."""
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 nan_aware=False):
         if stat_func is None:
             def asum_stat(x):
                 """|x|/size(x), the reference default."""
@@ -39,11 +40,39 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        # TPU extension (guardian debugging, docs/how_to/guardrails.md):
+        # nan_aware additionally counts non-finite elements per tapped
+        # tensor, in TAP ORDER — when a run goes NaN, first_nonfinite()
+        # names the earliest op output that went bad, which is the layer
+        # the numerical fault originated in (everything downstream is
+        # contamination)
+        self.nan_aware = bool(nan_aware)
+        self.nonfinite = []  # (step, name, bad_count) in tap order
 
     def stat_helper(self, name, arr):
         if not self.activated or not self.re_prog.match(name):
             return
+        if self.nan_aware:
+            import numpy as _np
+
+            a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+            bad = int(a.size - _np.count_nonzero(_np.isfinite(a)))
+            if bad:
+                self.nonfinite.append((self.step, name, bad))
+                self.queue.append(
+                    (self.step, name, "NONFINITE(%d/%d)" % (bad, a.size)))
+                return
         self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def first_nonfinite(self):
+        """The earliest (step, name, bad_count) record whose tensor held
+        non-finite values — which layer went bad FIRST — or None.
+        Records accumulate across toc() calls (they are the forensic
+        trail, not a per-interval stat); reset_nonfinite() clears."""
+        return self.nonfinite[0] if self.nonfinite else None
+
+    def reset_nonfinite(self):
+        self.nonfinite = []
 
     def install(self, exe):
         """ref: monitor.py:55."""

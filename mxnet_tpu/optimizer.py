@@ -366,12 +366,33 @@ create = Optimizer.create_optimizer
 
 
 def get_updater(optimizer):
-    """Closure with per-index state dict (ref: optimizer.py:803)."""
+    """Closure with per-index state dict (ref: optimizer.py:803).
+
+    Guardian integration (docs/how_to/guardrails.md): with
+    ``MXNET_GUARDIAN=1`` every update runs through the on-device
+    non-finite sentinel — a gradient with NaN/Inf (or past the absolute
+    ``MXNET_GUARDIAN_GRADNORM_MAX`` bound) leaves the weight and the
+    optimizer state untouched via ``jnp.where`` on device, no host
+    sync. The sentinel rides on ``updater.sentinel`` so the training
+    loop can read the per-step verdict with its existing metric fence.
+    The ``grad.nan``/``loss.spike`` chaos points live here too,
+    *outside* the guardian switch (the negative-control chaos leg
+    poisons an unguarded run through the same path)."""
+    from .resilience import guardian as _guardian
+
     states = {}
+    sentinel = _guardian.updater_sentinel()  # None unless MXNET_GUARDIAN=1
 
     def updater(index, grad, weight):
         if index not in states:
             states[index] = optimizer.create_state(index, weight)
-        optimizer.update(index, weight, grad, states[index])
+        grad = _guardian.corrupt_grad(grad)  # no-op unless a rule is armed
+        if sentinel is None:
+            optimizer.update(index, weight, grad, states[index])
+        else:
+            sentinel.guarded_update(optimizer, index, weight, grad,
+                                    states[index])
 
+    updater.sentinel = sentinel
+    updater.states = states  # guardian snapshot/rollback reads these
     return updater

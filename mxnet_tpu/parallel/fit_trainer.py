@@ -154,6 +154,26 @@ class FitTrainer:
         from .. import random as _mxrandom
 
         self._key = _mxrandom.next_key()
+        # guardian sentinel (docs/how_to/guardrails.md): when on, every
+        # scanned step computes finiteness + grad norm and applies the
+        # whole update (params, opt states, aux) through jnp.where — a
+        # poisoned step is suppressed INSIDE the fused program, and the
+        # per-step verdicts stack into the chunk's outputs (they ride
+        # the existing per-chunk D2H with the metrics; zero extra host
+        # syncs). Off (the default), none of the sentinel ops are even
+        # traced. The grad.nan/loss.spike chaos points stage
+        # one host-drawn multiplier per step (lax.scan bodies trace
+        # once, so the per-step fire pattern must enter as data).
+        from ..resilience import faults as _flt
+        from ..resilience import guardian as _grd
+
+        self._aux_names = symbol.list_auxiliary_states()
+        self._guard_on = _grd.enabled()
+        self._guard_max_norm = (
+            _grd._env_float("MXNET_GUARDIAN_GRADNORM_MAX", 0.0)
+            if self._guard_on else 0.0)
+        self._inject = _flt.armed("grad.nan") or _flt.armed("loss.spike")
+        self._last_flags = None
 
     # -- tracing helpers -------------------------------------------------------
     def _traced_update(self, params, opt_states, grads, lr_t, t_t):
@@ -213,7 +233,11 @@ class FitTrainer:
                 else v
             )
 
-        def step(params, opt_states, aux, batch, lr_t, t_t, rng):
+        guard_on = self._guard_on
+        max_norm = self._guard_max_norm
+        inject = self._inject
+
+        def step(params, opt_states, aux, batch, lr_t, t_t, rng, mult):
             def f(p):
                 vals = [
                     (cast_data(batch[n]) if n in batch else cast_param(p[n]))
@@ -230,21 +254,45 @@ class FitTrainer:
             head_grads = [jnp.ones(o.shape, o.dtype) for o in flt]
             (grads,) = vjp_fn(head_grads)
             grads = {k: v.astype(jnp.float32) for k, v in grads.items()}
-            params, opt_states = self._traced_update(
+            if inject:  # chaos multiplier (1.0 when this step drew no fault)
+                grads = {k: v * mult for k, v in grads.items()}
+            flags = None
+            if guard_on:
+                gsq = sum(jnp.sum(jnp.square(g)) for g in grads.values())
+                ok = jnp.array(True)
+                for g in grads.values():
+                    ok = ok & jnp.all(jnp.isfinite(g))
+                if max_norm > 0.0:
+                    ok = ok & (gsq <= jnp.float32(max_norm) ** 2)
+            new_params, new_states = self._traced_update(
                 params, opt_states, grads, lr_t, t_t)
-            return params, opt_states, new_aux, outs
+            if guard_on:
+                def sel(new, old):
+                    return jnp.where(ok, new, old)
 
-        def loop(params, opt_states, aux, batches, lrs, ts, rngs):
+                new_params = {k: sel(v, params[k])
+                              for k, v in new_params.items()}
+                new_states = [
+                    [None if l is None else sel(l, o)
+                     for l, o in zip(ns, os_)]
+                    for ns, os_ in zip(new_states, opt_states)
+                ]
+                new_aux = [sel(a, b) for a, b in zip(new_aux, aux)]
+                flags = (ok, jnp.sqrt(gsq))
+            return new_params, new_states, new_aux, outs, flags
+
+        def loop(params, opt_states, aux, batches, lrs, ts, rngs, mults):
             def body(carry, xs):
                 params, opt_states, aux = carry
-                batch, lr_t, t_t, rng = xs
-                params, opt_states, aux, outs = step(
-                    params, opt_states, aux, batch, lr_t, t_t, rng)
-                return (params, opt_states, aux), tuple(outs)
+                batch, lr_t, t_t, rng, mult = xs
+                params, opt_states, aux, outs, flags = step(
+                    params, opt_states, aux, batch, lr_t, t_t, rng, mult)
+                return (params, opt_states, aux), (tuple(outs), flags)
 
-            (params, opt_states, aux), stacked = jax.lax.scan(
-                body, (params, opt_states, aux), (batches, lrs, ts, rngs))
-            return params, opt_states, aux, stacked
+            (params, opt_states, aux), (stacked, flags) = jax.lax.scan(
+                body, (params, opt_states, aux),
+                (batches, lrs, ts, rngs, mults))
+            return params, opt_states, aux, stacked, flags
 
         return jax.jit(loop, donate_argnums=(0, 1, 2))
 
@@ -311,11 +359,22 @@ class FitTrainer:
         ts = _np.arange(base + 1, base + K + 1, dtype=_np.int32)
         self._key, sub = jax.random.split(self._key)
         rngs = jax.random.split(sub, K)
+        if self._inject:
+            # one host fire decision per step, staged into the program
+            from ..resilience import guardian as _grd
+
+            mults = _np.asarray(
+                [_grd.grad_fault_multiplier() for _ in range(K)],
+                _np.float32)
+        else:
+            mults = _np.ones((K,), _np.float32)
 
         if K not in self._jit_cache:
             self._jit_cache[K] = self._make_loop(K)
-        self.params, self.opt_states, self.aux, stacked = self._jit_cache[K](
-            self.params, self.opt_states, self.aux, batches, lrs, ts, rngs)
+        (self.params, self.opt_states, self.aux, stacked,
+         self._last_flags) = self._jit_cache[K](
+            self.params, self.opt_states, self.aux, batches, lrs, ts, rngs,
+            mults)
 
         # host-side optimizer bookkeeping advances by K applied steps
         for i in range(len(self.param_names)):
@@ -323,6 +382,93 @@ class FitTrainer:
                 opt._index_update_count.get(i, opt.begin_num_update) + K)
         opt.num_update = max(opt.num_update, base + K)
         return list(stacked)
+
+    def take_step_flags(self):
+        """The newest chunk's per-step guardian verdicts —
+        ``(ok[K], grad_norm[K])`` device arrays — or None when the
+        trainer runs unguarded. Consumed once (cleared on read) so a
+        drain can never double-account a chunk."""
+        flags, self._last_flags = self._last_flags, None
+        return flags
+
+    # -- guardian snapshot/rollback -------------------------------------------
+    def snapshot_state(self):
+        """Full host copy of the trainer state (params, optimizer
+        states, aux, host-side step bookkeeping) — the guardian's
+        in-memory last-good ring payload."""
+        opt = self.optimizer
+        return {
+            "params": {n: _np.asarray(v) for n, v in self.params.items()},
+            "aux": [_np.asarray(a) for a in self.aux],
+            "opt_states": [
+                [None if l is None else _np.asarray(l) for l in st]
+                for st in self.opt_states
+            ],
+            "num_update": opt.num_update,
+            "counts": dict(opt._index_update_count),
+        }
+
+    def restore_state(self, snap):
+        """Adopt a :meth:`snapshot_state` dump (guardian rollback)."""
+        import jax
+
+        jnp = self._jnp
+        dev = self.ctx.jax_device
+        self.params = {n: jax.device_put(jnp.asarray(v), dev)
+                       for n, v in snap["params"].items()}
+        self.aux = [jax.device_put(jnp.asarray(a), dev)
+                    for a in snap["aux"]]
+        self.opt_states = [
+            [None if l is None else jax.device_put(jnp.asarray(l), dev)
+             for l in st]
+            for st in snap["opt_states"]
+        ]
+        opt = self.optimizer
+        opt.num_update = snap["num_update"]
+        opt._index_update_count = dict(snap["counts"])
+
+    def load_params(self, arg_params, aux_params):
+        """Adopt checkpoint params/aux (the guardian's DISK rollback
+        fallback). Names missing from the checkpoint (a prefix reused
+        across model variants, allow_missing saves) keep their current
+        device values — a recoverable rollback must not become a
+        KeyError crash. A .params checkpoint carries no optimizer
+        state, so momenta/variances restart from fresh zeros — the same
+        contract as resuming a run from a checkpoint without its
+        .states file."""
+        import jax
+
+        from ..ndarray import NDArray
+
+        jnp = self._jnp
+        dev = self.ctx.jax_device
+        self.params = {
+            n: (jax.device_put(
+                jnp.asarray(arg_params[n].asnumpy(), jnp.float32), dev)
+                if n in arg_params else self.params[n])
+            for n in self.param_names
+        }
+        self.aux = [
+            (jax.device_put(
+                jnp.asarray(aux_params[n].asnumpy(), jnp.float32), dev)
+             if n in aux_params else a)
+            for n, a in zip(self._aux_names, self.aux)
+        ]
+        self.opt_states = []
+        for i, n in enumerate(self.param_names):
+            # create_state wants an NDArray-shaped weight; the restored
+            # device value covers names the checkpoint did not
+            w = arg_params.get(n)
+            if w is None:
+                w = NDArray(self.params[n], self.ctx)
+            st = self.optimizer.create_state(i, w)
+            leaves, _treedef = jax.tree_util.tree_flatten(
+                st, is_leaf=lambda x: x is None)
+            self.opt_states.append([
+                None if l is None else jax.device_put(
+                    jnp.asarray(l.asnumpy(), jnp.float32), dev)
+                for l in leaves
+            ])
 
     def write_back(self, arg_params, aux_params, aux_names):
         """Copy the device state into the user-visible NDArray dicts

@@ -20,6 +20,11 @@ Pieces (see docs/how_to/fault_tolerance.md):
   attempts, deadline, retryable filter) used by the KVStore coordinator
   paths, plus ``run_with_deadline`` for turning indefinite blocking
   calls (dist barriers) into diagnosable timeouts.
+- ``guardian`` — the training-run guardian (``MXNET_GUARDIAN=1``):
+  on-device non-finite gradient sentinels, EMA/z-score anomaly
+  detection, coordinated skip-steps, rollback-to-last-good (snapshot
+  ring, then newest on-disk checkpoint). See
+  docs/how_to/guardrails.md.
 
 Consumers wired through the rest of the tree:
 
@@ -33,12 +38,14 @@ Consumers wired through the rest of the tree:
 """
 from __future__ import annotations
 
-from . import faults, retry
+from . import faults, guardian, retry
 from .faults import FaultInjected, clear, inject, parse_spec, point
+from .guardian import TrainingGuardian
 from .retry import DeadlineExceeded, RetryPolicy, run_with_deadline
 
 __all__ = [
-    "faults", "retry",
+    "faults", "guardian", "retry",
     "FaultInjected", "point", "inject", "clear", "parse_spec",
+    "TrainingGuardian",
     "RetryPolicy", "DeadlineExceeded", "run_with_deadline",
 ]

@@ -34,6 +34,14 @@ Registered points (grep ``faults.point(`` for the live list):
                     sweep — the eviction retries on the next pass)
     kv.rejoin    -- elastic worker rejoin/re-register path
     engine.task  -- dependency-engine task body, before fn runs
+    grad.nan     -- optimizer update path: an ``error``-mode fire makes
+                    the production hook (``guardian.corrupt_grad`` /
+                    the scanned trainer's staged multipliers) poison
+                    that gradient with NaN instead of raising —
+                    consumed via :func:`check`, not :func:`point`
+    loss.spike   -- same hook: scales the gradient by
+                    MXNET_GUARDIAN_SPIKE_SCALE (a finite explosion the
+                    guardian's anomaly detector must catch)
 
 The registry is process-global and thread-safe. ``clear()`` removes
 every installed rule AND re-arms the env read, so a pytest fixture
@@ -51,8 +59,8 @@ import time
 from ..base import MXNetError
 
 __all__ = [
-    "FaultInjected", "FaultRule", "parse_spec", "point", "inject",
-    "clear", "active", "fire_pattern",
+    "FaultInjected", "FaultRule", "parse_spec", "point", "check",
+    "armed", "inject", "clear", "active", "fire_pattern",
 ]
 
 
@@ -236,6 +244,50 @@ def point(name):
         time.sleep(d)
     if boom is not None:
         raise FaultInjected(name, boom)
+
+
+def armed(name):
+    """True when any rule is installed for `name` — the fast gate for
+    call sites whose fault behavior is data corruption rather than an
+    exception (grad.nan/loss.spike): they must not even touch the
+    value when nothing is armed. Same lock-free fast path as point()."""
+    if _env_loaded and not _rules:
+        return False
+    with _lock:
+        _ensure_env_locked()
+        return bool(_rules.get(name))
+
+
+def check(name):
+    """Like :func:`point`, but an ``error``-mode fire RETURNS True
+    instead of raising — for points where 'the fault fired' means the
+    call site corrupts a value (grad.nan poisons the gradient) rather
+    than aborts. ``delay`` rules still sleep. Fire counting and
+    telemetry match point()."""
+    if _env_loaded and not _rules:
+        return False
+    with _lock:
+        _ensure_env_locked()
+        rules = _rules.get(name)
+        if not rules:
+            return False
+        naps, fired = [], False
+        for r in rules:
+            if r.should_fire():
+                if r.mode == "delay":
+                    naps.append(r.delay)
+                else:
+                    fired = True
+    if naps or fired:
+        from .. import telemetry as _tel
+
+        if _tel.ENABLED:
+            n = len(naps) + (1 if fired else 0)
+            _tel.counter("faults.fired_total").inc(n)
+            _tel.counter("faults.fired.%s" % name).inc(n)
+    for d in naps:
+        time.sleep(d)
+    return fired
 
 
 def inject(spec, **kwargs):

@@ -1,0 +1,34 @@
+"""Capability probe: do multi-process collectives work on this jaxlib?
+
+The smallest program exercising the machinery every dist_* kvstore test
+depends on: two processes rendezvous through jax.distributed, build a
+process-spanning global array, and all-reduce it (KVStore._global_reduce).
+On jaxlib builds whose CPU backend lacks cross-process collectives this
+hangs or crashes; tests/unittest/test_dist_kvstore.py runs this probe
+once and skips its legs — with the probe's reason — instead of failing.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    rank, world = kv.rank, kv.num_workers
+    kv.init(7, mx.nd.zeros((4,)))
+    kv.push(7, mx.nd.ones((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pull(7, out=out)
+    np.testing.assert_allclose(out.asnumpy(), float(world))
+    kv.barrier()
+    print("rank %d/%d: collective probe OK" % (rank, world), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,467 @@
+"""Training-run guardian tests (ISSUE 5; docs/how_to/guardrails.md).
+
+Covers the acceptance legs: the on-device sentinel suppresses a
+poisoned update, the skip counter escalates to snapshot-ring rollback
+then disk rollback, the iterator fast-forward resumes at the right
+batch, a poisoned elastic contribution makes every in-proc rank skip
+the same round, and the whole subsystem is off-by-default with a
+zero-overhead guard.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import telemetry as _tel
+from mxnet_tpu.resilience import faults, guardian
+
+
+@pytest.fixture()
+def guard_on(monkeypatch):
+    monkeypatch.setenv("MXNET_GUARDIAN", "1")
+    yield
+
+
+def _toy_iter(n=640, batch=32, seed=3):
+    return mx.io.MNISTIter(batch_size=batch, num_synthetic=n, seed=seed,
+                           flat=True)
+
+
+# -- on-device sentinel --------------------------------------------------------
+
+def test_updater_sentinel_suppresses_poisoned_update(guard_on):
+    sgd = opt.create("sgd", learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    upd = opt.get_updater(sgd)
+    assert upd.sentinel is not None
+    w = mx.nd.ones((4,))
+    upd(0, mx.nd.ones((4,)), w)
+    good = w.asnumpy().copy()
+    assert not np.allclose(good, 1.0)  # the good update landed
+    ok, gnorm = upd.sentinel.read_step()
+    assert ok and gnorm == pytest.approx(2.0)  # sqrt(4 * 1^2)
+
+    mom = upd.states[0].asnumpy().copy()
+    upd(0, mx.nd.array(np.array([1, np.nan, 1, 1], np.float32)), w)
+    # weight AND momentum untouched: the poisoned update never landed
+    np.testing.assert_array_equal(w.asnumpy(), good)
+    np.testing.assert_array_equal(upd.states[0].asnumpy(), mom)
+    ok, gnorm = upd.sentinel.read_step()
+    assert not ok and not np.isfinite(gnorm)
+    # accumulators reset after the read
+    assert upd.sentinel.read_step() == (True, None)
+
+
+def test_updater_sentinel_absolute_norm_bound(guard_on, monkeypatch):
+    monkeypatch.setenv("MXNET_GUARDIAN_GRADNORM_MAX", "1.0")
+    sgd = opt.create("sgd", learning_rate=0.1, rescale_grad=1.0)
+    upd = opt.get_updater(sgd)
+    w = mx.nd.ones((4,))
+    upd(0, mx.nd.array(np.full((4,), 10.0, np.float32)), w)  # norm 20 > 1
+    np.testing.assert_array_equal(w.asnumpy(), 1.0)  # suppressed on device
+    ok, _ = upd.sentinel.read_step()
+    assert not ok
+
+
+def test_guardian_off_by_default():
+    """The zero-overhead contract: nothing guarded, nothing created,
+    grads pass through by identity when no fault rule is armed."""
+    assert not guardian.enabled()
+    assert guardian.updater_sentinel() is None
+    assert guardian.TrainingGuardian.create() is None
+    upd = opt.get_updater(opt.create("sgd"))
+    assert upd.sentinel is None
+    g = mx.nd.ones((2,))
+    assert guardian.corrupt_grad(g) is g  # no copy, no wrapping
+
+
+# -- anomaly detector ----------------------------------------------------------
+
+def test_detector_classification_bands():
+    det = guardian.AnomalyDetector(guardian.GuardianConfig())
+    assert det.classify(finite=False) == guardian.POISONED
+    assert det.classify(loss=float("nan")) == guardian.POISONED
+    assert det.classify(grad_norm=float("inf")) == guardian.POISONED
+    # statistical detectors are unarmed before warmup
+    assert not det.armed
+    assert det.classify(grad_norm=1e9, loss=1e9) == guardian.GOOD
+    for _ in range(12):
+        det.observe(grad_norm=1.0, loss=2.0)
+    assert det.armed
+    assert det.classify(grad_norm=1.1, loss=2.05) == guardian.GOOD
+    assert det.classify(grad_norm=100.0) == guardian.POISONED  # explosion
+    assert det.classify(loss=50.0) == guardian.POISONED        # z spike
+    assert det.classify(loss=2.4) == guardian.SUSPECT          # z/2 band
+    # ONE-SIDED: a fast legitimate improvement (loss far BELOW the
+    # baseline) is GOOD — a two-sided test would freeze the run
+    # poisoned forever once convergence outpaced the EMA
+    assert det.classify(loss=0.2) == guardian.GOOD
+    assert det.classify(grad_norm=0.001) == guardian.GOOD
+
+
+# -- escalation policy ---------------------------------------------------------
+
+def test_skip_counter_escalates_ring_then_disk(guard_on, monkeypatch,
+                                               tmp_path):
+    monkeypatch.setenv("MXNET_GUARDIAN_MAX_SKIPS", "3")
+    monkeypatch.setenv("MXNET_GUARDIAN_SNAPSHOT_KEEP", "1")
+    monkeypatch.setenv("MXNET_GUARDIAN_FF_BATCHES", "2")
+    prefix = str(tmp_path / "guard")
+    from mxnet_tpu.model import save_checkpoint
+
+    sym = mx.models.get_mlp()
+    args = {n: mx.nd.ones((2, 2)) for n in ("w",)}
+    save_checkpoint(prefix, 5, None, {"w": mx.nd.full((2, 2), 7.0)},
+                    {}, sync=True)
+
+    g = guardian.TrainingGuardian.create(prefix=prefix)
+    # good steps feed the ring
+    for _ in range(5):
+        g.begin_step()
+        assert g.record_step(finite=True, grad_norm=1.0) == "ok"
+    assert g.maybe_snapshot(lambda: "SNAP-A")
+    # two poisoned steps: skips, no rollback yet
+    for i in range(2):
+        g.begin_step()
+        assert g.record_step(finite=False, suppressed=True) == "skip"
+    # third consecutive poisoned step escalates
+    g.begin_step()
+    assert g.record_step(finite=False, suppressed=True) == "rollback"
+    restored = []
+    it = _toy_iter()
+    it.reset()
+    first = it.next().data[0].asnumpy().copy()
+    target = g.rollback(restored.append, data_iter=it)
+    assert restored == ["SNAP-A"] and target == 5  # ring snapshot, step 5
+    assert g.rollbacks == 1 and g.consecutive_poisoned == 0
+    # FF_BATCHES=2: batches 2 and 3 were consumed; the next is batch 4
+    nxt = it.next().data[0].asnumpy()
+    assert not np.array_equal(nxt, first)
+
+    # ring now empty -> the SAME escalation falls back to disk
+    for _ in range(3):
+        g.begin_step()
+        action = g.record_step(finite=False, suppressed=True)
+    assert action == "rollback"
+    disk = {}
+    g.rollback(lambda p: pytest.fail("ring should be empty"),
+               disk_restore_fn=lambda a, x: disk.update(a))
+    assert g.rollbacks == 2
+    np.testing.assert_array_equal(disk["w"].asnumpy(), 7.0)
+
+
+def test_snapshots_never_taken_inside_poisoned_streak(guard_on):
+    g = guardian.TrainingGuardian.create()
+    g.begin_step()
+    g.record_step(finite=False, suppressed=True)
+    assert not g.snapshot_due()
+    assert not g.maybe_snapshot(lambda: pytest.fail("must not snapshot"))
+    assert not g.commit_snapshot("POISONED-STATE")
+    assert len(g.ring) == 0
+
+
+def test_fast_forward_resumes_at_the_right_batch():
+    # deterministic, shuffle-free iterator: batch i is constant i
+    X = np.repeat(np.arange(10, dtype=np.float32), 4)[:, None]
+    it = mx.io.NDArrayIter(X, np.zeros(40, np.float32), batch_size=4,
+                           shuffle=False)
+    it.reset()
+    assert guardian.fast_forward(it, 3) == 3
+    nxt = it.next().data[0].asnumpy()
+    np.testing.assert_array_equal(nxt, 3.0)  # batches 0-2 skipped
+    # epoch end stops the skip early instead of raising
+    it.reset()
+    assert guardian.fast_forward(it, 999) == 10
+
+
+# -- end-to-end fit legs -------------------------------------------------------
+
+def _fit_mlp(num_epoch=3):
+    mx.random.seed(0)
+    train = _toy_iter()
+    val = mx.io.MNISTIter(batch_size=32, num_synthetic=320, seed=4,
+                          flat=True, shuffle=False)
+    mod = mx.module.Module(mx.models.get_mlp(), context=mx.cpu(0))
+    mod.fit(train, num_epoch=num_epoch,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    acc = mod.score(val, "acc")[0][1]
+    ap, xp = mod.get_params()
+    finite = all(np.isfinite(v.asnumpy()).all()
+                 for v in list(ap.values()) + list(xp.values()))
+    return acc, finite
+
+
+@pytest.mark.parametrize("scan", ["1", "0"], ids=["scanned", "per-batch"])
+def test_fit_survives_nan_and_spike(guard_on, monkeypatch, scan):
+    """Both fit paths: grad.nan suppressed per step, the finite spike
+    escalates to a snapshot-ring rollback, and training still converges
+    with finite params. Counters land in telemetry."""
+    monkeypatch.setenv("MXNET_SCAN_TRAIN", scan)
+    monkeypatch.setenv("MXNET_GUARDIAN_SNAPSHOT_STEPS", "5")
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    _tel.reload()
+    try:
+        # p=0.05:seed=7 fires at scanned steps 8/21/56 (fire_pattern —
+        # the injection clock is per STEP on the scanned path, per
+        # param-update on the per-batch path, hence the spike offsets)
+        faults.inject("grad.nan:error:p=0.05:seed=7;"
+                      "loss.spike:error:count=1:skip=%s:seed=9"
+                      % ("30" if scan == "1" else "200"))
+        acc, finite = _fit_mlp()
+    finally:
+        _tel.reload()  # monkeypatch will restore the env after the test
+    assert finite, "non-finite params leaked through the sentinel"
+    assert acc > 0.8, "run did not recover (acc=%.3f)" % acc
+    counters = _tel.snapshot()["counters"]
+    assert counters.get("guardian.nonfinite_steps", 0) >= 1
+    assert counters.get("guardian.skipped_steps", 0) >= 1
+    assert counters.get("guardian.rollbacks", 0) >= 1
+
+
+def test_fit_negative_control_without_guardian(monkeypatch):
+    """The same injection with the guardian OFF corrupts the run — the
+    survival legs above prove something real."""
+    monkeypatch.setenv("MXNET_SCAN_TRAIN", "1")
+    faults.inject("grad.nan:error:p=0.05:seed=7")
+    acc, finite = _fit_mlp()
+    assert not finite or acc < 0.5
+
+
+# -- distributed coordination --------------------------------------------------
+
+def test_local_kvstore_vote_is_the_local_verdict():
+    kv = mx.kvstore.KVStore("local")
+    assert kv.guardian_vote(1, True) is True
+    assert kv.guardian_vote(2, False) is False
+
+
+def test_elastic_poisoned_round_skips_for_all_ranks(guard_on, monkeypatch):
+    """One rank's NaN contribution poisons the merged round; the
+    coordinator skips applying it for the WHOLE group — both ranks pull
+    the same unchanged weights for that round, and the skip is counted.
+    The next clean round applies normally."""
+    from mxnet_tpu.elastic import ElasticCoordinator
+
+    coord = ElasticCoordinator(world=2, bind=("127.0.0.1", 0),
+                               evict_after=30).start()
+    try:
+        monkeypatch.setenv("MXNET_KV_ELASTIC", "1")
+        monkeypatch.setenv("MXNET_ELASTIC_COORD", "%s:%d" % coord.addr)
+        monkeypatch.setenv("MXNET_NUM_PROCS", "2")
+        monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.5")
+
+        def mk(rank):
+            monkeypatch.setenv("MXNET_PROC_ID", str(rank))
+            return mx.kvstore.create("dist_sync")
+
+        kv0, kv1 = mk(0), mk(1)
+        kv0.init("w", mx.nd.ones((2,)))
+        kv1.init("w", mx.nd.ones((2,)))
+        # elastic stores never skip locally (that would wedge the round)
+        assert kv0.guardian_vote(1, True) is False
+        outs = {}
+
+        def step(kv, rank, val):
+            kv.push("w", mx.nd.array(np.asarray(val, np.float32)))
+            o = mx.nd.zeros((2,))
+            kv.pull("w", out=o)
+            outs[rank] = o.asnumpy()
+
+        t = threading.Thread(target=step, args=(kv0, 0, [np.nan, 1.0]))
+        t.start()
+        step(kv1, 1, [2.0, 2.0])
+        t.join(timeout=30)
+        assert not t.is_alive()
+        np.testing.assert_array_equal(outs[0], 1.0)
+        np.testing.assert_array_equal(outs[1], 1.0)
+        assert coord.agg.guard_skips_total == 1
+        assert coord.agg.guard_nonfinite_total == 1
+
+        t = threading.Thread(target=step, args=(kv0, 0, [1.0, 1.0]))
+        t.start()
+        step(kv1, 1, [2.0, 2.0])
+        t.join(timeout=30)
+        np.testing.assert_array_equal(outs[0], 3.0)  # assign semantics: sum
+        np.testing.assert_array_equal(outs[1], 3.0)
+        kv0.leave()
+        kv1.leave()
+    finally:
+        coord.stop()
+
+
+def test_elastic_guard_is_off_by_default(monkeypatch):
+    """Without MXNET_GUARDIAN the aggregator applies whatever it merged
+    — the guard must not silently change unguarded semantics."""
+    from mxnet_tpu.elastic.server import Aggregator
+
+    agg = Aggregator(world=1)
+    agg.init_key("w", np.ones(2, np.float32))
+    assert agg.contribute("w", 0, 1, np.array([np.nan, 1.0], np.float32)) \
+        == "ok"
+    assert agg.complete_ready({0}) == ["w"]
+    assert agg.guard_skips_total == 0
+    assert not np.isfinite(agg.weights["w"][0])  # NaN landed, as before
+
+
+# -- chaos points --------------------------------------------------------------
+
+def test_grad_fault_points_are_seeded_and_scoped():
+    g = mx.nd.ones((3,))
+    faults.inject("grad.nan:error:count=1")
+    bad = guardian.corrupt_grad(g)
+    assert not np.isfinite(bad.asnumpy()).any()
+    ok = guardian.corrupt_grad(g)  # count exhausted
+    np.testing.assert_array_equal(ok.asnumpy(), 1.0)
+    faults.clear()
+    faults.inject("loss.spike:error:count=1")
+    spiked = guardian.corrupt_grad(g)
+    assert spiked.asnumpy()[0] == pytest.approx(1e8)
+
+
+# -- nan-aware monitor ---------------------------------------------------------
+
+def test_monitor_nan_aware_names_first_bad_layer():
+    from mxnet_tpu.monitor import Monitor
+
+    mon = Monitor(interval=1, nan_aware=True)
+    mon.activated = True
+    mon.stat_helper("fc1_output", mx.nd.ones((4,)))
+    mon.step = 3
+    mon.stat_helper("fc2_output",
+                    mx.nd.array(np.array([1, np.nan], np.float32)))
+    mon.stat_helper("softmax_output",
+                    mx.nd.array(np.array([np.inf, np.nan], np.float32)))
+    step, name, bad = mon.first_nonfinite()
+    assert (step, name, bad) == (3, "fc2_output", 1)
+    # the queue carries the NONFINITE marker instead of a garbage stat
+    assert any("NONFINITE(1/2)" in str(v) for _s, n, v in mon.queue
+               if n == "fc2_output")
+    mon.reset_nonfinite()
+    assert mon.first_nonfinite() is None
+
+
+def test_monitor_default_stays_reference_shaped():
+    from mxnet_tpu.monitor import Monitor
+
+    mon = Monitor(interval=1)
+    mon.activated = True
+    mon.stat_helper("x", mx.nd.array(np.array([np.nan], np.float32)))
+    assert mon.first_nonfinite() is None  # nan_aware off: no tracking
+    assert len(mon.queue) == 1
+
+
+# -- loss z-score channel (metric feed) ---------------------------------------
+
+def test_metric_loss_feed_deltas_and_reset():
+    from mxnet_tpu import metric as metric_mod
+
+    ce = metric_mod.create("ce")
+    feed = guardian.MetricLossFeed(ce)
+    assert feed.active
+    ce.sum_metric, ce.num_inst = 6.0, 3
+    assert feed.step_loss() == pytest.approx(2.0)
+    ce.sum_metric, ce.num_inst = 10.0, 5
+    assert feed.step_loss() == pytest.approx(2.0)  # delta 4/2
+    assert feed.step_loss() is None                # no new instances
+    ce.reset()                                     # epoch boundary
+    ce.sum_metric, ce.num_inst = 3.0, 3
+    assert feed.step_loss() == pytest.approx(1.0)
+    # accuracy is not a loss: the channel must stay inert
+    assert not guardian.MetricLossFeed(metric_mod.create("acc")).active
+
+
+def test_loss_zscore_catches_spike_through_guard_batch(guard_on,
+                                                       monkeypatch):
+    """A finite loss explosion with modest gradients is caught by the
+    z-score channel alone (the scenario the grad-norm detectors miss)."""
+    monkeypatch.setenv("MXNET_GUARDIAN_WARMUP", "5")
+    from mxnet_tpu import metric as metric_mod
+
+    ce = metric_mod.create("ce")
+    g = guardian.TrainingGuardian.create()
+    assert g.attach_metric(ce)
+    for i in range(10):  # calm baseline: loss ~2, modest grads
+        ce.sum_metric += 2.0 * 32
+        ce.num_inst += 32
+        g.begin_step()
+        assert g.record_step(finite=True, grad_norm=1.0,
+                             loss=g.metric_step_loss()) == "ok"
+    ce.sum_metric += 500.0 * 32  # the spike, gradients still norm ~1
+    ce.num_inst += 32
+    g.begin_step()
+    assert g.record_step(finite=True, grad_norm=1.0,
+                         loss=g.metric_step_loss()) == "skip"
+    # the update already landed: an ANOMALY step, not a skipped one
+    assert g.anomaly_steps == 1
+    assert g.skipped_steps == 0 and g.nonfinite_steps == 0
+
+
+# -- counter semantics ---------------------------------------------------------
+
+def test_norm_clip_counts_as_skip_not_nonfinite(guard_on, monkeypatch):
+    """A finite gradient suppressed by the absolute norm bound is a
+    skipped step; guardian.nonfinite_steps means NaN/Inf only."""
+    monkeypatch.setenv("MXNET_GUARDIAN_GRADNORM_MAX", "1.0")
+    sgd = opt.create("sgd", learning_rate=0.1, rescale_grad=1.0)
+    upd = opt.get_updater(sgd)
+    g = guardian.TrainingGuardian.create()
+    w = mx.nd.ones((4,))
+
+    g.guard_batch(lambda: upd(0, mx.nd.full((4,), 10.0), w), updater=upd)
+    np.testing.assert_array_equal(w.asnumpy(), 1.0)  # suppressed
+    assert g.skipped_steps == 1 and g.nonfinite_steps == 0
+
+    g.guard_batch(
+        lambda: upd(0, mx.nd.array(np.array([np.nan, 0, 0, 0], np.float32)),
+                    w),
+        updater=upd)
+    assert g.skipped_steps == 2 and g.nonfinite_steps == 1
+
+
+def test_rollback_discard_flag_clears_at_epoch_boundary(guard_on):
+    """A rollback on an epoch's FINAL drain must not discard the next
+    epoch's first (clean, post-restore) chunk."""
+    g = guardian.TrainingGuardian.create()
+    g._discard_next_chunk = True  # as a rollback at the last drain left it
+    g.end_epoch()
+    # the next epoch's first chunk is accounted normally
+    ok = np.array([False])
+    gn = np.array([np.nan])
+    g.begin_step  # noqa: B018 - just exercising the path below
+    assert g.drain_chunk((ok, gn)) == "skip"
+    assert g.skipped_steps == 1
+
+
+def test_elastic_guardian_skips_local_grad_sync(guard_on, monkeypatch):
+    """On a mirroring (elastic) store the verdict is server-side: the
+    worker neither computes per-step grad stats (no host sync for a
+    discarded verdict) nor counts skips locally — the coordinator's
+    mirrored guardian.skipped_rounds carries the event. The loss
+    channel stays live locally."""
+
+    class _FakeElasticKV:
+        type = "dist_sync"
+        _guardian_mirrors_skips = True
+
+        def guardian_vote(self, step, poisoned):  # never consulted
+            raise AssertionError("elastic workers must not vote locally")
+
+    g = guardian.TrainingGuardian(kvstore=_FakeElasticKV())
+    ran = []
+
+    def _grads():
+        raise AssertionError("elastic workers must not pay the grad sync")
+
+    action = g.guard_batch(lambda: ran.append(1), grad_arrays_fn=_grads)
+    assert ran == [1]        # the push always proceeds
+    assert action == "ok"    # NaN detection is the server guard's job
+    assert g.skipped_steps == 0 and g.nonfinite_steps == 0
+    # the loss channel still drives local escalation on elastic paths
+    for _ in range(12):
+        g.begin_step()
+        g.record_step(finite=True, loss=2.0)
+    assert g.guard_batch(lambda: ran.append(2), loss=50.0) == "skip"
+    assert g.anomaly_steps == 1 and ran[-1] == 2

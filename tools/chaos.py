@@ -16,6 +16,8 @@ Usage::
 
     python tools/chaos.py --seed 0 --points ckpt.write,rio.read
     python tools/chaos.py --seed 3 --points engine.task,kv.coord --full
+    python tools/chaos.py --elastic     # SIGKILL/rejoin survival legs
+    python tools/chaos.py --guardian    # grad.nan/loss.spike survival legs
 
 The spec is derived deterministically from --seed: per point, a fire
 probability in [0.02, 0.15] and a per-point RNG seed. Same seed, same
@@ -58,51 +60,66 @@ _ND_MAGIC = 0x112
 _ITEMSIZE = {0: 4, 1: 8, 2: 2, 3: 1, 4: 4, 5: 1, 6: 8}
 
 
+def _iter_params_records(f):
+    """Walk one .params stream (pure struct, no jax): yields a
+    (dtype_code, payload_bytes) pair per tensor, raising ValueError on
+    any malformed structure. ONE parser for both the torn-file scan
+    (_params_ok) and the guardian's non-finite value scan
+    (_params_nonfinite) — a format change updated in one and not the
+    other would silently void whichever scan lagged."""
+    head = f.read(24)
+    if len(head) < 24:
+        raise ValueError("short header")
+    magic, _, count = struct.unpack("<QQQ", head)
+    if magic != _ND_MAGIC:
+        raise ValueError("bad magic")
+    raw = f.read(8)
+    if len(raw) < 8:
+        raise ValueError("short name count")
+    (n_names,) = struct.unpack("<Q", raw)
+    for _ in range(n_names):
+        raw = f.read(8)
+        if len(raw) < 8:
+            raise ValueError("short name length")
+        (ln,) = struct.unpack("<Q", raw)
+        if len(f.read(ln)) < ln:
+            raise ValueError("short name")
+    for _ in range(count):
+        raw = f.read(4)
+        if len(raw) < 4:
+            raise ValueError("short ndim")
+        (ndim,) = struct.unpack("<I", raw)
+        shape = f.read(4 * ndim)
+        if len(shape) < 4 * ndim:
+            raise ValueError("short shape")
+        dims = struct.unpack("<%dI" % ndim, shape) if ndim else ()
+        raw = f.read(4)
+        if len(raw) < 4:
+            raise ValueError("short dtype")
+        (code,) = struct.unpack("<I", raw)
+        if code not in _ITEMSIZE:
+            raise ValueError("unknown dtype code %d" % code)
+        n = 1
+        for d in dims:
+            n *= d
+        nbytes = n * _ITEMSIZE[code]
+        payload = f.read(nbytes)
+        if len(payload) < nbytes:
+            raise ValueError("short payload")
+        yield code, payload
+    if f.read(1) != b"":
+        raise ValueError("trailing garbage")  # torn too
+
+
 def _params_ok(path):
-    """Structurally validate a .params file (pure struct, no jax): the
-    header, every name, and every tensor must parse to exactly EOF."""
+    """Structurally validate a .params file: the header, every name,
+    and every tensor must parse to exactly EOF."""
     try:
         with open(path, "rb") as f:
-            head = f.read(24)
-            if len(head) < 24:
-                return False
-            magic, _, count = struct.unpack("<QQQ", head)
-            if magic != _ND_MAGIC:
-                return False
-            raw = f.read(8)
-            if len(raw) < 8:
-                return False
-            (n_names,) = struct.unpack("<Q", raw)
-            for _ in range(n_names):
-                raw = f.read(8)
-                if len(raw) < 8:
-                    return False
-                (ln,) = struct.unpack("<Q", raw)
-                if len(f.read(ln)) < ln:
-                    return False
-            for _ in range(count):
-                raw = f.read(4)
-                if len(raw) < 4:
-                    return False
-                (ndim,) = struct.unpack("<I", raw)
-                shape = f.read(4 * ndim)
-                if len(shape) < 4 * ndim:
-                    return False
-                dims = struct.unpack("<%dI" % ndim, shape) if ndim else ()
-                raw = f.read(4)
-                if len(raw) < 4:
-                    return False
-                (code,) = struct.unpack("<I", raw)
-                if code not in _ITEMSIZE:
-                    return False
-                n = 1
-                for d in dims:
-                    n *= d
-                nbytes = n * _ITEMSIZE[code]
-                if len(f.read(nbytes)) < nbytes:
-                    return False
-            return f.read(1) == b""  # trailing garbage is torn too
-    except OSError:
+            for _code, _payload in _iter_params_records(f):
+                pass
+        return True
+    except (OSError, ValueError):
         return False
 
 
@@ -161,6 +178,220 @@ def scan_torn_params(root):
                     os.path.join(dirpath, fn)):
                 torn.append(os.path.join(dirpath, fn))
     return torn
+
+
+def _params_nonfinite(path):
+    """Count non-finite floats in a .params file — the guardian
+    acceptance scan (a guarded run must never write NaN/Inf into a
+    checkpoint). Non-float tensors are skipped; a file that does not
+    parse returns -1 (structural corruption is _params_ok's job)."""
+    import numpy as np
+
+    _FLOATS = {0: np.float32, 1: np.float64, 2: np.float16}
+    bad = 0
+    try:
+        with open(path, "rb") as f:
+            for code, payload in _iter_params_records(f):
+                if code in _FLOATS:
+                    arr = np.frombuffer(payload, dtype=_FLOATS[code])
+                    bad += int(arr.size - np.count_nonzero(np.isfinite(arr)))
+        return bad
+    except (OSError, ValueError):
+        return -1
+
+
+def scan_nonfinite_params(root):
+    """(files_scanned, files_with_nonfinite, total_bad_values) over every
+    .params under root. A file that fails to parse counts as bad too —
+    an unverifiable checkpoint must never read as a clean one."""
+    scanned, files_bad, total = 0, 0, 0
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".params"):
+                continue
+            scanned += 1
+            bad = _params_nonfinite(os.path.join(dirpath, fn))
+            if bad != 0:
+                files_bad += 1
+                total += max(bad, 0)
+    return scanned, files_bad, total
+
+
+# -- guardian survival legs ----------------------------------------------------
+# The ISSUE-5 acceptance contract: with grad.nan:p=0.02 plus one forced
+# loss spike injected mid-Module.fit, a MXNET_GUARDIAN=1 run completes
+# within accuracy tolerance of the fault-free baseline, never writes a
+# non-finite value into any checkpoint, and its journal proves the
+# recovery fired (guardian.nonfinite_steps > 0, guardian.rollbacks >= 1);
+# the SAME injection with the guardian off demonstrably corrupts the run
+# (negative control). The elastic 4-proc leg proves the coordinated
+# skip: every rank finishes, with guardian.skipped_steps mirrored from
+# the coordinator's round-protocol guard.
+
+_GUARDIAN_ACC_TOL = 0.15
+_GUARDIAN_OK_RE = re.compile(r"guardian fit OK acc=([0-9.]+) finite=([01])")
+
+
+def _run_guardian_leg(tag, scratch, timeout, extra_env=None):
+    """One single-process guardian_fit.py run in its own checkpoint dir.
+    Returns (rc, acc|None, finite|None, counters, ckpt_dir, output)."""
+    ckpt_dir = os.path.join(scratch, tag + "-ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    journal = os.path.join(scratch, tag + "-journal.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": journal,
+        "GUARDIAN_TEST_PREFIX": os.path.join(ckpt_dir, "guard"),
+        "TMPDIR": scratch,
+    })
+    env.pop("MXNET_FAULT_SPEC", None)
+    env.pop("MXNET_GUARDIAN", None)
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tests", "nightly", "guardian_fit.py")],
+            cwd=REPO, env=env, timeout=timeout, capture_output=True,
+            text=True)
+        out, rc = proc.stdout + proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as exc:
+        out = str(exc.stdout or "") + "\n<HUNG: exceeded %.0fs>" % timeout
+        rc = -1
+    m = _GUARDIAN_OK_RE.search(out)
+    acc = float(m.group(1)) if m else None
+    finite = bool(int(m.group(2))) if m else None
+    return rc, acc, finite, fold_telemetry(journal), ckpt_dir, out
+
+
+def run_guardian(args):
+    """The guardian survival legs: baseline, guarded-under-fire,
+    negative control, then the elastic 4-proc coordinated-skip leg."""
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-guardian-")
+    per_leg = args.timeout / 5.0
+    failures = []
+    seed = args.seed
+    spec = ("grad.nan:error:p=0.02:seed=%d;"
+            "loss.spike:error:count=1:skip=40:seed=%d"
+            % (seed + 11, seed + 12))
+
+    print("chaos --guardian: baseline (fault-free)")
+    rc0, acc0, fin0, _c0, _d0, out0 = _run_guardian_leg(
+        "base", scratch, per_leg)
+    if rc0 != 0 or acc0 is None or not fin0:
+        failures.append("baseline leg failed (rc=%d acc=%s)\n%s"
+                        % (rc0, acc0, out0[-2000:]))
+        base_acc = None
+    else:
+        base_acc = acc0
+
+    print("chaos --guardian: guarded leg (MXNET_GUARDIAN=1, spec=%r)"
+          % spec)
+    rc1, acc1, fin1, c1, ckpt1, out1 = _run_guardian_leg(
+        "guarded", scratch, per_leg, extra_env={
+            "MXNET_GUARDIAN": "1",
+            "MXNET_FAULT_SPEC": spec,
+            "MXNET_GUARDIAN_SNAPSHOT_STEPS": "10",
+        })
+    if rc1 != 0 or acc1 is None:
+        failures.append("guarded leg did not complete (rc=%d)\n%s"
+                        % (rc1, out1[-2000:]))
+    else:
+        if not fin1:
+            failures.append("guarded leg finished with non-finite params")
+        if base_acc is not None and base_acc - acc1 > _GUARDIAN_ACC_TOL:
+            failures.append(
+                "guarded accuracy %.3f fell more than %.2f below "
+                "fault-free %.3f" % (acc1, _GUARDIAN_ACC_TOL, base_acc))
+        if c1.get("guardian.nonfinite_steps", 0) < 1:
+            failures.append("guarded leg: no non-finite step recorded "
+                            "(counters: %s)" % c1)
+        if c1.get("guardian.rollbacks", 0) < 1:
+            failures.append("guarded leg: no rollback recorded "
+                            "(counters: %s)" % c1)
+        scanned, files_bad, bad = scan_nonfinite_params(ckpt1)
+        if scanned < 1:
+            failures.append("guarded leg wrote no checkpoints to scan")
+        elif files_bad:
+            failures.append(
+                "guarded leg wrote non-finite values into %d checkpoint "
+                "file(s) (%d values) — the sentinel leaked poison to disk"
+                % (files_bad, bad))
+
+    print("chaos --guardian: negative control (guardian OFF, same spec)")
+    rc2, acc2, fin2, _c2, ckpt2, out2 = _run_guardian_leg(
+        "control", scratch, per_leg, extra_env={
+            "MXNET_GUARDIAN": "0",
+            "MXNET_FAULT_SPEC": spec,
+        })
+    _scanned2, files_bad2, _bad2 = scan_nonfinite_params(ckpt2)
+    corrupted = (rc2 != 0 or fin2 is False or files_bad2 > 0
+                 or (acc2 is not None and base_acc is not None
+                     and base_acc - acc2 > _GUARDIAN_ACC_TOL))
+    if not corrupted:
+        failures.append(
+            "negative control: the same injection did NOT corrupt the "
+            "unguarded run (rc=%d acc=%s finite=%s) — the guardian legs "
+            "prove nothing" % (rc2, acc2, fin2))
+
+    print("chaos --guardian: elastic legs (4 workers, coordinated skip)")
+    port = 29620 + (seed % 97) * 3
+    rc3, accs3, _c3, out3 = _run_elastic_leg(
+        "gbase", scratch, port, per_leg)
+    if rc3 != 0 or len(accs3) != _ELASTIC_N:
+        failures.append("elastic baseline failed (rc=%d done=%s)\n%s"
+                        % (rc3, sorted(accs3), out3[-2000:]))
+        ebase = None
+    else:
+        ebase = sum(accs3.values()) / len(accs3)
+    rc4, accs4, c4, out4 = _run_elastic_leg(
+        "gfault", scratch, port + 1, per_leg, extra_env={
+            "MXNET_GUARDIAN": "1",
+            "MXNET_FAULT_SPEC": "grad.nan:error:p=0.02:seed=%d" % (seed + 13),
+        })
+    if rc4 != 0 or len(accs4) != _ELASTIC_N:
+        failures.append("elastic guardian leg: not every rank finished "
+                        "(rc=%d done=%s)\n%s"
+                        % (rc4, sorted(accs4), out4[-2000:]))
+    else:
+        if c4.get("guardian.skipped_rounds", 0) < 1:
+            failures.append("elastic guardian leg: no coordinated skip "
+                            "recorded (counters: %s)" % c4)
+        if ebase is not None:
+            worst = min(accs4.values())
+            if ebase - worst > _GUARDIAN_ACC_TOL:
+                failures.append(
+                    "elastic guardian leg: accuracy %.3f fell more than "
+                    "%.2f below fault-free %.3f"
+                    % (worst, _GUARDIAN_ACC_TOL, ebase))
+
+    print("\n=== guardian survival report ===")
+    print("spec             : %s" % spec)
+    print("baseline acc     : %s"
+          % ("%.4f" % base_acc if base_acc is not None else "FAILED"))
+    print("guarded leg      : rc=%d acc=%s finite=%s" % (rc1, acc1, fin1))
+    print("guarded counters : nonfinite=%d skipped=%d anomaly=%d "
+          "rollbacks=%d"
+          % (c1.get("guardian.nonfinite_steps", 0),
+             c1.get("guardian.skipped_steps", 0),
+             c1.get("guardian.anomaly_steps", 0),
+             c1.get("guardian.rollbacks", 0)))
+    print("negative control : rc=%d acc=%s finite=%s corrupt=%s"
+          % (rc2, acc2, fin2, corrupted))
+    print("elastic guardian : rc=%d finished=%s skipped_rounds=%d"
+          % (rc4, sorted(accs4), c4.get("guardian.skipped_rounds", 0)))
+    if failures:
+        print("\nRESULT: FAIL")
+        for f in failures:
+            print(" - %s" % f)
+        return 5
+    print("\nRESULT: SURVIVED — poisoned gradients were suppressed, "
+          "skipped and rolled back within %.2f accuracy of fault-free; "
+          "no checkpoint ever carried a non-finite value; the unguarded "
+          "control demonstrably corrupted." % _GUARDIAN_ACC_TOL)
+    return 0
 
 
 # -- elastic survival legs -----------------------------------------------------
@@ -328,12 +559,22 @@ def main(argv=None):
                          "mid-Module.fit (survivors finish), then "
                          "restart-and-rejoin; asserts exit codes, "
                          "accuracy tolerance, and journal counters")
+    ap.add_argument("--guardian", action="store_true",
+                    help="run the training-run-guardian survival legs: "
+                         "grad.nan + loss.spike injected mid-Module.fit "
+                         "with MXNET_GUARDIAN=1 (must survive within "
+                         "accuracy tolerance, with skip/rollback journal "
+                         "counters and nan-free checkpoints), the same "
+                         "spec unguarded (negative control), and the "
+                         "elastic 4-proc coordinated-skip leg")
     ap.add_argument("tests", nargs="*",
                     help="explicit test paths (default: smoke set)")
     args = ap.parse_args(argv)
 
     if args.elastic:
         return run_elastic(args)
+    if args.guardian:
+        return run_guardian(args)
 
     points = [p.strip() for p in args.points.split(",") if p.strip()]
     spec = args.spec or build_spec(args.seed, points, args.mode)
