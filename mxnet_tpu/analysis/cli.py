@@ -177,7 +177,8 @@ def main(argv=None):
         findings.extend(_engine_selftest())
         n_targets += 1
 
-    findings.sort(key=lambda f: (f.severity != "error", f.pass_name, f.where))
+    findings.sort(key=lambda f: (-SEVERITIES.index(f.severity),
+                                 f.pass_name, f.where))
     if args.json:
         import json as _json
 

@@ -2,13 +2,17 @@
 
 Every pass (graph_lint, engine_verify, ast_lint) reports a flat list of
 ``Finding`` objects so the CLI, the test suite and programmatic callers
-consume one shape. Severity is two-level on purpose:
+consume one shape. Severity is three-level on purpose:
 
 - ``error``   — a proven defect (dtype clash on an elementwise edge, a
   write-write race, a tracer leak): the CLI exits nonzero on these.
 - ``warning`` — correct-but-costly or suspicious (sub-128 matmul dims
   whose XLA padding is the honest price of a small layer, dead graph
   nodes in a serialized JSON): reported, exit 0 unless --fail-on warning.
+- ``info``    — an optimization opportunity, not a problem (elementwise
+  chains the compile layer's fusion pass would merge): reported so the
+  lint surfaces what MXNET_COMPILE_OPT=1 would do even when it is off;
+  never affects the exit code unless --fail-on info.
 
 The module stays dependency-free (no jax, no mxnet_tpu imports) so the
 engine can record/verify without dragging the compute stack in.
@@ -17,7 +21,7 @@ from __future__ import annotations
 
 __all__ = ["Finding", "SEVERITIES", "max_severity", "summarize"]
 
-SEVERITIES = ("warning", "error")
+SEVERITIES = ("info", "warning", "error")
 
 
 class Finding:
@@ -67,5 +71,7 @@ def max_severity(findings):
 
 def summarize(findings):
     n_err = sum(1 for f in findings if f.severity == "error")
-    n_warn = len(findings) - n_err
-    return "%d error(s), %d warning(s)" % (n_err, n_warn)
+    n_warn = sum(1 for f in findings if f.severity == "warning")
+    n_info = len(findings) - n_err - n_warn
+    s = "%d error(s), %d warning(s)" % (n_err, n_warn)
+    return s + (", %d info" % n_info if n_info else "")

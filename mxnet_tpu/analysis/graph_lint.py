@@ -28,9 +28,18 @@ Walks a ``symbol.py`` node graph (or its serialized JSON) and reports:
   serialized graph unreachable from any head. A live Symbol can only
   hold reachable nodes, but hand-edited / converted JSON can ship dead
   weight that still costs load time and confuses diffing.
+- ``fusible-chain``   (info) — elementwise chains the compile layer's
+  fusion pass (compile/fuse.py) would merge into one segment. Reported
+  even when ``MXNET_COMPILE_OPT`` is off, so ``mxlint`` surfaces the
+  opportunity; cross-referenced with the 128-lane padding findings of
+  the nodes feeding the chain (fusion does not remove XLA pad).
+
+The graph walks (shape sweep, consumer maps, chain discovery) are
+shared with the compile passes via ``mxnet_tpu.compile.ir``.
 
 No jax import: everything here is host-side metadata walking, safe to
-run in CI before any device is touched.
+run in CI before any device is touched (compile.ir keeps the same
+contract).
 """
 from __future__ import annotations
 
@@ -39,6 +48,7 @@ import json
 
 import numpy as _np
 
+from ..compile import ir as _ir
 from .findings import Finding
 
 __all__ = ["lint_symbol", "lint_json", "PAD_ERROR_DEFICIT", "LANE"]
@@ -103,32 +113,9 @@ def _pad_findings(node_name, dim_label, d):
         % (dim_label, d, LANE, d, aligned, waste))]
 
 
-def _propagate_shapes(nodes, seed):
-    """Forward shape sweep over the DAG; ``seed`` maps (id(node), idx) ->
-    shape. Best-effort: unknown stays None, op infer errors are skipped
-    (lint must not die on a partially-specified graph)."""
-    shapes = dict(seed)
-    for _ in range(3):  # bidirectional infer needs a couple of sweeps
-        changed = False
-        for n in nodes:
-            if n.is_variable:
-                continue
-            in_shapes = [shapes.get((id(s), i)) for s, i in n.inputs]
-            try:
-                ins, outs, _aux = n.op.infer_shape(n.params, in_shapes)
-            except Exception:
-                continue
-            for (src, i), s in zip(n.inputs, ins):
-                if s is not None and shapes.get((id(src), i)) != tuple(s):
-                    shapes[(id(src), i)] = tuple(s)
-                    changed = True
-            for i, s in enumerate(outs):
-                if s is not None and shapes.get((id(n), i)) != tuple(s):
-                    shapes[(id(n), i)] = tuple(s)
-                    changed = True
-        if not changed:
-            break
-    return shapes
+# the shape sweep moved to the shared IR walk (compile/ir.py); the old
+# name stays for callers inside this package
+_propagate_shapes = _ir.propagate_shapes
 
 
 def lint_symbol(sym, input_shapes=None, input_types=None):
@@ -244,6 +231,25 @@ def lint_symbol(sym, input_shapes=None, input_types=None):
                         flat *= int(d)
                     findings.extend(_pad_findings(
                         n.name, "contraction dim %d" % flat, flat))
+
+    # -- fusible chains: what compile/fuse.py would merge (info) ---------------
+    pad_nodes = {f.where for f in findings if f.code == "tpu-pad"}
+    for chain in _ir.find_fusible_chains(sym):
+        names = [c.name for c in chain]
+        feeders = sorted({
+            s.name for c in chain for s, _i in c.inputs
+            if s.name in pad_nodes and s not in chain})
+        msg = ("chain of %d elementwise ops (%s) would fuse into one "
+               "segment under MXNET_COMPILE_OPT=1 (compile/fuse.py): "
+               "%d fewer graph nodes to trace/plan/dispatch"
+               % (len(chain), " -> ".join(names), len(chain) - 1))
+        if feeders:
+            msg += ("; note: the chain is fed by %s, which carry "
+                    "128-lane padding findings — fusion keeps the chain "
+                    "on the padded layout, fix those dims for the full "
+                    "win" % ", ".join(feeders))
+        findings.append(Finding(
+            "graph", "fusible-chain", "info", names[0], msg))
     return findings
 
 

@@ -1,0 +1,212 @@
+"""Persistent compilation cache: jit builds survive process restarts.
+
+Every process today pays every XLA compile from scratch — mxtel's
+``executor.jit_builds_total`` counts them, and for a serving cold start
+they ARE the latency floor. This module wires jax's persistent
+compilation-cache machinery (``jax_compilation_cache_dir``) through the
+framework's compile entry points (Executor, the scanned trainers,
+Predictor): with ``MXNET_COMPILE_CACHE_DIR`` set, compiled executables
+land on disk keyed by their HLO + compile options, and the next process
+that builds the same program LOADS instead of compiling.
+
+Keying: entries live under ``<dir>/jit-<config-hash>/`` where the hash
+covers the rewrite-pass configuration (pass set, layout/precision
+modes, cache format version). The HLO itself already differs when a
+pass rewrites the graph, but the subdir keying also isolates
+configurations whose effect is not visible in the HLO (and makes
+``rm -r`` per-config cleanup trivial).
+
+Robustness: a truncated or bit-flipped cache entry must cost a
+recompile, never a crash. jax's own read path already demotes
+undecodable entries to a miss (``_cache_read`` catches and warns);
+``verify_cache_dir`` goes further and sweeps the directory at ensure()
+time, deleting entries whose compressed payload no longer decodes and
+counting them via ``compile.cache_corrupt_total`` — so one poisoned
+entry costs exactly one recompile and disappears.
+
+Hit/miss accounting rides jax's monitoring events
+(``/jax/compilation_cache/cache_hits`` / ``cache_misses``) into both
+mxtel counters (``compile.cache_hits_total`` / ``misses_total``) and
+module-level plain ints readable without telemetry (bench.py's
+cold-start leg reports them from a bare subprocess).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import zlib
+
+from .. import telemetry as _tel
+
+__all__ = ["ensure", "verify_cache_dir", "cache_dir", "stats"]
+
+#: process-lifetime counters (mirrors of the mxtel counters; plain ints
+#: so subprocesses can report them without enabling telemetry)
+HITS = 0
+MISSES = 0
+CORRUPT = 0
+
+_configured_dir = None
+_listener_on = False
+
+
+def cache_dir():
+    """MXNET_COMPILE_CACHE_DIR, or None (cache off)."""
+    return os.environ.get("MXNET_COMPILE_CACHE_DIR", "").strip() or None
+
+
+def donation_unsafe():
+    """True when donated executables may load from the persistent cache
+    on the CPU backend. jaxlib 0.4.3x CPU executables deserialized from
+    the cache corrupt the heap when run with donated buffers (verified
+    in this container: the warm-process scanned-fit loop segfaults with
+    `malloc_consolidate(): invalid chunk size`; with donation stripped
+    the same cached executable runs clean — and the bug reproduces with
+    jax's own JAX_COMPILATION_CACHE_DIR env wiring, so it is not this
+    module's doing). Donating entry points (parallel/fit_trainer.py,
+    parallel/symbol_trainer.py) consult this and keep their buffers;
+    TPU backends keep donation (different serialization path, and the
+    HBM headroom matters there)."""
+    if cache_dir() is None:
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return False
+
+
+def stats():
+    return {"hits": HITS, "misses": MISSES, "corrupt": CORRUPT}
+
+
+def _on_event(event, **kwargs):
+    global HITS, MISSES
+    if event == "/jax/compilation_cache/cache_hits":
+        HITS += 1
+        if _tel.ENABLED:
+            _tel.counter("compile.cache_hits_total").inc()
+    elif event == "/jax/compilation_cache/cache_misses":
+        MISSES += 1
+        if _tel.ENABLED:
+            _tel.counter("compile.cache_misses_total").inc()
+
+
+def _register_listener():
+    global _listener_on
+    if _listener_on:
+        return
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        _listener_on = True
+    except Exception:  # monitoring API moved: counters stay at 0, cache
+        pass           # itself still works
+
+
+def _decompress_ok(payload):
+    """True iff a cache entry's payload decodes with the compressor jax
+    writes with (zstandard when installed, zlib otherwise — mirror of
+    compilation_cache.compress_executable)."""
+    try:
+        import zstandard
+    except ImportError:
+        zstandard = None
+    try:
+        if zstandard is not None:
+            zstandard.ZstdDecompressor().decompress(
+                payload, max_output_size=1 << 31)
+        else:
+            zlib.decompress(payload)
+        return True
+    except Exception:
+        return False
+
+
+def verify_cache_dir(path):
+    """Sweep ``path`` for undecodable ``*-cache`` entries; delete them
+    (recompile beats crash-or-warn-forever) and count each via
+    ``compile.cache_corrupt_total``. Returns (n_checked, n_removed)."""
+    global CORRUPT
+    checked = removed = 0
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0, 0
+    for name in names:
+        if not name.endswith("-cache"):
+            continue
+        fpath = os.path.join(path, name)
+        checked += 1
+        try:
+            with open(fpath, "rb") as f:
+                payload = f.read()
+            ok = _decompress_ok(payload)
+        except OSError:
+            ok = False
+        if not ok:
+            removed += 1
+            CORRUPT += 1
+            if _tel.ENABLED:
+                _tel.counter("compile.cache_corrupt_total").inc()
+            try:
+                os.remove(fpath)
+                # the atime sidecar of a removed entry is dead weight
+                sidecar = fpath[:-len("-cache")] + "-atime"
+                if os.path.exists(sidecar):
+                    os.remove(sidecar)
+            except OSError:
+                pass
+    return checked, removed
+
+
+def keyed_dir(base, config_key):
+    h = hashlib.sha256(config_key.encode()).hexdigest()[:16]
+    return os.path.join(base, "jit-%s" % h)
+
+
+def ensure(config_key=""):
+    """Idempotently enable the persistent jit cache when
+    MXNET_COMPILE_CACHE_DIR is set. Returns the active entry directory
+    or None. Called from every compile entry point (Executor bind, the
+    scanned trainers, Predictor) — the first caller configures jax,
+    later calls are one string compare."""
+    global _configured_dir
+    base = cache_dir()
+    if base is None:
+        return None
+    target = keyed_dir(base, config_key)
+    if _configured_dir == target:
+        return target
+    os.makedirs(target, exist_ok=True)
+    verify_cache_dir(target)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", target)
+    # default thresholds skip exactly the small fast-to-build programs
+    # a cold start is made of; cache everything (each knob guarded: the
+    # spelling differs across jax versions and a missing threshold knob
+    # must degrade to default gating, not crash every bind)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:
+        pass
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+    # jax memoizes cache-usability at the FIRST compile of the process
+    # (_cache_checked in compilation_cache.py): any jit dispatched
+    # before this ensure() — an autotuning trial, a warmup program —
+    # would otherwise freeze the cache off for the process lifetime
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass  # private API moved: configuring before first jit still works
+    _register_listener()
+    _configured_dir = target
+    return target

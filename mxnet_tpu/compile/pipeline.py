@@ -1,0 +1,178 @@
+"""Pass pipeline: ordered application + pass-level correctness check.
+
+Order matters and is fixed: ``fold`` first (a folded subgraph is fewer
+nodes for everyone downstream), ``layout`` second (NHWC regions are
+established before fusion so chains *inside* a region fuse), ``fuse``
+third (the boundary transposes and converted ops carry custom
+infer_shape and never enter a chain), ``precision`` last (it rewrites
+FC nodes wherever they ended up). Each pass is individually
+disableable via ``MXNET_COMPILE_PASSES`` (see compile/__init__).
+
+``MXNET_COMPILE_VERIFY=1`` adds a pass-level golden check at
+optimize() time: both graphs run eagerly on small random inputs and the
+heads must agree within tolerance — the unrewritten graph is the
+reference. A mismatch raises (a wrong rewrite must never train
+silently); the golden-equivalence tests in
+tests/unittest/test_compile.py apply the same check suite-style across
+the model zoo.
+"""
+from __future__ import annotations
+
+import time as _time
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import telemetry as _tel
+from . import CompileVerifyError
+
+__all__ = ["run"]
+
+#: most recent optimize() report: pass -> rewrite count (test hook and
+#: tools surface; one optimize at a time — binds are host-serial)
+LAST_REPORT = {}
+
+
+def run(sym, passes, input_shapes=None, input_types=None,
+        frozen_params=None, tuner=None, matmul_prec="auto", verify=False):
+    """Apply ``passes`` (iterable of names) to ``sym``; returns the
+    rewritten Symbol (``sym`` itself when nothing applied)."""
+    global LAST_REPORT
+    report = {}
+    new = sym
+    t0 = _time.monotonic()
+    for name in passes:
+        with _tel.span("compile.pass.%s" % name):
+            if name == "fold":
+                from . import fold
+
+                new, n = fold.apply(new, frozen_params=frozen_params)
+            elif name == "layout":
+                from . import layout
+
+                new, n = layout.apply(new, input_shapes=input_shapes,
+                                      input_types=input_types, tuner=tuner)
+            elif name == "fuse":
+                from . import fuse
+
+                new, n = fuse.apply(new, input_shapes=input_shapes,
+                                    tuner=tuner)
+            elif name == "precision":
+                from . import precision
+
+                new, n = precision.apply(
+                    new, input_shapes=input_shapes, input_types=input_types,
+                    tuner=tuner, mode=matmul_prec)
+            else:
+                raise MXNetError("unknown compile pass %r" % (name,))
+        report[name] = n
+        if n and _tel.ENABLED:
+            _tel.counter("compile.passes_applied_total").inc()
+            _tel.counter("compile.pass.%s_rewrites_total" % name).inc(n)
+    report["secs"] = round(_time.monotonic() - t0, 4)
+    LAST_REPORT = report
+    if verify and new is not sym:
+        check_equivalence(sym, new, input_shapes or {},
+                          frozen_params=frozen_params,
+                          loose=bool(report.get("layout")
+                                     or report.get("precision")))
+    return new
+
+
+# -- pass-level golden check ---------------------------------------------------
+
+def _eval_graph(sym, arg_vals, seed=0):
+    """Eager reference interpreter: run every node with op.apply
+    (is_train=False, no RNG) and return the head values. aux states get
+    their op-declared init (init_aux) or the zeros/ones-by-name default
+    simple_bind uses."""
+    env = {}
+    nodes = sym.nodes
+    for n in nodes:
+        if n.is_variable:
+            env[(id(n), 0)] = arg_vals[n.name]
+            continue
+        ins = [env[(id(s), i)] for s, i in n.inputs]
+        aux_names = n.op.list_auxiliary_states(n.params)
+        aux = []
+        if aux_names:
+            aux_shapes = None
+            if n.op.init_aux is not None:
+                try:
+                    _i, _o, aux_shapes = n.op.infer_shape(
+                        n.params, [getattr(x, "shape", None) for x in ins])
+                except MXNetError:
+                    aux_shapes = None
+            if n.op.init_aux is not None and aux_shapes is not None:
+                aux = [_np.asarray(a)
+                       for a in n.op.init_aux(n.params, aux_shapes)]
+            else:
+                _i, _o, aux_shapes = n.op.infer_shape(
+                    n.params, [getattr(x, "shape", None) for x in ins])
+                aux = [(_np.ones(s, _np.float32) if "var" in an
+                        else _np.zeros(s, _np.float32))
+                       for an, s in zip(aux_names, aux_shapes)]
+        outs, _new_aux = n.op.apply(n.params, ins, aux, False, None)
+        for i, o in enumerate(outs):
+            env[(id(n), i)] = o
+    return [env[(id(n), i)] for n, i in sym._outputs]
+
+
+def check_equivalence(ref_sym, opt_sym, input_shapes, frozen_params=None,
+                      loose=False, rtol=None, atol=None, seed=0):
+    """Run both graphs on shared random inputs; raise MXNetError when a
+    head diverges. ``loose`` applies the layout/precision tolerance
+    (reduction-order and accumulation-dtype changes are legitimate);
+    fuse/fold rewrites must match bit-exactly. ``frozen_params`` must
+    be the same values the fold pass baked — the reference graph reads
+    them as arguments, the rewritten graph carries them as constants,
+    so random stand-ins would diverge by construction."""
+    import jax.numpy as jnp
+
+    rng = _np.random.RandomState(seed)
+    frozen = dict(frozen_params or {})
+    arg_names = ref_sym.list_arguments()
+    shapes = {k: tuple(v) for k, v in input_shapes.items()
+              if k in set(arg_names)}
+    if any(n not in shapes and n not in frozen for n in arg_names):
+        # data/label-only callers (Symbol.optimize with just the input
+        # shapes): weight shapes are fully inferable from those
+        try:
+            arg_shapes, _, _ = ref_sym.infer_shape(**shapes)
+            for n, s in zip(arg_names, arg_shapes):
+                if s is not None:
+                    shapes.setdefault(n, tuple(s))
+        except MXNetError:
+            pass  # underdetermined: the explicit check below reports it
+    arg_vals = {}
+    for name in arg_names:
+        if name in frozen:
+            v = frozen[name]
+            v = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+        elif name not in shapes:
+            raise MXNetError(
+                "compile verify: no shape for argument %s" % name)
+        elif name.endswith("label"):
+            v = rng.randint(0, 2, shapes[name]).astype(_np.float32)
+        else:
+            v = rng.rand(*shapes[name]).astype(_np.float32) - 0.5
+        arg_vals[name] = jnp.asarray(v)
+    ref = _eval_graph(ref_sym, arg_vals, seed)
+    opt = _eval_graph(opt_sym, arg_vals, seed)
+    if rtol is None:
+        rtol = 2e-3 if loose else 0.0
+    if atol is None:
+        atol = 2e-3 if loose else 0.0
+    for i, (a, b) in enumerate(zip(ref, opt)):
+        a = _np.asarray(a)
+        b = _np.asarray(b)
+        if a.shape != b.shape:
+            raise CompileVerifyError(
+                "compile verify: head %d shape %s != reference %s"
+                % (i, b.shape, a.shape))
+        if not _np.allclose(a, b, rtol=rtol, atol=atol):
+            err = float(_np.max(_np.abs(a - b))) if a.size else 0.0
+            raise CompileVerifyError(
+                "compile verify: head %d diverges from the unrewritten "
+                "graph (max abs err %.3g, rtol=%g atol=%g)"
+                % (i, err, rtol, atol))

@@ -1,0 +1,106 @@
+"""Matmul-precision pass: tune the FullyConnected accumulation flag.
+
+``ops/nn.py`` hardcodes ``preferred_element_type=float32`` on the
+FullyConnected dot — the safe default (bf16 operands, f32 accumulate).
+Whether that flag costs anything is backend-dependent: on the MXU f32
+accumulation is free, on other backends the widened output can force an
+extra materialization. This pass makes the flag a *measured* per-shape
+choice: with a tuner, each FC problem is timed under both flags and the
+winner is keyed into the tuning DB; the rewrite swaps in an
+``_mxc_fc_prec`` node carrying the explicit choice.
+
+The pass is deliberately inert without a tuner decision or an explicit
+``MXNET_COMPILE_MATMUL_PREC`` override — 'fast' accumulation changes
+numerics (tolerance-bounded in the golden-equivalence tests), so it
+must be asked for, never defaulted in.
+"""
+from __future__ import annotations
+
+from . import ir
+
+__all__ = ["apply", "FC_PREC"]
+
+FC_PREC = "_mxc_fc_prec"
+
+
+def _make_fc_op(base_params, choice):
+    from ..ops.registry import Field, OpDef
+    from ..ops import nn as _nn
+
+    # only the 'fast' choice ever builds a node — 'f32' IS the stock
+    # FullyConnected, so apply() leaves those untouched
+    assert choice == "fast", choice
+
+    def forward(params, inputs, aux, is_train, rng):
+        import jax.numpy as jnp
+
+        data, w = inputs[0], inputs[1]
+        x = data.reshape(data.shape[0], -1)
+        out = jnp.dot(x, w.T)  # backend-default accumulation
+        if not params["no_bias"]:
+            out = out + inputs[2].astype(out.dtype)
+        return [out], []
+
+    return OpDef(
+        FC_PREC + "[%s]" % choice, forward,
+        params={
+            "num_hidden": Field("int", required=True),
+            "no_bias": Field("bool", default=False),
+        },
+        arguments=_nn._fc_args,
+        infer_shape=_nn._fc_shape,
+        doc="compile-time FullyConnected with tuned accumulation flag")
+
+
+def apply(sym, input_shapes=None, input_types=None, tuner=None, mode="auto"):
+    """Rewrite FullyConnected nodes to the tuned accumulation flag.
+
+    ``mode``: 'auto' (consult the tuner; inert without one), 'f32' or
+    'fast' (explicit override for every FC). Returns
+    ``(new_sym, n_rewritten)``."""
+    if mode == "auto" and tuner is None:
+        return sym, 0
+    import numpy as _np
+
+    nodes = sym.nodes
+    seed = {}
+    for n in nodes:
+        if n.is_variable and input_shapes and n.name in input_shapes:
+            seed[(id(n), 0)] = tuple(input_shapes[n.name])
+    shapes = ir.propagate_shapes(nodes, seed) if seed else {}
+    tseed = {(id(n), 0): _np.dtype(input_types[n.name])
+             for n in nodes
+             if n.is_variable and input_types and n.name in input_types}
+    # dtype of the FC's ACTUAL input edge (interior past the first
+    # layer) — propagated, not looked up by bound-argument name
+    dtype_map = ir.propagate_dtypes(nodes, tseed) if tseed else {}
+
+    choices = {}
+    for n in nodes:
+        if n.is_variable or n.op.name != "FullyConnected":
+            continue
+        if mode in ("f32", "fast"):
+            choice = mode
+        else:
+            dshape = shapes.get((id(n.inputs[0][0]), n.inputs[0][1]))
+            if dshape is None:
+                continue
+            dtype = dtype_map.get((id(n.inputs[0][0]), n.inputs[0][1]))
+            choice = tuner.pick_matmul_precision(
+                dshape, n.params["num_hidden"], dtype)
+        if choice != "f32":  # f32 IS the stock op; no rewrite needed
+            choices[id(n)] = choice
+    if not choices:
+        return sym, 0
+
+    from ..symbol import _Node
+
+    def replace(node, new_inputs, memo):
+        choice = choices.get(id(node))
+        if choice is None:
+            return None
+        return _Node(_make_fc_op(node.params, choice), node.name,
+                     node.params, new_inputs,
+                     dict(node.attrs, __mxc_opt__="precision"))
+
+    return ir.rebuild(sym, replace), len(choices)

@@ -31,6 +31,7 @@ import time as _time
 
 import numpy as _np
 
+from . import compile as _compile
 from . import telemetry as _tel
 from .base import MXNetError
 from .context import Context, current_context
@@ -52,7 +53,8 @@ def _as_req_list(grad_req, arg_names):
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 _compile_opts=None):
         import jax
 
         self._symbol = symbol
@@ -110,14 +112,46 @@ class Executor:
             self.aux_arrays = list(aux_states)
 
         # -- plan -------------------------------------------------------------
-        self._nodes = symbol.nodes
-        self._nid = {id(n): i for i, n in enumerate(self._nodes)}
+        # argument mapping keys off the ORIGINAL symbol's variable nodes;
+        # the compile passes preserve variable objects by identity, so
+        # the same map serves the rewritten graph (folded-away variables
+        # simply stop being looked up)
         self._var_argidx = {}
         ai = 0
-        for n in self._nodes:
+        for n in symbol.nodes:
             if n.is_variable:
                 self._var_argidx[id(n)] = ai
                 ai += 1
+        self._multi_device = bool(self._group2ctx)
+        # compile layer (docs/how_to/compilation.md): rewrite the graph
+        # before lowering — off by default, skipped under the eager
+        # multi-device pipeline (ctx_group placement is per ORIGINAL
+        # node). A pass failure falls back to the unrewritten graph (a
+        # slower bind must never become a crashed one); only the
+        # explicit MXNET_COMPILE_VERIFY gate is allowed to propagate.
+        self._exec_symbol = symbol
+        if _compile.ENABLED and not self._multi_device:
+            try:
+                self._exec_symbol = _compile.optimize(
+                    symbol,
+                    input_shapes={
+                        n: a.shape
+                        for n, a in zip(self._arg_names, self.arg_arrays)},
+                    input_types={
+                        n: a.dtype
+                        for n, a in zip(self._arg_names, self.arg_arrays)},
+                    **dict(_compile_opts or {}))
+            except _compile.CompileVerifyError:
+                raise
+            except Exception as e:
+                import logging
+
+                logging.getLogger("mxnet_tpu.compile").warning(
+                    "graph rewrite failed (%s: %s); binding the "
+                    "unrewritten graph", type(e).__name__, e)
+                self._exec_symbol = symbol
+        self._nodes = self._exec_symbol.nodes
+        self._nid = {id(n): i for i, n in enumerate(self._nodes)}
         self._node_aux = {}
         pos = 0
         for n in self._nodes:
@@ -127,7 +161,10 @@ class Executor:
             if na:
                 self._node_aux[id(n)] = (pos, pos + na)
                 pos += na
-        self._heads = [(self._nid[id(nd)], i) for nd, i in symbol._outputs]
+        self._heads = [(self._nid[id(nd)], i)
+                       for nd, i in self._exec_symbol._outputs]
+        # loss-head semantics come from the USER's graph (rewrites never
+        # wrap loss heads, and a boundary transpose head is never a loss)
         self._head_no_grad = [
             (not nd.is_variable) and nd.op.head_no_grad(nd.params)
             for nd, _ in symbol._outputs
@@ -135,7 +172,6 @@ class Executor:
         self._grad_idx = [i for i, r in enumerate(self._reqs) if r != "null"]
 
         # node devices for model parallelism (ctx_group; SURVEY §2.7)
-        self._multi_device = bool(self._group2ctx)
         self._node_device = {}
         if self._multi_device:
             for n in self._nodes:
@@ -171,6 +207,11 @@ class Executor:
             # custom-inl.h); a module-level cache would leak operators
             # across rebinds
             self._host_op_cache = {}
+
+        # persistent jit cache (MXNET_COMPILE_CACHE_DIR): compiled
+        # programs from this bind land on disk and the next process
+        # loads them instead of rebuilding — no-op when unconfigured
+        _compile.ensure_jit_cache()
 
         # jitted entry points (skip jit under multi-device eager pipeline)
         if self._multi_device:

@@ -115,6 +115,15 @@ class FitTrainer:
             # exists to avoid. Per-batch loop handles these graphs.
             raise MXNetError("scanned fit does not support host ops "
                              "(Custom/NumpyOp/torch bridge)")
+        # persistent jit cache (docs/how_to/compilation.md): the K-step
+        # scanned program this trainer builds is the single most
+        # expensive compile in the framework — with
+        # MXNET_COMPILE_CACHE_DIR set the next process loads it from
+        # disk instead of rebuilding (the bind below also applies the
+        # MXNET_COMPILE_OPT graph rewrites to the traced program)
+        from .. import compile as _compile
+
+        _compile.ensure_jit_cache()
         exe = symbol.simple_bind(ctx, grad_req="null", **input_shapes)
         if not all(exe._head_no_grad):
             raise MXNetError("scanned fit requires loss-op heads")
@@ -294,7 +303,13 @@ class FitTrainer:
                 (batches, lrs, ts, rngs, mults))
             return params, opt_states, aux, stacked, flags
 
-        return jax.jit(loop, donate_argnums=(0, 1, 2))
+        from ..compile import jit_cache as _jc
+
+        # donated buffers + a persistently-cached executable corrupt the
+        # heap on the CPU backend (jit_cache.donation_unsafe) — keep the
+        # buffers there; everywhere else donation updates params in place
+        donate = () if _jc.donation_unsafe() else (0, 1, 2)
+        return jax.jit(loop, donate_argnums=donate)
 
     # -- public API ------------------------------------------------------------
     def stage_chunk(self, batch_list):
@@ -371,6 +386,13 @@ class FitTrainer:
 
         if K not in self._jit_cache:
             self._jit_cache[K] = self._make_loop(K)
+            from .. import telemetry as _tel
+
+            if _tel.ENABLED:
+                # the scanned loop is a jit build like any executor
+                # program — the compile layer's cache-hit counters say
+                # whether it loaded from disk or compiled cold
+                _tel.counter("executor.jit_builds_total").inc()
         (self.params, self.opt_states, self.aux, stacked,
          self._last_flags) = self._jit_cache[K](
             self.params, self.opt_states, self.aux, batches, lrs, ts, rngs,

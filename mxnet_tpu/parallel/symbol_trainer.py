@@ -57,6 +57,18 @@ def make_symbol_train_step(symbol, input_shapes, optimizer=None,
         # these graphs through the hybrid engine instead
         raise MXNetError("make_symbol_train_step does not support host "
                          "ops (Custom/NumpyOp/torch bridge)")
+    # persistent jit cache: the fused train step (and bench.py's scanned
+    # loop over it) caches across processes once MXNET_COMPILE_CACHE_DIR
+    # is set; the bind below also applies the MXNET_COMPILE_OPT graph
+    # rewrites to the traced program (docs/how_to/compilation.md)
+    from .. import compile as _compile
+    from ..compile import jit_cache as _jc
+
+    _compile.ensure_jit_cache()
+    if donate and _jc.donation_unsafe():
+        # donated buffers + a persistently-cached executable corrupt the
+        # heap on the CPU backend (see jit_cache.donation_unsafe)
+        donate = False
     # one throwaway bind to reuse the Executor's traced program & plan;
     # release its device arrays — `run` is a bound method and would
     # otherwise pin a second full parameter set in HBM

@@ -91,7 +91,16 @@ class Predictor:
     def _bind(self, input_shapes, arg_params, aux_params):
         arg_names = self._symbol.list_arguments()
         aux_names = self._symbol.list_auxiliary_states()
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**input_shapes)
+        # seed shape inference with the checkpoint's own parameter
+        # shapes as well as the declared inputs: a graph whose weights
+        # feed through a transformation (w * scale into FullyConnected)
+        # has no inferable leaf shape from the data side alone — the
+        # loaded arrays are the authority
+        known = dict(input_shapes)
+        for name in arg_names:
+            if name not in known and name in arg_params:
+                known[name] = tuple(arg_params[name].shape)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**known)
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
             if name in input_shapes:
@@ -112,8 +121,20 @@ class Predictor:
         self._args = args
         self._arg_params = arg_params
         self._aux_params = aux_params
-        self._exe = self._symbol.bind(self._ctx, args, aux_states=aux,
-                                      grad_req="null")
+        # compile layer: predict-time weights never change after bind,
+        # so the fold pass may bake parameter-only subexpressions into
+        # constants (compile/fold.py frozen mode — the training
+        # executors never get this). The persistent jit cache turns the
+        # predict program's cold-start compile into a disk load — the
+        # serving latency-floor fix (docs/how_to/compilation.md).
+        from . import compile as _compile
+
+        _compile.ensure_jit_cache()
+        frozen = {n: args[n] for n in arg_names
+                  if n not in input_shapes and n in arg_params}
+        self._exe = self._symbol.bind(
+            self._ctx, args, aux_states=aux, grad_req="null",
+            _compile_opts={"frozen_params": frozen} if frozen else None)
         self._outputs = None
 
     @classmethod

@@ -392,19 +392,38 @@ class Symbol:
         )
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
-             group2ctx=None, shared_exec=None):
-        """ref: python/mxnet/symbol.py:716 / MXExecutorBindEX (c_api.h:973)."""
+             group2ctx=None, shared_exec=None, _compile_opts=None):
+        """ref: python/mxnet/symbol.py:716 / MXExecutorBindEX (c_api.h:973).
+        ``_compile_opts`` (internal) forwards options to the compile
+        layer's graph rewrite — Predictor passes its frozen parameters
+        here so constant folding may bake them (compile/fold.py)."""
         from .executor import Executor
 
         return Executor(
             self, ctx, args, args_grad=args_grad, grad_req=grad_req,
-            aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec
+            aux_states=aux_states, group2ctx=group2ctx, shared_exec=shared_exec,
+            _compile_opts=_compile_opts
         )
 
     def grad(self, wrt):
         """ref: python/mxnet/symbol.py:851 — kept for API parity; gradients
         are produced by Executor.backward (jax.vjp)."""
         raise MXNetError("Symbol.grad is superseded by Executor.backward in this framework")
+
+    # -- compilation -----------------------------------------------------------
+    def optimize(self, input_shapes=None, input_types=None,
+                 frozen_params=None):
+        """Run the compile-layer rewrite passes over this DAG and return
+        the rewritten Symbol (``self`` when nothing applies or the
+        layer is disabled). The result shares variable nodes with this
+        graph and contains executor-internal ops — bind it, don't
+        serialize it. See docs/how_to/compilation.md and
+        ``MXNET_COMPILE_OPT``."""
+        from . import compile as _compile
+
+        return _compile.optimize(self, input_shapes=input_shapes,
+                                 input_types=input_types,
+                                 frozen_params=frozen_params)
 
     # -- static analysis -------------------------------------------------------
     def lint(self, input_shapes=None, input_types=None):

@@ -22,12 +22,18 @@ def _small_mlp(num_classes=2):
 
 
 def test_feedforward_convergence():
+    # lr=0.5 with momentum=0.9 is an effective step of ~5 on this toy
+    # problem: seeds 2/4/7 overshoot into a half-learned basin (acc
+    # 0.756-0.88) on BOTH the scanned and per-batch loops — a
+    # hyperparameter seed-sensitivity, not a framework bug (diagnosed
+    # PR 6: identical per-seed accuracies with MXNET_SCAN_TRAIN=0/1).
+    # lr=0.1 converges >=0.93 on every seed 0..9; the gate is unchanged.
     mx.random.seed(7)
     np.random.seed(7)
     X, Y = _toy_data()
     train = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
     model = mx.FeedForward(
-        _small_mlp(), ctx=mx.cpu(), num_epoch=8, learning_rate=0.5, momentum=0.9,
+        _small_mlp(), ctx=mx.cpu(), num_epoch=8, learning_rate=0.1, momentum=0.9,
         initializer=mx.initializer.Xavier(),
     )
     model.fit(X=train)
