@@ -70,7 +70,8 @@ def main():
     model = os.environ.get("BENCH_MODEL", "")
     legs = [("resnet50", _run_resnet), ("transformer", _run_transformer),
             ("cifar", _run_cifar_ibn), ("packed_io", _run_packed_io),
-            ("cold_start", _run_cold_start)]
+            ("cold_start", _run_cold_start),
+            ("comm_bandwidth", _run_comm_bandwidth)]
     by_name = dict(legs)
     if model:
         if model not in by_name:
@@ -425,6 +426,57 @@ def _run_cold_start():
         }))
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _run_comm_bandwidth():
+    """Gradient-sync bandwidth, fp32 vs int8 wire (ISSUE 7): one
+    summary record folded from tools/bandwidth/measure.py's dist legs
+    (real worker processes + elastic coordinator, transfers paced to
+    the measure tool's default link model — the comms-bound regime
+    MXNET_KV_QUANTIZE targets). Headline value is the int8 effective
+    GB/s/rank; the fp32 leg, wire ratio and speedup ride along."""
+    import subprocess
+
+    size_mb = os.environ.get("BENCH_COMM_MB", "8")
+    workers = os.environ.get("BENCH_COMM_WORKERS", "4")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "bandwidth", "measure.py"),
+         "--transport", "dist", "--size-mb", size_mb,
+         "--workers", workers, "--rounds", "3", "--repeats", "3",
+         "--warmup", "1",
+         # cap each of measure.py's two dist legs well inside our own
+         # subprocess deadline (2 x 250s + overhead < 600s) — its
+         # default per-leg 600s budget would let a slow host blow the
+         # outer timeout with an uncaught TimeoutExpired
+         "--timeout", "250"],
+        capture_output=True, text=True, timeout=600)
+    recs = {}
+    for line in out.stdout.splitlines():
+        try:
+            r = json.loads(line)
+            recs[r.get("metric", "")] = r
+        except ValueError:
+            continue
+    fp32 = recs.get("comm_dist_allreduce_fp32")
+    int8 = recs.get("comm_dist_allreduce_int8")
+    if not fp32 or not int8:
+        raise RuntimeError("measure.py produced no dist records:\n%s%s"
+                           % (out.stdout[-1000:], out.stderr[-1000:]))
+    print(json.dumps({
+        "metric": "comm_bandwidth",
+        "value": int8["value"],
+        "unit": "GB/s/rank",
+        "fp32_gbps": fp32["value"],
+        "int8_gbps": int8["value"],
+        "wire_ratio_int8": int8["wire_ratio"],
+        "speedup_int8_vs_fp32": int8["speedup_vs_fp32"],
+        "workers": int(workers),
+        "size_mb": float(size_mb),
+        "link_mbps": int8.get("link_mbps"),
+        "transport": "elastic-tcp",
+    }))
 
 
 if __name__ == "__main__":

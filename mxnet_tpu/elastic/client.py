@@ -12,12 +12,22 @@ from __future__ import annotations
 import os
 import time
 
+from .. import quantize as _quant
 from ..base import MXNetError
 from ..resilience import faults as _faults
 from ..resilience.retry import RetryPolicy
 from . import protocol
 
 __all__ = ["ElasticClient", "parse_addr"]
+
+
+def _pull_wait():
+    """Server-side long-poll budget per pull/barrier_wait request
+    (seconds). 0 disables long-polling (immediate pending replies)."""
+    try:
+        return max(0.0, float(os.environ.get("MXNET_KV_PULL_WAIT", "0.25")))
+    except ValueError:
+        return 0.25
 
 
 def parse_addr(spec):
@@ -45,6 +55,10 @@ class ElasticClient:
         attempts = max(1, int(os.environ.get("MXNET_KV_RETRIES", "4")))
         self._policy = RetryPolicy(max_attempts=attempts, base_delay=0.05,
                                    max_delay=1.0, jitter=0.25)
+        # per-rank dither stream for the low-precision wire codec
+        # (MXNET_KV_QUANTIZE): deterministic per rank, so a chaos run's
+        # quantized bytes are bisectable like everything else
+        self._quant_rng = _quant.default_rng(self.rank)
 
     def call(self, op, check=True, **fields):
         """One RPC. Transport errors retry under the policy; an
@@ -65,6 +79,55 @@ class ElasticClient:
             raise MXNetError("elastic coordinator rejected %s: %s"
                              % (op, resp.get("message", "(no message)")))
         return resp
+
+    # -- gradient wire codec ---------------------------------------------------
+    # These helpers are THE wire-protocol assembly, shared by the
+    # elastic kvstore and tools/bandwidth/measure.py — a protocol
+    # change made here reaches both; never re-inline it at a call site.
+    def encode_grad(self, arr):
+        """``arr`` encoded per ``MXNET_KV_QUANTIZE`` with this rank's
+        deterministic dither stream, or ``None`` when it must stay
+        full precision (codec off, non-float, too small to win)."""
+        return _quant.encode_maybe(arr, rng=self._quant_rng)
+
+    def pull_fields(self, key, min_round, wait=None):
+        """Request fields for one pull poll. Advertises the configured
+        wire mode (the server answers gradient-like values encoded,
+        weights always raw — decode with ``mxnet_tpu.quantize.decode``
+        on any value) and the long-poll budget ``wait`` (default
+        ``MXNET_KV_PULL_WAIT``, 0.25s: the coordinator parks the
+        request until the round is ready instead of the caller
+        re-connecting every few milliseconds)."""
+        fields = {"key": key, "min_round": min_round}
+        m = _quant.mode()
+        if m is not None:
+            fields["wire"] = m
+        w = _pull_wait() if wait is None else wait
+        if w:
+            fields["wait"] = w
+        return fields
+
+    def push_grad(self, key, rnd, arr, check=True):
+        """Push one gradient contribution, encoding it per
+        ``MXNET_KV_QUANTIZE`` so the TCP bytes (not just the math)
+        shrink. Returns ``(resp, wire_payload_or_None)`` — the payload
+        is handed back so the caller can account wire/logical bytes and
+        the quantization-error gauge without re-encoding."""
+        payload = self.encode_grad(arr)
+        resp = self.call("push", check=check, key=key, round=rnd,
+                         value=payload if payload is not None else arr)
+        return resp, payload
+
+    def pull_weights(self, key, min_round, check=True, wait=None):
+        """One pull poll (see :meth:`pull_fields`)."""
+        return self.call("pull", check=check,
+                         **self.pull_fields(key, min_round, wait=wait))
+
+    def put_weight(self, key, rnd, arr, check=True):
+        """Land this rank's shard-update weight for ``rnd`` (weights
+        cross full precision — see quantize.py's scope discipline)."""
+        return self.call("put_weight", check=check, key=key, round=rnd,
+                         value=arr)
 
     # -- conveniences ----------------------------------------------------------
     def register(self):

@@ -18,7 +18,26 @@ coordinated membership + state restore gives (Abadi et al., 2016):
   dead rank's in-flight contributions and re-checks pending rounds
   against the reduced group, rescaling the sum by
   ``world / contributors`` so the update magnitude matches the
-  fault-free run (a *degraded step*).
+  fault-free run (a *degraded step*). Contributions may arrive as
+  low-precision wire payloads (``MXNET_KV_QUANTIZE``, mxnet_tpu/
+  quantize.py): they are stored encoded and dequant-summed at round
+  completion, so the guardian guard and the optimizer always ride the
+  dequantized values while the TCP bytes shrink ~4x.
+- **Sharded weight update** (``MXNET_KV_SHARD_UPDATE=1``, ZeRO-1 after
+  arXiv 2004.13336) — the optimizer runs on the *workers*, each owning
+  a byte-balanced shard of the keys: a completed round parks the merged
+  gradient; the owner's next pull is answered ``status="update"`` with
+  that gradient (quantized when the worker asked for a wire mode — a
+  merged gradient is still a gradient), the owner applies its local
+  optimizer and ships the new weight back via ``put_weight``, and
+  everyone else's pull blocks on the weight round, not the merge
+  round. Ownership is recomputed from the live set at every membership
+  epoch (an evicted owner's pending update is handed to the key's next
+  owner; its optimizer state for the reassigned keys restarts — the
+  documented ZeRO-1 elasticity cost), and rejoiners receive the shard
+  map with their register reply. Weights are NEVER quantized — only
+  gradients cross the wire low-precision
+  (docs/how_to/low_precision_comms.md).
 - **Barriers** — generation-counted arrival sets re-checked on every
   view change, so survivors rendezvous on the reduced group instead of
   deadlocking on a corpse.
@@ -46,9 +65,16 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..resilience import faults as _faults
+from .. import quantize as _quant
 from . import protocol
 
 __all__ = ["GroupView", "Aggregator", "ElasticCoordinator"]
+
+# server-side cap on a long-poll park (pull/barrier_wait "wait" field):
+# must sit comfortably below protocol.call's 30s socket timeout, or a
+# not-ready reply lands after the client's recv deadline and a healthy
+# coordinator reads as a transport failure
+_WAIT_CAP = 25.0
 
 
 class GroupView:
@@ -161,22 +187,48 @@ class Aggregator:
     def __init__(self, world):
         self.world = int(world)
         self.weights = {}        # key -> numpy array (authoritative copy)
-        self.done = {}           # key -> completed round count
-        self.pending = {}        # key -> {rank: numpy grad}
+        self.done = {}           # key -> completed (merged) round count
+        self.w_done = {}         # key -> rounds whose WEIGHT landed; lags
+        #                          done only in shard mode, between a
+        #                          merge and the owner's put_weight
+        self.pending = {}        # key -> {rank: numpy grad | wire payload}
+        self._acc = {}           # key -> [running sum, n folded, encoded]:
+        #                          contributions fold into the sum as they
+        #                          ARRIVE (overlapped with the other ranks'
+        #                          transfers) so round completion pays only
+        #                          the rescale, not an O(world) decode+sum
+        #                          on the critical path. Dropped on
+        #                          eviction/replacement/mixed-precision
+        #                          rounds — complete_ready rebuilds from
+        #                          pending (the slow exact path) whenever
+        #                          the fold count mismatches.
+        self.grads = {}          # key -> merged grad awaiting its owner
+        #                          (shard mode only)
         self.opt_blob = None     # pickled optimizer, as shipped
         self._updater = None
+        self.shard_update = False
         self.degraded_steps_total = 0
         self.updates_total = 0
         self.guard_skips_total = 0      # poisoned rounds nobody applied
         self.guard_nonfinite_total = 0  # of those, non-finite merges
 
     # -- optimizer -------------------------------------------------------------
-    def set_optimizer(self, blob):
+    def set_optimizer(self, blob, shard=False):
         """First optimizer wins: set_optimizer is SPMD (every worker
         ships the same pickle) and a rejoiner's re-ship must not reset
-        the server's accumulated optimizer state (momentum etc.)."""
+        the server's accumulated optimizer state (momentum etc.).
+
+        With ``shard`` (MXNET_KV_SHARD_UPDATE=1 on the workers) the
+        blob is kept only for rejoiners to adopt — the update runs
+        WORKER-side on each key's owner, so no server updater is built
+        and per-rank (and per-server) optimizer-state memory scales
+        ~1/world instead of full replicas."""
         if self.opt_blob is not None:
             return False
+        if shard:
+            self.shard_update = True
+            self.opt_blob = blob
+            return True
         from .. import optimizer as opt  # lazy: needs the jax stack
 
         self._updater = opt.get_updater(pickle.loads(blob))
@@ -190,10 +242,11 @@ class Aggregator:
         if key not in self.weights:
             self.weights[key] = _np.array(arr, copy=True)
             self.done[key] = 0
+            self.w_done[key] = 0
         return self.weights[key], self.done[key]
 
     # -- gradient rounds -------------------------------------------------------
-    def contribute(self, key, rank, rnd, arr):
+    def contribute(self, key, rank, rnd, arr, decoded=None):
         """Record rank's gradient for round ``rnd`` of ``key``.
         Returns 'ok' | 'stale' (round already completed — an idempotent
         retry after a lost ack, or a pre-eviction zombie catching up) |
@@ -212,13 +265,47 @@ class Aggregator:
                 "%d — resyncing the pusher (coordinator restarted from an "
                 "older snapshot?)", rank, key, rnd, cur)
             return "resync"
-        self.pending.setdefault(key, {})[int(rank)] = arr
+        pend = self.pending.setdefault(key, {})
+        if int(rank) in pend:
+            # idempotent retry replacing an in-flight contribution: the
+            # running sum can't subtract exactly in float — rebuild
+            self._acc.pop(key, None)
+            pend[int(rank)] = arr
+            return "ok"
+        pend[int(rank)] = arr
+        self._fold(key, arr, first=len(pend) == 1, decoded=decoded)
         return "ok"
+
+    def _fold(self, key, arr, first, decoded=None):
+        """Fold one arriving contribution into the round's running sum
+        (arrival order — exactly the order the completion loop would
+        sum). All-quantized rounds accumulate f32, full-precision
+        rounds f64; a MIXED round (some ranks with the codec off)
+        drops the accumulator and lets complete_ready rebuild with the
+        deterministic whole-set dtype choice."""
+        enc = _quant.is_encoded(arr)
+        if decoded is not None:
+            dec = decoded  # dequantized outside the lock by the caller
+        else:
+            dec = _quant.decode(arr, dtype=_np.float32) if enc else arr
+        if first:
+            self._acc[key] = [
+                dec.astype(_np.float32 if enc else _np.float64), 1, enc]
+            return
+        acc = self._acc.get(key)
+        if acc is None:
+            return  # already marked for rebuild
+        if enc != acc[2]:
+            self._acc.pop(key, None)
+            return
+        _np.add(acc[0], dec, out=acc[0])
+        acc[1] += 1
 
     def drop_rank(self, rank):
         """Drop an evicted rank's in-flight contributions."""
-        for contribs in self.pending.values():
-            contribs.pop(int(rank), None)
+        for key, contribs in self.pending.items():
+            if contribs.pop(int(rank), None) is not None:
+                self._acc.pop(key, None)  # rebuild without the corpse
 
     def complete_ready(self, live):
         """Finish every pending round whose contributors cover ``live``.
@@ -235,10 +322,34 @@ class Aggregator:
             contribs = self.pending[key]
             if not contribs or not live.issubset(contribs.keys()):
                 continue
-            total = None
-            for arr in contribs.values():
-                total = arr.astype(_np.float64) if total is None \
-                    else total + arr
+            if self.shard_update and \
+                    self.w_done.get(key, 0) < self.done.get(key, 0):
+                # the previous round's merged gradient is still parked
+                # for its owner: merging now would overwrite it and
+                # silently lose that round's weight update. Hold the
+                # round; put_weight re-checks and completes it.
+                continue
+            acc = self._acc.pop(key, None)
+            if acc is not None and acc[1] == len(contribs):
+                # fast path: every contribution already folded at
+                # arrival — completion pays only the rescale below
+                total = acc[0]
+            else:
+                # rebuild: eviction, replacement, or a mixed-precision
+                # round. f64 on the full-precision path (bit-stable
+                # degraded rescale, the chaos-bisect contract); an all-
+                # quantized round accumulates f32 — the codes carry ~8
+                # bits of mantissa, so f64 buys nothing
+                encoded = [_quant.is_encoded(v) for v in contribs.values()]
+                acc_t = _np.float32 if all(encoded) else _np.float64
+                total = None
+                for arr in contribs.values():
+                    arr = _quant.decode(arr, dtype=_np.float32) \
+                        if _quant.is_encoded(arr) else arr
+                    if total is None:
+                        total = arr.astype(acc_t)  # contribs stay pristine
+                    else:
+                        _np.add(total, arr, out=total)
             scale = self.world / float(len(contribs))
             if len(contribs) < self.world:
                 self.degraded_steps_total += 1
@@ -256,12 +367,26 @@ class Aggregator:
                 # riding the round protocol with zero extra RPCs.
                 del self.pending[key]
                 self.done[key] += 1
+                # a skipped round leaves the weights untouched, so its
+                # weight is "ready" immediately — also in shard mode,
+                # where no owner update will ever come for it
+                self.w_done[key] = self.done[key]
                 self.guard_skips_total += 1
                 finished.append(key)
                 logging.warning(
                     "elastic guardian: skipped poisoned round %d of key "
                     "%r for the whole group (%d skips total)",
                     self.done[key], key, self.guard_skips_total)
+                continue
+            if self.shard_update:
+                # park the merged gradient for the key's owner: the
+                # round is MERGED (done advances, so next-round pushes
+                # are accepted) but its weight is not ready until the
+                # owner's put_weight lands (w_done lags)
+                self.grads[key] = merged
+                del self.pending[key]
+                self.done[key] += 1
+                finished.append(key)
                 continue
             if self._updater is not None:
                 w = NDArray(self.weights[key], cpu(0))
@@ -274,9 +399,67 @@ class Aggregator:
             # on the next recheck) instead of wedging it forever
             del self.pending[key]
             self.done[key] += 1
+            self.w_done[key] = self.done[key]
             self.updates_total += 1
             finished.append(key)
         return finished
+
+    # -- sharded weight update (ZeRO-1 worker-side optimizer) ------------------
+    def take_update(self, key):
+        """(round, merged grad) awaiting the key's owner, or None."""
+        if key in self.grads and self.w_done.get(key, 0) < self.done[key]:
+            return self.done[key], self.grads[key]
+        return None
+
+    def put_weight(self, key, rnd, arr, guard=True):
+        """Land an owner's updated weight for round ``rnd``. 'stale'
+        when that round's weight already landed (a reassigned owner and
+        the original racing each other — first writer wins, the server
+        copy is the single authority). A non-finite weight under the
+        guardian is converted into a SKIP: old weight kept, round
+        marked ready, counted — defense in depth behind the worker's
+        own sentinel."""
+        if key not in self.weights:
+            raise MXNetError("elastic put_weight of uninitialized key %r"
+                             % (key,))
+        if rnd <= self.w_done.get(key, 0):
+            return "stale"
+        if guard and not _np.all(_np.isfinite(arr)):
+            from ..resilience import guardian as _grd
+
+            if _grd.enabled():
+                self.w_done[key] = rnd
+                self.grads.pop(key, None)
+                self.guard_skips_total += 1
+                self.guard_nonfinite_total += 1
+                logging.warning(
+                    "elastic guardian: rejected non-finite shard-update "
+                    "weight for key %r round %d (old weight kept)",
+                    key, rnd)
+                return "ok"
+        self.weights[key] = _np.array(arr, copy=True)
+        self.w_done[key] = rnd
+        self.grads.pop(key, None)
+        self.updates_total += 1
+        return "ok"
+
+    @staticmethod
+    def shard_map_for(weights, live):
+        """Greedy byte-balanced key->rank assignment over the live set
+        (largest keys first onto the least-loaded rank; deterministic
+        tie-breaks). Recomputed at every membership epoch — eviction
+        and rejoin reassign ownership."""
+        ranks = sorted(live)
+        if not ranks:
+            return {}
+        load = {r: 0 for r in ranks}
+        assign = {}
+        keys = sorted(weights, key=lambda k: (-weights[k].nbytes, repr(k)))
+        for k in keys:
+            r = min(ranks, key=lambda rr: (load[rr], rr))
+            assign[k] = r
+            load[r] += weights[k].nbytes
+        return assign
 
     def _guard_poisoned(self, merged):
         """Server half of the guardian sentinel, gated on the same
@@ -292,13 +475,18 @@ class Aggregator:
             return True
         max_norm = _grd._env_float("MXNET_GUARDIAN_GRADNORM_MAX", 0.0)
         if max_norm > 0.0:
+            # calibrated quantization-noise margin (1.0 with the codec
+            # off): dequantized merges carry bounded codec noise that
+            # must stay distinguishable from poisoning
+            max_norm *= _quant.guard_norm_scale()
             gsq = float(_np.sum(_np.square(merged.astype(_np.float64))))
             return gsq > max_norm * max_norm
         return False
 
     def snapshot_state(self):
         return {
-            "done": dict(self.done), "opt_blob": self.opt_blob,
+            "done": dict(self.done), "w_done": dict(self.w_done),
+            "shard_update": self.shard_update, "opt_blob": self.opt_blob,
             "degraded_steps_total": self.degraded_steps_total,
             "updates_total": self.updates_total,
             "guard_skips_total": self.guard_skips_total,
@@ -309,18 +497,32 @@ class Aggregator:
         self.weights = {k: _np.array(v, copy=True)
                         for k, v in weights.items()}
         self.done = {k: int(v) for k, v in st["done"].items()}
+        # pre-shard snapshots lack w_done: weights always tracked done
+        self.w_done = {k: int(v) for k, v in st.get(
+            "w_done", st["done"]).items()}
+        self.shard_update = bool(st.get("shard_update", False))
         # weights without a recorded round (snapshot raced an init):
         # treat as round 0
         for k in self.weights:
             self.done.setdefault(k, 0)
+            self.w_done.setdefault(k, 0)
+        if self.shard_update:
+            # a merged-but-unapplied round's gradient died with the
+            # coordinator: roll the merge counter back to the landed
+            # weight so the round replays (the same snapshot-cadence
+            # loss contract as pending contributions)
+            for k in self.done:
+                self.done[k] = min(self.done[k], self.w_done.get(k, 0))
         self.pending = {}  # in-flight contributions do not survive a crash
+        self._acc = {}
+        self.grads = {}
         self.degraded_steps_total = int(st["degraded_steps_total"])
         self.updates_total = int(st["updates_total"])
         # pre-guardian snapshots lack the guard counters
         self.guard_skips_total = int(st.get("guard_skips_total", 0))
         self.guard_nonfinite_total = int(st.get("guard_nonfinite_total", 0))
         if st["opt_blob"] is not None:
-            self.set_optimizer(st["opt_blob"])
+            self.set_optimizer(st["opt_blob"], shard=self.shard_update)
 
 
 def _key_to_name(k):
@@ -382,6 +584,13 @@ class ElasticCoordinator:
             snapshot_secs = float(
                 os.environ.get("MXNET_KV_SNAPSHOT_SECS", "0") or "0")
         self._lock = threading.Lock()
+        # long-poll rendezvous: pull/barrier_wait requests park on this
+        # condition (releasing the state lock) until a mutating op
+        # completes a round, lands a weight, or changes the view —
+        # instead of hammering the accept loop with a connection every
+        # few ms per waiting rank (a 4-rank poll storm costs more
+        # coordinator CPU than the gradient traffic itself)
+        self._cond = threading.Condition(self._lock)
         self.view = GroupView(world, evict_after)
         self.agg = Aggregator(world)
         self.barrier_gen = 0
@@ -390,6 +599,8 @@ class ElasticCoordinator:
         self.snapshot_prefix = snapshot_prefix
         self.snapshot_secs = float(snapshot_secs)
         self.snapshots_total = 0
+        self._shard_cache = None     # (epoch, nkeys, {key: owner rank})
+        self._wire_cache = {}        # key -> (round, mode, payload|raw)
         self._stop = threading.Event()
         if snapshot_prefix and os.path.exists(snapshot_prefix + ".meta"):
             self._restore_snapshot()
@@ -517,7 +728,8 @@ class ElasticCoordinator:
 
     def _recheck_locked(self):
         """After any view change or contribution: complete coverable
-        rounds and release coverable barriers."""
+        rounds, release coverable barriers, and wake every long-polling
+        request so it re-evaluates against the new state."""
         self.agg.complete_ready(self.view.live)
         if self._barrier_waiters and \
                 self.view.live.issubset(self._barrier_waiters.keys()):
@@ -525,6 +737,61 @@ class ElasticCoordinator:
             for r, c in self._barrier_waiters.items():
                 self._barrier_done[r] = max(self._barrier_done.get(r, 0), c)
             self._barrier_waiters.clear()
+        self._cond.notify_all()
+
+    def _shard_map_locked(self):
+        """Current key->owner map, cached per (membership epoch, key
+        count) — any view change or late init invalidates it."""
+        tag = (self.view.epoch, len(self.agg.weights))
+        if self._shard_cache is None or self._shard_cache[0] != tag:
+            self._shard_cache = (
+                tag, Aggregator.shard_map_for(self.agg.weights,
+                                              self.view.live))
+        return self._shard_cache[1]
+
+    @staticmethod
+    def _wire_rng_for(key, rnd):
+        """Dither stream for the server-side requant of (key, round):
+        derived, not shared — a shared mutable Generator would force
+        the encode to stay under the state lock (or corrupt under
+        concurrent draws), and two threads racing the same round must
+        produce the same bytes."""
+        import zlib
+
+        return _quant.default_rng(
+            (1 << 20) + (zlib.crc32(repr(key).encode()) + rnd) % (1 << 19))
+
+    def _wire_value_droplock(self, key, rnd, value, wire):
+        """Encode a GRADIENT-like response value in the requested wire
+        mode (pull of an all-reduce round, shard-update hand-out).
+        Cached per (key, round): every rank must receive the exact same
+        codes — per-rank re-dithering would fork the replicas.
+
+        Must be called with the state lock HELD; returns with it held,
+        but RELEASES it around the codec math — encoding a large key
+        is tens of ms of pure compute, and holding the lock for it
+        would stall every other RPC (heartbeats included) behind it.
+        The derived per-(key, round) dither stream makes a racing
+        duplicate encode byte-identical; first writer publishes."""
+        if not wire or wire not in _quant.MODES:
+            return value
+        if value.dtype != _np.float32 or \
+                value.nbytes < _quant.min_bytes():
+            return value
+        hit = self._wire_cache.get(key)
+        if hit is not None and hit[0] == rnd and hit[1] == wire:
+            return hit[2]
+        self._lock.release()
+        try:
+            payload = _quant.encode(
+                value, rng=self._wire_rng_for(key, rnd), mode_=wire)
+        finally:
+            self._lock.acquire()
+        hit = self._wire_cache.get(key)
+        if hit is not None and hit[0] == rnd and hit[1] == wire:
+            return hit[2]  # racing encoder published first (same bytes)
+        self._wire_cache[key] = (rnd, wire, payload)
+        return payload
 
     def _require_live(self, rank):
         """None when rank is a member; an 'evicted' reply otherwise —
@@ -538,6 +805,13 @@ class ElasticCoordinator:
         op = req.get("op")
         rank = int(req.get("rank", -1))
         now = time.monotonic()
+        decoded = None
+        if op == "push" and _quant.is_encoded(req.get("value")):
+            # dequantize OUTSIDE the state lock: pure function of the
+            # payload, so concurrent pushes decode in parallel handler
+            # threads (numpy releases the GIL) and only the cheap
+            # fold-into-the-running-sum serializes
+            decoded = _quant.decode(req["value"], dtype=_np.float32)
         with self._lock:
             if op == "register":
                 epoch, rejoined = self.view.register(rank, now)
@@ -553,6 +827,12 @@ class ElasticCoordinator:
                         "world": self.view.world,
                         "rounds": dict(self.agg.done),
                         "opt": self.agg.opt_blob,
+                        # NB: no shard fields here — ownership is
+                        # evaluated server-side per pull, and a
+                        # restarted worker re-ships set_optimizer
+                        # (whose reply carries the authoritative shard
+                        # mode); the map is visible via "stats" for
+                        # debugging
                         "counters": self._counters_locked()}
             if op == "beat":
                 self.view.beat(rank, now)
@@ -569,13 +849,15 @@ class ElasticCoordinator:
                 if err:
                     return err
                 value, rnd = self.agg.init_key(req["key"], req["value"])
+                self._cond.notify_all()  # wake pulls of a racing init
                 return {"status": "ok", "value": value, "round": rnd}
             if op == "push":
                 err = self._require_live(rank)
                 if err:
                     return err
                 st = self.agg.contribute(
-                    req["key"], rank, int(req["round"]), req["value"])
+                    req["key"], rank, int(req["round"]), req["value"],
+                    decoded=decoded)
                 if st == "ok":
                     self._recheck_locked()
                 # round lets a stale pusher (rejoiner whose retried push
@@ -584,24 +866,90 @@ class ElasticCoordinator:
                 return {"status": st,
                         "round": self.agg.done.get(req["key"], 0)}
             if op == "pull":
+                key, min_round = req["key"], int(req["min_round"])
+                wire = req.get("wire")
+                # long-poll budget: the request parks on the condition
+                # until the round is ready or the budget lapses ("wait"
+                # absent/0 preserves the immediate-reply semantics).
+                # Bounded waits: an evicted/restarted peer can never
+                # strand this handler thread past the budget.
+                deadline = now + min(float(req.get("wait", 0.0) or 0.0),
+                                     _WAIT_CAP)
+                while True:
+                    err = self._require_live(rank)
+                    if err:
+                        return err
+                    if key not in self.agg.done:
+                        return {"status": "error",
+                                "message": "key %r not initialized" % (key,)}
+                    if self.agg.shard_update:
+                        # ownership is evaluated HERE, against the
+                        # current epoch's map: after an owner eviction,
+                        # the next poll from the key's new owner
+                        # receives the parked merged gradient — no
+                        # client-side map refresh protocol needed for
+                        # correctness
+                        upd = self.agg.take_update(key)
+                        if upd is not None and \
+                                self._shard_map_locked().get(key) == rank:
+                            rnd, grad = upd
+                            return {"status": "update", "round": rnd,
+                                    "epoch": self.view.epoch,
+                                    "value": self._wire_value_droplock(
+                                        key, rnd, grad, wire)}
+                    ready = self.agg.w_done.get(key, self.agg.done[key])
+                    if ready >= min_round:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(
+                            min(remaining, 0.5)):
+                        if time.monotonic() >= deadline:
+                            return {"status": "pending", "round": ready,
+                                    "epoch": self.view.epoch}
+                value = self.agg.weights[key]
+                if self.agg._updater is None and \
+                        not self.agg.shard_update:
+                    # no optimizer: the stored value IS the merged
+                    # gradient (all-reduce mode) — requantizing it is
+                    # the second shot of a two-shot quantized
+                    # all-reduce. With an optimizer it is a WEIGHT and
+                    # stays full precision. The FIRST pull of a round
+                    # pins its wire representation for every later
+                    # puller (clients decode unconditionally): a mixed
+                    # group — some ranks with the codec off — must all
+                    # adopt identical bytes or the codec's bounded
+                    # error forks the quant-on replicas from the
+                    # quant-off ones.
+                    hit = self._wire_cache.get(key)
+                    if hit is not None and hit[0] == ready:
+                        value = hit[2]
+                    elif wire:
+                        value = self._wire_value_droplock(
+                            key, ready, value, wire)
+                    else:
+                        self._wire_cache[key] = (ready, None, value)
+                return {"status": "ok", "value": value,
+                        "round": ready,
+                        "epoch": self.view.epoch,
+                        "counters": self._counters_locked()}
+            if op == "put_weight":
                 err = self._require_live(rank)
                 if err:
                     return err
-                key, min_round = req["key"], int(req["min_round"])
-                if key not in self.agg.done:
-                    return {"status": "error",
-                            "message": "key %r not initialized" % (key,)}
-                if self.agg.done[key] < min_round:
-                    return {"status": "pending",
-                            "round": self.agg.done[key],
-                            "epoch": self.view.epoch}
-                return {"status": "ok", "value": self.agg.weights[key],
-                        "round": self.agg.done[key],
-                        "epoch": self.view.epoch,
-                        "counters": self._counters_locked()}
+                st = self.agg.put_weight(
+                    req["key"], int(req["round"]), req["value"])
+                # full recheck (which also wakes parked pulls): a round
+                # held back because THIS weight was in flight can
+                # complete now
+                self._recheck_locked()
+                return {"status": st,
+                        "round": self.agg.w_done.get(req["key"], 0),
+                        "epoch": self.view.epoch}
             if op == "set_optimizer":
-                installed = self.agg.set_optimizer(req["blob"])
-                return {"status": "ok", "installed": installed}
+                shard = bool(req.get("shard", False))
+                installed = self.agg.set_optimizer(req["blob"], shard=shard)
+                return {"status": "ok", "installed": installed,
+                        "shard": self.agg.shard_update}
             if op == "barrier":
                 err = self._require_live(rank)
                 if err:
@@ -619,8 +967,16 @@ class ElasticCoordinator:
                 return {"status": "ok", "gen": gen,
                         "done": self.barrier_gen > gen}
             if op == "barrier_wait":
+                gen = int(req["gen"])
+                deadline = now + min(float(req.get("wait", 0.0) or 0.0),
+                                     _WAIT_CAP)
+                while self.barrier_gen <= gen:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(min(remaining, 0.5))
                 return {"status": "ok",
-                        "done": self.barrier_gen > int(req["gen"]),
+                        "done": self.barrier_gen > gen,
                         "epoch": self.view.epoch}
             if op == "leave":
                 if self.view.leave(rank):
@@ -641,6 +997,10 @@ class ElasticCoordinator:
                         "evicted": sorted(self.view.evicted),
                         "world": self.view.world,
                         "rounds": dict(self.agg.done),
+                        "weight_rounds": dict(self.agg.w_done),
+                        "shard": self.agg.shard_update,
+                        "shard_map": (self._shard_map_locked()
+                                      if self.agg.shard_update else {}),
                         "barrier_gen": self.barrier_gen,
                         "counters": self._counters_locked()}
         if op == "snapshot":

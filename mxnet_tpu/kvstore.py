@@ -33,6 +33,7 @@ import warnings
 
 import numpy as _np
 
+from . import quantize as _quant
 from . import telemetry as _tel
 from .base import MXNetError
 from .context import cpu
@@ -79,6 +80,30 @@ def _ctypes_key(key):
 def _nd_bytes(arr):
     """Payload size of one NDArray/numpy value (telemetry byte counters)."""
     return int(_np.prod(arr.shape)) * _np.dtype(arr.dtype).itemsize
+
+
+def _pull_wait():
+    """Long-poll budget forwarded with elastic pull/barrier_wait
+    requests (lazy import: the elastic package loads only on the
+    elastic code paths)."""
+    from .elastic.client import _pull_wait as _pw
+
+    return _pw()
+
+
+def _shard_update_on():
+    """MXNET_KV_SHARD_UPDATE: cross-replica sharding of the weight
+    update (ZeRO-1, arXiv 2004.13336). Read live per use, like the
+    other MXNET_KV_* knobs."""
+    return os.environ.get("MXNET_KV_SHARD_UPDATE", "0").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
+# gradient dtypes that fuse into one f32 bucket: bf16/f16 keys are
+# upcast into the fused buffer, so low-precision gradients get a full-
+# precision accumulation (dequant-sum) instead of falling back to
+# per-key collectives in their storage dtype
+_FUSABLE_DTYPES = ("float32", "float16", "bfloat16")
 
 
 class KVStore:
@@ -225,11 +250,20 @@ class KVStore:
             _tel.counter("kvstore.push_bytes_total").inc(
                 sum(_nd_bytes(m) for m in merged_list))
         merged_list = self._global_reduce_many(merged_list)
+        shard = self._updater is not None and self._shard_active()
+        if shard:
+            self._ensure_shard_map()
         for k, merged in zip(order, merged_list):
             if self._updater is not None:
+                if shard and self._shard_map.get(k) != self.rank:
+                    # another rank owns this key's optimizer update;
+                    # its weight arrives in the all-gather below
+                    continue
                 self._updater(_key_int(k), merged, self._store[k])
             else:
                 self._store[k] = merged
+        if shard:
+            self._shard_allgather(order)
 
     def pull(self, key, out=None, priority=0):
         """ref: python/mxnet/kvstore.py:168."""
@@ -280,21 +314,7 @@ class KVStore:
 
         if jax.process_count() <= 1:
             return merged
-        import numpy as _np
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-        if not hasattr(self, "_proc_mesh"):
-            # one device per process carries that process's contribution
-            by_proc = {}
-            for d in jax.devices():
-                by_proc.setdefault(d.process_index, d)
-            devs = [by_proc[p] for p in sorted(by_proc)]
-            self._proc_mesh = Mesh(_np.array(devs), ("p",))
-            self._proc_sharding = NamedSharding(self._proc_mesh, P("p"))
-            self._local_mesh_dev = by_proc[jax.process_index()]
-            self._reduce_fn = jax.jit(
-                lambda x: x.sum(axis=0),
-                out_shardings=NamedSharding(self._proc_mesh, P()))
+        self._ensure_proc_mesh()
         # zero host round trips: place the local contribution on this
         # process's mesh device, assemble the global array shard-wise,
         # reduce on device, wrap the replicated local shard directly
@@ -309,6 +329,119 @@ class KVStore:
                              merged.context.jax_device)
         return NDArray(out, merged.context)
 
+    def _ensure_proc_mesh(self):
+        """One-device-per-process mesh shared by the fp32 reduce, the
+        quantized reduce and the shard-update weight all-gather."""
+        if hasattr(self, "_proc_mesh"):
+            return
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        # one device per process carries that process's contribution
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[p] for p in sorted(by_proc)]
+        self._proc_mesh = Mesh(_np.array(devs), ("p",))
+        self._proc_sharding = NamedSharding(self._proc_mesh, P("p"))
+        self._local_mesh_dev = by_proc[jax.process_index()]
+        self._reduce_fn = jax.jit(
+            lambda x: x.sum(axis=0),
+            out_shardings=NamedSharding(self._proc_mesh, P()))
+        self._qreduce_fns = {}
+
+    def _check_wire_agreement(self):
+        """One-time group-agreement check for ``MXNET_KV_QUANTIZE`` on
+        the XLA dist path. The elastic TCP transport tolerates mixed
+        codec settings (payloads are self-describing), but here the
+        wire mode selects the SPMD program: a rank entering the
+        quantized reduce while another runs the plain f32 sum executes
+        divergent computations over the shared process mesh and
+        deadlocks inside XLA. Same loud-failure contract as the shard
+        flag and the async transport decision: rank 0 publishes its
+        mode through the coordination KV, everyone else must match or
+        raise."""
+        if getattr(self, "_wire_checked", False):
+            return
+        self._wire_checked = True
+        client = _coordination_client()
+        if client is None:
+            return
+        import jax
+
+        global _WIRE_AGREE_COUNT
+        _WIRE_AGREE_COUNT += 1
+        mode = _quant.mode() or "off"
+        # the counter keeps the key fresh per store (creation order is
+        # SPMD-consistent, like the async transport decision)
+        key = "mxtpu_q/wire/%d" % _WIRE_AGREE_COUNT
+        if jax.process_index() == 0:
+            client.key_value_set(key, mode)
+            return
+        v = client.blocking_key_value_get(key, 60_000)
+        if v != mode:
+            raise MXNetError(
+                "MXNET_KV_QUANTIZE mismatch: rank %d has %r but rank 0 "
+                "published %r — the quantized and plain reduces are "
+                "different SPMD programs and would deadlock; export the "
+                "same value on every worker "
+                "(docs/how_to/low_precision_comms.md)"
+                % (jax.process_index(), mode, v))
+
+    def _global_reduce_quant(self, merged):
+        """Quantized cross-process reduce of one flat f32 bucket
+        (``MXNET_KV_QUANTIZE``): quantize the local contribution to
+        int8 codes + per-block f32 scales on device, assemble the
+        global (world, ...) code/scale arrays, and jit a dequant-sum
+        with replicated output — only the 1-byte codes and the ~0.4%%
+        scales cross DCN/ICI, and the accumulation runs in f32 on the
+        dequantized values (the guardian's contract). The fp8 wire
+        mode applies to the host/elastic transport; on the XLA
+        collective path it falls back to these int8 codes
+        (docs/how_to/low_precision_comms.md)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._ensure_proc_mesh()
+        blk = _quant.block_size()
+        flat = merged._data.ravel()
+        n = int(flat.shape[0])
+        pad = (-n) % blk
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        key = None
+        if _quant.rounding() == "stochastic":
+            if not hasattr(self, "_quant_base_key"):
+                seed = int(os.environ.get("MXNET_KV_QUANTIZE_SEED", "0"))
+                self._quant_base_key = jax.random.PRNGKey(
+                    seed * 1000003 + self.rank)
+                self._quant_step = 0
+            self._quant_step += 1
+            key = jax.random.fold_in(self._quant_base_key, self._quant_step)
+        q, scales = _quant.jnp_block_quant(flat, key=key, block=blk)
+        nproc = self._proc_mesh.shape["p"]
+        qloc = jax.device_put(q[None, ...], self._local_mesh_dev)
+        sloc = jax.device_put(scales[None, ...], self._local_mesh_dev)
+        qg = jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(q.shape), self._proc_sharding, [qloc])
+        sg = jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(scales.shape), self._proc_sharding, [sloc])
+        fn = self._qreduce_fns.get((int(q.shape[0]), blk))
+        if fn is None:
+            def _dequant_sum(codes, scl):
+                deq = codes.reshape(nproc, -1, blk).astype(jnp.float32) \
+                    * scl.reshape(nproc, -1, 1)
+                return deq.sum(axis=0).reshape(-1)
+
+            fn = jax.jit(_dequant_sum, out_shardings=NamedSharding(
+                self._proc_mesh, P()))
+            self._qreduce_fns[(int(q.shape[0]), blk)] = fn
+        summed = fn(qg, sg)
+        out = jax.device_put(summed.addressable_data(0)[:n],
+                             merged.context.jax_device)
+        return NDArray(out, merged.context)
+
     @property
     def _BUCKET_BYTES(self):
         """Gradient bucket size for fused dist collectives; mirrors the
@@ -318,40 +451,61 @@ class KVStore:
         return int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
                                   64 * 1024 * 1024))
 
-    def _global_reduce_many(self, merged_list):
+    def _global_reduce_many(self, merged_list, wire_ok=True):
         """Bucketed cross-process reduce: flatten+concat the push's keys
         into ~_BUCKET_BYTES device buffers, one all-reduce per bucket,
         split back. A ResNet push goes from hundreds of small DCN
         collectives to a handful of fused ones.
 
-        Only float32 keys sharing a context fuse (the gradient case);
-        anything else keeps the per-key path — fusing would reduce in
-        the wrong dtype (int32 sums past 2^24, f64 precision) or leave
-        pieces on another key's device."""
+        float32/float16/bfloat16 keys sharing a context fuse — the
+        fused buffer is ALWAYS f32 (and _BUCKET_BYTES is accounted in
+        the f32 upcast bytes it will actually allocate), so
+        mixed-precision pushes get a full-precision accumulation
+        (dequant-sum) and cast back to their storage dtype instead of
+        falling back to per-key collectives. Integer/f64
+        keys keep the per-key path — fusing would reduce in the wrong
+        dtype (int32 sums past 2^24, f64 precision).
+
+        With ``MXNET_KV_QUANTIZE`` set (and ``wire_ok``), each fused
+        bucket crosses the wire as int8 codes + per-block scales
+        through :meth:`_global_reduce_quant`. ``wire_ok=False`` marks
+        WEIGHT traffic (the shard-update all-gather), which is never
+        quantized."""
         if not self.type.startswith("dist"):
             return merged_list
         import jax
 
         if jax.process_count() <= 1:
             return merged_list
-        if len(merged_list) == 1:
-            return [self._global_reduce(merged_list[0])]
         import jax.numpy as jnp
+
+        self._check_wire_agreement()
+        quant_on = wire_ok and _quant.mode() is not None
+        if len(merged_list) == 1 and not quant_on and \
+                merged_list[0].dtype == _np.float32:
+            return [self._global_reduce(merged_list[0])]
 
         out = [None] * len(merged_list)
         groups = {}  # (device_key,) -> [idx]
         for idx, m in enumerate(merged_list):
-            if m.dtype == _np.float32:
+            if str(m.dtype) in _FUSABLE_DTYPES:
                 groups.setdefault(str(m.context), []).append(idx)
             else:
                 out[idx] = self._global_reduce(m)
 
         bucket_bytes = self._BUCKET_BYTES  # one env read per push, not per key
+        wire_bytes = logical_bytes = 0
         for idxs in groups.values():
             buckets = []
             cur, cur_bytes = [], 0
             for idx in idxs:
-                nbytes = int(_np.prod(merged_list[idx].shape)) * 4
+                m = merged_list[idx]
+                # capacity is the FUSED buffer's bytes: the bucket
+                # concatenates in f32 whatever the storage dtype, so a
+                # bf16 key costs 4 bytes/elem here — sizing by storage
+                # itemsize would let two half-precision buckets
+                # allocate 2x _BUCKET_BYTES on device
+                nbytes = int(_np.prod(m.shape)) * 4
                 if cur and cur_bytes + nbytes > bucket_bytes:
                     buckets.append(cur)
                     cur, cur_bytes = [], 0
@@ -360,28 +514,71 @@ class KVStore:
             if cur:
                 buckets.append(cur)
             for bucket in buckets:
-                if len(bucket) == 1:
-                    i = bucket[0]
-                    out[i] = self._global_reduce(merged_list[i])
-                    continue
                 parts = [merged_list[i] for i in bucket]
+                if len(bucket) == 1 and not quant_on and \
+                        parts[0].dtype == _np.float32:
+                    out[bucket[0]] = self._global_reduce(parts[0])
+                    continue
                 ctx = parts[0].context
-                flat = jnp.concatenate([p._data.ravel() for p in parts])
-                fused = self._global_reduce(NDArray(flat, ctx))
+                flat = jnp.concatenate(
+                    [p._data.astype(jnp.float32).ravel() for p in parts])
+                nd_flat = NDArray(flat, ctx)
+                if quant_on:
+                    fused = self._global_reduce_quant(nd_flat)
+                    if _tel.ENABLED and wire_ok:
+                        n = int(flat.shape[0])
+                        blk = _quant.block_size()
+                        npad = n + ((-n) % blk)
+                        logical_bytes += n * 4
+                        wire_bytes += npad + 4 * (npad // blk)
+                else:
+                    fused = self._global_reduce(nd_flat)
+                    if _tel.ENABLED and wire_ok:
+                        logical_bytes += int(flat.shape[0]) * 4
+                        wire_bytes += int(flat.shape[0]) * 4
                 off = 0
                 for i, p in zip(bucket, parts):
                     n = int(_np.prod(p.shape))
                     piece = fused._data[off:off + n].reshape(p.shape)
+                    if p.dtype != _np.float32:
+                        piece = piece.astype(p._data.dtype)
                     out[i] = NDArray(piece, p.context)
                     off += n
+        if _tel.ENABLED and logical_bytes:
+            self._account_wire(wire_bytes, logical_bytes)
         return out
+
+    def _account_wire(self, wire, logical, quant_err=None):
+        """Fold one transfer into the compression accounting: the
+        ``kvstore.wire_bytes_total`` / ``kvstore.logical_bytes_total``
+        counters, the running compression-ratio gauge, and (host paths
+        only, where it is already computed) the max per-block relative
+        quantization error gauge."""
+        self._wire_total = getattr(self, "_wire_total", 0) + int(wire)
+        self._logical_total = getattr(self, "_logical_total", 0) + \
+            int(logical)
+        _tel.counter("kvstore.wire_bytes_total").inc(int(wire))
+        _tel.counter("kvstore.logical_bytes_total").inc(int(logical))
+        _tel.gauge("kvstore.compression_ratio").set(
+            self._wire_total / float(self._logical_total))
+        if quant_err is not None:
+            self._quant_err_max = max(
+                getattr(self, "_quant_err_max", 0.0), float(quant_err))
+            _tel.gauge("kvstore.quant_error").set(self._quant_err_max)
 
     # -- optimizer/updater -----------------------------------------------------
     def set_optimizer(self, optimizer):
         """ref: python/mxnet/kvstore.py:231 — on dist the reference pickles
         the optimizer to the server process; here the updater runs in-process
         over the reduced gradient (round-trip through pickle kept so custom
-        optimizers fail early if unpicklable, like the reference)."""
+        optimizers fail early if unpicklable, like the reference).
+
+        With ``MXNET_KV_SHARD_UPDATE=1`` on a multi-process dist store,
+        ``push`` runs this updater only for the keys this rank OWNS
+        (greedy byte-balanced partition) and all-gathers the updated
+        weights — optimizer state (momenta etc.) is created lazily per
+        updated key, so per-rank state memory scales ~1/world (ZeRO-1,
+        docs/how_to/low_precision_comms.md)."""
         from . import optimizer as opt
 
         pickle.loads(pickle.dumps(optimizer))
@@ -389,10 +586,65 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     def _set_updater(self, updater):
-        """ref: python/mxnet/kvstore.py:255 _set_updater."""
+        """ref: python/mxnet/kvstore.py:255 _set_updater. A custom
+        updater participates in MXNET_KV_SHARD_UPDATE the same way the
+        optimizer-built one does: push consults key ownership before
+        calling it."""
         self._updater = updater
 
     set_updater = _set_updater
+
+    # -- cross-replica sharded weight update (ZeRO-1) --------------------------
+    def _shard_active(self):
+        """Shard the optimizer update across ranks only when there is
+        more than one process to shard across."""
+        if not _shard_update_on() or not self.type.startswith("dist"):
+            return False
+        import jax
+
+        return jax.process_count() > 1
+
+    def _ensure_shard_map(self):
+        """key->owner-rank partition over the current key set, greedy
+        by bytes (largest first onto the least-loaded rank) — the same
+        deterministic assignment on every rank, recomputed when keys
+        are added."""
+        keys = tuple(sorted(self._store, key=str))
+        if getattr(self, "_shard_keys", None) == keys:
+            return
+        from .elastic.server import Aggregator  # jax-free, reused greedy
+
+        self._shard_map = Aggregator.shard_map_for(
+            {k: self._store[k]._data for k in keys},
+            set(range(self.num_workers)))
+        self._shard_keys = keys
+
+    def _shard_allgather(self, keys):
+        """Broadcast each key's updated weight from its owner: every
+        rank contributes its weight for owned keys and zeros elsewhere,
+        and the existing fused reduce (each key has exactly one nonzero
+        contributor, so sum == owner's value, exactly in f32) assembles
+        the full set — the all-gather half of the ZeRO-1 exchange.
+        Weights are never quantized (``wire_ok=False``)."""
+        import jax.numpy as jnp
+
+        vals = []
+        for k in keys:
+            w = self._store[k]
+            if self._shard_map.get(k) == self.rank:
+                vals.append(w)
+            else:
+                vals.append(NDArray(jnp.zeros_like(w._data), w.context))
+        gathered = self._global_reduce_many(vals, wire_ok=False)
+        for k, g in zip(keys, gathered):
+            self._store[k] = g
+        if _tel.ENABLED:
+            from . import optimizer as opt
+
+            _tel.counter("kvstore.shard_weight_bytes_total").inc(
+                sum(_nd_bytes(self._store[k]) for k in keys))
+            _tel.gauge("kvstore.optimizer_state_bytes").set(
+                opt.state_nbytes(self._updater))
 
     # -- cluster control -------------------------------------------------------
     def barrier(self):
@@ -675,6 +927,7 @@ def create(name="local"):
 # dist_async creates are SPMD, so every rank's Nth create shares one
 # decision key — the counter keys successive creates apart
 _ASYNC_DECIDE_COUNT = 0
+_WIRE_AGREE_COUNT = 0
 
 
 def _async_transport_ok(client):
@@ -1171,6 +1424,7 @@ class _ElasticDistKVStore(KVStore):
         self._epoch = 0
         self._last_counters = {}
         self._left = False
+        self._shard_updater = None   # local optimizer (shard-update mode)
         super().__init__(kv_type)
         resp = self._client.register()
         self._absorb_view(resp)
@@ -1260,7 +1514,11 @@ class _ElasticDistKVStore(KVStore):
         for k in list(self._store):
             got = self._client.call("pull", key=k, min_round=0)
             if got.get("status") == "ok":
-                self._store[k] = NDArray(got["value"], self._store[k].context)
+                # all-reduce mode may serve the round's pinned wire
+                # payload even to a codec-off puller (replica
+                # consistency) — decode is a no-op on raw values
+                self._store[k] = NDArray(_quant.decode(got["value"]),
+                                         self._store[k].context)
         warnings.warn(
             "elastic kvstore: rank %d rejoined the group at epoch %d"
             % (self._rank, self._epoch), stacklevel=3)
@@ -1353,8 +1611,14 @@ class _ElasticDistKVStore(KVStore):
             merged = self._reduce(grouped[k], self._store[k])
             arr = merged.asnumpy()
             push_bytes += arr.nbytes
+            # low-precision wire (MXNET_KV_QUANTIZE): the gradient
+            # crosses the coordinator TCP socket as int8/fp8 codes +
+            # per-block scales, encoded ONCE (the resync replay below
+            # re-ships identical bytes — deterministic under chaos)
+            payload = self._client.encode_grad(arr)
+            value = arr if payload is None else payload
             rnd = self._rounds.get(k, 0) + 1
-            resp = self._op("push", key=k, round=rnd, value=arr)
+            resp = self._op("push", key=k, round=rnd, value=value)
             status = resp.get("status")
             if status == "stale":
                 # round already completed (idempotent retry, or a rejoin
@@ -1367,8 +1631,24 @@ class _ElasticDistKVStore(KVStore):
                 # step's gradient there (the gap is snapshot-cadence
                 # data loss, accepted by the restart-resume contract)
                 rnd = int(resp.get("round", 0)) + 1
-                resp = self._op("push", key=k, round=rnd, value=arr)
+                resp = self._op("push", key=k, round=rnd, value=value)
             self._rounds[k] = rnd
+            if _tel.ENABLED:
+                if payload is None:
+                    self._account_wire(arr.nbytes, arr.nbytes)
+                else:
+                    # the quant-error gauge needs a full decode of the
+                    # payload (~the cost of the encode itself), so it
+                    # samples 1-in-32 pushes per store instead of
+                    # doubling the codec bill on every key — the gauge
+                    # tracks the max over the run either way
+                    self._quant_err_tick = getattr(
+                        self, "_quant_err_tick", -1) + 1
+                    err = (_quant.max_block_rel_error(arr, payload)
+                           if self._quant_err_tick % 32 == 0 else None)
+                    self._account_wire(
+                        _quant.wire_nbytes(payload), arr.nbytes,
+                        quant_err=err)
         if _tel.ENABLED:
             _tel.counter("kvstore.push_total").inc()
             _tel.counter("kvstore.push_bytes_total").inc(push_bytes)
@@ -1388,9 +1668,19 @@ class _ElasticDistKVStore(KVStore):
                 # round whose only missing contribution was OURS (dropped
                 # at eviction) — a floor that can never be satisfied
                 min_round = self._rounds.get(k, 0)
-                resp = self._op("pull", key=k, min_round=min_round)
-                if resp.get("status") == "ok":
+                resp = self._op(
+                    "pull", **self._client.pull_fields(k, min_round))
+                status = resp.get("status")
+                if status == "ok":
                     break
+                if status == "update":
+                    # shard-update mode: this rank owns the key and the
+                    # merged gradient is waiting — run the optimizer
+                    # locally, land the weight, then re-poll (the poll
+                    # re-adopts the server copy even if a reassigned
+                    # owner's put raced ours, so replicas never fork)
+                    self._shard_apply_update(k, resp)
+                    continue
                 if time.monotonic() > deadline:
                     raise MXNetError(
                         "elastic pull of key %s round %d timed out on rank "
@@ -1401,12 +1691,21 @@ class _ElasticDistKVStore(KVStore):
                 time.sleep(0.005)
             # rejoin may have advanced our floor past min_round
             self._rounds[k] = max(self._rounds.get(k, 0), int(resp["round"]))
-            nd = NDArray(resp["value"], self._store[k].context)
+            value = resp["value"]
+            if _quant.is_encoded(value):
+                # all-reduce mode (no optimizer): the merged gradient
+                # came back requantized — the second shot of the
+                # two-shot quantized all-reduce
+                if _tel.ENABLED:
+                    self._account_wire(_quant.wire_nbytes(value),
+                                       _quant.logical_nbytes(value))
+                value = _quant.decode(value)
+            nd = NDArray(value, self._store[k].context)
             self._store[k] = nd
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 nd.copyto(t)
-            pulled_bytes += resp["value"].nbytes * len(targets)
+            pulled_bytes += value.nbytes * len(targets)
         if _tel.ENABLED:
             _tel.counter("kvstore.pull_total").inc()
             _tel.counter("kvstore.pull_bytes_total").inc(pulled_bytes)
@@ -1427,15 +1726,76 @@ class _ElasticDistKVStore(KVStore):
         verdict never suppresses a push here."""
         return False
 
+    def _shard_apply_update(self, k, resp):
+        """Owner half of the sharded weight update: decode the merged
+        gradient (the guardian-relevant dequantized value), apply the
+        LOCAL optimizer to this rank's weight copy, and land the
+        result via put_weight. A 'stale' reply (a reassigned owner's
+        put beat ours after an eviction race) is fine — the caller
+        re-polls and adopts the server's authoritative copy."""
+        if self._shard_updater is None:
+            raise MXNetError(
+                "elastic kvstore: coordinator handed rank %d a shard "
+                "update for key %r but no optimizer was set — call "
+                "set_optimizer with MXNET_KV_SHARD_UPDATE=1 on every "
+                "worker" % (self._rank, k))
+        rnd = int(resp["round"])
+        value = resp["value"]
+        if _quant.is_encoded(value):
+            if _tel.ENABLED:
+                self._account_wire(_quant.wire_nbytes(value),
+                                   _quant.logical_nbytes(value))
+            value = _quant.decode(value)
+        w = self._store[k]
+        grad = NDArray(_np.asarray(value, dtype=_np.float32), w.context)
+        self._shard_updater(_key_int(k), grad, w)
+        arr = w.asnumpy()
+        self._op("put_weight", key=k, round=rnd, value=arr)
+        if _tel.ENABLED:
+            from . import optimizer as opt
+
+            _tel.counter("kvstore.shard_updates_total").inc()
+            _tel.counter("kvstore.shard_weight_bytes_total").inc(arr.nbytes)
+            _tel.gauge("kvstore.optimizer_state_bytes").set(
+                opt.state_nbytes(self._shard_updater))
+
     # -- control plane ---------------------------------------------------------
     def set_optimizer(self, optimizer):
         """Ship the pickled optimizer to the coordinator (the reference's
         kController command) — the server runs the updater, which is
-        what lets a rejoiner pull optimizer state it never had."""
+        what lets a rejoiner pull optimizer state it never had.
+
+        With ``MXNET_KV_SHARD_UPDATE=1`` the blob is shipped with the
+        shard flag: the coordinator only keeps it for rejoiners, the
+        update itself runs on each key's owner through a LOCAL updater
+        installed here — per-rank optimizer state scales ~1/world
+        because state is created lazily only for owned keys. The flag
+        must agree across the group (the coordinator's installed mode
+        is authoritative; a mismatch raises instead of half the group
+        waiting on server updates that never come)."""
         blob = pickle.dumps(optimizer)
         pickle.loads(blob)  # fail early if unpicklable, like the reference
         self._optimizer = optimizer
-        self._op("set_optimizer", blob=blob)
+        shard = _shard_update_on()
+        resp = self._op("set_optimizer", blob=blob, shard=shard)
+        server_shard = bool(resp.get("shard", False))
+        if server_shard != shard:
+            raise MXNetError(
+                "elastic kvstore: MXNET_KV_SHARD_UPDATE mismatch — rank "
+                "%d has it %s but the coordinator group installed %s; "
+                "export the same value on every worker "
+                "(docs/how_to/low_precision_comms.md)"
+                % (self._rank, "on" if shard else "off",
+                   "sharded" if server_shard else "server-side"))
+        if shard:
+            from . import optimizer as opt
+
+            # inject_faults=False: the grad.nan/loss.spike chaos points
+            # already fire on the PUSH path for stores with no local
+            # _updater (model.py) — drawing again inside the owner's
+            # updater would double-consume the seeded pattern
+            self._shard_updater = opt.get_updater(
+                optimizer, inject_faults=False)
 
     def barrier(self):
         """Epoch-aware rendezvous on the *live* group: arrivals are a
@@ -1459,8 +1819,17 @@ class _ElasticDistKVStore(KVStore):
                         "MXNET_KV_BARRIER_TIMEOUT"
                         % (self._barrier_count, timeout, self._rank,
                            self._epoch, self.dead_ranks()))
-                time.sleep(0.005)
-                wait = self._client.call("barrier_wait", gen=gen)
+                # long-poll: the server parks this request on its
+                # condition until the generation advances (or its wait
+                # budget lapses), so a barrier costs one connection per
+                # outcome instead of a 5ms poll storm. With the budget
+                # disabled (MXNET_KV_PULL_WAIT=0) fall back to paced
+                # client-side polling.
+                budget = _pull_wait()
+                if not budget:
+                    time.sleep(0.005)
+                wait = self._client.call("barrier_wait", gen=gen,
+                                         wait=budget)
                 done = bool(wait.get("done"))
         finally:
             # observed on EVERY outcome: the pathological waits are the

@@ -22,7 +22,7 @@ from .ndarray import NDArray, zeros
 
 __all__ = [
     "Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
-    "AdaDelta", "Test", "create", "get_updater", "register",
+    "AdaDelta", "Test", "create", "get_updater", "register", "state_nbytes",
 ]
 
 
@@ -365,8 +365,15 @@ class Test(Optimizer):
 create = Optimizer.create_optimizer
 
 
-def get_updater(optimizer):
+def get_updater(optimizer, inject_faults=True):
     """Closure with per-index state dict (ref: optimizer.py:803).
+
+    State is created LAZILY on the first update of each index — which
+    is what makes cross-replica weight-update sharding
+    (``MXNET_KV_SHARD_UPDATE=1``, ZeRO-1) a memory win for free: a rank
+    that only ever updates its owned shard of the keys materializes
+    optimizer state for that shard alone, ~1/world of a full replica
+    (:func:`state_nbytes` measures it for the journal gauge).
 
     Guardian integration (docs/how_to/guardrails.md): with
     ``MXNET_GUARDIAN=1`` every update runs through the on-device
@@ -377,7 +384,11 @@ def get_updater(optimizer):
     loop can read the per-step verdict with its existing metric fence.
     The ``grad.nan``/``loss.spike`` chaos points live here too,
     *outside* the guardian switch (the negative-control chaos leg
-    poisons an unguarded run through the same path)."""
+    poisons an unguarded run through the same path);
+    ``inject_faults=False`` opts a SECONDARY updater out of the draw —
+    the elastic shard-update owner's updater runs on gradients that
+    already crossed the push path's injection, and drawing again would
+    double-consume the seeded pattern."""
     from .resilience import guardian as _guardian
 
     states = {}
@@ -386,7 +397,8 @@ def get_updater(optimizer):
     def updater(index, grad, weight):
         if index not in states:
             states[index] = optimizer.create_state(index, weight)
-        grad = _guardian.corrupt_grad(grad)  # no-op unless a rule is armed
+        if inject_faults:
+            grad = _guardian.corrupt_grad(grad)  # no-op unless armed
         if sentinel is None:
             optimizer.update(index, weight, grad, states[index])
         else:
@@ -396,3 +408,24 @@ def get_updater(optimizer):
     updater.sentinel = sentinel
     updater.states = states  # guardian snapshot/rollback reads these
     return updater
+
+
+def state_nbytes(updater):
+    """Total bytes of optimizer state an updater has materialized —
+    the ``kvstore.optimizer_state_bytes`` journal gauge. Walks the
+    lazy per-index state dict; tuple/list states (Adam, RMSProp)
+    count every slot."""
+    def _leaf_bytes(st):
+        if st is None:
+            return 0
+        if isinstance(st, (tuple, list)):
+            return sum(_leaf_bytes(s) for s in st)
+        size = 1
+        for d in st.shape:
+            size *= d
+        return size * _np.dtype(st.dtype).itemsize
+
+    total = 0
+    for st in getattr(updater, "states", {}).values():
+        total += _leaf_bytes(st)
+    return total

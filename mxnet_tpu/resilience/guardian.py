@@ -118,6 +118,15 @@ class GuardianConfig:
         self.gradnorm_max = _env_float("MXNET_GUARDIAN_GRADNORM_MAX", 0.0)
         self.warmup = max(1, int(_env_float("MXNET_GUARDIAN_WARMUP", 10)))
         self.ff_batches = max(0, int(_env_float("MXNET_GUARDIAN_FF_BATCHES", 0)))
+        # calibrated quantization-noise floor (MXNET_KV_QUANTIZE,
+        # docs/how_to/low_precision_comms.md): with low-precision
+        # comms on, gradient norms carry bounded codec noise; the
+        # detector must never read that noise as poisoning, however
+        # aggressive the explosion factor is configured. 1.0 (inert)
+        # when quantization is off.
+        from .. import quantize as _quantize
+
+        self.quant_guard_scale = _quantize.guard_norm_scale()
 
 
 class AnomalyDetector:
@@ -161,9 +170,16 @@ class AnomalyDetector:
                 return POISONED
         verdict = GOOD
         if self.armed:
-            if (grad_norm is not None and self._gnorm_mean > 0.0
-                    and grad_norm > self.cfg.gradnorm_factor * self._gnorm_mean):
-                return POISONED
+            if grad_norm is not None and self._gnorm_mean > 0.0:
+                # calibrated quantization-noise margin, multiplicative
+                # like the absolute bound (exactly 1.0 with the codec
+                # off): the explosion threshold widens by the worst
+                # codec noise, so a gradient sitting at the edge never
+                # tips POISONED from quantization alone
+                limit = (self.cfg.gradnorm_factor * self._gnorm_mean
+                         * getattr(self.cfg, "quant_guard_scale", 1.0))
+                if grad_norm > limit:
+                    return POISONED
             if loss is not None:
                 # variance floor at 5% of the mean: a near-constant loss
                 # baseline has ~zero EMA variance, and without the floor
@@ -346,7 +362,14 @@ def updater_sentinel():
     guardian is disabled (the off-by-default zero-overhead contract)."""
     if not enabled():
         return None
-    return UpdaterSentinel(max_norm=_env_float("MXNET_GUARDIAN_GRADNORM_MAX", 0))
+    # the absolute bound inflates by the calibrated quantization-noise
+    # margin (1.0 when MXNET_KV_QUANTIZE is off): a gradient sitting at
+    # the bound must not trip the sentinel from codec noise alone
+    from .. import quantize as _quantize
+
+    return UpdaterSentinel(
+        max_norm=_env_float("MXNET_GUARDIAN_GRADNORM_MAX", 0)
+        * _quantize.guard_norm_scale())
 
 
 # -- chaos injection (independent of the guardian switch) ----------------------

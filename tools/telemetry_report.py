@@ -90,6 +90,28 @@ def throughput_timeline(records):
     return out
 
 
+def comm_compression(records):
+    """(wire_bytes, logical_bytes) from the final snapshot's kvstore
+    byte counters, or None when the run had no accounted gradient
+    traffic. wire < logical means the low-precision codec
+    (MXNET_KV_QUANTIZE) was shrinking the TCP bytes."""
+    final = final_metrics(records)
+    if final is None:
+        return None
+    counters = final.get("counters", {})
+    logical = counters.get("kvstore.logical_bytes_total", 0)
+    if not logical:
+        return None
+    return counters.get("kvstore.wire_bytes_total", 0), logical
+
+
+def _human_bytes(n):
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024.0 or unit == "GB":
+            return "%.1f%s" % (n, unit) if unit != "B" else "%dB" % n
+        n /= 1024.0
+
+
 def _bar(v, vmax):
     if vmax <= 0:
         return ""
@@ -112,6 +134,17 @@ def render_report(records, top=10):
             lines.append("  t+%8.1fs %12.2f %s" % (t - t0, v, _bar(v, vmax)))
     else:
         lines.append("  (no throughput samples in journal)")
+
+    comm = comm_compression(records)
+    if comm is not None:
+        lines.append("")
+        lines.append("-- gradient wire compression (MXNET_KV_QUANTIZE) --")
+        wire, logical = comm
+        lines.append(
+            "  wire %s / logical %s = %.3fx on the wire (%.1fx "
+            "compression)"
+            % (_human_bytes(wire), _human_bytes(logical),
+               wire / logical, logical / wire if wire else float("inf")))
 
     lines.append("")
     lines.append("-- top spans by total time --")
