@@ -45,12 +45,21 @@ def _merge_block(o_acc, l_acc, m_acc, o, l, m):
             new_m)
 
 
-def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+def ring_attention(q, k, v, axis_name, causal=True, scale=None, q_offset=0):
     """Attention with K/V ring-rotated across `axis_name`.
 
     Shapes (inside shard_map, per-shard): q,k,v [batch, heads, t_local, d].
     Global sequence = ring_size * t_local, laid out contiguously by rank.
     Returns [batch, heads, t_local, d].
+
+    ``q_offset`` places the global query block at that absolute position
+    within the key sequence: query i (global) sits at key position
+    ``q_offset + i`` for causal masking. This is the chunked-prefill
+    geometry (serving/model.py cp_prefill_kv): queries are the last
+    ``ring * t_local_q`` tokens of a longer key sequence, so a serving
+    prefill chunk attends to the whole accumulated prefix without
+    re-running it. ``q_offset=0`` is the training case (q and k cover
+    the same sequence).
     """
     import jax
     import jax.numpy as jnp
@@ -80,8 +89,9 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
         o_acc, l_acc, m_acc, k_cur, v_cur = carry
         kv_rank = (my_rank - step) % ring
         if causal:
-            # absolute positions: q at my_rank*tq + iq ; k at kv_rank*tk + ik
-            iq = jnp.arange(tq)[:, None] + my_rank * tq
+            # absolute positions: q at q_offset + my_rank*tq + iq ;
+            # k at kv_rank*tk + ik
+            iq = jnp.arange(tq)[:, None] + my_rank * tq + q_offset
             ik = jnp.arange(tk)[None, :] + kv_rank * tk
             mask = ik <= iq
         else:
@@ -102,9 +112,11 @@ def ring_attention(q, k, v, axis_name, causal=True, scale=None):
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, seq_axis="seq", causal=True):
+def make_ring_attention(mesh, seq_axis="seq", causal=True, q_offset=0):
     """Wrap ring_attention in shard_map over `seq_axis` of `mesh`.
-    Takes/returns global arrays [B, H, T, D] with T sharded on seq_axis."""
+    Takes/returns global arrays [B, H, T, D] with T sharded on seq_axis.
+    Q and K/V lengths may differ; ``q_offset`` is the queries' absolute
+    start position in the key sequence (chunked-prefill reuse)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -119,6 +131,7 @@ def make_ring_attention(mesh, seq_axis="seq", causal=True):
         shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )
     def f(q, k, v):
-        return ring_attention(q, k, v, seq_axis, causal=causal)
+        return ring_attention(q, k, v, seq_axis, causal=causal,
+                              q_offset=q_offset)
 
     return f

@@ -24,12 +24,20 @@ from __future__ import annotations
 import functools
 
 
-def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
+def ulysses_attention(q, k, v, axis_name, causal=True, scale=None,
+                      q_offset=0):
     """All-to-all sequence-parallel attention.
 
     Per-shard shapes (inside shard_map): q,k,v [batch, heads, t_local, d]
     with the global sequence laid out contiguously by rank along
     `axis_name`. Returns [batch, heads, t_local, d].
+
+    Q and K/V lengths may differ; ``q_offset`` is the queries' absolute
+    start position in the key sequence for causal masking — the
+    chunked-prefill geometry (serving/model.py cp_prefill_kv), same
+    contract as ring_attention. A nonzero offset (or rectangular q/k)
+    takes the blockwise fallback; the square Pallas-kernel path is the
+    training case.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -38,6 +46,7 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
 
     n = axis_size(axis_name)
     b, h, t_local, d = q.shape
+    tk_local = k.shape[2]
     if h % n != 0:
         raise ValueError(
             "ulysses: heads (%d) must divide by mesh axis size (%d)" % (h, n))
@@ -68,15 +77,18 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     from ..ops import pallas_kernels as pk
 
     t_global = ql.shape[2]
-    if pk.flash_kernel_usable(t_global, t_global, d, vl.shape[-1]):
+    tk_global = kl.shape[2]
+    if (q_offset == 0 and t_global == tk_global
+            and pk.flash_kernel_usable(t_global, tk_global, d,
+                                       vl.shape[-1])):
         out = pk.flash_attention(ql, kl, vl, causal=causal, scale=scale)
         return heads_to_seq(out.astype(q.dtype))
     # fallback: blockwise over key chunks with the shared flash-style
     # LSE accumulation — peak memory O(T_global*chunk) scores per
     # head-chunk, not O(T_global^2)
-    chunk = t_local
+    chunk = tk_local
     acc = jnp.float32
-    iq = jnp.arange(t_global)[:, None]
+    iq = jnp.arange(t_global)[:, None] + q_offset
 
     def body(c, carry):
         o_acc, l_acc, m_acc = carry
@@ -99,15 +111,16 @@ def ulysses_attention(q, k, v, axis_name, causal=True, scale=None):
     # block results are device-varying (post-all_to_all operands);
     # mark the initial carry to match (same as ring's accumulators)
     init = mark_varying(init, axis_name)
-    o_acc, l_acc, m_acc = lax.fori_loop(0, t_global // chunk, body, init)
+    o_acc, l_acc, m_acc = lax.fori_loop(0, tk_global // chunk, body, init)
     out = o_acc / jnp.maximum(l_acc, 1e-30)[..., None]
     return heads_to_seq(out.astype(q.dtype))
 
 
-def make_ulysses_attention(mesh, seq_axis="seq", causal=True):
+def make_ulysses_attention(mesh, seq_axis="seq", causal=True, q_offset=0):
     """Wrap ulysses_attention in shard_map over `seq_axis` of `mesh` —
     same factory contract as make_ring_attention: takes/returns global
-    arrays [batch, heads, seq, d] sharded on the sequence axis."""
+    arrays [batch, heads, seq, d] sharded on the sequence axis, with
+    ``q_offset`` placing the query block inside the key sequence."""
     import jax
 
     try:
@@ -118,7 +131,8 @@ def make_ulysses_attention(mesh, seq_axis="seq", causal=True):
 
     spec = P(None, None, seq_axis, None)
     fn = functools.partial(
-        ulysses_attention, axis_name=seq_axis, causal=causal)
+        ulysses_attention, axis_name=seq_axis, causal=causal,
+        q_offset=q_offset)
     # replication checking off: the Pallas flash kernel's out_shapes
     # carry no varying-axes annotation, which the checker rejects inside
     # shard_map (jax >= 0.7 spells the knob check_vma, 0.4.x spells it

@@ -100,7 +100,11 @@ class Predictor:
         for name in arg_names:
             if name not in known and name in arg_params:
                 known[name] = tuple(arg_params[name].shape)
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**known)
+        arg_shapes, out_shapes, aux_shapes = self._symbol.infer_shape(**known)
+        # output shapes are fixed for the life of a binding — cache them
+        # here instead of re-running full graph shape inference on every
+        # get_output_shape() call; reshape() re-binds, refreshing them
+        self._out_shapes = [tuple(s) for s in out_shapes]
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
             if name in input_shapes:
@@ -173,10 +177,11 @@ class Predictor:
         return self._outputs
 
     def get_output_shape(self, index=0):
-        """ref: MXPredGetOutputShape (c_predict_api.h:113)."""
-        _, out_shapes, _ = self._symbol.infer_shape(
-            **{k: self._args[k].shape for k in self._input_names})
-        return tuple(out_shapes[index])
+        """ref: MXPredGetOutputShape (c_predict_api.h:113). Served from
+        the shapes cached at bind time (``_bind``) — shape inference is
+        a full graph walk, far too heavy for a per-call query on a hot
+        serving path."""
+        return self._out_shapes[index]
 
     def get_output(self, index=0):
         """ref: MXPredGetOutput (c_predict_api.h:161)."""

@@ -1,0 +1,35 @@
+"""mxserve: paged-KV continuous-batching inference.
+
+The production serving story the "millions of users" north star needs
+(ROADMAP.md; PAPERS.md "Ragged Paged Attention"), sitting next to the
+single-request fixed-shape ``Predictor``:
+
+- :mod:`.kv_cache` — paged KV-cache allocator: fixed-size blocks in one
+  preallocated device pool, per-request block tables, OOM backpressure;
+- :mod:`.model` — ragged batches assembled into fixed bucketed shapes
+  over ``models/transformer.py`` params: one jitted step covers prefill
+  chunks and single-token decode, warm across processes via the PR 6
+  persistent jit cache;
+- :mod:`.scheduler` — continuous batching: admit/evict per decode step
+  against a token budget, prefill/decode split, recompute-style
+  preemption (plus the static-batching baseline policy for A/B);
+- :mod:`.engine` — the request front-end: ``Engine.submit(prompt) ->
+  stream of tokens``, a synchronous ``generate`` batch API,
+  cancellation, max-queue-depth admission control, and the
+  ``serving.*`` mxtel catalog.
+
+Bench: ``bench_serve.py`` (Poisson open-loop load, static vs continuous
+tokens/s + p99 TTFT). Guide: docs/how_to/serving.md.
+"""
+from __future__ import annotations
+
+from .engine import Engine, QueueFullError, ServingConfig, StreamHandle
+from .kv_cache import PagedKVPool, blocks_for_tokens
+from .model import ServingModel, cp_prefill_kv
+from .scheduler import Request, Scheduler, StepPlan
+
+__all__ = [
+    "Engine", "ServingConfig", "StreamHandle", "QueueFullError",
+    "PagedKVPool", "blocks_for_tokens", "ServingModel", "cp_prefill_kv",
+    "Request", "Scheduler", "StepPlan",
+]

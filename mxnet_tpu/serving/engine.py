@@ -1,0 +1,535 @@
+"""Request front-end: ``Engine.submit(prompt) -> stream of tokens``.
+
+The serving subsystem's public surface. An Engine owns one model's
+params, a paged KV pool sized by :class:`ServingConfig`, a
+:class:`~.scheduler.Scheduler`, and the bucketed jitted step functions
+(:class:`~.model.ServingModel`). Each ``step()`` runs at most one
+decode batch and one prefill batch (scheduler.py module docstring);
+``start()`` drives steps from a background thread so ``submit`` is a
+non-blocking producer API, while tests and the bench drive ``step()``
+directly for determinism.
+
+Admission control: ``submit`` raises :class:`QueueFullError` past
+``max_queue_depth`` (counted as a rejection — the caller sheds load),
+and rejects outright any request whose worst-case footprint can never
+fit the pool or the model's position table.
+
+Telemetry (docs/how_to/serving.md catalog): counters
+``serving.requests_{admitted,completed,evicted,rejected,cancelled}``,
+gauges ``serving.kv_pool_utilization`` / ``serving.tokens_per_s`` /
+``serving.queue_depth``, histograms ``serving.ttft_s`` (submit -> first
+generated token) and ``serving.token_latency_s`` (gap between
+consecutive tokens of one request). Mirrored as plain numbers in
+``Engine.stats()`` so telemetry-off processes (bench subprocesses)
+still get the record.
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry as _tel
+from ..base import MXNetError, env_int as _env_int
+from .kv_cache import PagedKVPool, blocks_for_tokens
+from .model import ServingModel, cp_prefill_kv
+from .scheduler import (CANCELLED, DECODE, FINISHED, PREFILL, Request,
+                        Scheduler)
+
+__all__ = ["Engine", "ServingConfig", "StreamHandle", "QueueFullError"]
+
+_END = object()
+
+
+class QueueFullError(MXNetError):
+    """submit() past max_queue_depth — shed load upstream."""
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Engine knobs. Every field defaults from an ``MXNET_SERVE_*``
+    env var (docs/env_vars.md) so deployments tune without code."""
+
+    block_size: int = None
+    num_blocks: int = None
+    max_batch: int = None
+    max_active: int = None
+    prefill_chunk: int = None
+    token_budget: int = None
+    max_queue_depth: int = None
+    policy: str = "continuous"
+    eos_id: int = None
+    max_seq_tokens: int = None   # per-request cap; default model max_seq_len
+    # context-parallel long-prompt prefill (model.cp_prefill_kv):
+    mesh: object = None
+    cp_kind: str = "ring"
+    cp_seq_axis: str = "seq"
+    cp_min_tokens: int = None
+    cp_chunk: int = None
+
+    def __post_init__(self):
+        if self.block_size is None:
+            self.block_size = _env_int("MXNET_SERVE_BLOCK_SIZE", 16)
+        if self.num_blocks is None:
+            self.num_blocks = _env_int("MXNET_SERVE_KV_BLOCKS", 256)
+        if self.max_batch is None:
+            self.max_batch = _env_int("MXNET_SERVE_MAX_BATCH", 8)
+        if self.max_active is None:
+            self.max_active = _env_int("MXNET_SERVE_MAX_ACTIVE",
+                                       2 * self.max_batch)
+        if self.prefill_chunk is None:
+            self.prefill_chunk = _env_int("MXNET_SERVE_PREFILL_CHUNK", 64)
+        if self.token_budget is None:
+            self.token_budget = _env_int(
+                "MXNET_SERVE_TOKEN_BUDGET",
+                self.max_batch + self.prefill_chunk)
+        if self.max_queue_depth is None:
+            self.max_queue_depth = _env_int("MXNET_SERVE_MAX_QUEUE", 64)
+        if self.cp_min_tokens is None:
+            self.cp_min_tokens = _env_int("MXNET_SERVE_CP_MIN_TOKENS", 2048)
+
+
+class StreamHandle:
+    """Per-request token stream + control surface."""
+
+    def __init__(self, engine, req):
+        self._engine = engine
+        self._req = req
+        self._q = _queue.Queue()
+        self.status = "running"
+        req.stream = self
+
+    @property
+    def request_id(self):
+        return self._req.rid
+
+    def _emit(self, token):
+        self._q.put(int(token))
+
+    def _end(self, status):
+        self.status = status
+        self._q.put(_END)
+
+    def cancel(self):
+        """Request cancellation; takes effect at the next scheduler
+        sweep (mid-decode safe: blocks are freed, stream ends with
+        status "cancelled")."""
+        self._engine.cancel(self._req)
+
+    def tokens(self, timeout=None):
+        """Iterate generated tokens as they land; ends when the request
+        finishes, is cancelled, or errors."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _END:
+                return
+            yield item
+
+    def result(self, timeout=None):
+        """Block until the stream ends; returns the full token list."""
+        return list(self.tokens(timeout=timeout))
+
+
+class Engine:
+    """Continuous-batching serving engine over a transformer LM.
+
+    Parameters
+    ----------
+    params : pytree
+        ``models/transformer.py`` params (what bench_lm.py trains).
+    model_cfg : TransformerConfig
+    cfg : ServingConfig, optional
+    """
+
+    def __init__(self, params, model_cfg, cfg=None):
+        from ..compile import ensure_jit_cache
+
+        ensure_jit_cache()  # serving cold starts ride the PR 6 cache
+        self.params = params
+        self.model_cfg = model_cfg
+        self.cfg = cfg or ServingConfig()
+        bs = self.cfg.block_size
+        max_seq = min(self.cfg.max_seq_tokens or model_cfg.max_seq_len,
+                      model_cfg.max_seq_len)
+        self.max_seq_tokens = max_seq
+        self.pool = PagedKVPool(
+            model_cfg.num_layers, model_cfg.num_heads, model_cfg.head_dim,
+            self.cfg.num_blocks, bs, dtype=model_cfg.dtype)
+        w = blocks_for_tokens(max_seq, bs)
+        # buckets must cover the PREFILL batch too, which can span the
+        # whole admission depth (max_active), not just the decode width
+        top = max(self.cfg.max_batch, self.cfg.max_active)
+        batch_buckets = sorted({1, 2, 4, 8, 16, 32, 64, self.cfg.max_batch,
+                                top})
+        batch_buckets = [b for b in batch_buckets if b <= top]
+        chunk_buckets = sorted({8, 16, 32, 64, 128, 256,
+                                self.cfg.prefill_chunk})
+        chunk_buckets = [c for c in chunk_buckets
+                         if c <= self.cfg.prefill_chunk]
+        self.model = ServingModel(model_cfg, bs, w,
+                                  batch_buckets=batch_buckets,
+                                  chunk_buckets=chunk_buckets)
+        self.sched = Scheduler(
+            self.pool, max_batch=self.cfg.max_batch,
+            prefill_chunk=self.cfg.prefill_chunk,
+            token_budget=self.cfg.token_budget, policy=self.cfg.policy,
+            max_active=self.cfg.max_active)
+        self._lock = threading.RLock()
+        # serializes whole steps: model execution + pool swap run
+        # outside _lock (submit must not block on a dispatch), so two
+        # concurrent drivers (generate() from two client threads, or
+        # generate() racing start()'s loop) would otherwise each donate
+        # and swap the same pool buffers, losing each other's KV writes
+        self._step_lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._by_rid = {}
+        self._last_counts = {}
+        self._stats = {"admitted": 0, "completed": 0, "evicted": 0,
+                       "rejected": 0, "cancelled": 0, "tokens_emitted": 0,
+                       "steps": 0}
+        self._ttfts = []
+        self._token_lats = []
+        self._rate_window = []  # (t, cumulative tokens) ring for tokens/s
+        self._thread = None
+        self._stop = False
+        self._last_rate = 0.0
+
+    # -- intake --------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_id=None):
+        """Queue a generation request; returns a StreamHandle.
+
+        Raises QueueFullError past ``max_queue_depth`` and MXNetError
+        for requests that could never fit the KV pool / position table
+        (both counted under serving.requests_rejected).
+        """
+        req = Request(prompt, max_new_tokens,
+                      eos_id=self.cfg.eos_id if eos_id is None else eos_id)
+        total = req.total_len()
+        limit = min(self.max_seq_tokens,
+                    self.sched.max_request_tokens(),
+                    self.model.max_blocks * self.cfg.block_size)
+        with self._lock:
+            if total > limit:
+                self._reject()
+                raise MXNetError(
+                    "request needs %d tokens; engine limit is %d "
+                    "(pool/max_seq geometry)" % (total, limit))
+            if len(self.sched.queue) >= self.cfg.max_queue_depth:
+                self._reject()
+                raise QueueFullError(
+                    "admission queue full (%d)" % self.cfg.max_queue_depth)
+            req.submit_t = time.monotonic()
+            handle = StreamHandle(self, req)
+            self._by_rid[req.rid] = req
+            self.sched.submit(req)
+            self._work.notify_all()
+        return handle
+
+    def cancel(self, req):
+        with self._lock:
+            self.sched.cancel(req)
+            self._work.notify_all()
+
+    def _reject(self):
+        self._stats["rejected"] += 1
+        if _tel.ENABLED:
+            _tel.counter("serving.requests_rejected").inc()
+
+    # -- synchronous batch API -----------------------------------------------
+    def generate(self, prompts, max_new_tokens=16):
+        """Submit all prompts, drive the loop to completion, return the
+        generated token lists (the synchronous batch surface)."""
+        handles = [self.submit(p, max_new_tokens) for p in prompts]
+        if self._thread is None:
+            self.run_until_idle()
+        return [h.result() for h in handles]
+
+    # -- the step loop -------------------------------------------------------
+    def step(self):
+        """Run one scheduler step (<=1 decode batch + <=1 prefill
+        batch). Returns True when any work ran. Whole-step atomic:
+        concurrent drivers serialize on _step_lock."""
+        with self._step_lock:
+            with self._lock:
+                plan = self.sched.plan()
+                self._mirror_events()
+                decode = list(plan.decode)
+                prefill = list(plan.prefill)
+            worked = False
+            if decode:
+                self._run_decode(decode)
+                worked = True
+            if prefill:
+                self._run_prefill(prefill)
+                worked = True
+            if worked:
+                with self._lock:
+                    self._stats["steps"] += 1
+                    self._mirror_events()
+                    self._update_gauges()
+            return worked
+
+    def run_until_idle(self, max_steps=None):
+        """Drive step() until no work remains; returns steps run."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return n
+
+    def start(self):
+        """Serve from a background thread (submit() wakes it)."""
+        if self._thread is not None:
+            return
+        self._stop = False
+
+        def loop():
+            while not self._stop:
+                if not self.step():
+                    with self._work:
+                        if self._stop:
+                            break
+                        self._work.wait(timeout=0.05)
+
+        self._thread = threading.Thread(target=loop, name="mx-serve",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is None:
+            return
+        with self._lock:
+            self._stop = True
+            self._work.notify_all()
+        self._thread.join()
+        self._thread = None
+
+    # -- batch execution -----------------------------------------------------
+    def _tables(self, reqs):
+        w = self.model.max_blocks
+        bt = np.zeros((len(reqs), w), np.int32)
+        for i, r in enumerate(reqs):
+            bt[i, :len(r.blocks)] = r.blocks
+        return bt
+
+    def _run_decode(self, reqs):
+        t0 = time.monotonic()
+        B = len(reqs)
+        tokens = np.asarray([[r.generated[-1]] for r in reqs], np.int32)
+        start = np.asarray(
+            [len(r.prompt) + len(r.generated) - 1 for r in reqs], np.int32)
+        # static policy = fixed-shape serving: decode dispatches at the
+        # full batch width even as the batch drains (dead slots are
+        # padded lanes), faithfully paying what static batching pays on
+        # accelerators where a decode step costs the same at any live
+        # count; continuous dispatches at the ragged bucket
+        min_b = self.cfg.max_batch if self.cfg.policy == "static" else None
+        with _tel.span("serve.decode"):
+            nxt, _, kp, vp = self.model.step(
+                self.params, self.pool.k, self.pool.v, tokens, start,
+                np.ones((B,), np.int32), self._tables(reqs),
+                np.ones((B,), bool), min_batch_bucket=min_b)
+        now = time.monotonic()
+        with self._lock:
+            self.pool.swap(kp, vp)
+            if _tel.ENABLED:
+                _tel.histogram("serving.decode_batch_size").observe(B)
+                _tel.histogram("serving.decode_step_s").observe(now - t0)
+            for r, t in zip(reqs, nxt):
+                if r.state != DECODE:   # cancelled while stepping
+                    continue
+                self._emit(r, int(t), now)
+
+    def _run_prefill(self, chunks):
+        # context-parallel long prompts take their own path, off the
+        # bucketed batch (model.cp_prefill_kv)
+        batched = []
+        for req, cs, clen in chunks:
+            if (self.cfg.mesh is not None and cs == 0
+                    and req.ctx_len >= self.cfg.cp_min_tokens
+                    and self._cp_eligible(req)):
+                self._run_cp_prefill(req)
+            else:
+                batched.append((req, cs, clen))
+        if not batched:
+            return
+        B = len(batched)
+        C = max(clen for _, _, clen in batched)
+        tokens = np.zeros((B, C), np.int32)
+        start = np.zeros((B,), np.int32)
+        chunk_len = np.zeros((B,), np.int32)
+        for i, (req, cs, clen) in enumerate(batched):
+            tokens[i, :clen] = req.context[cs:cs + clen]
+            start[i] = cs
+            chunk_len[i] = clen
+        with _tel.span("serve.prefill"):
+            nxt, _, kp, vp = self.model.step(
+                self.params, self.pool.k, self.pool.v, tokens, start,
+                chunk_len, self._tables([r for r, _, _ in batched]),
+                np.ones((B,), bool))
+        now = time.monotonic()
+        with self._lock:
+            self.pool.swap(kp, vp)
+            for i, (req, cs, clen) in enumerate(batched):
+                if req.state != PREFILL:   # cancelled while stepping
+                    continue
+                self.sched.note_prefilled(req, clen)
+                if req.state == DECODE:
+                    # the final prefill chunk's logits sample the first
+                    # new token — no separate "first decode" dispatch
+                    self._emit(req, int(nxt[i]), now)
+
+    def _cp_eligible(self, req):
+        n = self.cfg.mesh.shape[self.cfg.cp_seq_axis]
+        chunk = self.cfg.cp_chunk or req.ctx_len
+        return chunk % n == 0 and req.ctx_len % chunk == 0
+
+    def _run_cp_prefill(self, req):
+        """Whole-prompt context-parallel prefill over the mesh, then
+        scatter the dense K/V into this request's pool blocks."""
+        import jax.numpy as jnp
+
+        cfg = self.model_cfg
+        with _tel.span("serve.cp_prefill"):
+            k, v, x_last = cp_prefill_kv(
+                self.params, cfg, req.context, self.cfg.mesh,
+                kind=self.cfg.cp_kind, chunk=self.cfg.cp_chunk)
+        bs = self.cfg.block_size
+        T = req.ctx_len
+        nb = blocks_for_tokens(T, bs)
+        pad = nb * bs - T
+        if pad:
+            zpad = np.zeros((cfg.num_layers, pad) + k.shape[2:], k.dtype)
+            k = np.concatenate([k, zpad], axis=1)
+            v = np.concatenate([v, zpad], axis=1)
+        k = k.reshape(cfg.num_layers, nb, bs, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(cfg.num_layers, nb, bs, cfg.num_heads, cfg.head_dim)
+        blocks = np.asarray(req.blocks[:nb], np.int32)
+        now = time.monotonic()
+        with self._lock:
+            self.pool.swap(
+                self.pool.k.at[:, blocks].set(
+                    jnp.asarray(k, self.pool.k.dtype)),
+                self.pool.v.at[:, blocks].set(
+                    jnp.asarray(v, self.pool.v.dtype)))
+            if req.state != PREFILL:
+                return
+            self.sched.note_prefilled(req, T - req.prefilled)
+            logits = x_last @ np.asarray(
+                self.params["embed"], np.float32).T
+            self._emit(req, int(np.argmax(logits)), now)
+
+    # -- per-token bookkeeping (under self._lock) ----------------------------
+    def _emit(self, req, token, now):
+        req.generated.append(token)
+        stream = req.stream
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self._ttfts.append(now - req.submit_t)
+            if _tel.ENABLED:
+                _tel.histogram("serving.ttft_s").observe(now - req.submit_t)
+        if req.last_token_t is not None:
+            self._token_lats.append(now - req.last_token_t)
+            if _tel.ENABLED:
+                _tel.histogram("serving.token_latency_s").observe(
+                    now - req.last_token_t)
+        req.last_token_t = now
+        self._stats["tokens_emitted"] += 1
+        self._rate_window.append((now, self._stats["tokens_emitted"]))
+        if stream is not None:
+            stream._emit(token)
+        # len(generated) is the client-visible stream length — eviction
+        # folds tokens into the recompute context but never drops them
+        done = len(req.generated) >= req.max_new_tokens
+        if req.eos_id is not None and token == req.eos_id:
+            done = True
+        if done:
+            req.finish_t = now
+            self.sched.finish(req)
+            self._mirror_events()
+            if stream is not None:
+                stream._end("finished")
+
+    def _mirror_events(self):
+        """Fold scheduler event counts into stats + mxtel counters, and
+        close out cancelled streams."""
+        mapping = {"admit": "admitted", "complete": "completed",
+                   "evict": "evicted", "cancel": "cancelled"}
+        for ev, stat in mapping.items():
+            n = self.sched.counts.get(ev, 0)
+            d = n - self._last_counts.get(ev, 0)
+            if d:
+                self._stats[stat] += d
+                self._last_counts[ev] = n
+                if _tel.ENABLED:
+                    _tel.counter("serving.requests_%s" % stat).inc(d)
+        # end streams of requests the sweep cancelled
+        for rid, req in list(self._by_rid.items()):
+            if req.state == CANCELLED:
+                if req.stream is not None and req.stream.status == "running":
+                    req.stream._end("cancelled")
+                del self._by_rid[rid]
+            elif req.state == FINISHED:
+                del self._by_rid[rid]
+
+    def _update_gauges(self):
+        util = self.pool.utilization()
+        now = time.monotonic()
+        # tokens/s over a sliding 2 s window of emissions
+        win = [x for x in self._rate_window if now - x[0] <= 2.0]
+        self._rate_window = win
+        rate = 0.0
+        if len(win) >= 2 and win[-1][0] > win[0][0]:
+            rate = (win[-1][1] - win[0][1]) / (win[-1][0] - win[0][0])
+        self._last_rate = rate
+        if _tel.ENABLED:
+            _tel.gauge("serving.kv_pool_utilization").set(util)
+            _tel.gauge("serving.kv_pool_hwm_blocks").set(
+                self.pool.high_water_mark())
+            _tel.gauge("serving.tokens_per_s").set(rate)
+            _tel.gauge("serving.queue_depth").set(len(self.sched.queue))
+
+    def note_idle(self):
+        """Mark the engine drained: the tokens/s gauge drops to zero
+        instead of freezing at its last in-flight value (journal
+        timelines honest across idle gaps)."""
+        with self._lock:
+            self._rate_window = []
+            self._last_rate = 0.0
+            if _tel.ENABLED:
+                _tel.gauge("serving.tokens_per_s").set(0.0)
+                _tel.gauge("serving.queue_depth").set(len(self.sched.queue))
+
+    # -- reporting -----------------------------------------------------------
+    def latency_samples(self):
+        """Copies of the raw TTFT / per-token latency sample lists (the
+        bench slices per-window percentiles out of a reused engine)."""
+        with self._lock:
+            return list(self._ttfts), list(self._token_lats)
+
+    def stats(self):
+        """Plain-number mirror of the serving metrics (works with
+        telemetry off — the bench subprocess contract)."""
+        def pct(xs, q):
+            if not xs:
+                return None
+            return float(np.percentile(np.asarray(xs), q))
+
+        with self._lock:
+            out = dict(self._stats)
+            out.update({
+                "kv_pool_utilization": self.pool.utilization(),
+                "kv_pool_hwm_blocks": self.pool.high_water_mark(),
+                "queue_depth": len(self.sched.queue),
+                "active": len(self.sched.active),
+                "tokens_per_s_window": self._last_rate,
+                "ttft_p50_s": pct(self._ttfts, 50),
+                "ttft_p99_s": pct(self._ttfts, 99),
+                "token_latency_p50_s": pct(self._token_lats, 50),
+                "token_latency_p99_s": pct(self._token_lats, 99),
+            })
+        return out

@@ -1,0 +1,134 @@
+"""Paged KV-cache allocator: fixed-size blocks in a preallocated pool.
+
+The serving engine's memory story (PAPERS.md "Ragged Paged Attention"):
+instead of one contiguous [max_seq] KV strip per request — which wastes
+(max_seq - len) slots on every short request and fragments HBM — the
+K/V cache is a single preallocated device pool of fixed-size *blocks*
+(``block_size`` tokens each), and every request holds a *block table*:
+the ordered list of pool blocks its tokens live in. Token position
+``p`` of a request maps to slot ``(table[p // block_size], p %
+block_size)``. Admission allocates ceil(len/block_size) blocks; decode
+allocates one more each time a request crosses a block boundary;
+finish/cancel/evict frees them all. Utilization is therefore exact and
+allocation is O(1) against a free list — no compaction, no copying.
+
+Block 0 is reserved as the *scratch sink*: padded batch rows and
+masked-out lanes inside the jitted step function write their K/V there
+(a data-dependent "don't write" is not expressible in one fixed-shape
+XLA program, but an index redirect is), so scratch absorbs garbage and
+real blocks stay clean. The pool hands out blocks 1..num_blocks-1.
+
+Backpressure: ``alloc`` returns None when the free list can't cover a
+request instead of raising — the scheduler treats None as the OOM
+signal (stop admitting; evict if a *running* request needs the block).
+"""
+from __future__ import annotations
+
+__all__ = ["PagedKVPool", "blocks_for_tokens"]
+
+
+def blocks_for_tokens(num_tokens, block_size):
+    """Blocks needed to hold ``num_tokens`` (ceil division, min 1)."""
+    return max(1, -(-int(num_tokens) // int(block_size)))
+
+
+class PagedKVPool:
+    """Preallocated paged K/V device pool + free-list block allocator.
+
+    Storage is two device arrays shaped
+    ``[num_layers, num_blocks, block_size, num_kv_heads, head_dim]``
+    (K and V). The arrays are *functional* state: the jitted step
+    functions return updated pools and the engine swaps them in via
+    :meth:`swap`; this object owns the allocator bookkeeping, which is
+    host-side and must never enter a traced program.
+
+    Parameters
+    ----------
+    num_layers, num_heads, head_dim : int
+        KV geometry, matching the model config.
+    num_blocks : int
+        Total pool blocks *including* the reserved scratch block 0.
+        Usable capacity is ``num_blocks - 1`` blocks.
+    block_size : int
+        Tokens per block.
+    dtype : str
+        Pool element dtype (normally the model's compute dtype).
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, num_blocks,
+                 block_size, dtype="float32"):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the scratch "
+                             "sink), got %d" % num_blocks)
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1, got %d" % block_size)
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # LIFO free list (reuse the most recently freed blocks first —
+        # they are the likeliest still resident in cache hierarchies)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._hwm = 0  # high-water mark of blocks in use
+
+    # -- allocator -----------------------------------------------------------
+    @property
+    def capacity(self):
+        """Usable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return self.capacity - len(self._free)
+
+    def utilization(self):
+        """Fraction of usable blocks currently allocated, 0..1."""
+        return self.num_used / float(self.capacity)
+
+    def high_water_mark(self):
+        """Peak blocks-in-use since construction."""
+        return self._hwm
+
+    def can_alloc(self, n):
+        return n <= len(self._free)
+
+    def alloc(self, n):
+        """Take ``n`` blocks off the free list; ``None`` when the pool
+        can't cover them (the OOM-backpressure signal — the caller
+        decides between waiting and evicting, never this class)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError("alloc(%d)" % n)
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._hwm = max(self._hwm, self.num_used)
+        return blocks
+
+    def free(self, blocks):
+        """Return blocks to the free list (idempotence is NOT provided:
+        double-free is a bug and raises)."""
+        for b in blocks:
+            b = int(b)
+            if not 1 <= b < self.num_blocks:
+                raise ValueError("free of invalid block %d" % b)
+            if b in self._free:
+                raise ValueError("double free of block %d" % b)
+        self._free.extend(int(b) for b in blocks)
+
+    # -- device state --------------------------------------------------------
+    def swap(self, k, v):
+        """Install updated pool arrays returned by a jitted step."""
+        self.k = k
+        self.v = v

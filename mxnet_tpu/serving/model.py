@@ -1,0 +1,324 @@
+"""Decode-model adapter: bucketed ragged batches over a paged KV pool.
+
+Bridges ``models/transformer.py`` (pure-function training forward) to
+the serving engine's incremental decode. One jitted *step* function
+covers both phases:
+
+- **prefill chunk**: ``C`` prompt tokens per request enter at arbitrary
+  start offsets, attend causally to their own chunk plus everything the
+  request already has in the paged pool, and write their K/V into the
+  pool blocks named by the request's block table;
+- **decode**: the same function at ``C == 1`` — one new token per
+  request per step.
+
+Ragged batches (every request at a different length) are assembled into
+**fixed bucketed shapes**: batch rows pad to the next configured batch
+bucket, chunk lengths pad to the next chunk bucket, and the block-table
+width is a compile-time constant — so the number of distinct XLA
+programs is ``len(batch_buckets) x len(chunk_buckets)``, bounded and
+warm across processes via the PR 6 persistent jit cache
+(``MXNET_COMPILE_CACHE_DIR``). Padded lanes redirect their K/V writes
+to the pool's scratch block 0 and are masked out of attention reads, so
+padding never corrupts real state (ragged-vs-padded equivalence is
+pinned by tests/unittest/test_serving.py).
+
+Numerical contract: a token decoded through the paged path produces the
+same logits as ``transformer.forward`` over the whole sequence would at
+that position (same op order, same f32 softmax accumulation), which is
+what makes continuous batching a pure scheduling win.
+
+Long-context prefill on a mesh reuses the context-parallel attention in
+``parallel/ring_attention.py`` / ``parallel/ulysses.py``: chunked
+prefill is exactly their new ``q_offset`` form (queries are a suffix of
+the key sequence), see :func:`cp_prefill_kv`.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..models.transformer import TransformerConfig, _layer_norm
+
+__all__ = ["ServingModel", "bucket_for", "cp_prefill_kv"]
+
+
+def bucket_for(n, buckets):
+    """Smallest bucket >= n (buckets sorted ascending); raises when n
+    exceeds every bucket — the caller sized its batch wrong."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError("no bucket fits %d (buckets %s)" % (n, list(buckets)))
+
+
+class ServingModel:
+    """Jitted paged-attention step functions over transformer params.
+
+    Parameters
+    ----------
+    cfg : TransformerConfig
+        Model geometry (the same config object bench_lm.py trains).
+    block_size : int
+        Paged-pool tokens per block.
+    max_blocks_per_req : int
+        Block-table width ``W`` — a compile-time constant; a request
+        can span at most ``W * block_size`` total tokens.
+    batch_buckets, chunk_buckets : tuple of int
+        Padded batch sizes / chunk lengths (ascending). Decode always
+        uses chunk bucket 1 (its own program).
+    """
+
+    def __init__(self, cfg: TransformerConfig, block_size,
+                 max_blocks_per_req, batch_buckets=(1, 2, 4, 8),
+                 chunk_buckets=(32, 64, 128)):
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.max_blocks = int(max_blocks_per_req)
+        self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
+        self.chunk_buckets = tuple(sorted(set(int(c) for c in chunk_buckets)))
+        self._jitted = {}  # (B, C) -> compiled step
+
+    # -- the step program ----------------------------------------------------
+    def _step_impl(self, params, kpool, vpool, tokens, start, chunk_len,
+                   block_tables, active):
+        """One fused forward over ``C`` new tokens per request.
+
+        tokens [B, C] int32, start [B] int32 (global position of
+        tokens[:, 0]), chunk_len [B] int32 (real tokens this chunk, 0
+        for padded rows), block_tables [B, W] int32, active [B] bool.
+        Returns (next_token [B] int32, logits_last [B, V] f32, kpool,
+        vpool).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        B, C = tokens.shape
+        W, bs = self.max_blocks, self.block_size
+        S = W * bs
+        H, D = cfg.num_heads, cfg.head_dim
+        scale = 1.0 / float(D) ** 0.5
+
+        pos = start[:, None] + jnp.arange(C)[None, :]            # [B, C]
+        in_chunk = jnp.arange(C)[None, :] < chunk_len[:, None]   # [B, C]
+        valid = in_chunk & active[:, None]
+        # pos_embed rows are clipped for padded lanes (jnp.take clips);
+        # their outputs are never read back
+        x = jnp.take(params["embed"], tokens, axis=0)
+        x = x + jnp.take(params["pos_embed"], jnp.minimum(
+            pos, cfg.max_seq_len - 1), axis=0).astype(x.dtype)
+
+        # K/V write coordinates: padded / inactive lanes redirect to the
+        # scratch block 0 (kv_cache.py module docstring)
+        blk_idx = jnp.clip(pos // bs, 0, W - 1)                  # [B, C]
+        table_blk = jnp.take_along_axis(block_tables, blk_idx, axis=1)
+        write_blk = jnp.where(valid, table_blk, 0)               # [B, C]
+        write_slot = jnp.where(valid, pos % bs, 0)               # [B, C]
+
+        # pool key positions: slot (w, i) of a request's table holds its
+        # token w*bs + i
+        key_pos = jnp.arange(S)                                  # [S]
+        # keys already in the pool are those strictly before this
+        # chunk's first token; the chunk attends to itself causally
+        pool_mask = key_pos[None, None, :] < start[:, None, None]  # [B,1,S]
+        pool_mask = jnp.broadcast_to(pool_mask, (B, C, S))
+        chunk_mask = (jnp.arange(C)[None, :, None] >=
+                      jnp.arange(C)[None, None, :]) & in_chunk[:, None, :]
+        chunk_mask = jnp.broadcast_to(chunk_mask, (B, C, C))
+        full_mask = jnp.concatenate([pool_mask, chunk_mask], axis=2)
+        neg = jnp.asarray(-1e30, jnp.float32)
+
+        for li, lp in enumerate(params["layers"]):
+            h = _layer_norm(x, lp["ln1"])
+            qkv = jnp.einsum("bcd,de->bce", h, lp["wqkv"])
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            k = k.reshape(B, C, H, D)
+            v = v.reshape(B, C, H, D)
+            # write this chunk's K/V into the pool (scatter; scratch
+            # absorbs padded lanes)
+            kpool = kpool.at[li, write_blk, write_slot].set(
+                k.astype(kpool.dtype))
+            vpool = vpool.at[li, write_blk, write_slot].set(
+                v.astype(vpool.dtype))
+            # gather the request's paged history [B, S, H, D]
+            k_hist = kpool[li][block_tables].reshape(B, S, H, D)
+            v_hist = vpool[li][block_tables].reshape(B, S, H, D)
+            k_all = jnp.concatenate([k_hist.astype(k.dtype), k], axis=1)
+            v_all = jnp.concatenate([v_hist.astype(v.dtype), v], axis=1)
+
+            qh = q.reshape(B, C, H, D)
+            scores = jnp.einsum("bchd,bshd->bhcs", qh, k_all) * scale
+            scores = jnp.where(full_mask[:, None], scores.astype(jnp.float32),
+                               neg)
+            m = jnp.max(scores, axis=-1, keepdims=True)
+            p = jnp.exp(scores - m)
+            p = p * jnp.any(full_mask[:, None], axis=-1,
+                            keepdims=True).astype(p.dtype)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            p = p / jnp.maximum(l, 1e-30)
+            o = jnp.einsum("bhcs,bshd->bchd", p.astype(v_all.dtype), v_all)
+            o = o.reshape(B, C, H * D)
+            x = x + jnp.einsum("bcd,de->bce", o, lp["wo"])
+            h = _layer_norm(x, lp["ln2"])
+            ff = jax.nn.gelu(jnp.einsum("bcd,df->bcf", h, lp["w1"]))
+            x = x + jnp.einsum("bcf,fd->bcd", ff, lp["w2"])
+
+        x = _layer_norm(x, params["ln_f"])
+        # logits only at each row's last real chunk position — the one
+        # spot a next token can be sampled from
+        last = jnp.clip(chunk_len - 1, 0, C - 1)                 # [B]
+        x_last = jnp.take_along_axis(
+            x, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, d]
+        logits = jnp.einsum("bd,vd->bv", x_last,
+                            params["embed"]).astype(jnp.float32)
+        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return next_token, logits, kpool, vpool
+
+    def _compiled(self, B, C):
+        key = (B, C)
+        fn = self._jitted.get(key)
+        if fn is None:
+            import jax
+
+            from ..compile import jit_cache
+
+            # pools are donated on TPU; jaxlib 0.4.3x CPU executables
+            # deserialized from the persistent cache corrupt the heap
+            # under donation (jit_cache.donation_unsafe, PR 6) — keep
+            # the buffers there
+            donate = () if jit_cache.donation_unsafe() else (1, 2)
+            fn = jax.jit(self._step_impl, donate_argnums=donate)
+            self._jitted[key] = fn
+        return fn
+
+    # -- host-facing API -----------------------------------------------------
+    def step(self, params, kpool, vpool, tokens, start, chunk_len,
+             block_tables, active, min_batch_bucket=None):
+        """Run one bucketed step over host-side (numpy) batch inputs.
+
+        Inputs are RAGGED: ``tokens`` is [B, C_real<=bucket] already
+        padded per-row by the caller via ``chunk_len``; this method pads
+        the batch and chunk dims to their buckets and slices the result
+        back down.
+
+        ``min_batch_bucket`` forces at least that batch bucket — the
+        static-batching baseline dispatches decode at the FIXED batch
+        shape even when slots have drained (dead slots are padded
+        lanes), which is what "static" means on hardware where a decode
+        step costs the same at any live count.
+        """
+        import numpy as np
+
+        B_real, C_real = tokens.shape
+        B = bucket_for(max(B_real, min_batch_bucket or 1),
+                       self.batch_buckets)
+        C = 1 if C_real == 1 else bucket_for(C_real, self.chunk_buckets)
+
+        def padb(a, fill=0):
+            if a.shape[0] == B:
+                return a
+            pad = np.full((B - a.shape[0],) + a.shape[1:], fill, a.dtype)
+            return np.concatenate([a, pad], axis=0)
+
+        tok = np.zeros((B, C), np.int32)
+        tok[:B_real, :C_real] = tokens
+        start = padb(np.asarray(start, np.int32))
+        chunk_len = padb(np.asarray(chunk_len, np.int32))
+        bt = np.zeros((B, self.max_blocks), np.int32)
+        bt[:B_real] = block_tables
+        act = np.zeros((B,), bool)
+        act[:B_real] = active
+        nxt, logits, kp, vp = self._compiled(B, C)(
+            params, kpool, vpool, tok, start, chunk_len, bt, act)
+        return (np.asarray(nxt)[:B_real], np.asarray(logits)[:B_real],
+                kp, vp)
+
+    def warmup(self, params, pool, batch_sizes=None):
+        """Pre-compile the decode programs (and let the persistent jit
+        cache serve them next process). Prefill buckets compile on first
+        use."""
+        import numpy as np
+
+        for B in (batch_sizes or self.batch_buckets):
+            bt = np.zeros((B, self.max_blocks), np.int32)
+            nxt, _, kp, vp = self.step(
+                params, pool.k, pool.v, np.zeros((B, 1), np.int32),
+                np.zeros((B,), np.int32), np.ones((B,), np.int32), bt,
+                np.zeros((B,), bool))
+            pool.swap(kp, vp)
+
+
+def cp_prefill_kv(params, cfg, tokens, mesh, kind="ring", chunk=None,
+                  seq_axis="seq"):
+    """Context-parallel chunked prefill: per-layer K/V for one long
+    prompt, computed over a mesh with ring or Ulysses attention.
+
+    This is the long-context prefill path the engine uses for prompts
+    big enough to matter (engine ``cp_min_tokens``): activations for a
+    ``chunk``-token slice are materialized at a time (bounding memory to
+    O(chunk x d) instead of O(T x d) scores), and each chunk's queries
+    attend to the full accumulated prefix via the sequence-parallel
+    attention in parallel/ring_attention.py / parallel/ulysses.py using
+    their ``q_offset`` form — queries are a suffix of the key sequence,
+    exactly the chunked-prefill geometry. Both the chunk length and
+    every prefix length must divide by the mesh axis size.
+
+    tokens: [T] or [1, T] int32. Returns (k [L, T, H, D], v likewise,
+    x_last [d_model] final-position hidden state) as host arrays.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..parallel.ring_attention import make_ring_attention
+    from ..parallel.ulysses import make_ulysses_attention
+
+    tokens = np.asarray(tokens, np.int32).reshape(1, -1)
+    T = tokens.shape[1]
+    n = mesh.shape[seq_axis]
+    if chunk is None:
+        chunk = T
+    if chunk % n or T % chunk:
+        raise ValueError(
+            "cp prefill: chunk %d must divide by mesh axis %d and T %d "
+            "by chunk" % (chunk, n, T))
+    H, D = cfg.num_heads, cfg.head_dim
+    L = cfg.num_layers
+    factory = {"ring": make_ring_attention,
+               "ulysses": make_ulysses_attention}[kind]
+
+    k_out = np.zeros((L, T, H, D), np.float32)
+    v_out = np.zeros((L, T, H, D), np.float32)
+    x_last = None
+    # dense per-layer K/V accumulated on host; each chunk re-enters the
+    # layer stack with its predecessors' K/V as the attention prefix
+    for c0 in range(0, T, chunk):
+        c1 = c0 + chunk
+        x = jnp.take(params["embed"], jnp.asarray(tokens[:, c0:c1]), axis=0)
+        x = x + params["pos_embed"][c0:c1][None].astype(x.dtype)
+        attn = factory(mesh, seq_axis=seq_axis, causal=True, q_offset=c0)
+        for li, lp in enumerate(params["layers"]):
+            h = _layer_norm(x, lp["ln1"])
+            qkv = jnp.einsum("btd,de->bte", h, lp["wqkv"])
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+            def heads(t):
+                return t.reshape(1, t.shape[1], H, D).transpose(0, 2, 1, 3)
+
+            k_out[li, c0:c1] = np.asarray(
+                k.reshape(chunk, H, D), np.float32)
+            v_out[li, c0:c1] = np.asarray(
+                v.reshape(chunk, H, D), np.float32)
+            k_full = jnp.asarray(k_out[li, :c1][None]).astype(x.dtype)
+            v_full = jnp.asarray(v_out[li, :c1][None]).astype(x.dtype)
+            o = attn(heads(q),
+                     k_full.transpose(0, 2, 1, 3),
+                     v_full.transpose(0, 2, 1, 3))
+            o = o.transpose(0, 2, 1, 3).reshape(1, chunk, H * D)
+            x = x + jnp.einsum("btd,de->bte", o, lp["wo"])
+            h = _layer_norm(x, lp["ln2"])
+            import jax
+
+            ff = jax.nn.gelu(jnp.einsum("btd,df->btf", h, lp["w1"]))
+            x = x + jnp.einsum("btf,fd->btd", ff, lp["w2"])
+        x_last = np.asarray(
+            _layer_norm(x, params["ln_f"])[0, -1], np.float32)
+    return k_out, v_out, x_last

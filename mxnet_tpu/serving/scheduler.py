@@ -1,0 +1,295 @@
+"""Continuous-batching scheduler: admit/evict per decode step.
+
+The scheduling model (PAPERS.md "Ragged Paged Attention"; the policy is
+the now-standard continuous batching shape):
+
+- every engine step runs at most one **decode batch** (one token for
+  every running request) and one **prefill batch** (the next chunk of
+  each admitted-but-not-yet-prefilled prompt, budget permitting) —
+  prefill is batched *separately* so a long prompt never stalls the
+  decoders, and a per-step **token budget** caps prefill work;
+- **admission** is per step: whenever a slot (``max_batch``) and enough
+  KV blocks for the prompt exist, the oldest queued request joins —
+  requests never wait for a "batch to fill";
+- **eviction** is the OOM pressure valve: when a *running* request
+  crosses a block boundary and the pool can't hand out one more block,
+  the youngest running request is preempted — its blocks are freed and
+  it re-queues at the front with its already-streamed tokens folded
+  into a recompute context (so nothing the client saw is lost);
+- the **static** policy is the A/B baseline (bench_serve.py): admission
+  only happens when the active set is fully drained, i.e. classic
+  static batching — every batch runs to the completion of its slowest
+  member while newly arrived requests queue.
+
+All decisions are deterministic functions of (arrival order, config,
+pool state): the ``events`` log of two runs over the same trace is
+identical (pinned by tests/unittest/test_serving.py).
+
+Block-allocation invariant: admission allocates every block the
+*context* (prompt + any recompute tokens) needs, so prefill itself
+never allocates; only admission and decode boundary-crossings touch the
+free list. A request whose total footprint (context + max_new_tokens)
+can never fit the pool or the model's ``max_seq_len`` is rejected at
+submit time, not deadlocked.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+
+import numpy as np
+
+from .kv_cache import blocks_for_tokens
+
+__all__ = ["Request", "Scheduler", "StepPlan",
+           "QUEUED", "PREFILL", "DECODE", "FINISHED", "CANCELLED"]
+
+QUEUED, PREFILL, DECODE, FINISHED, CANCELLED = (
+    "queued", "prefill", "decode", "finished", "cancelled")
+
+_rid = itertools.count()
+
+
+class Request:
+    """One generation request tracked by the scheduler."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "state",
+                 "blocks", "context", "prefilled", "generated",
+                 "submit_t", "first_token_t", "last_token_t", "finish_t",
+                 "evictions", "cancel_requested", "stream")
+
+    def __init__(self, prompt, max_new_tokens, eos_id=None, stream=None):
+        self.rid = next(_rid)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.state = QUEUED
+        self.blocks = []
+        # context = tokens whose KV must be in the pool before decode:
+        # the prompt, plus already-generated tokens after an eviction
+        # (recompute-style preemption keeps the client's stream intact)
+        self.context = self.prompt
+        self.prefilled = 0
+        self.generated = []
+        self.submit_t = None
+        self.first_token_t = None
+        self.last_token_t = None
+        self.finish_t = None
+        self.evictions = 0
+        self.cancel_requested = False
+        self.stream = stream
+
+    @property
+    def ctx_len(self):
+        return int(self.context.shape[0])
+
+    def total_len(self):
+        """Worst-case sequence length this request can reach."""
+        return int(self.prompt.shape[0]) + self.max_new_tokens
+
+
+class StepPlan:
+    """What one engine step should run."""
+
+    __slots__ = ("decode", "prefill")
+
+    def __init__(self, decode, prefill):
+        self.decode = decode        # [Request] — one token each
+        self.prefill = prefill      # [(Request, chunk_start, chunk_len)]
+
+    def __bool__(self):
+        return bool(self.decode or self.prefill)
+
+
+class Scheduler:
+    """Admission / eviction / step planning over a PagedKVPool.
+
+    Parameters
+    ----------
+    pool : PagedKVPool
+    max_batch : int
+        Concurrent active (prefill+decode) requests.
+    prefill_chunk : int
+        Max prompt tokens prefilled per request per step.
+    token_budget : int
+        Per-step cap on total tokens entering the model: the decode
+        batch (1/request) plus prefill chunks must fit under it.
+    policy : "continuous" | "static"
+    """
+
+    def __init__(self, pool, max_batch=8, prefill_chunk=128,
+                 token_budget=None, policy="continuous", max_active=None):
+        if policy not in ("continuous", "static"):
+            raise ValueError("unknown policy %r" % (policy,))
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.prefill_chunk = int(prefill_chunk)
+        self.token_budget = int(token_budget if token_budget is not None
+                                else self.max_batch + self.prefill_chunk)
+        self.policy = policy
+        # admission depth: more requests than one decode batch may be
+        # active so freshly-prefilled requests backfill drained decode
+        # slots immediately (decode occupancy is the throughput lever);
+        # static keeps depth == batch (one batch at a time, by design)
+        if policy == "static":
+            self.max_active = self.max_batch
+        else:
+            self.max_active = int(max_active if max_active is not None
+                                  else 2 * self.max_batch)
+        self.queue = collections.deque()
+        self.active = []          # admission-ordered PREFILL/DECODE reqs
+        self.events = []          # deterministic audit log
+        self.counts = collections.Counter()
+
+    # -- intake --------------------------------------------------------------
+    def max_request_tokens(self):
+        """Largest total sequence the pool geometry can ever host."""
+        return self.pool.capacity * self.pool.block_size
+
+    def submit(self, req):
+        """Queue a request (depth limits are the engine's concern)."""
+        self.queue.append(req)
+
+    def cancel(self, req):
+        req.cancel_requested = True
+
+    # -- internal helpers ----------------------------------------------------
+    def _finish(self, req, state, event):
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        req.state = state
+        if req in self.active:
+            self.active.remove(req)
+        self.events.append((event, req.rid))
+        self.counts[event] += 1
+
+    def finish(self, req):
+        """Mark a running request complete (engine calls after the stop
+        condition trips)."""
+        self._finish(req, FINISHED, "complete")
+
+    def _sweep_cancelled(self):
+        for req in [r for r in self.active if r.cancel_requested]:
+            self._finish(req, CANCELLED, "cancel")
+        kept = [r for r in self.queue if not r.cancel_requested]
+        for req in self.queue:
+            if req.cancel_requested:
+                req.state = CANCELLED
+                self.events.append(("cancel", req.rid))
+                self.counts["cancel"] += 1
+        if len(kept) != len(self.queue):
+            self.queue = collections.deque(kept)
+
+    def _admit_one(self, req):
+        need = blocks_for_tokens(req.ctx_len, self.pool.block_size)
+        if self.policy == "static":
+            # static batches are sized once: reserve the whole worst
+            # case so the batch can always run to completion
+            need = blocks_for_tokens(req.total_len(), self.pool.block_size)
+        blocks = self.pool.alloc(need)
+        if blocks is None:
+            return False
+        req.blocks = blocks
+        req.state = PREFILL
+        req.prefilled = 0
+        self.active.append(req)
+        self.events.append(("admit", req.rid))
+        self.counts["admit"] += 1
+        return True
+
+    def _admit(self):
+        if self.policy == "static" and self.active:
+            return  # classic static batching: drain before refill
+        while self.queue and len(self.active) < self.max_active:
+            if not self._admit_one(self.queue[0]):
+                break  # OOM backpressure: wait for frees
+            self.queue.popleft()
+
+    def _evict_youngest(self):
+        """Preempt the newest active request; returns it (or None)."""
+        if not self.active:
+            return None
+        victim = self.active.pop()
+        self.pool.free(victim.blocks)
+        victim.blocks = []
+        # recompute context: everything already streamed is folded in
+        victim.context = np.concatenate(
+            [victim.context,
+             np.asarray(victim.generated[
+                 len(victim.context) - len(victim.prompt):], np.int32)])
+        victim.prefilled = 0
+        victim.state = QUEUED
+        victim.evictions += 1
+        self.queue.appendleft(victim)
+        self.events.append(("evict", victim.rid))
+        self.counts["evict"] += 1
+        return victim
+
+    def _ensure_decode_block(self, req):
+        """Make sure the slot for this step's KV write exists;
+        evict-youngest until it does (the request itself may be the
+        youngest, in which case it preempts itself and the step skips
+        it). False = req can't decode this step.
+
+        The slot written during decode is the *input* token's position:
+        the engine feeds ``generated[-1]``, which lives at global
+        position ``len(prompt) + len(generated) - 1`` (the recompute
+        fold moves tokens between context and generated but never moves
+        their global positions)."""
+        pos = len(req.prompt) + len(req.generated) - 1
+        need = pos // self.pool.block_size + 1
+        while need > len(req.blocks):
+            got = self.pool.alloc(need - len(req.blocks))
+            if got is not None:
+                req.blocks.extend(got)
+                return True
+            victim = self._evict_youngest()
+            if victim is None or victim is req:
+                return False
+        return True
+
+    # -- planning ------------------------------------------------------------
+    def plan(self):
+        """One step's work. Mutates state (admissions, evictions,
+        allocations) and returns a StepPlan."""
+        self._sweep_cancelled()
+        self._admit()
+
+        decode = []
+        cap = min(self.max_batch, self.token_budget)
+        # iterate a snapshot: _ensure_decode_block may evict the
+        # youngest active request mid-loop. Eviction always moves the
+        # victim's state to QUEUED, so the state check below filters
+        # both never-decoding and just-evicted requests; victims are
+        # the newest member of `active`, so an already-collected
+        # (older) decode entry can never be evicted by a later one.
+        for req in list(self.active):
+            if req.state != DECODE:
+                continue
+            if len(decode) >= cap:
+                break
+            if self._ensure_decode_block(req):
+                decode.append(req)
+
+        budget = self.token_budget - len(decode)
+        prefill = []
+        for req in self.active:
+            if req.state != PREFILL or budget <= 0:
+                continue
+            chunk = min(self.prefill_chunk, req.ctx_len - req.prefilled,
+                        budget)
+            if chunk <= 0:
+                continue
+            prefill.append((req, req.prefilled, chunk))
+            budget -= chunk
+        return StepPlan(decode, prefill)
+
+    # -- engine feedback -----------------------------------------------------
+    def note_prefilled(self, req, chunk_len):
+        req.prefilled += chunk_len
+        if req.prefilled >= req.ctx_len:
+            req.state = DECODE
+
+    def utilization(self):
+        return self.pool.utilization()

@@ -9,13 +9,13 @@ from mxnet_tpu.parallel import create_mesh, make_train_step, ShardedTrainer
 from mxnet_tpu.parallel.ring_attention import make_ring_attention, ring_attention
 
 
-def _dense_attention(q, k, v, causal=True):
+def _dense_attention(q, k, v, causal=True, q_offset=0):
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
-        T = q.shape[2]
-        mask = np.tril(np.ones((T, T), bool))
-        scores = np.where(mask, scores, -1e30)
+        iq = np.arange(q.shape[2])[:, None] + q_offset
+        ik = np.arange(k.shape[2])[None, :]
+        scores = np.where(ik <= iq, scores, -1e30)
     p = np.exp(scores - scores.max(-1, keepdims=True))
     p = p / p.sum(-1, keepdims=True)
     return np.einsum("bhqk,bhkd->bhqd", p, v)
@@ -94,6 +94,80 @@ def test_ring_attention_matches_dense():
     out = np.array(ring(q, k, v))
     ref = _dense_attention(q, k, v, causal=True)
     assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_ring_attention_q_offset_chunked_prefill():
+    """The serving chunked-prefill geometry: queries are the LAST C
+    tokens of a longer key sequence (q_offset = prefix length). Ring
+    with q_offset must match dense offset-causal attention for every
+    chunk position."""
+    mesh = create_mesh((4,), ("seq",))
+    B, H, D = 1, 2, 8
+    C, T = 16, 48  # chunk length, full key length
+    rng = np.random.RandomState(11)
+    k = rng.randn(B, H, T, D).astype("f")
+    v = rng.randn(B, H, T, D).astype("f")
+    for off in (0, 16, 32):
+        q = rng.randn(B, H, C, D).astype("f")
+        ring = make_ring_attention(mesh, seq_axis="seq", causal=True,
+                                   q_offset=off)
+        out = np.array(ring(q, k[:, :, :off + C], v[:, :, :off + C]))
+        ref = _dense_attention(q, k[:, :, :off + C], v[:, :, :off + C],
+                               causal=True, q_offset=off)
+        assert np.allclose(out, ref, atol=1e-4), (off,
+                                                  np.abs(out - ref).max())
+
+
+def test_ulysses_q_offset_matches_ring():
+    """Both context-parallel schemes agree on the rectangular
+    chunked-prefill case (q shorter than k, offset causal masking)."""
+    from mxnet_tpu.parallel import make_ulysses_attention
+
+    mesh = create_mesh((2,), ("seq",))
+    B, H, D = 1, 2, 8
+    C, off = 8, 16
+    rng = np.random.RandomState(12)
+    q = rng.randn(B, H, C, D).astype("f")
+    k = rng.randn(B, H, off + C, D).astype("f")
+    v = rng.randn(B, H, off + C, D).astype("f")
+    uly = make_ulysses_attention(mesh, seq_axis="seq", causal=True,
+                                 q_offset=off)
+    ring = make_ring_attention(mesh, seq_axis="seq", causal=True,
+                               q_offset=off)
+    out_u = np.array(uly(q, k, v))
+    out_r = np.array(ring(q, k, v))
+    ref = _dense_attention(q, k, v, causal=True, q_offset=off)
+    assert np.allclose(out_u, ref, atol=1e-4)
+    assert np.allclose(out_u, out_r, atol=1e-4)
+
+
+def test_cp_prefill_kv_matches_forward():
+    """serving.cp_prefill_kv (chunked context-parallel prefill over the
+    mesh) reproduces the training forward's final-position logits and
+    next token for both schemes."""
+    import jax
+
+    from mxnet_tpu.models.transformer import (TransformerConfig, forward,
+                                              init_params)
+    from mxnet_tpu.serving import cp_prefill_kv
+
+    mesh = create_mesh((4,), ("seq",))
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, d_model=32,
+                            num_heads=4, d_ff=64, max_seq_len=96,
+                            dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(0, 61, (32,)).astype(np.int32)
+    ref = np.asarray(forward(params, prompt[None], cfg))[0, -1]
+    embed = np.asarray(params["embed"], np.float32)
+    for kind in ("ring", "ulysses"):
+        k, v, x_last = cp_prefill_kv(params, cfg, prompt, mesh, kind=kind,
+                                     chunk=16)
+        logits = x_last @ embed.T
+        assert np.allclose(logits, ref, atol=2e-4), (
+            kind, np.abs(logits - ref).max())
+        assert int(np.argmax(logits)) == int(np.argmax(ref))
+        assert k.shape == (2, 32, 4, 8)
 
 
 def test_ring_attention_non_causal():

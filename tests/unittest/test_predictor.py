@@ -98,3 +98,25 @@ def test_compiled_export_roundtrip(tmp_path):
 
     with pytest.raises(mx.MXNetError):
         mx.predictor.load_compiled(b"JUNKDATA")
+
+
+def test_output_shape_cached_at_bind(tmp_path, monkeypatch):
+    """get_output_shape is served from the shapes cached at _bind time
+    (a full infer_shape graph walk per call is serving-path poison) and
+    refreshed by reshape()."""
+    _, _, prefix = _make_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 1, ctx=mx.cpu(), input_shapes={"data": (4, 784)})
+    assert pred.get_output_shape(0) == (4, 10)
+
+    # after bind, shape queries must not re-enter graph shape inference
+    def _boom(*a, **k):
+        raise AssertionError("get_output_shape re-ran infer_shape")
+
+    monkeypatch.setattr(type(pred._symbol), "infer_shape", _boom)
+    assert pred.get_output_shape(0) == (4, 10)
+    monkeypatch.undo()
+
+    # reshape re-binds and must refresh the cache
+    pred.reshape({"data": (2, 784)})
+    assert pred.get_output_shape(0) == (2, 10)

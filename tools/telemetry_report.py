@@ -105,6 +105,64 @@ def comm_compression(records):
     return counters.get("kvstore.wire_bytes_total", 0), logical
 
 
+def serving_timeline(records):
+    """[(t, serving.tokens_per_s)] across metric snapshots — the served
+    throughput over the run (bench_serve.py journals; spots admission
+    stalls, eviction storms, drain phases)."""
+    out = []
+    for r in metrics_records(records):
+        v = r.get("gauges", {}).get("serving.tokens_per_s")
+        if v is not None:
+            out.append((r.get("t", 0.0), float(v)))
+    return out
+
+
+def serving_section(records):
+    """Rendered lines for the serving engine, or [] when the journal
+    has no serving.* metrics (docs/how_to/serving.md catalog)."""
+    final = final_metrics(records)
+    if final is None:
+        return []
+    counters = final.get("counters", {})
+    gauges = final.get("gauges", {})
+    hists = final.get("histograms", {})
+    has = any(k.startswith("serving.")
+              for d in (counters, gauges, hists) for k in d)
+    if not has:
+        return []
+    lines = ["", "-- serving engine (mxserve) --"]
+    timeline = serving_timeline(records)
+    if timeline:
+        t0 = timeline[0][0]
+        vmax = max(v for _, v in timeline)
+        lines.append("  tokens/s timeline:")
+        for t, v in timeline:
+            lines.append("    t+%8.1fs %12.2f %s" % (t - t0, v,
+                                                     _bar(v, vmax)))
+    lat_rows = [("ttft", "serving.ttft_s"),
+                ("per-token", "serving.token_latency_s")]
+    have_lat = [r for r in lat_rows if r[1] in hists]
+    if have_lat:
+        lines.append("  %-12s %8s %10s %10s %10s %10s" % (
+            "latency", "count", "p50_s", "p95_s", "p99_s", "max_s"))
+        for label, name in have_lat:
+            s = hists[name]
+            lines.append("  %-12s %8d %10.6g %10.6g %10.6g %10.6g" % (
+                label, s.get("count", 0), s.get("p50") or 0,
+                s.get("p95") or 0, s.get("p99") or 0, s.get("max") or 0))
+    util = gauges.get("serving.kv_pool_utilization")
+    if util is not None:
+        lines.append("  kv pool: %.1f%% utilized (hwm %g blocks)"
+                     % (100.0 * util,
+                        gauges.get("serving.kv_pool_hwm_blocks", 0)))
+    reqs = sorted((k, v) for k, v in counters.items()
+                  if k.startswith("serving.requests_"))
+    if reqs:
+        lines.append("  requests: " + "  ".join(
+            "%s=%d" % (k.split("requests_")[-1], v) for k, v in reqs))
+    return lines
+
+
 def _human_bytes(n):
     for unit in ("B", "KB", "MB", "GB"):
         if n < 1024.0 or unit == "GB":
@@ -145,6 +203,8 @@ def render_report(records, top=10):
             "compression)"
             % (_human_bytes(wire), _human_bytes(logical),
                wire / logical, logical / wire if wire else float("inf")))
+
+    lines.extend(serving_section(records))
 
     lines.append("")
     lines.append("-- top spans by total time --")
