@@ -97,7 +97,11 @@ def load(name):
         if name in _cache:
             return _cache[name]
         lib = None
-        path = _build(name)
+        # the g++ compile runs UNDER the lock on purpose: two threads
+        # racing the first use of a component must not race the same
+        # .so build (one compiles, everyone else waits for the cache) —
+        # vetted blocking-under-lock
+        path = _build(name)  # mxlint: disable
         if path is not None:
             try:
                 lib = ctypes.CDLL(path)
@@ -110,7 +114,7 @@ def load(name):
                     os.unlink(path)
                 except OSError:
                     pass
-                path = _build(name)
+                path = _build(name)  # mxlint: disable (same: serialized rebuild)
                 if path is not None:
                     try:
                         lib = ctypes.CDLL(path)

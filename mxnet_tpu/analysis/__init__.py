@@ -11,6 +11,13 @@ Three coordinated passes, one Finding model (findings.py):
   ``MXNET_ENGINE_VERIFY=1``.
 - ``ast_lint``      — tracer-leak lint over jitted op bodies
   (np-on-tracer, tracer branches, host syncs).
+- ``lock_lint``     — mxrace concurrency lint over the lock-using
+  modules (lock-order inversions, blocking-under-lock, unguarded
+  fields, condition-variable misuse) + the static lock-order graph
+  cross-checked against runtime lock traces.
+- ``schedule``      — mxrace deterministic interleaving explorer:
+  seeded/exhaustive thread-schedule exploration with replayable
+  failure seeds (chaos testing for schedules).
 
 CLI: ``tools/mxlint.py`` / the ``mxlint`` console script (cli.py).
 
@@ -21,13 +28,19 @@ and CI wants the AST pass runnable without devices.
 from __future__ import annotations
 
 from .findings import Finding, max_severity, summarize
-from .engine_verify import EngineTrace, recording, verify as verify_trace
+from .engine_verify import (EngineTrace, TracedLock, maybe_trace_lock,
+                            observed_lock_edges, recording,
+                            verify as verify_trace)
 from .ast_lint import lint_file, lint_package, lint_source
 from .graph_lint import lint_json, lint_symbol
+from .lock_lint import (build_lock_graph, cross_check,
+                        lint_package as lint_locks)
 
 __all__ = [
     "Finding", "max_severity", "summarize",
     "EngineTrace", "recording", "verify_trace",
+    "TracedLock", "maybe_trace_lock", "observed_lock_edges",
     "lint_file", "lint_package", "lint_source",
     "lint_json", "lint_symbol",
+    "build_lock_graph", "cross_check", "lint_locks",
 ]

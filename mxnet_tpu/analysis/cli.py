@@ -1,13 +1,16 @@
-"""mxlint CLI: run the three analysis passes from the command line.
+"""mxlint CLI: run the analysis passes from the command line.
 
 Entry points: ``tools/mxlint.py`` (repo checkout) and the ``mxlint``
 console script (pyproject). Typical invocations::
 
-    mxlint --all                      # model zoo + ops package + engine
+    mxlint --all                      # zoo + ops + engine + lock lint
     mxlint --model mlp                # one zoo symbol
     mxlint --graph net.json           # a serialized symbol (dead nodes too)
     mxlint --ops mxnet_tpu/ops        # tracer-leak lint a file or package
     mxlint --engine-trace trace.json  # verify a recorded engine trace
+    mxlint --locks                    # concurrency lint, whole package
+    mxlint --locks some/module.py     # concurrency lint one file/dir
+    mxlint --schedules                # interleaving-explorer survival run
     mxlint --all --fail-on warning    # strict mode: warnings also fail
 
 Exit codes: 0 clean (no finding at/above --fail-on), 1 findings,
@@ -84,7 +87,26 @@ def main(argv=None):
     p.add_argument("--ops", action="append", default=[],
                    help="tracer-leak lint a .py file or package dir")
     p.add_argument("--engine-trace", action="append", default=[],
-                   help="verify a recorded engine trace JSON file")
+                   help="verify a recorded engine trace JSON file "
+                        "(push hazards AND runtime lock-order events)")
+    p.add_argument("--locks", action="append", nargs="?", const="",
+                   metavar="PATH", default=[],
+                   help="mxrace concurrency lint (lock-order inversions, "
+                        "blocking-under-lock, unguarded fields, cv "
+                        "misuse) over PATH — bare --locks lints the "
+                        "whole mxnet_tpu package")
+    p.add_argument("--schedules", action="store_true",
+                   help="mxrace interleaving-explorer survival run: "
+                        "seeded-race negative controls must be found "
+                        "and replayed, the serving submit/cancel/step "
+                        "loop and the elastic aggregator round protocol "
+                        "must survive every explored schedule")
+    p.add_argument("--schedule-seed", type=int,
+                   default=int(os.environ.get("MXRACE_SEED", "0") or 0),
+                   help="base seed for --schedules (env MXRACE_SEED)")
+    p.add_argument("--schedule-count", type=int, default=None,
+                   help="schedules per --schedules leg (env "
+                        "MXRACE_SCHEDULES, default 25)")
     p.add_argument("--fail-on", choices=list(SEVERITIES), default="error",
                    help="lowest severity that makes the exit code nonzero "
                         "(default: error)")
@@ -102,7 +124,7 @@ def main(argv=None):
             print(name)
         return 0
     if not (args.all or args.model or args.graph or args.ops
-            or args.engine_trace):
+            or args.engine_trace or args.locks or args.schedules):
         p.print_usage(sys.stderr)
         print("mxlint: nothing to do (try --all)", file=sys.stderr)
         return 2
@@ -113,6 +135,7 @@ def main(argv=None):
     trace_files = list(args.engine_trace)
     ops_paths = list(args.ops)
     model_names = list(args.model)
+    lock_paths = list(args.locks)
     run_selftest = False
     if args.all:
         model_names.extend(sorted(zoo_models()))
@@ -120,6 +143,8 @@ def main(argv=None):
 
         ops_paths.append(os.path.dirname(os.path.abspath(_ops_pkg.__file__)))
         run_selftest = True
+        if not lock_paths:
+            lock_paths.append("")  # whole-package concurrency lint
 
     def _load_error(path, e):
         print("mxlint: %s: %s: %s" % (path, type(e).__name__, e),
@@ -173,8 +198,25 @@ def main(argv=None):
             return _load_error(path, e)
         findings.extend(verify(trace))
         n_targets += 1
+    for path in lock_paths:
+        from .lock_lint import DEFAULT_PACKAGE, lint_package as lint_locks
+
+        try:
+            findings.extend(lint_locks(path or DEFAULT_PACKAGE))
+        except (OSError, SyntaxError) as e:  # unreadable / unparsable .py
+            return _load_error(path or DEFAULT_PACKAGE, e)
+        n_targets += 1
     if run_selftest:
         findings.extend(_engine_selftest())
+        n_targets += 1
+    if args.schedules:
+        from .schedule import survival_suite
+
+        fs, lines = survival_suite(seed=args.schedule_seed,
+                                   schedules=args.schedule_count)
+        for ln in lines:  # survival rows go to stderr: --json stays pure
+            print("mxrace: %s" % ln, file=sys.stderr)
+        findings.extend(fs)
         n_targets += 1
 
     findings.sort(key=lambda f: (-SEVERITIES.index(f.severity),

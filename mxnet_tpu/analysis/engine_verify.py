@@ -28,6 +28,16 @@ Checks (all 'engine' pass):
   whose pending ops include A itself or any op that (transitively)
   depends on A: A waits on work that cannot start until A completes.
   ``wait_for_all`` inside any engine op is an immediate cycle.
+- ``lock-order`` (error) — the trace also carries runtime lock
+  acquire/release events (``lock_acquire``/``lock_release``, recorded
+  by :class:`TracedLock` wrappers that the concurrent subsystems
+  install around their state locks under ``MXNET_ENGINE_VERIFY=1``).
+  Per-thread held stacks replay the events into an observed
+  acquisition-order edge set; two locks observed in both orders are a
+  deadlock cycle that actually happened order-wise at runtime. The
+  observed edges also cross-check the static graph from
+  ``lock_lint.build_lock_graph`` (``lock_lint.cross_check``): an edge
+  the static lint cannot see is a blind spot worth auditing.
 
 Record mode is engaged by ``MXNET_ENGINE_VERIFY=1`` (the engine then
 self-verifies on every wait and raises on findings) or programmatically:
@@ -44,12 +54,39 @@ builder methods the engine hooks call, and round-trip through
 from __future__ import annotations
 
 import json
+import os
 import threading
 from contextlib import contextmanager
 
 from .findings import Finding
 
-__all__ = ["TraceOp", "EngineTrace", "verify", "recording"]
+__all__ = ["TraceOp", "EngineTrace", "verify", "recording",
+           "TracedLock", "maybe_trace_lock", "ambient_trace",
+           "set_ambient_trace", "observed_lock_edges"]
+
+# lock events kept verbatim per trace (diagnostics + JSON round-trip);
+# the ORDER EDGES are folded incrementally so a suite-long ambient
+# trace stays O(distinct lock pairs), not O(acquisitions)
+_LOCK_EVENT_TAIL = 4096
+
+
+def _fold_lock_event(held, edges, seq, tid, name, kind):
+    """THE observed-lock-order edge semantics, shared by live recording
+    (lock_acquire/lock_release) and events-only JSON replay (from_json):
+    an acquire adds an edge from every lock the thread already holds
+    (self-edges — RLock re-entry — skipped; first seq wins), a release
+    pops the thread's innermost matching hold."""
+    stack = held.setdefault(tid, [])
+    if kind == "acquire":
+        for h in stack:
+            if h != name:
+                edges.setdefault((h, name), seq)
+        stack.append(name)
+    else:
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                break
 
 
 class TraceOp:
@@ -85,6 +122,11 @@ class EngineTrace:
         self.events = []    # [TraceOp]
         self.deletes = []   # [(seq, var)]
         self.waits = []     # [(seq, var-or-None, ctx-op-seq-or-None)]
+        # runtime lock discipline: bounded raw event tail + the folded
+        # observed-order edge set {(held, acquired): first seq}
+        self.lock_events = []   # [(seq, thread_id, name, 'acquire'|'release')]
+        self.lock_edges = {}
+        self._held = {}         # thread_id -> [lock name] stack
         self._lock = threading.Lock()
         self._seq = 0
         self._tls = threading.local()
@@ -130,6 +172,27 @@ class EngineTrace:
         with self._lock:
             self.waits.append((self._next_seq(), var, ctx))
 
+    # -- runtime lock events (TracedLock wrappers) -----------------------------
+    def lock_acquire(self, name, thread=None):
+        """Record that ``thread`` acquired lock ``name``. Folds the
+        observed-order edges (every currently held lock -> name)
+        immediately so the edge set stays bounded for suite-long
+        ambient traces; the raw event tail is capped."""
+        self._lock_event(name, "acquire", thread)
+
+    def lock_release(self, name, thread=None):
+        self._lock_event(name, "release", thread)
+
+    def _lock_event(self, name, kind, thread=None):
+        tid = threading.get_ident() if thread is None else thread
+        with self._lock:
+            seq = self._next_seq()
+            _fold_lock_event(self._held, self.lock_edges,
+                             seq, tid, name, kind)
+            self.lock_events.append((seq, tid, name, kind))
+            if len(self.lock_events) > _LOCK_EVENT_TAIL:
+                del self.lock_events[:_LOCK_EVENT_TAIL // 2]
+
     # -- executing-op context (set by the engine around fn execution) ----------
     @contextmanager
     def op_context(self, op):
@@ -145,6 +208,10 @@ class EngineTrace:
 
     # -- serialization ---------------------------------------------------------
     def to_json(self):
+        with self._lock:
+            return self._to_json_locked()
+
+    def _to_json_locked(self):
         return json.dumps({
             "events": [{
                 "seq": e.seq, "name": e.name,
@@ -154,6 +221,9 @@ class EngineTrace:
             } for e in self.events],
             "deletes": [[s, v] for s, v in self.deletes],
             "waits": [[s, v, c] for s, v, c in self.waits],
+            "lock_events": [list(e) for e in self.lock_events],
+            "lock_edges": [[a, b, s]
+                           for (a, b), s in sorted(self.lock_edges.items())],
         }, indent=2)
 
     @classmethod
@@ -176,6 +246,21 @@ class EngineTrace:
                 s, v, c = (list(w) + [None, None])[:3]
                 t.waits.append((int(s), v, c))
                 t._seq = max(t._seq, int(s))
+            for ev in data.get("lock_events", []):
+                s, tid, name, kind = ev
+                if kind not in ("acquire", "release"):
+                    raise ValueError("bad lock event kind %r" % (kind,))
+                t.lock_events.append((int(s), int(tid), name, kind))
+                t._seq = max(t._seq, int(s))
+            for a, b, s in data.get("lock_edges", []):
+                t.lock_edges[(a, b)] = int(s)
+            if t.lock_events and not t.lock_edges:
+                # events-only trace (hand-built JSON): replay through
+                # the SAME fold as live recording — one edge semantics
+                held = {}
+                for s, tid, name, kind in sorted(t.lock_events):
+                    _fold_lock_event(held, t.lock_edges, s, tid, name,
+                                     kind)
         except (KeyError, TypeError, AttributeError) as e:
             raise ValueError(
                 "malformed trace JSON: %s: %s" % (type(e).__name__, e)) \
@@ -305,6 +390,22 @@ def verify(trace, since_seq=0):
                     "%s -> %s" % (waiter.label(), e.label()),
                     "waits on var %r pending in %s, which depends on the "
                     "waiter — deadlock" % (v, e.label())))
+
+    # -- observed lock-order inversions ----------------------------------------
+    for (a, b), seq_ab in sorted(trace.lock_edges.items()):
+        if a >= b:
+            continue  # report each unordered pair once (from its
+            #            lexicographically first direction)
+        seq_ba = trace.lock_edges.get((b, a))
+        if seq_ba is None or max(seq_ab, seq_ba) < since_seq:
+            continue
+        findings.append(Finding(
+            "engine", "lock-order", "error",
+            "%s <-> %s" % (a, b),
+            "runtime lock trace observed %r acquired while holding %r "
+            "(seq %d) AND the reverse (seq %d): a deadlock cycle — two "
+            "threads taking the two paths concurrently wedge forever"
+            % (b, a, seq_ab, seq_ba)))
     return findings
 
 
@@ -317,3 +418,122 @@ def recording(engine):
         yield trace
     finally:
         engine.attach_trace(prev)
+
+
+# -- runtime lock tracing ------------------------------------------------------
+#
+# The concurrent subsystems (serving engine, elastic coordinator, the
+# dependency engine itself) wrap their state locks in TracedLock under
+# MXNET_ENGINE_VERIFY=1: every acquire/release lands in the process
+# AMBIENT trace, whose folded edge set is the *observed* lock-order
+# graph — checked for inversions by verify() and cross-checked against
+# the static graph from lock_lint.build_lock_graph.
+
+_ambient = None
+_ambient_lock = threading.Lock()
+
+
+def _verify_env_on():
+    return os.environ.get("MXNET_ENGINE_VERIFY", "").strip() \
+        not in ("", "0", "false")
+
+
+def ambient_trace(create=None):
+    """The process-wide lock trace. Created lazily when
+    MXNET_ENGINE_VERIFY=1 (or ``create=True``); None otherwise."""
+    global _ambient
+    # double-checked creation: the unlocked fast-path read is the point
+    # (this sits on every traced acquire) — a racing reader either sees
+    # the published trace or takes the lock
+    if _ambient is None and (create or (create is None  # mxlint: disable
+                                        and _verify_env_on())):
+        with _ambient_lock:
+            if _ambient is None:
+                _ambient = EngineTrace()
+    return _ambient  # mxlint: disable (same deliberate unlocked read)
+
+
+def set_ambient_trace(trace):
+    """Swap the ambient lock trace (tests); returns the previous one."""
+    global _ambient
+    with _ambient_lock:
+        prev, _ambient = _ambient, trace
+    return prev
+
+
+class TracedLock:
+    """A Lock/RLock/Condition proxy that records acquire/release into a
+    trace (default: the ambient trace at call time, so a test swapping
+    the ambient trace observes locks wrapped long before).
+
+    The proxy forwards everything else to the wrapped primitive —
+    including the private ``_release_save``/``_acquire_restore`` pair
+    ``threading.Condition`` uses, so a Condition built OVER a traced
+    lock works; the wait-window release/reacquire goes unrecorded
+    through those private hooks, which keeps the held-stack replay
+    consistent (the window is invisible, not torn)."""
+
+    __slots__ = ("_inner", "_name", "_trace")
+
+    def __init__(self, inner, name, trace=None):
+        self._inner = inner
+        self._name = name
+        self._trace = trace
+
+    def _t(self):
+        return self._trace if self._trace is not None else ambient_trace()
+
+    @property
+    def name(self):
+        return self._name
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            t = self._t()
+            if t is not None:
+                t.lock_acquire(self._name)
+        return got
+
+    def release(self):
+        t = self._t()
+        if t is not None:
+            t.lock_release(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, attr):
+        # _is_owned / _release_save / _acquire_restore / notify / wait …
+        return getattr(self._inner, attr)
+
+    def __repr__(self):
+        return "<TracedLock %s %r>" % (self._name, self._inner)
+
+
+def maybe_trace_lock(lock, name):
+    """Wrap ``lock`` in a TracedLock when MXNET_ENGINE_VERIFY=1; return
+    it untouched otherwise — the zero-overhead-by-default wiring the
+    subsystems call at construction time."""
+    if _verify_env_on():
+        return TracedLock(lock, name)
+    return lock
+
+
+def observed_lock_edges(trace=None):
+    """{(held, acquired): first seq} from a trace (default ambient).
+    Feed to ``lock_lint.cross_check`` against the static graph."""
+    trace = trace if trace is not None else ambient_trace(create=False)
+    if trace is None:
+        return {}
+    with trace._lock:
+        return dict(trace.lock_edges)

@@ -1,0 +1,1247 @@
+"""mxrace lock-discipline lint: concurrency static analysis over the
+threaded runtime.
+
+The repo runs several heavily concurrent subsystems (the elastic TCP
+coordinator, the serving engine's background drive loop, the dependency
+engine's worker pool, the async kvstore server) and every one of them
+has needed hand-caught lock-discipline fixes in review — pickle
+encode/decode moved outside a state lock, long-poll caps reasoned
+against socket timeouts by hand. This pass mechanizes exactly that
+review: a static AST walk over every lock-using module that builds a
+per-class/per-module lock-acquisition graph and flags the four bug
+classes that actually bite this codebase.
+
+Detectors (all ``locks`` pass):
+
+- ``lock-inversion`` (error) — two locks are acquired in both orders
+  on some pair of code paths: the classic deadlock cycle. Edges come
+  from nested ``with`` blocks, bare ``.acquire()`` intervals, and
+  (depth-bounded) calls into same-module functions/methods that
+  acquire locks of their own.
+- ``blocking-under-lock`` (warning) — a blocking operation runs while
+  a lock is held: ``time.sleep``, socket send/recv/accept/connect,
+  ``pickle`` encode/decode, framed-RPC helpers (``send_msg`` /
+  ``recv_msg`` / ``protocol.call``), device sync / D2H
+  (``.block_until_ready()``, ``jax.device_get``, ``.asnumpy()``),
+  potential jit compiles (``jax.*`` / ``jnp.*`` calls), blocking
+  ``queue.get``, ``subprocess``, ``os.fsync``, and ``Thread.join``.
+  Every other request, heartbeat and wait in the process serializes
+  behind that lock for the op's whole duration. (``Condition.wait``
+  is NOT flagged — it releases the lock by contract.)
+- ``unguarded-field`` (warning for writes, info for reads) — a field
+  written under the class's (or module's) lock in one method but
+  written — or read, at info severity, since the GIL makes many racy
+  reads deliberate — without it elsewhere. ``__init__``/``__del__``,
+  methods reachable only from ``__init__`` (pre-publication), and
+  methods whose name ends in ``_locked`` (the caller-holds-the-lock
+  convention used throughout this repo) are exempt.
+- ``cv-wait-no-loop`` (error) — ``Condition.wait`` outside a ``while``
+  predicate loop: wakeups are spurious and racy by contract, the
+  predicate must be re-checked.
+- ``cv-notify-unlocked`` (error) — ``notify``/``notify_all`` without
+  holding the condition's lock: raises RuntimeError at runtime, or —
+  with a foreign lock held instead — wakes waiters into a torn state.
+- ``cv-wait-timeout`` (warning) — a ``Condition.wait(t)`` whose
+  numeric budget is >= a socket timeout derivable from the same module
+  (``settimeout(n)`` / ``create_connection(..., timeout=n)`` literals
+  or a module-level ``*TIMEOUT*`` constant): the peer's socket gives
+  up before the wait does, so a healthy reply lands after the caller
+  stopped listening (the exact bug class of the long-poll cap).
+
+A line ending in ``# mxlint: disable`` suppresses findings on it (same
+pragma as the tracer pass); pragma'd findings should carry a one-line
+justification in the surrounding comment.
+
+The pass also exports the static lock-order graph
+(:func:`build_lock_graph`) so live lock traces recorded by
+``engine_verify`` under ``MXNET_ENGINE_VERIFY=1`` can be cross-checked
+against it (:func:`cross_check`): an observed acquisition order absent
+from the static graph is a lint blind spot (unresolvable indirection),
+an observed inversion is a deadlock in waiting.
+
+Scope honesty: lock identity is resolved per class and per module —
+``self.X``, ``Cls.X`` and module-level names. Locks reached through a
+foreign object's attribute (``self.pool.lock``) are not resolved, and
+call-through edges only follow same-module callees (depth-bounded).
+The live cross-check exists precisely to catch what this misses.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding
+
+__all__ = ["lint_source", "lint_file", "lint_package",
+           "build_lock_graph", "cross_check", "DEFAULT_PACKAGE"]
+
+_PRAGMA = "mxlint: disable"
+_LOCK_FACTORIES = {"Lock", "RLock"}
+_COND_FACTORY = "Condition"
+_CALL_DEPTH = 4          # interprocedural propagation bound
+
+# blocking calls by dotted-attribute tail (obj.<name>(...))
+_BLOCKING_METHODS = {
+    "recv": "socket recv", "recv_into": "socket recv",
+    "recvfrom": "socket recv", "recvmsg": "socket recv",
+    "send": "socket send", "sendall": "socket send",
+    "sendmsg": "socket send", "accept": "socket accept",
+    "connect": "socket connect",
+    "block_until_ready": "device sync",
+    "asnumpy": "device->host copy",
+    "communicate": "subprocess wait",
+}
+# blocking calls by full dotted path root.attr
+_BLOCKING_DOTTED = {
+    ("time", "sleep"): "time.sleep",
+    ("os", "fsync"): "os.fsync",
+    ("pickle", "dumps"): "pickle encode",
+    ("pickle", "loads"): "pickle decode",
+    ("pickle", "dump"): "pickle encode",
+    ("pickle", "load"): "pickle decode",
+    ("socket", "create_connection"): "socket connect",
+    ("subprocess", "run"): "subprocess",
+    ("subprocess", "check_call"): "subprocess",
+    ("subprocess", "check_output"): "subprocess",
+    ("subprocess", "Popen"): "subprocess spawn",
+    ("jax", "device_get"): "device->host copy",
+    ("protocol", "call"): "framed RPC round-trip",
+}
+# bare-name blocking calls (from-imports and repo RPC helpers)
+_BLOCKING_NAMES = {
+    "send_msg": "framed RPC send",
+    "recv_msg": "framed RPC recv",
+    "sleep": None,  # only when imported from time (checked at scan)
+}
+# roots whose any call under a lock is a potential trace/compile or
+# device dispatch (the "jit compiles under a lock" class)
+_JAX_ROOTS = {"jax", "jnp"}
+
+# obj.method() callee resolution skips these too-common names: resolving
+# dict.get/list.append against a same-module class is FP fuel
+_COMMON_METHODS = {
+    "get", "set", "put", "pop", "add", "append", "extend", "insert",
+    "remove", "discard", "update", "clear", "copy", "items", "keys",
+    "values", "read", "write", "close", "open", "join", "start", "stop",
+    "wait", "notify", "notify_all", "acquire", "release", "index",
+    "count", "sort", "split", "strip", "format", "encode", "decode",
+    "setdefault", "popleft", "appendleft", "flush", "fileno", "search",
+    "match", "findall", "group", "step", "run", "send", "recv",
+}
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "popleft", "appendleft",
+    "remove", "clear", "update", "setdefault", "add", "discard",
+    "put", "sort",
+}
+_EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+def _attr_chain(expr):
+    """('a','b','c') for a.b.c, or None when the chain isn't pure names."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _find_lock_factory(call, threading_names):
+    """The threading.Lock/RLock/Condition call inside ``call``, looking
+    through one wrapper layer (``maybe_trace_lock(threading.Lock(), ..)``
+    — the traced-lock idiom must still register as a lock)."""
+    if not isinstance(call, ast.Call):
+        return None
+    chain = _attr_chain(call.func)
+    name = None
+    if chain and len(chain) == 2 and chain[0] in threading_names:
+        name = chain[1]
+    elif isinstance(call.func, ast.Name) and \
+            call.func.id in _LOCK_FACTORIES | {_COND_FACTORY}:
+        name = call.func.id  # from threading import Lock
+    if name in _LOCK_FACTORIES:
+        return ("lock", call)
+    if name == _COND_FACTORY:
+        return ("cond", call)
+    for a in call.args:
+        found = _find_lock_factory(a, threading_names)
+        if found:
+            return found
+    return None
+
+
+class _LockInfo:
+    __slots__ = ("key", "kind", "alias", "lineno")
+
+    def __init__(self, key, kind, alias=None, lineno=0):
+        self.key = key      # 'mod:NAME' | 'mod:Cls.NAME'
+        self.kind = kind    # 'lock' | 'cond'
+        self.alias = alias  # cond built over an existing lock: its key
+        self.lineno = lineno
+
+    def order_key(self):
+        """Identity used in the acquisition graph: a condition over an
+        explicit lock IS that lock."""
+        return self.alias or self.key
+
+
+class _FnInfo:
+    """Per-function facts gathered in pass 1."""
+
+    __slots__ = ("name", "qual", "cls", "node", "acquires", "blocking",
+                 "calls", "order_edges", "field_writes", "field_reads",
+                 "lock_ctx_lines", "has_direct_lock_ctx")
+
+    def __init__(self, name, qual, cls, node):
+        self.name = name
+        self.qual = qual          # 'Cls.meth' | 'func'
+        self.cls = cls            # class name or None
+        self.node = node
+        self.acquires = set()     # lock order-keys acquired anywhere
+        self.blocking = []        # [(lineno, desc)] regardless of held
+        self.calls = []           # [(callee_ref, lineno, frozenset(held))]
+        self.order_edges = []     # [(held_key, acquired_key, lineno)]
+        self.field_writes = []    # [(field, lineno, bool(held))]
+        self.field_reads = []     # [(field, lineno, bool(held))]
+        self.lock_ctx_lines = []  # [(lineno, frozenset(held))] per stmt
+        self.has_direct_lock_ctx = False
+
+
+class _ModuleScan:
+    """One module's lock inventory + per-function facts."""
+
+    def __init__(self, tree, src, filename, modname):
+        self.tree = tree
+        self.filename = filename
+        self.modname = modname
+        self.src_lines = src.splitlines()
+        self.threading_names = set()
+        self.from_time_sleep = False
+        self.locks = {}        # resolution key -> _LockInfo
+        self.classes = {}      # cls name -> ClassDef
+        self.class_methods = {}  # cls -> {meth name -> _FnInfo}
+        self.mod_funcs = {}    # func name -> _FnInfo
+        self.method_index = {} # meth name -> [qual] across classes
+        self.queues = set()    # resolution keys assigned queue.Queue()
+        self.threads = set()   # resolution keys assigned threading.Thread
+        self.socket_timeouts = []  # (value, lineno) literals in module
+        self._scan_imports()
+        self._scan_locks()
+
+    # -- inventory -------------------------------------------------------------
+    def _scan_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        self.threading_names.add(a.asname or "threading")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for a in node.names:
+                        if a.name == "sleep":
+                            self.from_time_sleep = True
+
+    def _res_key(self, target, cls):
+        """Resolution key for an assignment target / lock expression:
+        module-level ``NAME``, class-level ``Cls.NAME``, instance
+        ``Cls.self.NAME`` (folded to ``Cls.NAME``)."""
+        if isinstance(target, ast.Name):
+            return ("%s.%s" % (cls, target.id)) if cls else target.id
+        chain = _attr_chain(target)
+        if chain and len(chain) == 2:
+            root, attr = chain
+            if root in ("self", "cls") and cls:
+                return "%s.%s" % (cls, attr)
+            if root in self.classes or (cls and root == cls):
+                return "%s.%s" % (root, attr)
+        return None
+
+    def _register_lock(self, target, value, cls):
+        found = _find_lock_factory(value, self.threading_names)
+        kind = None
+        if found:
+            kind, call = found
+        elif isinstance(value, ast.Call):
+            chain = _attr_chain(value.func)
+            if chain and chain[-1] == "Queue":
+                key = self._res_key(target, cls)
+                if key:
+                    self.queues.add(key)
+                return
+            if chain and chain[-1] == "Thread":
+                key = self._res_key(target, cls)
+                if key:
+                    self.threads.add(key)
+                return
+        if kind is None:
+            return
+        key = self._res_key(target, cls)
+        if key is None:
+            return
+        alias = None
+        if kind == "cond" and call.args:
+            alias_key = self._res_key(call.args[0], cls)
+            if alias_key in self.locks:
+                alias = self.locks[alias_key].order_key()
+            elif alias_key:
+                alias = "%s:%s" % (self.modname, alias_key)
+        full = "%s:%s" % (self.modname, key)
+        self.locks[key] = _LockInfo(full, kind, alias, value.lineno)
+
+    def _scan_locks(self):
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+        for node in ast.walk(self.tree):
+            cls = None
+            if isinstance(node, ast.ClassDef):
+                cls = node.name
+                body_iter = ast.walk(node)
+            elif node is self.tree:
+                body_iter = [node]
+            else:
+                continue
+            for sub in body_iter:
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        self._register_lock(t, sub.value, cls)
+        # module-level assigns (cls=None)
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._register_lock(t, node.value, None)
+            # module-level socket-timeout constants: NAME with TIMEOUT /
+            # WAIT_CAP-ish spelling bound to a number
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, (int, float)):
+                nm = node.targets[0].id.upper()
+                if "TIMEOUT" in nm:
+                    self.socket_timeouts.append(
+                        (float(node.value.value), node.lineno))
+        # socket timeout literals anywhere: settimeout(n) /
+        # create_connection(..., timeout=n) / call(..., timeout=n)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            tail = chain[-1] if chain else None
+            if tail == "settimeout" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, (int, float)):
+                self.socket_timeouts.append(
+                    (float(node.args[0].value), node.lineno))
+            elif tail in ("create_connection", "call"):
+                for kw in node.keywords:
+                    if kw.arg == "timeout" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, (int, float)):
+                        self.socket_timeouts.append(
+                            (float(kw.value.value), node.lineno))
+
+    # -- helpers ---------------------------------------------------------------
+    def lock_of(self, expr, cls):
+        key = self._res_key(expr, cls)
+        if key is None:
+            return None
+        return self.locks.get(key)
+
+    def suppressed(self, lineno):
+        if 1 <= lineno <= len(self.src_lines):
+            return _PRAGMA in self.src_lines[lineno - 1]
+        return False
+
+    def class_locks(self, cls):
+        """Order-keys of the locks a class owns (instance + class level)."""
+        out = set()
+        for key, info in self.locks.items():
+            if key.startswith(cls + "."):
+                out.add(info.order_key())
+        return out
+
+    def module_locks(self):
+        return {i.order_key() for k, i in self.locks.items() if "." not in k}
+
+
+class _FnWalker:
+    """Pass 1 over one function body: held-set tracking + fact capture.
+
+    Held locks come from two sources: ``with`` blocks (tracked as a
+    stack during the recursive walk) and bare ``.acquire()`` /
+    ``.release()`` calls (tracked as line intervals — an unmatched
+    leading ``release()`` means the lock was held on entry, the
+    droplock idiom; an unmatched trailing ``acquire()`` holds to the
+    end of the function)."""
+
+    def __init__(self, scan, fn, cls):
+        self.scan = scan
+        self.fn = fn
+        self.cls = cls
+        self.info = _FnInfo(fn.node.name, fn.qual, cls, fn.node)
+        self.manual = {}   # order-key -> [(start_line, end_line)]
+        self._collect_manual_intervals()
+
+    # -- manual acquire()/release() intervals ----------------------------------
+    def _collect_manual_intervals(self):
+        events = []  # (lineno, 'a'|'r', order_key)
+        for node in ast.walk(self.fn.node):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("acquire", "release"):
+                continue
+            lk = self.scan.lock_of(node.func.value, self.cls)
+            if lk is None:
+                continue
+            events.append((node.lineno,
+                           "a" if node.func.attr == "acquire" else "r",
+                           lk.order_key()))
+        end = getattr(self.fn.node, "end_lineno", None) or (1 << 30)
+        start = self.fn.node.lineno
+        per = {}
+        for lineno, kind, key in sorted(events):
+            st = per.setdefault(key, [])
+            if kind == "a":
+                st.append(lineno)
+            else:
+                if st:
+                    a = st.pop()
+                    self.manual.setdefault(key, []).append((a, lineno))
+                else:
+                    # release with no prior acquire: held on entry
+                    self.manual.setdefault(key, []).append((start, lineno))
+        for key, st in per.items():
+            for a in st:
+                self.manual.setdefault(key, []).append((a, end))
+
+    def _manual_held(self, lineno):
+        out = set()
+        for key, spans in self.manual.items():
+            for a, b in spans:
+                if a <= lineno < b:
+                    out.add(key)
+                    break
+        return out
+
+    def _convention_held(self):
+        """``*_locked`` naming convention: the caller holds the lock.
+        Resolvable to a concrete lock only when the class (or module)
+        owns exactly one."""
+        if not self.fn.node.name.endswith("_locked"):
+            return set()
+        owned = (self.scan.class_locks(self.cls) if self.cls
+                 else self.scan.module_locks())
+        if len(owned) == 1:
+            return set(owned)
+        return {"<%s convention>" % (self.cls or self.scan.modname)} \
+            if owned else set()
+
+    # -- the walk --------------------------------------------------------------
+    def run(self):
+        base = self._convention_held()
+        if base:
+            self.info.has_direct_lock_ctx = True
+        self._walk_body(self.fn.node.body, list(base), in_while=False)
+        return self.info
+
+    def _held_at(self, node, with_held):
+        return set(with_held) | self._manual_held(node.lineno)
+
+    def _walk_body(self, stmts, held, in_while):
+        for stmt in stmts:
+            self._walk_stmt(stmt, held, in_while)
+
+    def _walk_stmt(self, stmt, held, in_while):
+        if isinstance(stmt, ast.With):
+            inner = list(held)
+            for item in stmt.items:
+                lk = self.scan.lock_of(item.context_expr, self.cls)
+                if lk is not None:
+                    self._note_acquire(lk.order_key(), item.context_expr,
+                                       inner)
+                    inner = inner + [lk.order_key()]
+                    self.info.has_direct_lock_ctx = True
+                else:
+                    self._walk_expr(item.context_expr, held, in_while)
+            self._walk_body(stmt.body, inner, in_while)
+            return
+        if isinstance(stmt, ast.While):
+            self._walk_expr(stmt.test, held, in_while)
+            self._walk_body(stmt.body, held, in_while=True)
+            self._walk_body(stmt.orelse, held, in_while)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs execute later, analyzed separately
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._walk_expr(stmt.iter, held, in_while)
+            self._walk_body(stmt.body, held, in_while)
+            self._walk_body(stmt.orelse, held, in_while)
+            return
+        if isinstance(stmt, (ast.If,)):
+            self._walk_expr(stmt.test, held, in_while)
+            self._walk_body(stmt.body, held, in_while)
+            self._walk_body(stmt.orelse, held, in_while)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, held, in_while)
+            for h in stmt.handlers:
+                self._walk_body(h.body, held, in_while)
+            self._walk_body(stmt.orelse, held, in_while)
+            self._walk_body(stmt.finalbody, held, in_while)
+            return
+        # leaf statements: record field accesses + expression facts
+        self._record_fields(stmt, held)
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.expr):
+                self._walk_expr_leaf(node, held, in_while)
+
+    def _walk_expr(self, expr, held, in_while):
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.expr):
+                self._walk_expr_leaf(node, held, in_while)
+
+    # -- leaf analysis ---------------------------------------------------------
+    def _note_acquire(self, key, node, held_before):
+        manual = self._manual_held(node.lineno)
+        for h in list(held_before) + list(manual):
+            if h != key:
+                self.info.order_edges.append((h, key, node.lineno))
+        self.info.acquires.add(key)
+
+    def _record_fields(self, stmt, with_held):
+        """self.FIELD loads/stores on this statement (class methods)."""
+        if self.cls is None:
+            self._record_globals(stmt, with_held)
+            return
+        held = bool(self._held_at(stmt, with_held))
+
+        def is_self_attr(node):
+            return (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self")
+
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Attribute) and is_self_attr(node):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    self.info.field_writes.append(
+                        (node.attr, node.lineno, held))
+                else:
+                    self.info.field_reads.append(
+                        (node.attr, node.lineno, held))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    is_self_attr(node.value):
+                self.info.field_writes.append(
+                    (node.value.attr, node.lineno, held))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS and \
+                    is_self_attr(node.func.value):
+                self.info.field_writes.append(
+                    (node.func.value.attr, node.lineno, held))
+
+    def _record_globals(self, stmt, with_held):
+        """Module-level function: global-name accesses against module
+        locks. 4-tuples (name, lineno, held, kind): kind 'name' is a
+        plain NAME store (a global only when declared ``global``),
+        'sub'/'mut' are subscript stores and mutator-method calls on a
+        NAME (global mutations whenever the name is not a local)."""
+        for node in ast.walk(stmt):
+            if not hasattr(node, "lineno"):
+                continue
+            held = bool(self._held_at(node, with_held))
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.info.field_writes.append(
+                    (node.id, node.lineno, held, "name"))
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    isinstance(node.value, ast.Name):
+                self.info.field_writes.append(
+                    (node.value.id, node.lineno, held, "sub"))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS and \
+                    isinstance(node.func.value, ast.Name):
+                self.info.field_writes.append(
+                    (node.func.value.id, node.lineno, held, "mut"))
+            elif isinstance(node, ast.Name):
+                self.info.field_reads.append(
+                    (node.id, node.lineno, held, "name"))
+
+    def _is_cond(self, expr):
+        lk = self.scan.lock_of(expr, self.cls)
+        return lk if (lk is not None and lk.kind == "cond") else None
+
+    def _walk_expr_leaf(self, node, with_held, in_while):
+        if not isinstance(node, ast.Call):
+            return
+        held = self._held_at(node, with_held)
+        # condition-variable use
+        if isinstance(node.func, ast.Attribute):
+            cond = self._is_cond(node.func.value)
+            if cond is not None:
+                if node.func.attr == "wait":
+                    if not in_while:
+                        self._cv_finding(
+                            node, "cv-wait-no-loop",
+                            "Condition.wait outside a while predicate "
+                            "loop: wakeups are spurious/racy by contract "
+                            "— re-check the predicate in a loop")
+                    self._check_wait_timeout(node, cond)
+                    return  # wait releases the lock: never blocking
+                if node.func.attr in ("notify", "notify_all"):
+                    lock_key = cond.order_key()
+                    if lock_key not in held:
+                        self._cv_finding(
+                            node, "cv-notify-unlocked",
+                            "%s() without holding the condition's lock "
+                            "— RuntimeError at runtime, or waiters woken "
+                            "into a torn state" % node.func.attr)
+                    return
+        # blocking classification. A pragma on the blocking line vets
+        # the op as lock-safe at its SOURCE: it suppresses the direct
+        # finding and keeps the op out of the call-through propagation
+        # (otherwise every caller would re-report a justified op).
+        desc = self._blocking_desc(node)
+        if desc is not None:
+            if self.scan.suppressed(node.lineno):
+                return
+            self.info.blocking.append((node.lineno, desc))
+            if held:
+                self._blocking_finding(node, desc, held)
+            return
+        # call-through candidates (only interesting when held — but we
+        # record unconditionally so pass 2 can propagate transitively
+        # through intermediate helpers that hold nothing themselves)
+        ref = self._callee_ref(node)
+        if ref is not None:
+            self.info.calls.append((ref, node.lineno, frozenset(held)))
+
+    def _blocking_desc(self, node):
+        chain = _attr_chain(node.func)
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name == "sleep" and self.scan.from_time_sleep:
+                return "time.sleep"
+            d = _BLOCKING_NAMES.get(name)
+            if d:
+                return d
+            return None
+        if not chain:
+            # e.g. jax.jit(...)(x) — func is itself a Call; look inside
+            if isinstance(node.func, ast.Call):
+                inner = _attr_chain(node.func.func)
+                if inner and inner[0] in _JAX_ROOTS:
+                    return "jax dispatch/compile"
+            return None
+        if len(chain) >= 2 and (chain[0], chain[-1]) in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[(chain[0], chain[-1])]
+        if len(chain) >= 2 and (chain[-2], chain[-1]) in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[(chain[-2], chain[-1])]
+        if chain[0] in _JAX_ROOTS:
+            return "jax dispatch/compile"
+        tail = chain[-1]
+        if tail in _BLOCKING_METHODS:
+            return _BLOCKING_METHODS[tail]
+        if tail == "join" and \
+                self.scan._res_key(node.func.value, self.cls) in \
+                self.scan.threads:
+            return "Thread.join"
+        if tail == "get" and \
+                self.scan._res_key(node.func.value, self.cls) in \
+                self.scan.queues:
+            blockless = any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords)
+            if not blockless:
+                return "blocking queue.get"
+        return None
+
+    def _callee_ref(self, node):
+        if isinstance(node.func, ast.Name):
+            return ("func", node.func.id)
+        if isinstance(node.func, ast.Attribute):
+            chain = _attr_chain(node.func)
+            if chain and chain[0] in ("self", "cls") and len(chain) == 2:
+                return ("method", self.cls, chain[1])
+            meth = node.func.attr
+            if meth not in _COMMON_METHODS and not meth.startswith("__"):
+                return ("anymethod", meth)
+        return None
+
+    # -- findings --------------------------------------------------------------
+    def _cv_finding(self, node, code, msg):
+        if self.scan.suppressed(node.lineno):
+            return
+        _FINDINGS.append(Finding(
+            "locks", code, "error",
+            "%s:%d" % (self.scan.filename, node.lineno),
+            "%s (in %s)" % (msg, self.fn.qual)))
+
+    def _check_wait_timeout(self, node, cond):
+        val = None
+        arg = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                arg = kw.value
+        if isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, (int, float)):
+            val = float(arg.value)
+        elif isinstance(arg, ast.Name):
+            # module-level numeric constant
+            for n in self.scan.tree.body:
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                        isinstance(n.targets[0], ast.Name) and \
+                        n.targets[0].id == arg.id and \
+                        isinstance(n.value, ast.Constant) and \
+                        isinstance(n.value.value, (int, float)):
+                    val = float(n.value.value)
+        if val is None:
+            return
+        for sock_t, sock_line in self.scan.socket_timeouts:
+            if val >= sock_t:
+                if self.scan.suppressed(node.lineno):
+                    return
+                _FINDINGS.append(Finding(
+                    "locks", "cv-wait-timeout", "warning",
+                    "%s:%d" % (self.scan.filename, node.lineno),
+                    "Condition.wait budget %gs >= the %gs socket timeout "
+                    "at line %d: the peer's socket gives up before this "
+                    "wait does, so a healthy reply lands after the "
+                    "caller stopped listening (in %s)"
+                    % (val, sock_t, sock_line, self.fn.qual)))
+                return
+
+    def _blocking_finding(self, node, desc, held, via=None):
+        if self.scan.suppressed(node.lineno):
+            return
+        chain = (" via %s" % via) if via else ""
+        _FINDINGS.append(Finding(
+            "locks", "blocking-under-lock", "warning",
+            "%s:%d" % (self.scan.filename, node.lineno),
+            "%s%s while holding %s (in %s): every other thread "
+            "serializes behind the lock for the op's whole duration — "
+            "move it outside the critical section"
+            % (desc, chain, _fmt_locks(held), self.fn.qual)))
+
+
+def _fmt_locks(keys):
+    return ", ".join(sorted(keys))
+
+
+# findings accumulate here during one lint_source run (module-local
+# walkers append); lint_source swaps it in and out
+_FINDINGS = []
+
+
+class _ModuleAnalysis:
+    """Pass 2 over one module: interprocedural propagation, the lock
+    graph, and the guarded-field heuristic."""
+
+    def __init__(self, scan):
+        self.scan = scan
+        self.fns = {}          # qual -> _FnInfo
+        self._collect()
+        self._trans_memo = {}
+
+    def _collect(self):
+        tree = self.scan.tree
+        # top-level functions
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add(node, cls=None, qual=node.name)
+        # class methods + nested defs (nested defs keep the enclosing
+        # class so `self.X` resolves inside closures, but are not
+        # addressable as callees)
+        for cnode in tree.body:
+            if not isinstance(cnode, ast.ClassDef):
+                continue
+            for node in cnode.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add(node, cls=cnode.name,
+                              qual="%s.%s" % (cnode.name, node.name))
+        # nested functions anywhere
+        seen = {id(f.node) for f in self.fns.values()}
+        for cnode in ast.walk(tree):
+            if not isinstance(cnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(cnode) in seen:
+                continue
+            cls = self._enclosing_class(cnode)
+            self._add(cnode, cls=cls,
+                      qual="%s.<nested %s>" % (cls or self.scan.modname,
+                                               cnode.name),
+                      addressable=False)
+
+    def _enclosing_class(self, target):
+        for cnode in self.scan.tree.body:
+            if isinstance(cnode, ast.ClassDef):
+                for sub in ast.walk(cnode):
+                    if sub is target:
+                        return cnode.name
+        return None
+
+    def _add(self, node, cls, qual, addressable=True):
+        holder = _Fn(node, qual, cls)
+        info = _FnWalker(self.scan, holder, cls).run()
+        self.fns[qual] = info
+        if addressable:
+            if cls is None:
+                self.scan.mod_funcs[node.name] = info
+            else:
+                self.scan.class_methods.setdefault(cls, {})[node.name] = info
+                self.scan.method_index.setdefault(node.name, []).append(qual)
+
+    # -- callee resolution -----------------------------------------------------
+    def resolve(self, ref):
+        if ref[0] == "func":
+            return self.scan.mod_funcs.get(ref[1])
+        if ref[0] == "method":
+            return self.scan.class_methods.get(ref[1], {}).get(ref[2])
+        if ref[0] == "anymethod":
+            quals = self.scan.method_index.get(ref[1], ())
+            if len(quals) == 1:
+                return self.fns.get(quals[0])
+        return None
+
+    # -- transitive summaries --------------------------------------------------
+    def trans(self, info, depth=0, stack=None):
+        """(acquires, blocking) closed over same-module callees."""
+        if info.qual in self._trans_memo:
+            return self._trans_memo[info.qual]
+        stack = stack or set()
+        if info.qual in stack or depth > _CALL_DEPTH:
+            return set(info.acquires), [
+                (ln, d, info.qual) for ln, d in info.blocking]
+        stack = stack | {info.qual}
+        acq = set(info.acquires)
+        blk = [(ln, d, info.qual) for ln, d in info.blocking]
+        for ref, _lineno, _held in info.calls:
+            callee = self.resolve(ref)
+            if callee is None or callee is info:
+                continue
+            ca, cb = self.trans(callee, depth + 1, stack)
+            acq |= ca
+            blk.extend(cb)
+        if depth == 0:
+            self._trans_memo[info.qual] = (acq, blk)
+        return acq, blk
+
+    # -- propagated findings + edges -------------------------------------------
+    def propagate(self):
+        edges = {}   # (a, b) -> [(file, lineno, qual)]
+        for info in self.fns.values():
+            for a, b, lineno in info.order_edges:
+                if not self.scan.suppressed(lineno):
+                    edges.setdefault((a, b), []).append(
+                        (self.scan.filename, lineno, info.qual))
+            for ref, lineno, held in info.calls:
+                if not held:
+                    continue
+                callee = self.resolve(ref)
+                if callee is None:
+                    continue
+                acq, blk = self.trans(callee)
+                for lk in acq:
+                    if lk in held or self.scan.suppressed(lineno):
+                        continue
+                    for h in sorted(held):
+                        edges.setdefault((h, lk), []).append(
+                            (self.scan.filename, lineno, info.qual))
+                if blk and not self.scan.suppressed(lineno):
+                    ln0, desc0, q0 = blk[0]
+                    _FINDINGS.append(Finding(
+                        "locks", "blocking-under-lock", "warning",
+                        "%s:%d" % (self.scan.filename, lineno),
+                        "call into %s while holding %s reaches a blocking "
+                        "op (%s at %s:%d): every other thread serializes "
+                        "behind the lock — move the blocking work outside "
+                        "the critical section (in %s)"
+                        % (q0, _fmt_locks(held), desc0,
+                           os.path.basename(self.scan.filename), ln0,
+                           info.qual)))
+        return edges
+
+    # -- guarded-field heuristic -----------------------------------------------
+    def _locked_only_methods(self, cls):
+        """Methods of ``cls`` whose every same-class call site holds a
+        lock (transitively) — the `_update_gauges`-style helpers that
+        run under the caller's critical section."""
+        methods = self.scan.class_methods.get(cls, {})
+        callers = {}   # meth -> [(caller_qual, held bool)]
+        for info in self.fns.values():
+            if info.cls != cls:
+                continue
+            for ref, _lineno, held in info.calls:
+                if ref[0] == "method" and ref[1] == cls and ref[2] in methods:
+                    callers.setdefault(ref[2], []).append(
+                        (info.node.name, bool(held)))
+        locked = {m for m, info in methods.items()
+                  if info.node.name.endswith("_locked")}
+        for _ in range(len(methods) + 1):
+            changed = False
+            for m, sites in callers.items():
+                if m in locked:
+                    continue
+                if sites and all(held or caller in locked
+                                 for caller, held in sites):
+                    locked.add(m)
+                    changed = True
+            if not changed:
+                break
+        return locked, callers
+
+    def _init_only_methods(self, callers):
+        init_only = set()
+        for _ in range(len(callers) + 1):
+            changed = False
+            for m, sites in callers.items():
+                if m in init_only:
+                    continue
+                if sites and all(c in _EXEMPT_METHODS or c in init_only
+                                 for c, _h in sites):
+                    init_only.add(m)
+                    changed = True
+            if not changed:
+                break
+        return init_only
+
+    def check_fields(self):
+        for cls in self.scan.classes:
+            if not self.scan.class_locks(cls):
+                continue
+            locked_only, callers = self._locked_only_methods(cls)
+            init_only = self._init_only_methods(callers)
+            guarded = set()
+            for info in self.fns.values():
+                if info.cls != cls or info.node.name in _EXEMPT_METHODS:
+                    continue
+                for f, _ln, held in info.field_writes:
+                    if held:
+                        guarded.add(f)
+            # lock attributes themselves are not data
+            own = {k.split(".", 1)[1] for k in self.scan.locks
+                   if k.startswith(cls + ".")}
+            guarded -= own
+            if not guarded:
+                continue
+            reported = set()
+            for info in self.fns.values():
+                if info.cls != cls:
+                    continue
+                name = info.node.name
+                if name in _EXEMPT_METHODS or name.endswith("_locked") \
+                        or name in locked_only or name in init_only:
+                    continue
+                for f, ln, held in info.field_writes:
+                    if f in guarded and not held and \
+                            (cls, f, info.qual, "w") not in reported and \
+                            not self.scan.suppressed(ln):
+                        reported.add((cls, f, info.qual, "w"))
+                        _FINDINGS.append(Finding(
+                            "locks", "unguarded-field", "warning",
+                            "%s:%d" % (self.scan.filename, ln),
+                            "self.%s is written under %s's lock elsewhere "
+                            "but written WITHOUT it in %s — a concurrent "
+                            "locked writer can interleave (add the lock, "
+                            "or pragma with a justification)"
+                            % (f, cls, info.qual)))
+                for f, ln, held in info.field_reads:
+                    if f in guarded and not held and \
+                            (cls, f, info.qual, "r") not in reported and \
+                            not self.scan.suppressed(ln):
+                        reported.add((cls, f, info.qual, "r"))
+                        _FINDINGS.append(Finding(
+                            "locks", "unguarded-field", "info",
+                            "%s:%d" % (self.scan.filename, ln),
+                            "self.%s is written under %s's lock elsewhere "
+                            "but read without it in %s — racy read "
+                            "(often deliberate under the GIL; verify and "
+                            "pragma if so)" % (f, cls, info.qual)))
+        self._check_module_globals()
+
+    def _check_module_globals(self):
+        if not self.scan.module_locks():
+            return
+        module_names = set()
+        for node in self.scan.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_names.add(t.id)
+
+        def fn_env(info):
+            declared = {n for sub in ast.walk(info.node)
+                        if isinstance(sub, ast.Global) for n in sub.names}
+            params = {a.arg for a in info.node.args.args}
+            local_stores = {n for n, _ln, _h, kind in info.field_writes
+                            if kind == "name" and n not in declared}
+            return declared, params | local_stores
+
+        def is_global_write(info, n, kind, declared, locals_):
+            if n not in module_names:
+                return False
+            if kind == "name":
+                return n in declared
+            return n not in locals_  # sub/mut on a non-local name
+
+        guarded = set()
+        for info in self.fns.values():
+            if info.cls is not None:
+                continue
+            declared, locals_ = fn_env(info)
+            for n, _ln, held, kind in info.field_writes:
+                if held and is_global_write(info, n, kind, declared,
+                                            locals_):
+                    guarded.add(n)
+        # lock/condition globals are not data
+        guarded -= {k for k in self.scan.locks if "." not in k}
+        if not guarded:
+            return
+        reported = set()
+        for info in self.fns.values():
+            if info.cls is not None:
+                continue
+            name = info.node.name
+            if name.endswith("_locked") or name in _EXEMPT_METHODS:
+                continue
+            declared, locals_ = fn_env(info)
+            for n, ln, held, kind in info.field_writes:
+                if n in guarded and not held and \
+                        is_global_write(info, n, kind, declared, locals_) \
+                        and (n, info.qual, "w") not in reported and \
+                        not self.scan.suppressed(ln):
+                    reported.add((n, info.qual, "w"))
+                    _FINDINGS.append(Finding(
+                        "locks", "unguarded-field", "warning",
+                        "%s:%d" % (self.scan.filename, ln),
+                        "module global %s is written under the module "
+                        "lock elsewhere but written WITHOUT it in %s"
+                        % (n, info.qual)))
+            for n, ln, held, _kind in info.field_reads:
+                if n in guarded and not held and n not in locals_ and \
+                        (n, info.qual, "r") not in reported and \
+                        not self.scan.suppressed(ln):
+                    reported.add((n, info.qual, "r"))
+                    _FINDINGS.append(Finding(
+                        "locks", "unguarded-field", "info",
+                        "%s:%d" % (self.scan.filename, ln),
+                        "module global %s is written under the module "
+                        "lock elsewhere but read without it in %s — racy "
+                        "read (often deliberate under the GIL)"
+                        % (n, info.qual)))
+
+
+class _Fn:
+    """Thin holder handed to _FnWalker."""
+
+    __slots__ = ("node", "qual", "cls")
+
+    def __init__(self, node, qual, cls):
+        self.node = node
+        self.qual = qual
+        self.cls = cls
+
+
+def _tarjan_sccs(graph):
+    """Tarjan over {node: set(succ)}; yields SCCs (lists) of size > 1."""
+    index = {}
+    low = {}
+    onstack = set()
+    stack = []
+    counter = [0]
+    out = []
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def _cycle_findings(edges):
+    graph = {}
+    for (a, b), _locs in edges.items():
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    findings = []
+    for scc in _tarjan_sccs(graph):
+        scc_set = set(scc)
+        locs = []
+        for (a, b), where in sorted(edges.items()):
+            if a in scc_set and b in scc_set:
+                f, ln, qual = where[0]
+                locs.append("%s -> %s at %s:%d (%s)"
+                            % (a, b, os.path.basename(f), ln, qual))
+        findings.append(Finding(
+            "locks", "lock-inversion", "error",
+            " <-> ".join(scc),
+            "locks are acquired in conflicting orders — a potential "
+            "deadlock cycle: %s. Pick one global order (or pragma with "
+            "the reason the cycle is unreachable)." % "; ".join(locs)))
+    return findings
+
+
+def _module_name(path, package_root=None):
+    base = os.path.splitext(os.path.basename(path))[0]
+    if package_root:
+        rel = os.path.relpath(path, os.path.dirname(package_root))
+        if not rel.startswith(".."):
+            return os.path.splitext(rel)[0].replace(os.sep, ".")
+    return base
+
+
+def _analyze_source(src, filename, modname):
+    """Returns (findings, edges) for one module."""
+    global _FINDINGS
+    tree = ast.parse(src, filename=filename)
+    scan = _ModuleScan(tree, src, filename, modname)
+    saved, _FINDINGS = _FINDINGS, []
+    try:
+        analysis = _ModuleAnalysis(scan)
+        edges = analysis.propagate()
+        analysis.check_fields()
+        findings = _FINDINGS
+    finally:
+        _FINDINGS = saved
+    findings.extend(_cycle_findings(edges))
+    return findings, edges
+
+
+def lint_source(src, filename="<string>", modname=None):
+    findings, _edges = _analyze_source(
+        src, filename, modname or _module_name(filename))
+    return findings
+
+
+def lint_file(path, package_root=None):
+    with open(path, "r") as f:
+        src = f.read()
+    return lint_source(src, filename=path,
+                       modname=_module_name(path, package_root))
+
+
+DEFAULT_PACKAGE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _iter_py(path):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def lint_package(path=None):
+    """Lint every .py under ``path`` (default: the mxnet_tpu package)."""
+    path = path or DEFAULT_PACKAGE
+    findings = []
+    for p in _iter_py(path):
+        findings.extend(lint_file(p, package_root=path))
+    return findings
+
+
+def build_lock_graph(path=None):
+    """The static lock-order graph over ``path`` (default package):
+    {(lock_a, lock_b): [(file, lineno, qual)]} meaning lock_b was
+    acquired while lock_a was held. Feed to :func:`cross_check`."""
+    path = path or DEFAULT_PACKAGE
+    edges = {}
+    for p in _iter_py(path):
+        with open(p, "r") as f:
+            src = f.read()
+        _f, e = _analyze_source(src, p, _module_name(p, path))
+        for k, v in e.items():
+            edges.setdefault(k, []).extend(v)
+    return edges
+
+
+def _norm_lock_name(name):
+    """Normalize a lock identity for static<->observed matching: keep
+    the trailing ``Class.attr`` (or bare name) segment."""
+    name = str(name).rsplit(":", 1)[-1]
+    parts = name.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else name
+
+
+def cross_check(static_edges, observed_edges):
+    """Compare a live lock trace's observed acquisition orders (from
+    ``engine_verify.observed_lock_edges``) against the static graph.
+
+    - an observed edge whose REVERSE is in the static graph is an
+      inversion the lint could not see end-to-end (error);
+    - an observed edge with neither direction known statically is a
+      lint blind spot — unresolvable indirection (warning).
+    """
+    stat = {}
+    for (a, b), locs in static_edges.items():
+        stat[(_norm_lock_name(a), _norm_lock_name(b))] = locs
+    findings = []
+    for (a, b), where in sorted(observed_edges.items()):
+        na, nb = _norm_lock_name(a), _norm_lock_name(b)
+        if na == nb:
+            continue
+        if (na, nb) in stat:
+            continue
+        if (nb, na) in stat:
+            f, ln, qual = stat[(nb, na)][0]
+            findings.append(Finding(
+                "locks", "lock-order", "error",
+                "%s -> %s" % (a, b),
+                "live trace observed %s acquired while holding %s, but "
+                "the static graph orders them the OTHER way (%s:%d in "
+                "%s) — a deadlock in waiting" % (b, a,
+                                                 os.path.basename(f), ln,
+                                                 qual)))
+        else:
+            findings.append(Finding(
+                "locks", "lock-order", "warning",
+                "%s -> %s" % (a, b),
+                "live trace observed an acquisition order the static "
+                "lock graph does not know (observed at seq %s) — "
+                "indirection the lint cannot resolve; audit by hand"
+                % (where,)))
+    return findings

@@ -213,7 +213,7 @@ class Aggregator:
         self.guard_nonfinite_total = 0  # of those, non-finite merges
 
     # -- optimizer -------------------------------------------------------------
-    def set_optimizer(self, blob, shard=False):
+    def set_optimizer(self, blob, shard=False, preloaded=None):
         """First optimizer wins: set_optimizer is SPMD (every worker
         ships the same pickle) and a rejoiner's re-ship must not reset
         the server's accumulated optimizer state (momentum etc.).
@@ -222,7 +222,12 @@ class Aggregator:
         blob is kept only for rejoiners to adopt — the update runs
         WORKER-side on each key's owner, so no server updater is built
         and per-rank (and per-server) optimizer-state memory scales
-        ~1/world instead of full replicas."""
+        ~1/world instead of full replicas.
+
+        With ``preloaded`` the caller already unpickled the blob
+        OUTSIDE the coordinator's state lock (the dispatch path does —
+        the same discipline as push decode), so the lock-held section
+        only builds the updater."""
         if self.opt_blob is not None:
             return False
         if shard:
@@ -231,7 +236,12 @@ class Aggregator:
             return True
         from .. import optimizer as opt  # lazy: needs the jax stack
 
-        self._updater = opt.get_updater(pickle.loads(blob))
+        # the in-line pickle.loads fallback only runs from lock-free
+        # callers (snapshot restore at construction); the dispatch path
+        # always hands in ``preloaded`` decoded outside the state lock
+        self._updater = opt.get_updater(
+            pickle.loads(blob) if preloaded is None  # mxlint: disable
+            else preloaded)
         self.opt_blob = blob
         return True
 
@@ -389,9 +399,15 @@ class Aggregator:
                 finished.append(key)
                 continue
             if self._updater is not None:
+                # the server-side optimizer update (device math + D2H)
+                # runs inside the coordinator's critical section BY
+                # DESIGN: the non-shard round protocol's weights must
+                # be updated atomically with the round counters, and
+                # MXNET_KV_SHARD_UPDATE=1 is the fix-by-configuration
+                # that moves this work onto the owners' side entirely
                 w = NDArray(self.weights[key], cpu(0))
                 self._updater(_key_int(key), NDArray(merged, cpu(0)), w)
-                self.weights[key] = w.asnumpy()
+                self.weights[key] = w.asnumpy()  # mxlint: disable
             else:
                 self.weights[key] = merged
             # contributions are consumed only once the update LANDED: an
@@ -583,7 +599,12 @@ class ElasticCoordinator:
         if snapshot_secs is None:
             snapshot_secs = float(
                 os.environ.get("MXNET_KV_SNAPSHOT_SECS", "0") or "0")
-        self._lock = threading.Lock()
+        # TracedLock under MXNET_ENGINE_VERIFY=1: acquires land in the
+        # ambient lock trace for observed-lock-order verification
+        from ..analysis.engine_verify import maybe_trace_lock
+
+        self._lock = maybe_trace_lock(
+            threading.Lock(), "elastic.ElasticCoordinator._lock")
         # long-poll rendezvous: pull/barrier_wait requests park on this
         # condition (releasing the state lock) until a mutating op
         # completes a round, lands a weight, or changes the view —
@@ -812,6 +833,12 @@ class ElasticCoordinator:
             # threads (numpy releases the GIL) and only the cheap
             # fold-into-the-running-sum serializes
             decoded = _quant.decode(req["value"], dtype=_np.float32)
+        pre_opt = None
+        if op == "set_optimizer" and not req.get("shard", False):
+            # unpickle the optimizer blob outside the lock too (same
+            # reasoning; a repeat ship from a rejoiner wastes the decode
+            # but never stalls heartbeats behind it)
+            pre_opt = pickle.loads(req["blob"])
         with self._lock:
             if op == "register":
                 epoch, rejoined = self.view.register(rank, now)
@@ -947,7 +974,8 @@ class ElasticCoordinator:
                         "epoch": self.view.epoch}
             if op == "set_optimizer":
                 shard = bool(req.get("shard", False))
-                installed = self.agg.set_optimizer(req["blob"], shard=shard)
+                installed = self.agg.set_optimizer(
+                    req["blob"], shard=shard, preloaded=pre_opt)
                 return {"status": "ok", "installed": installed,
                         "shard": self.agg.shard_update}
             if op == "barrier":

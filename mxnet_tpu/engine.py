@@ -120,7 +120,7 @@ class Engine:
             not in ("", "0", "false")
         self._trace = None
         if self._verify:
-            from .analysis.engine_verify import EngineTrace
+            from .analysis.engine_verify import EngineTrace, maybe_trace_lock
 
             self._trace = EngineTrace()
         threaded = 0 if engine_type == "NaiveEngine" else 1
@@ -132,6 +132,13 @@ class Engine:
         # keep callback objects alive until their op completes
         self._live = {}
         self._live_lock = threading.Lock()
+        if self._verify:
+            # runtime lock-order recording (analysis/engine_verify.py):
+            # acquires/releases land in the ambient lock trace, whose
+            # observed edges are checked for inversions and
+            # cross-checked against lock_lint's static graph
+            self._live_lock = maybe_trace_lock(
+                self._live_lock, "engine.Engine._live_lock")
         self._next_key = 1
         self._errors = []
         # key -> fn name for ops dispatched to a worker but not yet

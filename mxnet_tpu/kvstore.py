@@ -1019,6 +1019,17 @@ class _AsyncServer:
         self._client = client
         self._ns = ns
         self._n = nworkers
+        # _mu guards the weight/version dict structure and the updater
+        # swap: init_key runs on rank 0's MAIN thread while _run polls
+        # from the server thread — an unguarded init racing an apply on
+        # a freshly-initialized key could publish a version for a
+        # weight it never saw (found by the mxrace audit sweep; the
+        # server thread stays the only mutator of weight CONTENTS, so
+        # the updater math itself runs outside the lock)
+        self._mu = threading.Lock()
+        from .analysis.engine_verify import maybe_trace_lock
+
+        self._mu = maybe_trace_lock(self._mu, "kvstore._AsyncServer._mu")
         self._weights = {}           # key(str) -> NDArray (cpu)
         self._versions = {}          # key(str) -> int
         self._applied = [0] * nworkers
@@ -1039,14 +1050,21 @@ class _AsyncServer:
         """Rank-0 direct init (program order guarantees this precedes any
         of rank 0's own pushes; other ranks block in init until the
         publish lands)."""
-        self._weights[key] = NDArray(arr, cpu(0))
-        self._versions[key] = 0
+        with self._mu:
+            self._weights[key] = NDArray(arr, cpu(0))
+            self._versions[key] = 0
         self._publish(key)
 
     def _publish(self, key):
+        # snapshot under the lock; the D2H + pickle + network write run
+        # outside it. A concurrent apply bumping the version between the
+        # snapshot and the send only means the NEXT publish re-asserts
+        # newer state — publishes are idempotent last-writer-wins
+        with self._mu:
+            ver, w = self._versions[key], self._weights[key]
         self._client.key_value_set(
             "%s/w/%s" % (self._ns, key),
-            _b64((self._versions[key], self._weights[key].asnumpy())),
+            _b64((ver, w.asnumpy())),
             allow_overwrite=True)
 
     def _try_get(self, k):
@@ -1064,8 +1082,10 @@ class _AsyncServer:
             return
         from . import optimizer as opt
 
-        self._optv = int(v)
-        self._updater = opt.get_updater(_unb64(blob))
+        updater = opt.get_updater(_unb64(blob))  # decode outside the lock
+        with self._mu:
+            self._optv = int(v)
+            self._updater = updater
 
     def _run(self):
         # Failure discipline: _applied[r] advances IMMEDIATELY after a
@@ -1096,17 +1116,22 @@ class _AsyncServer:
                         break  # seq bumped before payload landed
                     try:
                         for key, grad in _unb64(blob):
-                            w = self._weights.get(key)
+                            with self._mu:
+                                w = self._weights.get(key)
+                                updater = self._updater
                             if w is None:
                                 continue  # push raced an unknown key
                             g = NDArray(grad, cpu(0))
-                            if self._updater is not None:
-                                self._updater(_key_int(key), g, w)
+                            # updater math outside the lock: this thread
+                            # is the only weight-CONTENT mutator
+                            if updater is not None:
+                                updater(_key_int(key), g, w)
                             else:
                                 # no optimizer: per-arrival assign, the
                                 # sync path's "store = merged" analog
                                 w[:] = g.asnumpy()
-                            self._versions[key] += 1
+                            with self._mu:
+                                self._versions[key] += 1
                             dirty.add(key)
                     except Exception:  # pragma: no cover - poison group
                         import logging

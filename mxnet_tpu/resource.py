@@ -59,7 +59,10 @@ class RandomResource:
         import jax
 
         with self._lock:
-            self._key, sub = jax.random.split(self._key)
+            # the split IS the guarded state transition (the stream
+            # advance must be atomic); a cached-jit scalar op, not a
+            # compile — vetted blocking-under-lock
+            self._key, sub = jax.random.split(self._key)  # mxlint: disable
             return sub
 
     def uniform(self, shape, low=0.0, high=1.0, dtype="float32"):
@@ -156,8 +159,17 @@ class ResourceManager:
 
     def seed(self, seed_state):
         """Reseed every live random resource (ref: resource.cc
-        SeedRandom; called from mxnet_tpu.random.seed)."""
+        SeedRandom; called from mxnet_tpu.random.seed). The jax work in
+        reseed() (a fold_in dispatch, a compile on first use) runs
+        OUTSIDE the manager lock — holding _mu across it would
+        serialize every concurrent request() behind device work; each
+        resource's own lock makes the reseed itself atomic."""
+        seed = int(seed_state)
         with self._mu:
-            self._seed = int(seed_state)
-            for r in self._random.values():
-                r.reseed(self._seed)
+            self._seed = seed
+            live = list(self._random.values())
+        for r in live:
+            # reseed with the LOCAL value: re-reading self._seed here
+            # would let two concurrent seed() calls leave resources on
+            # a mix of the two values
+            r.reseed(seed)

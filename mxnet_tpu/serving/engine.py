@@ -33,6 +33,7 @@ import time
 import numpy as np
 
 from .. import telemetry as _tel
+from ..analysis.engine_verify import maybe_trace_lock as _maybe_trace_lock
 from ..base import MXNetError, env_int as _env_int
 from .kv_cache import PagedKVPool, blocks_for_tokens
 from .model import ServingModel, cp_prefill_kv
@@ -177,13 +178,18 @@ class Engine:
             prefill_chunk=self.cfg.prefill_chunk,
             token_budget=self.cfg.token_budget, policy=self.cfg.policy,
             max_active=self.cfg.max_active)
-        self._lock = threading.RLock()
+        # under MXNET_ENGINE_VERIFY=1 the locks are TracedLock-wrapped:
+        # every acquire/release lands in the ambient lock trace
+        # (analysis/engine_verify.py) for observed-order verification
+        self._lock = _maybe_trace_lock(threading.RLock(),
+                                       "serving.Engine._lock")
         # serializes whole steps: model execution + pool swap run
         # outside _lock (submit must not block on a dispatch), so two
         # concurrent drivers (generate() from two client threads, or
         # generate() racing start()'s loop) would otherwise each donate
         # and swap the same pool buffers, losing each other's KV writes
-        self._step_lock = threading.Lock()
+        self._step_lock = _maybe_trace_lock(threading.Lock(),
+                                            "serving.Engine._step_lock")
         self._work = threading.Condition(self._lock)
         self._by_rid = {}
         self._last_counts = {}
@@ -243,7 +249,9 @@ class Engine:
         """Submit all prompts, drive the loop to completion, return the
         generated token lists (the synchronous batch surface)."""
         handles = [self.submit(p, max_new_tokens) for p in prompts]
-        if self._thread is None:
+        with self._lock:
+            background = self._thread is not None
+        if not background:
             self.run_until_idle()
         return [h.result() for h in handles]
 
@@ -263,7 +271,10 @@ class Engine:
                 self._run_decode(decode)
                 worked = True
             if prefill:
-                self._run_prefill(prefill)
+                # model dispatch under _step_lock is the DESIGN: the
+                # step lock exists to serialize whole steps, model
+                # execution included (see its comment in __init__)
+                self._run_prefill(prefill)  # mxlint: disable
                 worked = True
             if worked:
                 with self._lock:
@@ -283,30 +294,41 @@ class Engine:
 
     def start(self):
         """Serve from a background thread (submit() wakes it)."""
-        if self._thread is not None:
-            return
-        self._stop = False
 
         def loop():
-            while not self._stop:
+            while True:
+                with self._lock:
+                    if self._stop:
+                        break
                 if not self.step():
                     with self._work:
                         if self._stop:
                             break
                         self._work.wait(timeout=0.05)
 
-        self._thread = threading.Thread(target=loop, name="mx-serve",
-                                        daemon=True)
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop = False
+            self._thread = threading.Thread(target=loop, name="mx-serve",
+                                            daemon=True)
+            self._thread.start()
 
     def stop(self):
-        if self._thread is None:
-            return
         with self._lock:
+            thread = self._thread
+            if thread is None:
+                return
             self._stop = True
             self._work.notify_all()
-        self._thread.join()
-        self._thread = None
+        # join OUTSIDE the lock (the loop's own step() takes it), and
+        # clear _thread only AFTER the join: a start() racing this stop
+        # must keep seeing the old thread and no-op — clearing early
+        # would let it spawn a second loop while the first still runs
+        thread.join()
+        with self._lock:
+            if self._thread is thread:
+                self._thread = None
 
     # -- batch execution -----------------------------------------------------
     def _tables(self, reqs):
@@ -409,18 +431,21 @@ class Engine:
         k = k.reshape(cfg.num_layers, nb, bs, cfg.num_heads, cfg.head_dim)
         v = v.reshape(cfg.num_layers, nb, bs, cfg.num_heads, cfg.head_dim)
         blocks = np.asarray(req.blocks[:nb], np.int32)
+        # device scatter + logits D2H run OUTSIDE _lock (a submit must
+        # not stall behind them; the pool reads are safe because every
+        # pool-swapping path serializes on _step_lock) — only the swap
+        # and the scheduler/stream bookkeeping take the state lock
+        new_k = self.pool.k.at[:, blocks].set(
+            jnp.asarray(k, self.pool.k.dtype))
+        new_v = self.pool.v.at[:, blocks].set(
+            jnp.asarray(v, self.pool.v.dtype))
+        logits = x_last @ np.asarray(self.params["embed"], np.float32).T
         now = time.monotonic()
         with self._lock:
-            self.pool.swap(
-                self.pool.k.at[:, blocks].set(
-                    jnp.asarray(k, self.pool.k.dtype)),
-                self.pool.v.at[:, blocks].set(
-                    jnp.asarray(v, self.pool.v.dtype)))
+            self.pool.swap(new_k, new_v)
             if req.state != PREFILL:
                 return
             self.sched.note_prefilled(req, T - req.prefilled)
-            logits = x_last @ np.asarray(
-                self.params["embed"], np.float32).T
             self._emit(req, int(np.argmax(logits)), now)
 
     # -- per-token bookkeeping (under self._lock) ----------------------------

@@ -21,6 +21,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # trace is kept in memory and re-checked per wait: fine at test scale
 # (measured no-op on this suite), a debug mode, not a production one —
 # see docs/how_to/static_analysis.md.
+#
+# The same switch also arms the mxrace runtime lock recorder: the
+# serving engine, elastic coordinator, dependency engine and async
+# kvstore server wrap their state locks in TracedLock, so every
+# acquire/release the suite performs lands in the ambient lock trace.
+# pytest_sessionfinish (below) is the suite-wide gate over it.
 os.environ.setdefault("MXNET_ENGINE_VERIFY", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -58,6 +64,28 @@ def _clear_fault_specs():
     from mxnet_tpu.resilience import faults
 
     faults.clear()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Suite-wide mxrace clean-repo gate (the PR 1 engine-verify
+    pattern, lock edition): after the whole suite ran with TracedLock
+    recording on, the ambient lock trace's OBSERVED acquisition orders
+    must contain no inversion. An inversion here means two subsystems
+    really took two locks in both orders at runtime somewhere in the
+    suite — a deadlock in waiting that no single test owns, so it is
+    raised at session scope where the evidence lives."""
+    from mxnet_tpu.analysis import engine_verify
+
+    trace = engine_verify.ambient_trace(create=False)
+    if trace is None:
+        return
+    findings = [f for f in engine_verify.verify(trace)
+                if f.code == "lock-order"]
+    if findings:
+        raise pytest.UsageError(
+            "mxrace suite-wide lock-order gate: %d observed inversion(s) "
+            "across the session:\n%s"
+            % (len(findings), "\n".join(str(f) for f in findings)))
 
 
 @pytest.fixture(autouse=True)

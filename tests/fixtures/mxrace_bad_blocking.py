@@ -1,0 +1,57 @@
+"""mxrace seeded-bad fixture: blocking operations under a held lock.
+
+One finding per class of blocking op the lint knows: time.sleep, pickle
+encode, socket recv, device sync, D2H copy, framed RPC, plus an
+interprocedural one (a helper that blocks, called under the lock). The
+pragma'd sleep and the Condition.wait must NOT be flagged.
+
+Never imported by tests — parsed by lock_lint only.
+"""
+import pickle
+import threading
+import time
+
+
+class Server:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._sock = sock
+        self.state = {}
+
+    def slow_update(self, value):
+        with self._lock:
+            time.sleep(0.1)                    # blocking-under-lock
+
+    def encode_reply(self, value):
+        with self._lock:
+            return pickle.dumps(value)         # blocking-under-lock
+
+    def read_request(self):
+        with self._lock:
+            return self._sock.recv(4096)       # blocking-under-lock
+
+    def sync_device(self, arr):
+        with self._lock:
+            arr.block_until_ready()            # blocking-under-lock
+
+    def fetch_weights(self, arr):
+        with self._lock:
+            return arr.asnumpy()               # blocking-under-lock
+
+    def _ship(self, value):
+        return pickle.dumps(value)             # blocks (callee)
+
+    def publish(self, value):
+        with self._lock:
+            return self._ship(value)           # blocking via call-through
+
+    def vetted_nap(self):
+        with self._lock:
+            # justified: <one-line reason would live here in real code>
+            time.sleep(0.01)  # mxlint: disable
+
+    def wait_ready(self):
+        with self._cond:
+            while not self.state:
+                self._cond.wait(0.1)           # NOT blocking: releases

@@ -1,0 +1,51 @@
+"""mxrace seeded-bad fixture: condition-variable misuse.
+
+- wait() outside a while predicate loop (error);
+- notify_all() without the condition's lock (error);
+- a long-poll wait budget >= the module's socket timeout (warning) —
+  the peer's socket gives up first, so the healthy reply lands after
+  the caller stopped listening;
+- the well-formed waiter at the bottom must NOT be flagged.
+
+Never imported by tests — parsed by lock_lint only.
+"""
+import socket
+import threading
+
+POLL_BUDGET = 35.0
+
+
+def connect(addr):
+    sock = socket.create_connection(addr, timeout=30.0)
+    sock.settimeout(30.0)
+    return sock
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.items = []
+
+    def take_one(self):
+        with self._cond:
+            if not self.items:
+                self._cond.wait()           # cv-wait-no-loop
+            return self.items.pop()
+
+    def put(self, item):
+        with self._lock:
+            self.items.append(item)
+        self._cond.notify_all()             # cv-notify-unlocked
+
+    def long_poll(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait(POLL_BUDGET)   # cv-wait-timeout >= 30s
+            return self.items[-1]
+
+    def take_forever(self):
+        with self._cond:
+            while not self.items:
+                self._cond.wait(0.5)        # clean: loop + small slice
+            return self.items.pop()

@@ -1,0 +1,56 @@
+"""mxrace seeded-bad fixture: a lock-order inversion (deadlock cycle).
+
+``ship()`` takes A then B; ``audit()`` takes B then A — two threads on
+the two paths can each hold one lock and wait forever on the other.
+``logthing()`` takes A then C: a second edge that must NOT be part of
+any reported cycle (C is ordered consistently everywhere).
+
+Never imported by tests — parsed by lock_lint only.
+"""
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+C = threading.Lock()
+
+
+def ship():
+    with A:
+        with B:
+            return 1
+
+
+def audit():
+    with B:
+        with A:
+            return 2
+
+
+def logthing():
+    with A:
+        with C:
+            return 3
+
+
+class Teller:
+    """An interprocedural inversion: the edge through a method call."""
+
+    def __init__(self):
+        self._book = threading.Lock()
+        self._till = threading.Lock()
+
+    def _count_till(self):
+        with self._till:
+            return 0
+
+    def close_book(self):
+        with self._book:
+            return self._count_till()   # book -> till
+
+    def _audit_book(self):
+        with self._book:
+            return 1
+
+    def open_till(self):
+        with self._till:
+            return self._audit_book()   # till -> book: the cycle

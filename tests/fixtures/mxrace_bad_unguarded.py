@@ -1,0 +1,40 @@
+"""mxrace seeded-bad fixture: a field guarded in one method, touched
+bare in another.
+
+``counter`` is written under the lock in record() but written without
+it in reset() (warning) and read without it in peek() (info).
+``__init__`` writes, ``*_locked`` helpers, helpers only ever called
+under the lock, and the pragma'd read are all exempt.
+
+Never imported by tests — parsed by lock_lint only.
+"""
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0          # construction: exempt
+        self.label = "m"
+
+    def record(self, n):
+        with self._lock:
+            self.counter += n
+            self._bump_locked()
+            self._note()
+
+    def _bump_locked(self):
+        self.counter += 1         # _locked suffix: caller holds it
+
+    def _note(self):
+        self.counter += 1         # only called under the lock: exempt
+
+    def reset(self):
+        self.counter = 0          # unguarded WRITE: warning
+
+    def peek(self):
+        return self.counter       # unguarded read: info
+
+    def vetted_peek(self):
+        # deliberate racy read (GIL-atomic int load)
+        return self.counter  # mxlint: disable

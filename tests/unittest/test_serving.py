@@ -326,6 +326,25 @@ class TestEngine:
         finally:
             eng.stop()
 
+    def test_stop_start_cycles_leave_single_loop_thread(self, model):
+        """stop() must clear _thread only AFTER joining, so a start()
+        racing a stop() can never spawn a second drive loop; repeated
+        cycles (with a concurrent start thrown in) end with every
+        mx-serve thread dead and _thread None."""
+        import threading
+
+        eng = _mk_engine(model)
+        for _ in range(3):
+            eng.start()
+            stopper = threading.Thread(target=eng.stop)
+            stopper.start()
+            eng.start()   # racing start: no-op or a clean new loop
+            stopper.join()
+            eng.stop()
+            assert eng._thread is None
+        assert not any(t.name == "mx-serve" and t.is_alive()
+                       for t in threading.enumerate())
+
     def test_telemetry_catalog(self, model, monkeypatch, tmp_path):
         """The serving.* catalog lands in mxtel when enabled: request
         counters, pool gauges, TTFT/per-token histograms."""

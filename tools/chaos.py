@@ -18,6 +18,7 @@ Usage::
     python tools/chaos.py --seed 3 --points engine.task,kv.coord --full
     python tools/chaos.py --elastic     # SIGKILL/rejoin survival legs
     python tools/chaos.py --guardian    # grad.nan/loss.spike survival legs
+    python tools/chaos.py --schedules   # thread-schedule survival legs
 
 The spec is derived deterministically from --seed: per point, a fire
 probability in [0.02, 0.15] and a per-point RNG seed. Same seed, same
@@ -723,6 +724,50 @@ def run_quantized(args):
     return 0
 
 
+# -- thread-schedule survival legs ---------------------------------------------
+# The ISSUE-9 acceptance contract: the mxrace interleaving explorer
+# (mxnet_tpu/analysis/schedule.py) deterministically finds BOTH seeded
+# races (the lost-update counter and the unlocked elastic-aggregator
+# protocol, the latter at line granularity inside elastic/server.py) and
+# replays each from its printed seed; the serving engine's
+# submit/cancel/step loop and the aggregator under the coordinator's
+# lock then survive every explored schedule with zero deadlocks and
+# zero invariant violations. Chaos testing for thread schedules: same
+# survival-report shape as the fault legs, but the adversary is the
+# scheduler, not the network.
+
+def run_schedules(args):
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time as _time
+
+    from mxnet_tpu.analysis.schedule import survival_suite
+
+    budget = int(os.environ.get("MXRACE_SCHEDULES", "0") or 0) or 50
+    print("chaos --schedules: seed=%d, %d schedules per leg"
+          % (args.seed, budget))
+    t0 = _time.time()
+    findings, lines = survival_suite(seed=args.seed, schedules=budget)
+    wall = _time.time() - t0
+
+    print("\n=== schedule survival report ===")
+    print("seed            : %d" % args.seed)
+    print("wall time       : %.1fs" % wall)
+    for ln in lines:
+        print(ln)
+    if findings:
+        print("\nRESULT: FAIL")
+        for f in findings:
+            print(" - %s" % f)
+        return 7
+    print("\nRESULT: SURVIVED — both seeded races were found and "
+          "replayed from their seeds; the serving submit/cancel/step "
+          "loop and the elastic aggregator round protocol survived "
+          "every explored schedule (no deadlock, no invariant "
+          "violation). Rerun with the same --seed to reproduce.")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run the test suite under a seeded fault spec")
@@ -759,6 +804,14 @@ def main(argv=None):
                          "per-rank optimizer state, plus a grad.nan leg "
                          "proving the guardian counts poisoned rounds "
                          "(and nothing on a clean quantized run)")
+    ap.add_argument("--schedules", action="store_true",
+                    help="run the mxrace thread-schedule survival legs "
+                         "(ISSUE 9): the interleaving explorer must "
+                         "find + replay both seeded races, then the "
+                         "serving submit/cancel/step loop and the "
+                         "elastic aggregator round protocol must "
+                         "survive every explored schedule (MXRACE_"
+                         "SCHEDULES overrides the per-leg budget)")
     ap.add_argument("tests", nargs="*",
                     help="explicit test paths (default: smoke set)")
     args = ap.parse_args(argv)
@@ -769,6 +822,8 @@ def main(argv=None):
         return run_guardian(args)
     if args.quantized:
         return run_quantized(args)
+    if args.schedules:
+        return run_schedules(args)
 
     points = [p.strip() for p in args.points.split(",") if p.strip()]
     spec = args.spec or build_spec(args.seed, points, args.mode)
