@@ -95,6 +95,11 @@ def main(argv=None):
                         "blocking-under-lock, unguarded fields, cv "
                         "misuse) over PATH — bare --locks lints the "
                         "whole mxnet_tpu package")
+    p.add_argument("--telemetry", action="store_true",
+                   help="metrics catalog gate: every counter/gauge/"
+                        "histogram registered in the package must appear "
+                        "in docs/how_to/observability.md's catalog, and "
+                        "vice versa")
     p.add_argument("--schedules", action="store_true",
                    help="mxrace interleaving-explorer survival run: "
                         "seeded-race negative controls must be found "
@@ -124,7 +129,8 @@ def main(argv=None):
             print(name)
         return 0
     if not (args.all or args.model or args.graph or args.ops
-            or args.engine_trace or args.locks or args.schedules):
+            or args.engine_trace or args.locks or args.schedules
+            or args.telemetry):
         p.print_usage(sys.stderr)
         print("mxlint: nothing to do (try --all)", file=sys.stderr)
         return 2
@@ -137,12 +143,14 @@ def main(argv=None):
     model_names = list(args.model)
     lock_paths = list(args.locks)
     run_selftest = False
+    run_telemetry = args.telemetry
     if args.all:
         model_names.extend(sorted(zoo_models()))
         from .. import ops as _ops_pkg
 
         ops_paths.append(os.path.dirname(os.path.abspath(_ops_pkg.__file__)))
         run_selftest = True
+        run_telemetry = True
         if not lock_paths:
             lock_paths.append("")  # whole-package concurrency lint
 
@@ -208,6 +216,11 @@ def main(argv=None):
         n_targets += 1
     if run_selftest:
         findings.extend(_engine_selftest())
+        n_targets += 1
+    if run_telemetry:
+        from .telemetry_lint import lint_catalog
+
+        findings.extend(lint_catalog())
         n_targets += 1
     if args.schedules:
         from .schedule import survival_suite

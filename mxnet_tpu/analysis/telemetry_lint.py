@@ -1,0 +1,219 @@
+"""Telemetry catalog gate: registered metric names vs the documented
+catalog (mxlint ``--telemetry``).
+
+Every counter/gauge/histogram a subsystem registers must appear in
+docs/how_to/observability.md's metrics catalog, and every catalog entry
+must still exist in code — otherwise the catalog silently drifts as
+subsystems add counters (exactly how the serving and quantize metrics
+escaped it before this gate).
+
+Code side: an AST walk over the package collects the first argument of
+every ``*.counter(...)`` / ``*.gauge(...)`` / ``*.histogram(...)``
+call —
+
+- string literals register exactly;
+- ``"prefix.%s_suffix" % x`` and f-strings register a ``prefix.*``
+  wildcard pattern (likewise literal ``+`` concatenation);
+- anything else is a *dynamic* site: reported as an info finding unless
+  a pragma comment within the preceding few lines declares its names::
+
+      # mxtel-metrics: kvstore.evictions_total kvstore.rejoins_total
+
+  (adjacency is required — a pragma elsewhere in the file must not
+  blanket-suppress a NEW dynamic site added later)
+
+Doc side: every backticked token containing a dot inside a markdown
+table row (``| `name` | kind | ...``), with ``<x>`` placeholders
+normalized to ``*`` wildcards, ``{a,b}`` sets brace-expanded, and
+``a` / `b`` cells split naturally by backtick extraction.
+
+Matching is wildcard-aware in both directions (fnmatch): the code
+pattern ``serving.requests_*`` is covered by the documented
+``serving.requests_admitted`` and vice versa.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+
+from .findings import Finding
+
+__all__ = ["collect_code_metrics", "collect_doc_metrics", "lint_catalog",
+           "DEFAULT_PACKAGE", "DEFAULT_DOC"]
+
+DEFAULT_PACKAGE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_DOC = os.path.join(os.path.dirname(DEFAULT_PACKAGE),
+                           "docs", "how_to", "observability.md")
+
+_METRIC_METHODS = frozenset(("counter", "gauge", "histogram"))
+_PRAGMA_RE = re.compile(r"#\s*mxtel-metrics:\s*(.+)")
+#: a pragma covers a dynamic registration site at most this many lines
+#: below it (adjacency, so one pragma never blankets a whole file)
+_PRAGMA_REACH = 10
+_DOC_TOKEN_RE = re.compile(r"`([^`]+)`")
+# a plausible metric name: dotted, lowercase-ish, optional wildcards
+_NAME_RE = re.compile(r"^[a-z0-9_*]+(\.[a-z0-9_*]+)+$")
+
+#: files whose counter()/gauge()/histogram() calls are the telemetry
+#: plumbing itself, not metric registrations
+_SKIP_DIRS = (os.path.join("mxnet_tpu", "telemetry"),)
+
+
+def _pattern_from_arg(node):
+    """(exact_name | wildcard_pattern | None) for a metric-name arg."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    # "prefix%s" % x  /  "prefix" % x
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) and \
+            isinstance(node.left, ast.Constant) and \
+            isinstance(node.left.value, str):
+        return re.sub(r"%[sdifr]", "*", node.left.value)
+    # "prefix" + x
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add) and \
+            isinstance(node.left, ast.Constant) and \
+            isinstance(node.left.value, str):
+        return node.left.value + "*"
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def collect_code_metrics(pkg_path=None):
+    """Walk the package: (names_or_patterns set, dynamic_sites list).
+    ``dynamic_sites`` are ``(relpath, lineno)`` of calls whose name is
+    underivable and not covered by a file pragma."""
+    pkg_path = pkg_path or DEFAULT_PACKAGE
+    names = set()
+    dynamic = []
+    for root, dirs, files in os.walk(pkg_path):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        if any(root.endswith(s) or (s + os.sep) in root
+               for s in _SKIP_DIRS):
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            rel = os.path.relpath(path, os.path.dirname(pkg_path))
+            pragma_lines = []
+            for lineno, line in enumerate(src.splitlines(), 1):
+                m = _PRAGMA_RE.search(line)
+                if m is None:
+                    continue
+                # only well-formed names: the pragma may be quoted in
+                # docs/docstrings (this file's own included)
+                declared = {n for n in m.group(1).split()
+                            if _NAME_RE.match(n)}
+                if declared:
+                    names.update(declared)
+                    pragma_lines.append(lineno)
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue  # lock_lint/ast_lint own syntax diagnostics
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _METRIC_METHODS
+                        and node.args):
+                    continue
+                # self.xxx.counter(...) on non-telemetry receivers
+                # (e.g. a Registry instance) still counts: the name
+                # space is process-global either way
+                pat = _pattern_from_arg(node.args[0])
+                if pat is not None and _NAME_RE.match(pat):
+                    names.add(pat)
+                else:
+                    # underivable name OR a literal that is not a
+                    # dotted metric name — both must surface, or a
+                    # dotless counter('throughput') silently escapes
+                    # the whole gate
+                    covered = any(
+                        0 <= node.lineno - pl <= _PRAGMA_REACH
+                        for pl in pragma_lines)
+                    if not covered:
+                        dynamic.append((rel, node.lineno))
+    return names, dynamic
+
+
+def _expand_braces(token):
+    m = re.search(r"\{([^}]*)\}", token)
+    if not m:
+        return [token]
+    head, tail = token[:m.start()], token[m.end():]
+    out = []
+    for part in m.group(1).split(","):
+        out.extend(_expand_braces(head + part.strip() + tail))
+    return out
+
+
+def collect_doc_metrics(doc_path=None):
+    """Metric names/patterns documented in the catalog's tables."""
+    doc_path = doc_path or DEFAULT_DOC
+    names = set()
+    with open(doc_path, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.lstrip().startswith("|"):
+                continue
+            first_cell = line.split("|")[1] if "|" in line else ""
+            for tok in _DOC_TOKEN_RE.findall(first_cell):
+                tok = tok.strip()
+                tok = re.sub(r"<[^>]*>", "*", tok)
+                for t in _expand_braces(tok):
+                    if _NAME_RE.match(t):
+                        names.add(t)
+    return names
+
+
+def _covered(entry, others):
+    """True when ``entry`` (name or pattern) matches any of ``others``
+    in either wildcard direction."""
+    for o in others:
+        if entry == o or fnmatch.fnmatchcase(entry, o) or \
+                fnmatch.fnmatchcase(o, entry):
+            return True
+    return False
+
+
+def lint_catalog(pkg_path=None, doc_path=None):
+    """The gate: findings for undocumented metrics, stale catalog
+    entries, and unverifiable dynamic registration sites."""
+    doc_path = doc_path or DEFAULT_DOC
+    code, dynamic = collect_code_metrics(pkg_path)
+    try:
+        docs = collect_doc_metrics(doc_path)
+    except OSError as e:
+        return [Finding("telemetry", "catalog-missing", "error", doc_path,
+                        "metrics catalog unreadable: %s" % e)]
+    findings = []
+    for name in sorted(code):
+        if not _covered(name, docs):
+            findings.append(Finding(
+                "telemetry", "undocumented-metric", "error", name,
+                "registered in the package but absent from the metrics "
+                "catalog (%s)" % os.path.relpath(doc_path)))
+    for name in sorted(docs):
+        if not _covered(name, code):
+            findings.append(Finding(
+                "telemetry", "stale-catalog-entry", "error", name,
+                "documented in the metrics catalog but no longer "
+                "registered anywhere in the package"))
+    for rel, lineno in dynamic:
+        findings.append(Finding(
+            "telemetry", "dynamic-metric-name", "info",
+            "%s:%d" % (rel, lineno),
+            "metric name not statically derivable (or not a dotted "
+            "metric name) — declare it with an adjacent "
+            "'# mxtel-metrics: <name>...' pragma so the catalog gate "
+            "can see it"))
+    return findings

@@ -13,12 +13,18 @@ import os
 import time
 
 from .. import quantize as _quant
+from .. import telemetry as _tel
 from ..base import MXNetError
 from ..resilience import faults as _faults
 from ..resilience.retry import RetryPolicy
 from . import protocol
 
 __all__ = ["ElasticClient", "parse_addr"]
+
+# ops whose clock-sync pairs feed trace_merge's offset estimate: fast,
+# never-parking handlers only — a long-polled pull's server timestamp
+# lands seconds after the request midpoint and would skew the estimate
+_CLOCK_OPS = frozenset(("register", "beat", "view", "leave"))
 
 
 def _pull_wait():
@@ -64,17 +70,47 @@ class ElasticClient:
         """One RPC. Transport errors retry under the policy; an
         ``error`` status raises MXNetError (when ``check``); other
         non-ok statuses ('pending', 'evicted', 'stale') are protocol
-        answers the caller dispatches on."""
+        answers the caller dispatches on.
+
+        With telemetry on, the RPC runs inside an ``elastic.rpc.<op>``
+        span whose trace context rides the request envelope
+        (``_trace``) — the coordinator opens its handler span as a
+        child of this one, so one trace crosses the process boundary.
+        Replies from a telemetry-on coordinator carry ``_srv_t``; for
+        fast ops the (t0, t1, srv_t) triple is journaled as a ``clock``
+        record, which is what lets trace_merge estimate per-rank clock
+        offsets against the coordinator's clock."""
         req = dict(fields)
         req["op"] = op
         req["rank"] = self.rank
+        # clock stamps taken INSIDE the attempt, around the single
+        # round trip: retry backoff between attempts must not widen the
+        # t0..t1 bracket (srv_t comes from the final attempt's reply,
+        # so a bracket spanning the whole retry budget would skew the
+        # midpoint offset estimate by seconds)
+        stamps = {}
 
         def _rpc():
             _faults.point("kv.coord")
-            return protocol.call(self.addr, req, timeout=self.timeout)
+            stamps["t0"] = time.time()
+            out = protocol.call(self.addr, req, timeout=self.timeout)
+            stamps["t1"] = time.time()
+            return out
 
         _rpc.__name__ = "elastic %s" % op
-        resp = self._policy.call(_rpc)
+        if not _tel.ENABLED:
+            resp = self._policy.call(_rpc)
+        else:
+            with _tel.span("elastic.rpc.%s" % op):
+                req["_trace"] = _tel.wire_context()
+                resp = self._policy.call(_rpc)
+            srv_t = resp.get("_srv_t") if isinstance(resp, dict) else None
+            if srv_t is not None and op in _CLOCK_OPS and "t1" in stamps:
+                from ..telemetry import export as _export
+
+                _export.emit({"kind": "clock", "op": op, "rank": self.rank,
+                              "t0": stamps["t0"], "t1": stamps["t1"],
+                              "srv_t": float(srv_t)})
         if check and resp.get("status") == "error":
             raise MXNetError("elastic coordinator rejected %s: %s"
                              % (op, resp.get("message", "(no message)")))

@@ -14,6 +14,14 @@ Pickle is the payload codec for the same reason the reference ships its
 optimizer as a pickle to the ps-lite server (python/mxnet/kvstore.py:231):
 the peers are the job's own cooperating processes.
 
+Tracing envelope (telemetry on only): requests may carry a ``_trace``
+field — the caller's ``telemetry.wire_context()`` dict
+(``{"trace": str, "span": int}``) — which the server handler pops and
+adopts so its spans join the caller's trace; replies carry ``_srv_t``
+(server wall clock at reply time) for trace_merge's clock-offset
+estimation. Both are optional underscore fields: codec-off peers
+ignore them entirely.
+
 SECURITY: unpickling executes code, so anyone who can reach the
 coordinator port owns the job. Bind the coordinator to a loopback or
 cluster-private interface only (the 127.0.0.1 default), exactly as the

@@ -66,6 +66,7 @@ import numpy as _np
 from ..base import MXNetError
 from ..resilience import faults as _faults
 from .. import quantize as _quant
+from .. import telemetry as _tel
 from . import protocol
 
 __all__ = ["GroupView", "Aggregator", "ElasticCoordinator"]
@@ -569,13 +570,25 @@ class _Handler(socketserver.BaseRequestHandler):
             req = protocol.recv_msg(self.request)
             if req is None:
                 return
+            # cross-process trace propagation (docs/how_to/
+            # observability.md): the caller's wire context rides the
+            # request envelope; the handler span opens as its child so
+            # the coordinator's work lands in the CALLER's trace.
+            # Popped either way — dispatch must never see the envelope.
+            wire = req.pop("_trace", None) if isinstance(req, dict) else None
             try:
-                resp = self.server.coordinator._dispatch(req)
+                with _tel.span("elastic.serve.%s" % req.get("op"),
+                               wire=wire):
+                    resp = self.server.coordinator._dispatch(req)
             except MXNetError as e:
                 # a semantic rejection (round ahead, uninited key) must
                 # reach the caller as a reply — a dropped connection
                 # reads as a transient and would be retried verbatim
                 resp = {"status": "error", "message": str(e)}
+            if _tel.ENABLED and isinstance(resp, dict):
+                # server wall clock at reply time: the client's clock
+                # records pair it with (t0, t1) for offset estimation
+                resp.setdefault("_srv_t", time.time())
             protocol.send_msg(self.request, resp)
         except (OSError, protocol.ProtocolError):
             pass  # a dying client mid-frame must not log-spam the server

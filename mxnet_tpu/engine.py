@@ -457,6 +457,18 @@ class Engine:
             return 0
         return self._lib.EnginePendingCount(h)
 
+    def pending_snapshot(self):
+        """Structured pending-work snapshot: native pending count plus
+        the queued (pushed, not yet dispatched) and in-flight
+        (dispatched, not yet completed) task names. The wait watchdog's
+        dump and the /enginez introspection endpoint both read this."""
+        with self._live_lock:
+            queued = [getattr(fn, "__name__", None) or "fn"
+                      for fn, _a, _e, _t in self._live.values()]
+            inflight = list(self._inflight.values())
+        return {"pending": self.pending_count(), "queued": queued,
+                "in_flight": inflight}
+
     def pending_dump(self):
         """Diagnostic snapshot for the wait watchdog: how many ops the
         native engine still counts pending, which tasks are queued
@@ -465,14 +477,11 @@ class Engine:
         attached (MXNET_ENGINE_VERIFY=1) — the trace tail with each
         op's declared var sets, which names the dependency chain the
         wait is stuck behind."""
-        with self._live_lock:
-            queued = [getattr(fn, "__name__", None) or "fn"
-                      for fn, _a, _e, _t in self._live.values()]
-            inflight = list(self._inflight.values())
+        snap = self.pending_snapshot()
         lines = ["pending ops: %d native; queued: %s; in-flight: %s"
-                 % (self.pending_count(),
-                    ", ".join(queued) or "(none)",
-                    ", ".join(inflight) or "(none)")]
+                 % (snap["pending"],
+                    ", ".join(snap["queued"]) or "(none)",
+                    ", ".join(snap["in_flight"]) or "(none)")]
         trace = self._trace
         if trace is not None and trace.events:
             tail = sorted(trace.events, key=lambda e: e.seq)[-8:]

@@ -1499,6 +1499,11 @@ class _ElasticDistKVStore(KVStore):
             if delta > 0:
                 self._last_counters[src] = cur
                 if _tel.ENABLED:
+                    # mxtel-metrics: kvstore.evictions_total
+                    # mxtel-metrics: kvstore.rejoins_total
+                    # mxtel-metrics: kvstore.degraded_steps_total
+                    # mxtel-metrics: guardian.skipped_rounds
+                    # mxtel-metrics: guardian.nonfinite_rounds
                     _tel.counter(name).inc(delta)
 
     @staticmethod
@@ -1687,11 +1692,24 @@ class _ElasticDistKVStore(KVStore):
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been inited" % k)
+            # the round_wait record is the straggler signal: time this
+            # rank spent blocked on the round completing (i.e. on its
+            # slowest peer) — tools/trace_merge.py's per-epoch
+            # barrier-wait-vs-compute attribution sums it. Owner-side
+            # shard updates running inside the poll loop are COMPUTE,
+            # not wait, so their time is subtracted; the record is
+            # emitted with explicit timestamps (tracing.event) for the
+            # same reason — its duration is not the loop's wall time.
+            tel_on = _tel.ENABLED
+            if tel_on:
+                ctx = _tel.wire_context()
+                wall0, t_wait, shard_s = time.time(), time.monotonic(), 0.0
             while True:
                 # re-read the floor every poll: a rejoin inside _op
-                # resyncs _rounds, and the pre-eviction floor may name a
-                # round whose only missing contribution was OURS (dropped
-                # at eviction) — a floor that can never be satisfied
+                # resyncs _rounds, and the pre-eviction floor may
+                # name a round whose only missing contribution was
+                # OURS (dropped at eviction) — a floor that can
+                # never be satisfied
                 min_round = self._rounds.get(k, 0)
                 resp = self._op(
                     "pull", **self._client.pull_fields(k, min_round))
@@ -1699,21 +1717,31 @@ class _ElasticDistKVStore(KVStore):
                 if status == "ok":
                     break
                 if status == "update":
-                    # shard-update mode: this rank owns the key and the
-                    # merged gradient is waiting — run the optimizer
-                    # locally, land the weight, then re-poll (the poll
-                    # re-adopts the server copy even if a reassigned
-                    # owner's put raced ours, so replicas never fork)
+                    # shard-update mode: this rank owns the key and
+                    # the merged gradient is waiting — run the
+                    # optimizer locally, land the weight, then
+                    # re-poll (the poll re-adopts the server copy
+                    # even if a reassigned owner's put raced ours,
+                    # so replicas never fork)
+                    t_upd = time.monotonic() if tel_on else 0.0
                     self._shard_apply_update(k, resp)
+                    if tel_on:
+                        shard_s += time.monotonic() - t_upd
                     continue
                 if time.monotonic() > deadline:
                     raise MXNetError(
-                        "elastic pull of key %s round %d timed out on rank "
-                        "%d (epoch %d) — no eviction unblocked the round; "
-                        "check the coordinator (docs/how_to/"
+                        "elastic pull of key %s round %d timed out on "
+                        "rank %d (epoch %d) — no eviction unblocked "
+                        "the round; check the coordinator (docs/how_to/"
                         "elastic_training.md)"
                         % (k, min_round, self._rank, self._epoch))
                 time.sleep(0.005)
+            if tel_on:
+                waited = max(0.0, time.monotonic() - t_wait - shard_s)
+                _tel.event("kvstore.round_wait", t=wall0, dur=waited,
+                           trace=ctx["trace"] if ctx else None,
+                           parent=ctx["span"] if ctx else None)
+                _tel.histogram("kvstore.round_wait_secs").observe(waited)
             # rejoin may have advanced our floor past min_round
             self._rounds[k] = max(self._rounds.get(k, 0), int(resp["round"]))
             value = resp["value"]
@@ -1832,6 +1860,10 @@ class _ElasticDistKVStore(KVStore):
         timeout = _barrier_timeout()
         _faults.point("kv.barrier")
         t0 = time.monotonic()
+        # named wait span: trace_merge attributes barrier rendezvous
+        # time (blocked on peers) separately from compute per epoch
+        _wait_span = _tel.span("kvstore.barrier_wait")
+        _wait_span.__enter__()
         try:
             resp = self._op("barrier", count=self._barrier_count)
             gen = int(resp["gen"])
@@ -1857,6 +1889,7 @@ class _ElasticDistKVStore(KVStore):
                                          wait=budget)
                 done = bool(wait.get("done"))
         finally:
+            _wait_span.__exit__(None, None, None)
             # observed on EVERY outcome: the pathological waits are the
             # percentiles this histogram exists to expose
             if _tel.ENABLED:

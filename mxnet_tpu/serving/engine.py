@@ -29,6 +29,7 @@ import dataclasses
 import queue as _queue
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -40,9 +41,20 @@ from .model import ServingModel, cp_prefill_kv
 from .scheduler import (CANCELLED, DECODE, FINISHED, PREFILL, Request,
                         Scheduler)
 
-__all__ = ["Engine", "ServingConfig", "StreamHandle", "QueueFullError"]
+__all__ = ["Engine", "ServingConfig", "StreamHandle", "QueueFullError",
+           "live_engines"]
 
 _END = object()
+
+# every constructed Engine, weakly held — the /servingz introspection
+# endpoint (telemetry/server.py) iterates this to render live request
+# tables without the serving layer ever knowing about HTTP
+_live_engines = weakref.WeakSet()
+
+
+def live_engines():
+    """The Engines currently alive in this process (weakly tracked)."""
+    return sorted(_live_engines, key=id)
 
 
 class QueueFullError(MXNetError):
@@ -202,6 +214,7 @@ class Engine:
         self._thread = None
         self._stop = False
         self._last_rate = 0.0
+        _live_engines.add(self)
 
     # -- intake --------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None):
@@ -228,6 +241,17 @@ class Engine:
                 raise QueueFullError(
                     "admission queue full (%d)" % self.cfg.max_queue_depth)
             req.submit_t = time.monotonic()
+            if _tel.ENABLED:
+                # request-scoped trace: every lifecycle span of this
+                # request (submit -> prefill -> decode -> complete)
+                # shares one trace id, so the journal alone
+                # reconstructs the request's lifetime
+                req.trace = _tel.mint_trace()
+                req.wall0 = time.time()
+                _tel.event("serve.request.submit", t=req.wall0,
+                           trace=req.trace, rid=req.rid,
+                           prompt_len=int(req.prompt.shape[0]),
+                           max_new_tokens=req.max_new_tokens)
             handle = StreamHandle(self, req)
             self._by_rid[req.rid] = req
             self.sched.submit(req)
@@ -266,6 +290,10 @@ class Engine:
                 self._mirror_events()
                 decode = list(plan.decode)
                 prefill = list(plan.prefill)
+                now = time.monotonic()
+                for req, _cs, _clen in prefill:
+                    if req.admit_t is None:  # first admission only —
+                        req.admit_t = now    # eviction re-prefills later
             worked = False
             if decode:
                 self._run_decode(decode)
@@ -401,6 +429,11 @@ class Engine:
                     continue
                 self.sched.note_prefilled(req, clen)
                 if req.state == DECODE:
+                    if req.prefill_done_t is None:  # first time only —
+                        req.prefill_done_t = now    # an eviction
+                    # re-prefill must not swallow the first decode
+                    # phase from the journaled lifecycle spans
+                    # (evictions field records the wrinkle)
                     # the final prefill chunk's logits sample the first
                     # new token — no separate "first decode" dispatch
                     self._emit(req, int(nxt[i]), now)
@@ -446,6 +479,8 @@ class Engine:
             if req.state != PREFILL:
                 return
             self.sched.note_prefilled(req, T - req.prefilled)
+            if req.state == DECODE and req.prefill_done_t is None:
+                req.prefill_done_t = now
             self._emit(req, int(np.argmax(logits)), now)
 
     # -- per-token bookkeeping (under self._lock) ----------------------------
@@ -475,9 +510,36 @@ class Engine:
         if done:
             req.finish_t = now
             self.sched.finish(req)
+            self._trace_request(req, "complete", now)
             self._mirror_events()
             if stream is not None:
                 stream._end("finished")
+
+    def _trace_request(self, req, status, now):
+        """Journal the request's lifecycle as spans sharing its trace id
+        (submit already landed at intake). Phase boundaries come from
+        the monotonic stamps collected along the way, re-anchored to
+        the submit wall clock so the journal's epoch-seconds timeline
+        stays coherent."""
+        if req.trace is None:
+            return
+
+        def w(mono):  # monotonic stamp -> journal wall clock
+            return req.wall0 + (mono - req.submit_t)
+
+        _tel.event("serve.request", t=req.wall0, dur=now - req.submit_t,
+                   trace=req.trace, rid=req.rid, status=status,
+                   tokens=len(req.generated), evictions=req.evictions)
+        if req.admit_t is not None:
+            _tel.event("serve.request.prefill", t=w(req.admit_t),
+                       dur=(req.prefill_done_t or now) - req.admit_t,
+                       trace=req.trace, rid=req.rid)
+        if req.prefill_done_t is not None:
+            _tel.event("serve.request.decode", t=w(req.prefill_done_t),
+                       dur=now - req.prefill_done_t,
+                       trace=req.trace, rid=req.rid)
+        _tel.event("serve.request.%s" % status, t=w(now),
+                   trace=req.trace, rid=req.rid)
 
     def _mirror_events(self):
         """Fold scheduler event counts into stats + mxtel counters, and
@@ -497,6 +559,7 @@ class Engine:
             if req.state == CANCELLED:
                 if req.stream is not None and req.stream.status == "running":
                     req.stream._end("cancelled")
+                self._trace_request(req, "cancel", time.monotonic())
                 del self._by_rid[rid]
             elif req.state == FINISHED:
                 del self._by_rid[rid]
@@ -557,4 +620,45 @@ class Engine:
                 "token_latency_p50_s": pct(self._token_lats, 50),
                 "token_latency_p99_s": pct(self._token_lats, 99),
             })
+        return out
+
+    def introspect(self, event_tail=50):
+        """Live request table + pool state + scheduler event tail — the
+        /servingz endpoint's payload (telemetry/server.py). Answers
+        "what is this serving request doing RIGHT NOW": every queued and
+        active request with its state, progress, and trace id."""
+        now = time.monotonic()
+        with self._lock:
+            reqs = []
+            for req in list(self.sched.active) + list(self.sched.queue):
+                reqs.append({
+                    "rid": req.rid, "state": req.state,
+                    "trace": req.trace,
+                    "prompt_len": int(req.prompt.shape[0]),
+                    "ctx_len": req.ctx_len,
+                    "prefilled": req.prefilled,
+                    "generated": len(req.generated),
+                    "max_new_tokens": req.max_new_tokens,
+                    "blocks": len(req.blocks),
+                    "evictions": req.evictions,
+                    "age_s": (now - req.submit_t
+                              if req.submit_t is not None else None),
+                })
+            out = {
+                "policy": self.cfg.policy,
+                "requests": reqs,
+                "pool": {
+                    "capacity_blocks": self.pool.capacity,
+                    "free_blocks": self.pool.num_free,
+                    "utilization": self.pool.utilization(),
+                    "hwm_blocks": self.pool.high_water_mark(),
+                    "block_size": self.cfg.block_size,
+                },
+                "events": [list(e) for e in self.sched.events[-event_tail:]],
+            }
+        # stats() sorts the full latency sample lists for percentiles —
+        # do that in its OWN lock window, not nested inside this one,
+        # so a scrape of a long-lived engine holds the lock per piece
+        # instead of for the whole render
+        out["stats"] = self.stats()
         return out

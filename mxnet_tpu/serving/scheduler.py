@@ -56,7 +56,12 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "state",
                  "blocks", "context", "prefilled", "generated",
                  "submit_t", "first_token_t", "last_token_t", "finish_t",
-                 "evictions", "cancel_requested", "stream")
+                 "evictions", "cancel_requested", "stream",
+                 # request-scoped tracing (engine fills these in when
+                 # telemetry is on; scheduling never reads them):
+                 # trace id, submit wall-clock anchor, first-admission
+                 # and prefill-complete monotonic stamps
+                 "trace", "wall0", "admit_t", "prefill_done_t")
 
     def __init__(self, prompt, max_new_tokens, eos_id=None, stream=None):
         self.rid = next(_rid)
@@ -78,6 +83,10 @@ class Request:
         self.evictions = 0
         self.cancel_requested = False
         self.stream = stream
+        self.trace = None
+        self.wall0 = None
+        self.admit_t = None
+        self.prefill_done_t = None
 
     @property
     def ctx_len(self):

@@ -17,6 +17,10 @@ Enablement contract::
     MXNET_TELEMETRY=1                 # master switch (off by default)
     MXNET_TELEMETRY_JOURNAL=run.jsonl # optional JSONL run journal
     MXNET_TELEMETRY_FLUSH_SECS=10     # journal flush cadence
+    MXNET_TELEMETRY_HTTP=8321         # optional live introspection
+                                      # server (mxdash, server.py):
+                                      # /metrics /healthz /statusz
+                                      # /tracez /enginez /servingz
 
 Instrumented hot paths guard on the module attribute ``ENABLED``::
 
@@ -35,12 +39,17 @@ catalog lives in docs/how_to/observability.md.
 from __future__ import annotations
 
 import os
+import time as _time
 
 from . import registry as _registry_mod
 from . import tracing
 from . import export
+from . import server
 from .registry import Counter, Gauge, Histogram, Registry, default_registry
-from .tracing import span, current_span, span_aggregates, span_tail
+from .tracing import (
+    span, current_span, span_aggregates, span_tail,
+    wire_context, mint_trace, open_spans, event,
+)
 from .export import (
     console_summary, flush_at_exit, journal_path, prometheus_text,
 )
@@ -49,9 +58,14 @@ __all__ = [
     "ENABLED", "enabled", "reload", "reset", "flush",
     "counter", "gauge", "histogram", "span", "current_span",
     "span_aggregates", "span_tail", "snapshot",
+    "wire_context", "mint_trace", "open_spans", "event",
     "Counter", "Gauge", "Histogram", "Registry", "default_registry",
     "console_summary", "prometheus_text", "journal_path", "flush_at_exit",
 ]
+
+#: subsystem import time — /statusz uptime (telemetry is imported at
+#: package init, so this is ~process start)
+_T0 = _time.time()
 
 #: Master switch. Instrumentation reads this ONE attribute; everything
 #: else in the subsystem sits behind it.
@@ -69,8 +83,9 @@ def _env_on(name):
 
 def reload():
     """Re-read MXNET_TELEMETRY / MXNET_TELEMETRY_JOURNAL /
-    MXNET_TELEMETRY_FLUSH_SECS and apply them. Called once at import;
-    tests call it after mutating the environment."""
+    MXNET_TELEMETRY_FLUSH_SECS / MXNET_TELEMETRY_HTTP and apply them.
+    Called once at import; tests call it after mutating the
+    environment."""
     global ENABLED
     ENABLED = _env_on("MXNET_TELEMETRY")
     path = os.environ.get("MXNET_TELEMETRY_JOURNAL", "").strip() or None
@@ -82,6 +97,11 @@ def reload():
     except ValueError:
         flush_secs = None
     export.configure(path, flush_secs)
+    # live introspection server (mxdash): gated on BOTH the master
+    # switch and the endpoint var — off means no thread and no socket
+    http_spec = server.parse_spec(
+        os.environ.get("MXNET_TELEMETRY_HTTP")) if ENABLED else None
+    server.configure(http_spec)
     return ENABLED
 
 

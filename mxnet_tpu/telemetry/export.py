@@ -112,6 +112,13 @@ def _open_locked():
         _path = None
         _buffer[:] = []
         return
+    # identity header: trace_merge (tools/trace_merge.py) reads the
+    # rank from the journal itself instead of trusting file names
+    _buffer.insert(0, {
+        "kind": "meta", "t": time.time(), "pid": os.getpid(),
+        "rank": int(os.environ.get("MXNET_PROC_ID", "0") or 0),
+        "world": int(os.environ.get("MXNET_NUM_PROCS", "1") or 1),
+    })
     stop = _flusher_stop = threading.Event()
     # a zero/negative cadence would busy-loop the flusher thread
     secs = _flush_secs if _flush_secs > 0 else DEFAULT_FLUSH_SECS

@@ -1,0 +1,626 @@
+"""mxdash tests (ISSUE 10): live introspection server, cross-process
+trace propagation, per-rank journal merging, serving request traces,
+and the telemetry catalog gate.
+
+The load-bearing acceptance properties:
+
+- with ``MXNET_TELEMETRY_HTTP`` set during a live fit, ``/metrics``
+  serves valid Prometheus text and ``/tracez`` shows the open
+  epoch ▸ batch spans; with telemetry off there is no thread and no
+  socket (zero added work);
+- a coordinator RPC opens a server-side span in the CALLER's trace
+  (wire-context propagation) and journals clock records;
+- one serving request's spans share a trace id and reconstruct its
+  lifetime from the journal alone;
+- trace_merge aligns journals with known clock skew and identifies the
+  straggler rank, and its Chrome export is loadable JSON.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.analysis import telemetry_lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if os.path.join(ROOT, "tools") not in sys.path:
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import trace_merge as trace_merge_cli  # noqa: E402
+
+merge = trace_merge_cli.load_merge_module()
+
+
+def _enable(monkeypatch, journal=None, http=None):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    if journal is not None:
+        monkeypatch.setenv("MXNET_TELEMETRY_JOURNAL", str(journal))
+    else:
+        monkeypatch.delenv("MXNET_TELEMETRY_JOURNAL", raising=False)
+    if http is not None:
+        monkeypatch.setenv("MXNET_TELEMETRY_HTTP", str(http))
+    else:
+        monkeypatch.delenv("MXNET_TELEMETRY_HTTP", raising=False)
+    telemetry.reset()
+    assert telemetry.reload() is True
+
+
+def _get(path, timeout=10):
+    port = telemetry.server.port()
+    assert port is not None
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+def _http_threads():
+    return [t for t in threading.enumerate() if t.name == "mxtel-http"]
+
+
+# -- off-by-default zero-overhead guards ---------------------------------------
+class TestOffByDefault:
+    def test_no_server_without_endpoint_var(self, monkeypatch):
+        _enable(monkeypatch)  # telemetry on, HTTP unset
+        assert telemetry.server.port() is None
+        assert not telemetry.server.running()
+        assert _http_threads() == []
+
+    def test_no_server_without_master_switch(self, monkeypatch):
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        monkeypatch.setenv("MXNET_TELEMETRY_HTTP", "0")
+        telemetry.reset()
+        telemetry.reload()
+        # HTTP var alone must not open a socket: the master switch
+        # gates the whole subsystem
+        assert telemetry.server.port() is None
+        assert _http_threads() == []
+
+    def test_disabled_paths_mint_no_traces(self, monkeypatch):
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.reset()
+        telemetry.reload()
+        assert telemetry.wire_context() is None
+        assert telemetry.event("nope") is None
+        assert telemetry.open_spans() == []
+        with telemetry.span("off"):
+            assert telemetry.wire_context() is None
+        assert telemetry.span_aggregates() == {}
+
+
+# -- span/trace unit semantics -------------------------------------------------
+class TestTraceIds:
+    def test_children_inherit_trace_roots_mint(self, monkeypatch):
+        _enable(monkeypatch)
+        with telemetry.span("root"):
+            ctx = telemetry.wire_context()
+            with telemetry.span("child"):
+                assert telemetry.wire_context()["trace"] == ctx["trace"]
+        with telemetry.span("other-root"):
+            assert telemetry.wire_context()["trace"] != ctx["trace"]
+        tail = {r["name"]: r for r in telemetry.span_tail()}
+        assert tail["child"]["trace"] == tail["root"]["trace"]
+        assert tail["other-root"]["trace"] != tail["root"]["trace"]
+
+    def test_wire_adoption_records_remote_parent(self, monkeypatch):
+        _enable(monkeypatch)
+        ctx = {"trace": "feed-1", "span": 777}
+        with telemetry.span("server-side", wire=ctx):
+            pass
+        rec = telemetry.span_tail(1)[0]
+        assert rec["trace"] == "feed-1"
+        assert rec["remote_parent"] == 777
+
+    def test_event_lands_in_tail_and_aggregates(self, monkeypatch):
+        _enable(monkeypatch)
+        telemetry.event("lifecycle", t=123.0, dur=2.5, trace="t-1", rid=9)
+        rec = telemetry.span_tail(1)[0]
+        assert rec["t"] == 123.0 and rec["dur"] == 2.5
+        assert rec["trace"] == "t-1" and rec["rid"] == 9
+        assert telemetry.span_aggregates()["lifecycle"]["total"] == 2.5
+
+    def test_open_spans_live_view(self, monkeypatch):
+        _enable(monkeypatch)
+        with telemetry.span("held"):
+            live = telemetry.open_spans()
+            assert [r["name"] for r in live] == ["held"]
+            assert live[0]["age_s"] >= 0.0
+        assert telemetry.open_spans() == []
+
+
+# -- the introspection server --------------------------------------------------
+class TestServer:
+    def test_endpoint_roundtrips(self, monkeypatch):
+        _enable(monkeypatch, http="0")  # ephemeral port
+        assert telemetry.server.running()
+        assert _get("/healthz") == "ok\n"
+        telemetry.counter("engine.push_total").inc(5)
+        prom = _get("/metrics")
+        assert "# TYPE mxtpu_engine_push_total counter" in prom
+        assert re.search(r"^mxtpu_engine_push_total 5$", prom, re.M)
+        status = json.loads(_get("/statusz"))
+        assert status["pid"] == os.getpid()
+        assert "MXNET_TELEMETRY" in status["env"]
+        with telemetry.span("openz"):
+            tz = json.loads(_get("/tracez?n=5"))
+        assert "openz" in [r["name"] for r in tz["open"]]
+        ez = json.loads(_get("/enginez"))
+        assert "engine" in ez  # engine may or may not exist yet
+        sz = json.loads(_get("/servingz"))
+        assert isinstance(sz["engines"], list)
+
+    def test_unknown_endpoint_404(self, monkeypatch):
+        _enable(monkeypatch, http="0")
+        port = telemetry.server.port()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/bogus" % port, timeout=10)
+        assert ei.value.code == 404
+
+    def test_scrape_during_live_fit(self, monkeypatch, tmp_path):
+        """ISSUE acceptance: scrape mid-run returns valid Prometheus
+        text and /tracez shows the OPEN epoch/batch spans of the fit in
+        flight."""
+        _enable(monkeypatch, http="0")
+        seen = {}
+
+        def scrape_cb(param):
+            if param.nbatch == 2 and not seen:
+                seen["prom"] = _get("/metrics")
+                seen["tracez"] = json.loads(_get("/tracez"))
+                seen["enginez"] = json.loads(_get("/enginez"))
+
+        # make sure the host-task engine singleton exists so /enginez
+        # has something to introspect (a pure local fit may never push)
+        from mxnet_tpu import engine as _eng
+
+        _eng.push(lambda: None)
+        _eng.wait_for_all()
+        rng = np.random.RandomState(3)
+        X = rng.rand(64, 8).astype("f")
+        Y = (X[:, 0] > 0.5).astype("f")
+        train = mx.io.NDArrayIter(X, Y, batch_size=16)
+        fc = mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                                   num_hidden=2, name="fc")
+        sym = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+        model = mx.FeedForward(sym, ctx=mx.cpu(), num_epoch=2,
+                               learning_rate=0.1)
+        model.fit(X=train, kvstore=mx.kvstore.create("local"),
+                  batch_end_callback=scrape_cb)
+        assert seen, "callback never scraped"
+        # valid Prometheus exposition lines, with live training metrics
+        for line in seen["prom"].splitlines():
+            assert re.match(r"^(# TYPE \S+ (counter|gauge|summary)|"
+                            r'\S+({quantile="[\d.]+"})? [-+0-9.eginf]+)$',
+                            line), line
+        assert "mxtpu_train_step_secs" in seen["prom"]
+        open_names = [r["name"] for r in seen["tracez"]["open"]]
+        assert "epoch" in open_names and "batch" in open_names
+        ep = next(r for r in seen["tracez"]["open"] if r["name"] == "epoch")
+        ba = next(r for r in seen["tracez"]["open"] if r["name"] == "batch")
+        assert ba["parent"] == ep["id"] and ba["trace"] == ep["trace"]
+        # /enginez reports the live engine's state mid-run
+        assert seen["enginez"]["engine"] is not None
+        assert seen["enginez"]["pending"] >= 0
+
+    def test_server_stops_on_reload_off(self, monkeypatch):
+        _enable(monkeypatch, http="0")
+        t = _http_threads()
+        assert t
+        monkeypatch.delenv("MXNET_TELEMETRY_HTTP")
+        telemetry.reload()
+        t[0].join(timeout=10)
+        assert not t[0].is_alive()
+        assert telemetry.server.port() is None
+
+
+# -- cross-process trace propagation -------------------------------------------
+class TestWirePropagation:
+    def test_coordinator_round_joins_callers_trace(self, monkeypatch,
+                                                   tmp_path):
+        from mxnet_tpu.elastic.client import ElasticClient
+        from mxnet_tpu.elastic.server import ElasticCoordinator
+
+        journal = tmp_path / "wire.jsonl"
+        _enable(monkeypatch, journal=journal)
+        coord = ElasticCoordinator(world=1, bind=("127.0.0.1", 0)).start()
+        try:
+            client = ElasticClient(coord.addr, 0)
+            with telemetry.span("caller-op"):
+                client.register()
+                caller_trace = telemetry.wire_context()["trace"]
+            client.call("init", key="w", value=np.zeros(4, "f"))
+            client.push_grad("w", 1, np.ones(4, "f"))
+            client.pull_weights("w", 1)
+        finally:
+            coord.stop()
+        telemetry.flush()
+        recs = [json.loads(l) for l in open(journal)]
+        spans = [r for r in recs if r.get("kind") == "span"]
+        srv = next(s for s in spans
+                   if s["name"] == "elastic.serve.register")
+        rpc = next(s for s in spans if s["name"] == "elastic.rpc.register")
+        assert srv["trace"] == rpc["trace"] == caller_trace
+        assert srv["remote_parent"] == rpc["id"]
+        # rounds outside any client span still trace (root at the rpc)
+        push_srv = next(s for s in spans
+                        if s["name"] == "elastic.serve.push")
+        push_rpc = next(s for s in spans
+                        if s["name"] == "elastic.rpc.push")
+        assert push_srv["trace"] == push_rpc["trace"]
+        # clock records journaled for fast ops, with a sane offset
+        clocks = [r for r in recs if r.get("kind") == "clock"]
+        assert clocks, "no clock records journaled"
+        for c in clocks:
+            assert c["t0"] <= c["t1"]
+            # in-process round trip: offset within a second of zero
+            assert abs(c["srv_t"] - (c["t0"] + c["t1"]) / 2.0) < 1.0
+        # the journal opens with the identity header
+        assert recs[0]["kind"] == "meta" and "rank" in recs[0]
+
+    def test_off_path_sends_no_envelope(self, monkeypatch):
+        """Telemetry off: the RPC request must not carry _trace and no
+        clock/span work happens — the zero-added-work contract on the
+        coordinator wire."""
+        from mxnet_tpu.elastic.client import ElasticClient
+        from mxnet_tpu.elastic.server import ElasticCoordinator
+        from mxnet_tpu.elastic import protocol
+
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.reset()
+        telemetry.reload()
+        seen = []
+        orig = protocol.call
+
+        def spy(addr, req, timeout=30.0):
+            seen.append(dict(req))
+            return orig(addr, req, timeout=timeout)
+
+        monkeypatch.setattr(protocol, "call", spy)
+        coord = ElasticCoordinator(world=1, bind=("127.0.0.1", 0)).start()
+        try:
+            ElasticClient(coord.addr, 0).register()
+        finally:
+            coord.stop()
+        assert seen and all("_trace" not in r for r in seen)
+        assert telemetry.span_aggregates() == {}
+
+
+# -- serving request traces ----------------------------------------------------
+@pytest.fixture(scope="module")
+def serving_model():
+    import jax
+
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, d_model=32,
+                            num_heads=2, d_ff=64, max_seq_len=96,
+                            dtype="float32")
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+class TestServingTrace:
+    def test_request_lifecycle_shares_one_trace(self, monkeypatch,
+                                                tmp_path, serving_model):
+        from mxnet_tpu.serving import Engine, ServingConfig
+
+        journal = tmp_path / "serve.jsonl"
+        _enable(monkeypatch, journal=journal)
+        cfg, params = serving_model
+        eng = Engine(params, cfg,
+                     ServingConfig(block_size=8, num_blocks=33,
+                                   max_batch=4, prefill_chunk=16))
+        h = eng.submit(np.arange(7, dtype=np.int32), max_new_tokens=4)
+        eng.run_until_idle()
+        assert len(h.result()) == 4
+        telemetry.flush()
+        recs = [json.loads(l) for l in open(journal)]
+        by = {}
+        for r in recs:
+            if r.get("kind") == "span" and \
+                    r["name"].startswith("serve.request"):
+                by.setdefault(r["name"], []).append(r)
+        phases = ["serve.request.submit", "serve.request.prefill",
+                  "serve.request.decode", "serve.request.complete"]
+        for name in phases + ["serve.request"]:
+            assert name in by, (name, sorted(by))
+        # acceptance: one trace id across submit→prefill→decode→complete
+        traces = {r["trace"] for v in by.values() for r in v}
+        assert len(traces) == 1
+        # the journal alone reconstructs the lifetime: monotone phase
+        # starts, root span covering the whole run
+        sub, pre, dec, comp = (by[n][0] for n in phases)
+        assert sub["t"] <= pre["t"] <= dec["t"] <= comp["t"]
+        root = by["serve.request"][0]
+        assert root["t"] == sub["t"]
+        assert root["t"] + root["dur"] == pytest.approx(comp["t"], abs=0.05)
+        assert root["tokens"] == 4 and root["status"] == "complete"
+
+    def test_servingz_endpoint_reports_live_requests(self, monkeypatch,
+                                                     serving_model):
+        from mxnet_tpu.serving import Engine, ServingConfig
+
+        _enable(monkeypatch, http="0")
+        cfg, params = serving_model
+        eng = Engine(params, cfg,
+                     ServingConfig(block_size=8, num_blocks=33,
+                                   max_batch=4, prefill_chunk=16))
+        eng.submit(np.arange(9, dtype=np.int32), max_new_tokens=3)
+        sz = json.loads(_get("/servingz"))
+        mine = [e for e in sz["engines"]
+                if any(r["state"] == "queued" for r in e["requests"])]
+        assert mine, sz
+        req = mine[0]["requests"][0]
+        assert req["prompt_len"] == 9 and req["trace"]
+        eng.run_until_idle()
+        assert eng.introspect()["requests"] == []
+
+    def test_cancel_traces_cancel_event(self, monkeypatch, tmp_path,
+                                        serving_model):
+        from mxnet_tpu.serving import Engine, ServingConfig
+
+        journal = tmp_path / "cancel.jsonl"
+        _enable(monkeypatch, journal=journal)
+        cfg, params = serving_model
+        eng = Engine(params, cfg,
+                     ServingConfig(block_size=8, num_blocks=33,
+                                   max_batch=4, prefill_chunk=16))
+        h = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=8)
+        h.cancel()
+        eng.run_until_idle()
+        telemetry.flush()
+        recs = [json.loads(l) for l in open(journal)]
+        names = [r["name"] for r in recs if r.get("kind") == "span"
+                 and r["name"].startswith("serve.request")]
+        assert "serve.request.cancel" in names
+
+    def test_off_path_leaves_requests_untraced(self, monkeypatch,
+                                               serving_model):
+        from mxnet_tpu.serving import Engine, ServingConfig
+
+        monkeypatch.delenv("MXNET_TELEMETRY", raising=False)
+        telemetry.reset()
+        telemetry.reload()
+        cfg, params = serving_model
+        eng = Engine(params, cfg,
+                     ServingConfig(block_size=8, num_blocks=33,
+                                   max_batch=4, prefill_chunk=16))
+        h = eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=2)
+        eng.run_until_idle()
+        assert len(h.result()) == 2
+        assert telemetry.span_aggregates() == {}
+
+
+# -- journal merging -----------------------------------------------------------
+def _write_journal(path, recs):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _rank_journal(rank, skew, wait_durs, n_batches=6, epoch_dur=10.0):
+    """Synthetic rank journal: local clock = server clock + skew (so
+    clock records imply offset -skew), one epoch span, batches, and the
+    given kvstore.round_wait durations."""
+    base = 1000.0 + skew
+    recs = [{"kind": "meta", "t": base, "rank": rank, "pid": 100 + rank,
+             "world": 2}]
+    for i in range(4):
+        recs.append({"kind": "clock", "op": "beat", "rank": rank,
+                     "t0": base + i, "t1": base + i + 0.02,
+                     "srv_t": 1000.0 + i + 0.01})
+    recs.append({"kind": "span", "name": "epoch", "id": 1, "parent": None,
+                 "trace": "r%d-1" % rank, "t": base + 1.0,
+                 "dur": epoch_dur, "thread": "MainThread"})
+    for i in range(n_batches):
+        recs.append({"kind": "span", "name": "batch", "id": 10 + i,
+                     "parent": 1, "trace": "r%d-1" % rank,
+                     "t": base + 1.5 + i, "dur": 0.3,
+                     "thread": "MainThread"})
+    for i, d in enumerate(wait_durs):
+        recs.append({"kind": "span", "name": "kvstore.round_wait",
+                     "id": 100 + i, "parent": 1, "trace": "r%d-1" % rank,
+                     "t": base + 2.0 + i, "dur": d,
+                     "thread": "MainThread"})
+    recs.append({"kind": "metrics", "t": base + 1.0 + epoch_dur,
+                 "mark": "exit", "counters": {}, "gauges": {},
+                 "histograms": {"train.step_secs": {
+                     "count": n_batches, "sum": 1.0, "min": 0.1,
+                     "max": 0.3, "p50": 0.15, "p95": 0.3, "p99": 0.3}}})
+    return recs
+
+
+class TestTraceMerge:
+    def test_known_skew_is_recovered_and_aligned(self, tmp_path):
+        j0 = str(tmp_path / "j-0.jsonl")
+        j1 = str(tmp_path / "j-1.jsonl")
+        # rank 0 waits a lot (on rank 1); rank 1 barely waits
+        _write_journal(j0, _rank_journal(0, skew=0.0,
+                                         wait_durs=[0.9] * 6))
+        _write_journal(j1, _rank_journal(1, skew=7.5,
+                                         wait_durs=[0.05]))
+        merged = merge.merge([j0, j1])
+        assert merged["ranks"][0]["offset"] == pytest.approx(0.0, abs=0.02)
+        assert merged["ranks"][1]["offset"] == pytest.approx(-7.5, abs=0.02)
+        epochs = [s for s in merged["spans"] if s["name"] == "epoch"]
+        # after alignment both epochs start at the same server-clock time
+        assert abs(epochs[0]["t_aligned"] - epochs[1]["t_aligned"]) < 0.05
+        rows = merge.epoch_rows(merged)
+        by_rank = {r["rank"]: r for r in rows}
+        assert by_rank[0]["wait_s"] == pytest.approx(5.4, abs=0.01)
+        assert by_rank[1]["wait_s"] == pytest.approx(0.05, abs=0.01)
+        assert by_rank[0]["compute_s"] < by_rank[1]["compute_s"]
+        rep = merge.straggler_report(merged, rows)
+        assert rep["straggler"] == 1  # everyone waited on rank 1
+
+    def test_truncated_journal_identifies_killed_rank(self, tmp_path):
+        j0 = str(tmp_path / "k-0.jsonl")
+        j1 = str(tmp_path / "k-1.jsonl")
+        _write_journal(j0, _rank_journal(0, 0.0, [0.5] * 4,
+                                         epoch_dur=30.0))
+        # rank 1's journal stops early AND closes no epoch: killed
+        recs = _rank_journal(1, 0.0, [0.1], epoch_dur=30.0)
+        recs = [r for r in recs if r.get("t", 0) < 1005.0
+                and r.get("name") != "epoch"]
+        _write_journal(j1, recs)
+        rep = merge.straggler_report(merge.merge([j0, j1]))
+        assert rep["straggler"] == 1
+        assert 1 in (rep["truncated"] + rep["incomplete"])
+
+    def test_chrome_export_is_perfetto_shaped(self, tmp_path):
+        j0 = str(tmp_path / "c-0.jsonl")
+        j1 = str(tmp_path / "c-1.jsonl")
+        _write_journal(j0, _rank_journal(0, 0.0, [0.2]))
+        _write_journal(j1, _rank_journal(1, 3.0, [0.2]))
+        trace = merge.chrome_trace(merge.merge([j0, j1]))
+        evs = trace["traceEvents"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert xs and all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+        assert {e["pid"] for e in xs} == {0, 1}
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in evs)
+        json.dumps(trace)  # serializable as-is
+
+    def test_cli_and_report_tool_integration(self, tmp_path):
+        j0 = str(tmp_path / "m-0.jsonl")
+        j1 = str(tmp_path / "m-1.jsonl")
+        _write_journal(j0, _rank_journal(0, 0.0, [0.8] * 5))
+        _write_journal(j1, _rank_journal(1, 5.0, [0.05]))
+        chrome = str(tmp_path / "merged.json")
+        res = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "trace_merge.py"),
+             j0, j1, "--chrome", chrome, "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert res.returncode == 0, res.stderr
+        rep = json.loads(res.stdout)
+        assert rep["report"]["straggler"] == 1
+        assert {r["rank"] for r in rep["ranks"]} == {0, 1}
+        assert json.load(open(chrome))["traceEvents"]
+        res2 = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "telemetry_report.py"), j0, j1],
+            capture_output=True, text=True, timeout=120)
+        assert res2.returncode == 0, res2.stderr
+        assert "cross-rank (2 journals)" in res2.stdout
+        assert "straggler: rank 1" in res2.stdout
+        # an empty FIRST journal (rank killed before its first flush)
+        # must not suppress the cross-rank view over the healthy ones
+        jdead = str(tmp_path / "m-dead.jsonl")
+        _write_journal(jdead, [])
+        res3 = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "telemetry_report.py"),
+             jdead, j0, j1],
+            capture_output=True, text=True, timeout=120)
+        assert res3.returncode == 0, res3.stderr
+        assert "cross-rank (3 journals)" in res3.stdout
+
+    def test_empty_journals_fail_cleanly(self, tmp_path):
+        j = str(tmp_path / "empty.jsonl")
+        _write_journal(j, [])
+        res = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "trace_merge.py"),
+             j], capture_output=True, text=True, timeout=120)
+        assert res.returncode == 1
+        assert "no spans" in res.stderr
+
+
+# -- launcher env fan-out ------------------------------------------------------
+class TestLaunchEnv:
+    def _env(self, rank, **env):
+        import launch
+
+        class A:
+            coordinator = "127.0.0.1:9876"
+            num_workers = 4
+            elastic = True
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            return launch._worker_env(A(), rank)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def test_http_rank_templating(self):
+        env = self._env(2, MXNET_TELEMETRY_HTTP="90{rank}1")
+        assert env["MXNET_TELEMETRY_HTTP"] == "9021"
+
+    def test_http_base_port_offsets(self):
+        assert self._env(0, MXNET_TELEMETRY_HTTP="8321")[
+            "MXNET_TELEMETRY_HTTP"] == "8321"
+        assert self._env(3, MXNET_TELEMETRY_HTTP="8321")[
+            "MXNET_TELEMETRY_HTTP"] == "8324"
+        assert self._env(2, MXNET_TELEMETRY_HTTP="0.0.0.0:9000")[
+            "MXNET_TELEMETRY_HTTP"] == "0.0.0.0:9002"
+        # ephemeral stays ephemeral (already collision-free)
+        assert self._env(2, MXNET_TELEMETRY_HTTP="0")[
+            "MXNET_TELEMETRY_HTTP"] == "0"
+
+    def test_journal_templating_unchanged(self):
+        env = self._env(1, MXNET_TELEMETRY_JOURNAL="/tmp/j-{rank}.jsonl")
+        assert env["MXNET_TELEMETRY_JOURNAL"] == "/tmp/j-1.jsonl"
+
+
+# -- telemetry catalog gate ----------------------------------------------------
+class TestCatalogGate:
+    def test_clean_repo(self):
+        assert telemetry_lint.lint_catalog() == []
+
+    def test_undocumented_metric_is_an_error(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "def f(tel):\n"
+            "    tel.counter('rogue.subsystem_total').inc()\n")
+        doc = tmp_path / "doc.md"
+        doc.write_text("| `known.metric` | counter | x |\n")
+        fs = telemetry_lint.lint_catalog(str(pkg), str(doc))
+        codes = {(f.code, f.where) for f in fs}
+        assert ("undocumented-metric", "rogue.subsystem_total") in codes
+        assert ("stale-catalog-entry", "known.metric") in codes
+
+    def test_wildcards_and_pragmas_cover(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "def f(tel, name):\n"
+            "    tel.counter('fam.req_%s' % name).inc()\n"
+            "    # mxtel-metrics: dyn.total\n"
+            "    tel.gauge(name).set(1)\n")
+        doc = tmp_path / "doc.md"
+        doc.write_text("| `fam.req_{a,b}` | counter | x |\n"
+                       "| `dyn.total` | gauge | y |\n")
+        assert telemetry_lint.lint_catalog(str(pkg), str(doc)) == []
+
+    def test_dynamic_site_without_pragma_is_info(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "def f(tel, name):\n"
+            "    tel.counter(name).inc()\n")
+        doc = tmp_path / "doc.md"
+        doc.write_text("\n")
+        fs = telemetry_lint.lint_catalog(str(pkg), str(doc))
+        assert [f.code for f in fs] == ["dynamic-metric-name"]
+        assert fs[0].severity == "info"
+
+    def test_cli_flag(self):
+        res = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+             "--telemetry"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "checked 1 target(s)" in res.stdout

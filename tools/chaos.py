@@ -445,6 +445,10 @@ def _run_elastic_leg(tag, scratch, port, timeout, extra_env=None,
         # per-rank journals: launch.py expands {rank}
         "MXNET_TELEMETRY_JOURNAL": os.path.join(
             scratch, tag + "-journal-{rank}.jsonl"),
+        # tight flush cadence: a SIGKILLed rank must leave mid-run spans
+        # on disk for the trace_merge attribution leg (buffered records
+        # die with the process)
+        "MXNET_TELEMETRY_FLUSH_SECS": "2",
     })
     env.update(extra_env or {})
     cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
@@ -482,6 +486,31 @@ def _run_elastic_leg(tag, scratch, port, timeout, extra_env=None,
         for k, v in folded.items():
             counters[k] = max(counters.get(k, 0), v)
     return rc, accs, counters, out
+
+
+def _run_trace_merge(scratch, tag):
+    """tools/trace_merge.py over one leg's per-rank journals. Returns
+    (output, parsed report dict or None). The Perfetto trace lands next
+    to the journals (ISSUE 10 acceptance: clock-aligned merged timeline
+    + trace-event JSON from a real chaos run)."""
+    journals = [os.path.join(scratch, "%s-journal-%d.jsonl" % (tag, r))
+                for r in range(_ELASTIC_N)]
+    chrome = os.path.join(scratch, "%s-merged-trace.json" % tag)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "trace_merge.py"),
+           *journals, "--chrome", chrome, "--json"]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, text=True,
+                              capture_output=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        # a wedged merge is a leg FAILURE, not a harness crash — the
+        # survival report (and the other legs' verdicts) must still land
+        return "<trace_merge HUNG: exceeded 120s>", None
+    if proc.returncode != 0:
+        return proc.stdout + proc.stderr, None
+    try:
+        return proc.stdout + proc.stderr, json.loads(proc.stdout)
+    except ValueError:
+        return proc.stdout + proc.stderr, None
 
 
 def run_elastic(args):
@@ -524,14 +553,21 @@ def run_elastic(args):
                 "below fault-free %.3f" % (worst, _ELASTIC_ACC_TOL,
                                            base_acc))
 
-    print("chaos --elastic: rejoin leg (SIGKILL rank 3, restart, rejoin)")
+    print("chaos --elastic: rejoin leg (SIGKILL rank 3, restart held past "
+          "the evict window, rejoin)")
     mark = tempfile.mkdtemp(prefix="mark-", dir=scratch)
+    # --restart-delay 5 > MXNET_KV_EVICT_AFTER=3 (+ sweep cadence):
+    # the dead incarnation is always EVICTED before the respawn
+    # re-registers, so rejoins_total >= 1 is deterministic. Without the
+    # hold, warm jit caches respawn the worker inside the 3s window and
+    # its register is a plain (uncounted) re-admission — the
+    # pre-existing rejoin-leg flake (PR 9 NB).
     rc2, accs2, c2, out2 = _run_elastic_leg(
         "rejoin", scratch, port + 2, per_leg,
         extra_env={"MXNET_ELASTIC_TEST_DIE_RANK": "3",
                    "MXNET_ELASTIC_TEST_DIE_AT": "15",
                    "MXNET_ELASTIC_TEST_MARK": mark},
-        launch_args=["--max-restarts", "1"])
+        launch_args=["--max-restarts", "1", "--restart-delay", "5"])
     if rc2 != 0 or len(accs2) != _ELASTIC_N:
         failures.append("rejoin leg: not every rank (incl. the restarted "
                         "one) finished (rc=%d, done=%s)\n%s"
@@ -540,6 +576,26 @@ def run_elastic(args):
         failures.append("rejoin leg: no rejoin recorded in the journal "
                         "(counters: %s)" % c2)
 
+    print("chaos --elastic: trace-merge leg (merged timeline over the "
+          "evict leg's %d journals)" % _ELASTIC_N)
+    merge_out, merge_rep = _run_trace_merge(scratch, "evict")
+    if merge_rep is None:
+        failures.append("trace-merge leg: tools/trace_merge.py failed\n%s"
+                        % merge_out[-2000:])
+    else:
+        if merge_rep.get("report", {}).get("straggler") != 3:
+            failures.append(
+                "trace-merge leg: attribution did not identify killed "
+                "rank 3 (report: %s)" % merge_rep.get("report"))
+        chrome = os.path.join(scratch, "evict-merged-trace.json")
+        try:
+            with open(chrome) as f:
+                n_events = len(json.load(f)["traceEvents"])
+        except (OSError, ValueError, KeyError) as e:
+            n_events = 0
+            failures.append("trace-merge leg: Perfetto trace unreadable "
+                            "(%s)" % e)
+
     print("\n=== elastic survival report ===")
     print("baseline acc    : %s"
           % ("%.4f" % base_acc if base_acc is not None else "FAILED"))
@@ -547,6 +603,12 @@ def run_elastic(args):
           % (rc1, sorted(survivors), {r: round(a, 3)
                                       for r, a in survivors.items()}))
     print("rejoin leg      : rc=%d finished=%s" % (rc2, sorted(accs2)))
+    if merge_rep is not None:
+        rep = merge_rep.get("report", {})
+        print("trace merge     : straggler=rank %s truncated=%s "
+              "incomplete=%s perfetto_events=%d"
+              % (rep.get("straggler"), rep.get("truncated"),
+                 rep.get("incomplete"), n_events))
     for name, counters in (("evict", c1), ("rejoin", c2)):
         print("%-6s counters : evictions=%d rejoins=%d degraded_steps=%d"
               % (name,

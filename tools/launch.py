@@ -58,6 +58,21 @@ def _worker_env(args, rank):
     journal = env.get("MXNET_TELEMETRY_JOURNAL", "")
     if "{rank}" in journal:
         env["MXNET_TELEMETRY_JOURNAL"] = journal.format(rank=rank)
+    # per-rank mxdash introspection ports (docs/how_to/observability.md):
+    # N processes cannot share one listen port. {rank} templates like
+    # the journal; a plain base port fans out as base+rank — either way
+    # a launched job is scrapeable out of the box.
+    http = env.get("MXNET_TELEMETRY_HTTP", "").strip()
+    if "{rank}" in http:
+        env["MXNET_TELEMETRY_HTTP"] = http.format(rank=rank)
+    elif http:
+        host, sep, port = http.rpartition(":")
+        try:
+            base = int(port)
+        except ValueError:
+            base = -1  # telemetry.reload() warns about the malformed value
+        if base > 0:  # 0 = ephemeral everywhere, already collision-free
+            env["MXNET_TELEMETRY_HTTP"] = host + sep + str(base + rank)
     return env
 
 
@@ -102,9 +117,15 @@ def launch_local(args, cmd):
     # never re-admit it — the restart would just wedge the collectives
     restarts_left = args.max_restarts if args.elastic else 0
     failed = {}  # rank -> exit code of its FINAL incarnation
+    pending = {}  # rank -> monotonic respawn deadline (--restart-delay)
     try:
-        while procs:
+        while procs or pending:
             time.sleep(0.2)
+            now = time.monotonic()
+            for rank in [r for r, t in pending.items() if now >= t]:
+                del pending[rank]
+                procs[rank] = subprocess.Popen(
+                    cmd, env=_worker_env(args, rank))
             for rank, p in list(procs.items()):
                 rc = p.poll()
                 if rc is None:
@@ -116,10 +137,23 @@ def launch_local(args, cmd):
                 if restarts_left > 0:
                     restarts_left -= 1
                     print("launch: worker %d exited %d — restarting "
-                          "(%d restart(s) left)" % (rank, rc, restarts_left),
+                          "(%d restart(s) left%s)"
+                          % (rank, rc, restarts_left,
+                             ", after %.1fs" % args.restart_delay
+                             if args.restart_delay > 0 else ""),
                           file=sys.stderr)
-                    procs[rank] = subprocess.Popen(
-                        cmd, env=_worker_env(args, rank))
+                    if args.restart_delay > 0:
+                        # deferred respawn (non-blocking: other workers
+                        # stay supervised): holding the replacement past
+                        # the coordinator's MXNET_KV_EVICT_AFTER window
+                        # guarantees the dead incarnation is EVICTED
+                        # before the new one registers — so the rejoin
+                        # counter proves a real recovery instead of
+                        # racing the eviction sweep (chaos.py --elastic)
+                        pending[rank] = now + args.restart_delay
+                    else:
+                        procs[rank] = subprocess.Popen(
+                            cmd, env=_worker_env(args, rank))
                 else:
                     failed[rank] = rc
     except KeyboardInterrupt:
@@ -187,6 +221,11 @@ def main():
                         "mode), export MXNET_KV_ELASTIC/MXNET_ELASTIC_COORD")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="total respawns of dead workers (elastic rejoin)")
+    p.add_argument("--restart-delay", type=float, default=0.0,
+                   help="seconds to hold a respawn; set it past "
+                        "MXNET_KV_EVICT_AFTER so the dead incarnation is "
+                        "evicted before the replacement re-registers "
+                        "(deterministic rejoin accounting)")
     p.add_argument("--tolerate", type=int, default=0,
                    help="failed workers allowed before the job fails "
                         "(survivors-finish contract)")

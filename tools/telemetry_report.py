@@ -16,16 +16,26 @@ run post-mortem starts from:
    snapshot (per-task engine latency, batch fetch, step time, ...),
    plus the final counter and gauge values.
 
+Given SEVERAL journals (one per rank of an elastic job), a cross-rank
+section is prepended: per-rank step-time / barrier-wait table plus the
+straggler attribution, sharing tools/trace_merge.py's merge machinery
+(clock offsets from coordinator-RPC clock records).
+
 Usage::
 
     python tools/telemetry_report.py run.jsonl
     python tools/telemetry_report.py run.jsonl --top 20
+    python tools/telemetry_report.py run-{0,1,2,3}.jsonl   # cross-rank
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from trace_merge import load_merge_module  # noqa: E402
 
 THROUGHPUT_GAUGE = "train.samples_per_sec"
 BAR_WIDTH = 40
@@ -250,19 +260,59 @@ def render_report(records, top=10):
     return "\n".join(lines)
 
 
+def cross_rank_section(journals):
+    """Rendered lines for the multi-journal (per-rank) view: step-time/
+    barrier-wait table + straggler attribution via the trace_merge
+    machinery."""
+    m = load_merge_module()
+    merged = m.merge(journals)
+    lines = ["", "-- cross-rank (%d journals) --" % len(journals)]
+    lines.append("  %-5s %10s %8s %8s %12s %12s %8s" % (
+        "rank", "offset_s", "epochs", "batches", "step_p50_s",
+        "wait_total_s", "spans"))
+    for r in m.cross_rank_rows(merged):
+        lines.append("  %-5d %+10.3f %8d %8d %12s %12.3f %8d" % (
+            r["rank"], r["offset_s"], r["epochs"], r["batches"],
+            ("%.6g" % r["step_p50_s"]) if r["step_p50_s"] is not None
+            else "-", r["wait_s"], r["spans"]))
+    rep = m.straggler_report(merged)
+    if rep["truncated"]:
+        lines.append("  truncated journals (killed rank?): %s"
+                     % rep["truncated"])
+    if rep["straggler"] is not None:
+        lines.append("  straggler: rank %d" % rep["straggler"])
+    return lines
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render an mxtel run journal (JSONL)")
-    ap.add_argument("journal", help="path written via MXNET_TELEMETRY_JOURNAL")
+    ap.add_argument("journals", nargs="+", metavar="journal",
+                    help="path(s) written via MXNET_TELEMETRY_JOURNAL — "
+                         "several journals add the cross-rank section")
     ap.add_argument("--top", type=int, default=10,
                     help="span rows in the top-spans table (default 10)")
     args = ap.parse_args(argv)
-    records = load(args.journal)
-    if not records:
-        print("telemetry_report: %s has no records" % args.journal,
-              file=sys.stderr)
+    # single-rank body from the first NON-empty journal: in a chaos run
+    # one rank's journal may be empty (SIGKILLed before its first
+    # flush) and the cross-rank view over the healthy journals is
+    # exactly what diagnoses it
+    records, base = None, None
+    for j in args.journals:
+        recs = load(j)
+        if recs:
+            records, base = recs, j
+            break
+    if records is None:
+        print("telemetry_report: no records in %s"
+              % ", ".join(args.journals), file=sys.stderr)
         return 1
-    print(render_report(records, top=args.top))
+    out = render_report(records, top=args.top)
+    if len(args.journals) > 1:
+        lines = out.split("\n")
+        out = "\n".join([lines[0] + "  (single-rank body: %s)" % base]
+                        + cross_rank_section(args.journals) + lines[1:])
+    print(out)
     return 0
 
 
