@@ -18,6 +18,13 @@ Three coordinated passes, one Finding model (findings.py):
 - ``schedule``      — mxrace deterministic interleaving explorer:
   seeded/exhaustive thread-schedule exploration with replayable
   failure seeds (chaos testing for schedules).
+- ``proto_lint``    — mxproto protocol lint over the elastic RPC
+  substrate: client call sites diffed bidirectionally against server
+  dispatch arms, plus the cross-module timeout-budget lattice.
+- ``protosim``      — mxproto deterministic message-schedule simulator:
+  the real coordinator state machine under explorable delivery
+  orders, losses, duplicates, crashes and restarts, with (seed, index)
+  replay.
 
 CLI: ``tools/mxlint.py`` / the ``mxlint`` console script (cli.py).
 

@@ -11,6 +11,8 @@ console script (pyproject). Typical invocations::
     mxlint --locks                    # concurrency lint, whole package
     mxlint --locks some/module.py     # concurrency lint one file/dir
     mxlint --schedules                # interleaving-explorer survival run
+    mxlint --proto                    # protocol schema + timeout lattice
+    mxlint --protosim                 # protocol-simulator survival run
     mxlint --all --fail-on warning    # strict mode: warnings also fail
 
 Exit codes: 0 clean (no finding at/above --fail-on), 1 findings,
@@ -106,6 +108,27 @@ def main(argv=None):
                         "and replayed, the serving submit/cancel/step "
                         "loop and the elastic aggregator round protocol "
                         "must survive every explored schedule")
+    p.add_argument("--proto", action="append", nargs="?", const="",
+                   metavar="PATH", default=[],
+                   help="mxproto protocol lint: diff every elastic-RPC "
+                        "client call site against every server dispatch "
+                        "arm (unknown ops, unread/unsent fields, "
+                        "missing reply keys, undisciplined transport "
+                        "calls) and check the cross-module timeout-"
+                        "budget lattice — bare --proto lints the "
+                        "elastic substrate and its in-package speakers")
+    p.add_argument("--protosim", action="store_true",
+                   help="mxproto protocol-simulator survival run: both "
+                        "seeded protocol mutants must be found and "
+                        "replayed, then the all-reduce, barrier and "
+                        "shard-update workloads must survive every "
+                        "explored message schedule")
+    p.add_argument("--proto-seed", type=int,
+                   default=int(os.environ.get("MXPROTO_SEED", "0") or 0),
+                   help="base seed for --protosim (env MXPROTO_SEED)")
+    p.add_argument("--proto-count", type=int, default=None,
+                   help="schedules per --protosim leg (env "
+                        "MXPROTO_SCHEDULES, default 25)")
     p.add_argument("--schedule-seed", type=int,
                    default=int(os.environ.get("MXRACE_SEED", "0") or 0),
                    help="base seed for --schedules (env MXRACE_SEED)")
@@ -130,7 +153,7 @@ def main(argv=None):
         return 0
     if not (args.all or args.model or args.graph or args.ops
             or args.engine_trace or args.locks or args.schedules
-            or args.telemetry):
+            or args.telemetry or args.proto or args.protosim):
         p.print_usage(sys.stderr)
         print("mxlint: nothing to do (try --all)", file=sys.stderr)
         return 2
@@ -142,6 +165,7 @@ def main(argv=None):
     ops_paths = list(args.ops)
     model_names = list(args.model)
     lock_paths = list(args.locks)
+    proto_paths = list(args.proto)
     run_selftest = False
     run_telemetry = args.telemetry
     if args.all:
@@ -153,6 +177,8 @@ def main(argv=None):
         run_telemetry = True
         if not lock_paths:
             lock_paths.append("")  # whole-package concurrency lint
+        if not proto_paths:
+            proto_paths.append("")  # elastic-substrate protocol lint
 
     def _load_error(path, e):
         print("mxlint: %s: %s: %s" % (path, type(e).__name__, e),
@@ -214,6 +240,14 @@ def main(argv=None):
         except (OSError, SyntaxError) as e:  # unreadable / unparsable .py
             return _load_error(path or DEFAULT_PACKAGE, e)
         n_targets += 1
+    for path in proto_paths:
+        from .proto_lint import lint_protocol
+
+        try:
+            findings.extend(lint_protocol([path] if path else None))
+        except (OSError, SyntaxError) as e:  # unreadable / unparsable .py
+            return _load_error(path or "(elastic substrate)", e)
+        n_targets += 1
     if run_selftest:
         findings.extend(_engine_selftest())
         n_targets += 1
@@ -229,6 +263,15 @@ def main(argv=None):
                                    schedules=args.schedule_count)
         for ln in lines:  # survival rows go to stderr: --json stays pure
             print("mxrace: %s" % ln, file=sys.stderr)
+        findings.extend(fs)
+        n_targets += 1
+    if args.protosim:
+        from .protosim import survival_suite as proto_suite
+
+        fs, lines = proto_suite(seed=args.proto_seed,
+                                schedules=args.proto_count)
+        for ln in lines:
+            print("mxproto: %s" % ln, file=sys.stderr)
         findings.extend(fs)
         n_targets += 1
 

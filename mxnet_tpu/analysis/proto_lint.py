@@ -1,0 +1,826 @@
+"""mxproto protocol lint: static schema + timing analysis over the
+elastic RPC substrate (``mxlint --proto``).
+
+The elastic coordination protocol (mxnet_tpu/elastic/) is a string-op,
+dict-payload RPC dispatched through if-chains — flexible, and with zero
+static checking: a misspelled op, a field the server never reads, or a
+reply key the client consumes but no arm returns are all silent until a
+distributed job wedges. Every protocol bug this repo has already paid
+for (the long-poll-cap-vs-socket-timeout incident, the chaos
+heartbeat-starvation flake) was exactly such a cross-module mismatch.
+This pass extracts both halves of the protocol from the AST and diffs
+them bidirectionally:
+
+- **Client side** — every ``X.call("op", field=...)`` / ``X._op("op",
+  ...)`` literal-op call site, the per-op wrapper methods of
+  ``ElasticClient`` (a method whose body is a single literal-op
+  ``self.call(...)`` registers the wrapper name, and ``X.wrapper(...)``
+  calls on client-named receivers resolve through it), and
+  ``**fields`` expansions through dict-building helpers
+  (``pull_fields``). Reply consumption is tracked per function:
+  ``resp = <client call>`` followed by ``resp["k"]`` (required) or
+  ``resp.get("k")`` (optional).
+- **Server side** — any function containing ``op = req.get("op")`` is a
+  dispatch function; ``op == "literal"`` guards open per-op arms, whose
+  ``req["f"]``/``req.get("f")`` reads and returned dict-literal keys
+  (including dict-returning helpers reached via ``err = helper(); return
+  err``) accumulate per op. Reads/returns outside any guard are common
+  to every op.
+
+Detectors (pass ``proto``):
+
+| code | severity | meaning |
+|---|---|---|
+| ``unknown-op`` | error | client sends an op no dispatch arm handles |
+| ``reply-missing`` | error | client subscripts a reply key absent from every return of that op |
+| ``field-unread`` | warning | field sent but no arm ever reads it |
+| ``field-missing`` | warning | required (subscripted) request field no client ever sends |
+| ``raw-protocol-call`` | warning | ``protocol.call`` outside the RetryPolicy/``kv.coord`` discipline (the enclosing function carries no ``*.point("kv.coord")``) |
+| ``dead-arm`` | info | dispatch arm no in-package client calls (admin/test hooks) |
+| ``lattice-*`` | error | a timeout-ordering invariant is violated (below) |
+| ``lattice-incomplete`` | warning | an expected lattice constant could not be derived — the check silently narrowed |
+| ``lattice-conflict`` | warning | two modules declare different defaults for the same env knob |
+
+**Timeout-budget lattice.** The timing constants live in different
+modules (client socket timeout, server long-poll cap ``_WAIT_CAP``,
+heartbeat period, ``MXNET_KV_EVICT_AFTER``, retry policy, barrier
+deadline); :func:`derive_lattice` recovers each from its defining site
+(env-parse defaults, ``timeout=`` parameter defaults, ``*WAIT_CAP*``
+module constants, ``RetryPolicy(...)`` kwargs), applies any live env
+overrides, and hands the values to
+``mxnet_tpu.elastic.budget.check_budgets`` — the shared invariant
+oracle the coordinator's own evict-floor clamp uses. Violations
+(server cap >= socket timeout; heartbeat x misses + jitter slack >
+evict window; client poll budget > server cap; retry budget >= barrier
+deadline) are errors: they are the PR 7 and chaos-flake bug classes as
+lint findings.
+
+Scope honesty: reply reads through helper *parameters*
+(``_absorb_view(resp)``) and wrapper calls on receivers not named like
+a client are not attributed — the protocol simulator
+(``analysis/protosim.py``) exercises those paths dynamically. Fields
+starting with ``_`` are the tracing envelope and exempt by contract.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding
+
+__all__ = ["lint_protocol", "extract_schema", "derive_lattice",
+           "DEFAULT_TARGETS", "Schema", "OpSchema"]
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the modules that speak the elastic protocol (both halves) plus the
+#: ones defining its timing constants
+DEFAULT_TARGETS = (
+    os.path.join(_PKG, "elastic", "client.py"),
+    os.path.join(_PKG, "elastic", "server.py"),
+    os.path.join(_PKG, "elastic", "protocol.py"),
+    os.path.join(_PKG, "elastic", "budget.py"),
+    os.path.join(_PKG, "kvstore.py"),
+    os.path.join(_PKG, "analysis", "protosim.py"),
+)
+
+#: constants the lattice must recover from DEFAULT_TARGETS; an explicit
+#: path list (fixtures) checks whatever it finds instead
+_LATTICE_REQUIRED = ("client_timeout", "wait_cap", "pull_wait",
+                     "heartbeat", "evict_after", "retry_attempts")
+
+#: env knob -> lattice constant name
+_ENV_CONSTS = {
+    "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "heartbeat",
+    "MXNET_KV_EVICT_AFTER": "evict_after",
+    "MXNET_KV_PULL_WAIT": "pull_wait",
+    "MXNET_KV_RETRIES": "retry_attempts",
+    "MXNET_KV_BARRIER_TIMEOUT": "barrier_timeout",
+    "MXNET_KV_HEARTBEAT_MISSES": "misses",
+    "MXNET_KV_EVICT_JITTER_SLACK": "jitter_slack",
+}
+
+_ENVELOPE = "_"          # _trace/_srv_t: tracing envelope, exempt
+_CALL_METHODS = ("call", "_op")
+
+
+def _attr_chain(expr):
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _str_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _num_const(node):
+    if isinstance(node, ast.Constant) and \
+            isinstance(node.value, (int, float)) and \
+            not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
+class OpSchema:
+    """Both protocol halves for one op, with source sites."""
+
+    def __init__(self, op):
+        self.op = op
+        self.sent = {}            # field -> [where]
+        self.dynamic_send = False  # a **expansion we could not resolve
+        self.client_sites = []    # [where]
+        self.req_required = {}    # field -> [where]  (req["f"])
+        self.req_optional = {}    # field -> [where]  (req.get("f"...))
+        self.replies = {}         # key -> [where]
+        self.server_sites = []    # [where]
+        self.resp_required = {}   # key -> [where]    (resp["k"])
+        self.resp_optional = {}   # key -> [where]
+
+
+class Schema:
+    """The whole extracted protocol: per-op schemas + the common
+    (every-op) halves + undisciplined transport call sites."""
+
+    def __init__(self):
+        self.ops = {}             # op -> OpSchema
+        self.common = OpSchema("*")
+        self.raw_calls = []       # [where] protocol.call outside discipline
+
+    def op(self, name):
+        if name == "*":
+            return self.common
+        return self.ops.setdefault(name, OpSchema(name))
+
+
+def _add(dct, key, where):
+    dct.setdefault(key, []).append(where)
+
+
+class _FileFacts:
+    """Pass-1 inventory of one source file."""
+
+    def __init__(self, path, tree):
+        self.path = path
+        self.rel = os.path.relpath(path, os.path.dirname(_PKG)) \
+            if path.startswith(_PKG) else os.path.basename(path)
+        self.tree = tree
+        self.dict_fns = {}        # fn name -> set(returned dict keys)
+        self.wrappers = {}        # method name -> (op, {field: line})
+        self.call_param_names = set()  # named params of call-like defs
+
+    def where(self, node):
+        return "%s:%d" % (self.rel, getattr(node, "lineno", 0))
+
+
+def _returned_dict_keys(fn):
+    """String keys a function can return as a dict: direct dict-literal
+    returns plus dict-literal vars extended by ``var["k"] = v`` that are
+    later returned."""
+    keys = set()
+    dict_vars = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.FunctionDef) and node is not fn:
+            continue  # shallow enough: nested defs rare in these modules
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            ks = {_str_const(k) for k in node.value.keys if k is not None}
+            ks.discard(None)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    dict_vars.setdefault(t.id, set()).update(ks)
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.targets[0], ast.Subscript) and \
+                isinstance(node.targets[0].value, ast.Name):
+            k = _str_const(node.targets[0].slice)
+            nm = node.targets[0].value.id
+            if k is not None and nm in dict_vars:
+                dict_vars[nm].add(k)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                s = _str_const(k) if k is not None else None
+                if s is not None:
+                    keys.add(s)
+        elif isinstance(node.value, ast.Name) and \
+                node.value.id in dict_vars:
+            keys.update(dict_vars[node.value.id])
+    return keys
+
+
+def _inventory(path, tree):
+    facts = _FileFacts(path, tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        keys = _returned_dict_keys(node)
+        if keys:
+            facts.dict_fns.setdefault(node.name, set()).update(keys)
+        if node.name in _CALL_METHODS:
+            for a in node.args.args[2:]:  # beyond (self, op)
+                facts.call_param_names.add(a.arg)
+        # wrapper methods: body contains exactly one literal-op
+        # self.call(...) and no other client-call expressions
+        calls = [n for n in ast.walk(node)
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr in _CALL_METHODS]
+        if len(calls) == 1 and node.name not in _CALL_METHODS:
+            c = calls[0]
+            chain = _attr_chain(c.func)
+            op = _str_const(c.args[0]) if c.args else None
+            if op is not None and chain and chain[0] == "self":
+                fields = {kw.arg: c.lineno for kw in c.keywords
+                          if kw.arg is not None}
+                facts.wrappers[node.name] = (op, fields)
+    return facts
+
+
+def _client_receiver(chain):
+    """True when an attribute chain's receiver looks like an elastic
+    client handle (``self._client.x``, ``client.x`` …) — the scope rule
+    that keeps ``self.agg.put_weight`` / ``self.view.register`` (server
+    internals sharing wrapper names) out of the client schema."""
+    if chain is None or len(chain) < 2:
+        return False
+    recv = chain[-2]
+    return "client" in recv.lower() or recv in ("c0", "c1", "c2", "cl")
+
+
+class _ClientScan(ast.NodeVisitor):
+    """Pass 2, client half of one file."""
+
+    def __init__(self, facts, all_facts, schema, exclude_kwargs):
+        self.facts = facts
+        self.all_facts = all_facts
+        self.schema = schema
+        self.exclude = exclude_kwargs
+
+    def _dict_fn_keys(self, name):
+        for f in self.all_facts:
+            if name in f.dict_fns:
+                return f.dict_fns[name]
+        return None
+
+    def _wrapper(self, name):
+        for f in self.all_facts:
+            if name in f.wrappers:
+                return f.wrappers[name]
+        return None
+
+    def _classify_call(self, call):
+        """(op or None, fields {name: lineno}, dynamic) for a client-call
+        expression, else (None, None, False)."""
+        if not isinstance(call, ast.Call) or \
+                not isinstance(call.func, ast.Attribute):
+            return None, None, False
+        chain = _attr_chain(call.func)
+        meth = call.func.attr
+        if meth in _CALL_METHODS and call.args:
+            op = _str_const(call.args[0])
+            if op is None:
+                # dynamic op (retry-policy .call(fn), _op passthrough):
+                # only reply reads matter, attributed to every op
+                if isinstance(call.args[0], (ast.Name, ast.Attribute)):
+                    return "*", {}, False
+                return None, None, False
+            fields, dynamic = {}, False
+            for kw in call.keywords:
+                if kw.arg is None:  # **expansion
+                    keys = None
+                    if isinstance(kw.value, ast.Call) and \
+                            isinstance(kw.value.func,
+                                       (ast.Attribute, ast.Name)):
+                        fname = kw.value.func.attr \
+                            if isinstance(kw.value.func, ast.Attribute) \
+                            else kw.value.func.id
+                        keys = self._dict_fn_keys(fname)
+                    if keys:
+                        for k in keys:
+                            fields[k] = call.lineno
+                    else:
+                        dynamic = True
+                elif kw.arg not in self.exclude:
+                    fields[kw.arg] = call.lineno
+            return op, fields, dynamic
+        wrap = self._wrapper(meth)
+        if wrap is not None and _client_receiver(chain):
+            op, fields = wrap
+            out = {k: call.lineno for k in fields if k not in self.exclude}
+            # wrapper bodies may **-expand a dict helper too
+            return op, out, False
+        return None, None, False
+
+    def scan_function(self, fn):
+        var_ops = {}  # var name -> set(op)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                continue
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.targets[0], ast.Name):
+                op, _f, _d = self._classify_call(node.value)
+                if op is not None:
+                    var_ops.setdefault(node.targets[0].id, set()).add(op)
+            if isinstance(node, ast.Call):
+                op, fields, dynamic = self._classify_call(node)
+                if op is not None and op != "*":
+                    sch = self.schema.op(op)
+                    sch.client_sites.append(self.facts.where(node))
+                    for k, ln in (fields or {}).items():
+                        if not k.startswith(_ENVELOPE):
+                            _add(sch.sent, k,
+                                 "%s:%d" % (self.facts.rel, ln))
+                    if dynamic:
+                        sch.dynamic_send = True
+        # reply reads on vars assigned from client calls
+        for node in ast.walk(fn):
+            key = name = None
+            required = False
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                key, name, required = (_str_const(node.slice),
+                                       node.value.id, True)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and \
+                    isinstance(node.func.value, ast.Name) and node.args:
+                key, name = _str_const(node.args[0]), node.func.value.id
+            if key is None or name not in var_ops or \
+                    key.startswith(_ENVELOPE):
+                continue
+            for op in var_ops[name]:
+                sch = self.schema.op(op)
+                tgt = sch.resp_required if required else sch.resp_optional
+                _add(tgt, key, self.facts.where(node))
+
+    def _scan_common_sends(self, fn):
+        """Fields the transport assembly attaches to EVERY request:
+        ``req["op"] = op`` / ``req["rank"] = ...`` subscript-assigns in
+        a function whose subtree hands a dict to ``protocol.call``."""
+        req_vars = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                chain = _attr_chain(n.func)
+                if chain and len(chain) >= 2 and chain[-1] == "call" \
+                        and chain[-2] == "protocol" and \
+                        len(n.args) >= 2 and \
+                        isinstance(n.args[1], ast.Name):
+                    req_vars.add(n.args[1].id)
+        if not req_vars:
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.targets[0], ast.Subscript) and \
+                    isinstance(node.targets[0].value, ast.Name) and \
+                    node.targets[0].value.id in req_vars:
+                k = _str_const(node.targets[0].slice)
+                if k is not None and not k.startswith(_ENVELOPE):
+                    _add(self.schema.common.sent, k,
+                         self.facts.where(node))
+
+    def run(self):
+        for node in ast.walk(self.facts.tree):
+            if isinstance(node, ast.FunctionDef):
+                self.scan_function(node)
+                self._scan_common_sends(node)
+
+
+class _ServerScan:
+    """Pass 2, server half: dispatch functions and their arms."""
+
+    def __init__(self, facts, all_facts, schema):
+        self.facts = facts
+        self.all_facts = all_facts
+        self.schema = schema
+
+    def _helper_keys(self, name):
+        for f in self.all_facts:
+            if name in f.dict_fns:
+                return f.dict_fns[name]
+        return None
+
+    @staticmethod
+    def _find_dispatch(fn):
+        """req/op variable names when ``fn`` is a dispatch function."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr == "get" and \
+                    isinstance(node.value.func.value, ast.Name) and \
+                    node.value.args and \
+                    _str_const(node.value.args[0]) == "op":
+                return node.value.func.value.id, node.targets[0].id
+        return None, None
+
+    def _ops_in_test(self, test, opvar):
+        ops = []
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare) and \
+                    isinstance(node.left, ast.Name) and \
+                    node.left.id == opvar and \
+                    len(node.ops) == 1 and \
+                    isinstance(node.ops[0], ast.Eq):
+                s = _str_const(node.comparators[0])
+                if s is not None:
+                    ops.append(s)
+        return ops
+
+    def _collect(self, stmts, ctx, reqvar, opvar, helper_vars):
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                arm_ops = self._ops_in_test(stmt.test, opvar)
+                inner = arm_ops if arm_ops else ctx
+                if arm_ops:
+                    for op in arm_ops:
+                        self.schema.op(op).server_sites.append(
+                            self.facts.where(stmt))
+                self._collect(stmt.body, inner, reqvar, opvar, helper_vars)
+                self._collect(stmt.orelse, ctx, reqvar, opvar, helper_vars)
+                continue
+            if isinstance(stmt, (ast.With, ast.For, ast.While, ast.Try)):
+                for attr in ("body", "orelse", "finalbody"):
+                    self._collect(getattr(stmt, attr, []) or [], ctx,
+                                  reqvar, opvar, helper_vars)
+                for h in getattr(stmt, "handlers", []) or []:
+                    self._collect(h.body, ctx, reqvar, opvar, helper_vars)
+                continue
+            # helper-returning assignments: err = self._require_live(r)
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.targets[0], ast.Name):
+                fname = None
+                if isinstance(stmt.value.func, ast.Attribute):
+                    fname = stmt.value.func.attr
+                elif isinstance(stmt.value.func, ast.Name):
+                    fname = stmt.value.func.id
+                keys = self._helper_keys(fname) if fname else None
+                if keys:
+                    helper_vars[stmt.targets[0].id] = keys
+            self._scan_reads(stmt, ctx, reqvar)
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                self._record_return(stmt, ctx, helper_vars)
+
+    def _scan_reads(self, stmt, ctx, reqvar):
+        for node in ast.walk(stmt):
+            key = required = None
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == reqvar and \
+                    isinstance(node.ctx, ast.Load):
+                key, required = _str_const(node.slice), True
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("get", "pop") and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == reqvar and node.args:
+                key, required = _str_const(node.args[0]), False
+            if key is None or key.startswith(_ENVELOPE) or key == "op":
+                continue
+            for op in (ctx or ["*"]):
+                sch = self.schema.op(op)
+                tgt = sch.req_required if required else sch.req_optional
+                _add(tgt, key, self.facts.where(node))
+
+    def _record_return(self, stmt, ctx, helper_vars):
+        keys = set()
+        if isinstance(stmt.value, ast.Dict):
+            for k in stmt.value.keys:
+                s = _str_const(k) if k is not None else None
+                if s is not None and not s.startswith(_ENVELOPE):
+                    keys.add(s)
+        elif isinstance(stmt.value, ast.Name) and \
+                stmt.value.id in helper_vars:
+            keys = {k for k in helper_vars[stmt.value.id]
+                    if not k.startswith(_ENVELOPE)}
+        for op in (ctx or ["*"]):
+            sch = self.schema.op(op)
+            for k in keys:
+                _add(sch.replies, k, self.facts.where(stmt))
+
+    def run(self):
+        for node in ast.walk(self.facts.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            reqvar, opvar = self._find_dispatch(node)
+            if reqvar is None:
+                continue
+            # the nested preamble (op == "push" and ...) guards and the
+            # arm chain all merge per op via the ctx mechanism
+            self._collect(node.body, None, reqvar, opvar, {})
+
+
+def _scan_raw_calls(facts, schema):
+    """protocol.call sites whose innermost enclosing function carries no
+    kv.coord fault point — transport use outside the retry discipline."""
+    if os.path.basename(facts.path) == "protocol.py":
+        return  # the definition module
+    fns = [n for n in ast.walk(facts.tree)
+           if isinstance(n, ast.FunctionDef)]
+
+    def innermost(node):
+        best = None
+        for fn in fns:
+            if fn.lineno <= node.lineno <= \
+                    (fn.end_lineno or fn.lineno) and \
+                    (best is None or fn.lineno > best.lineno):
+                best = fn
+        return best
+
+    def has_coord_point(fn):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "point" and n.args and \
+                    _str_const(n.args[0]) == "kv.coord":
+                return True
+        return False
+
+    for node in ast.walk(facts.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or len(chain) < 2 or chain[-1] != "call" or \
+                chain[-2] != "protocol":
+            continue
+        fn = innermost(node)
+        if fn is None or not has_coord_point(fn):
+            schema.raw_calls.append(facts.where(node))
+
+
+def _iter_sources(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            yield p
+
+
+def extract_schema(paths=None):
+    """Extract the full protocol :class:`Schema` from ``paths``
+    (defaults to the elastic substrate + its in-package speakers).
+    Raises OSError/SyntaxError on unreadable or unparsable input."""
+    default_targets = paths is None
+    paths = list(_iter_sources(paths or DEFAULT_TARGETS))
+    all_facts = []
+    for p in paths:
+        if default_targets and not os.path.exists(p):
+            continue  # a default target absent in a stripped checkout
+        with open(p, "r", encoding="utf-8") as f:
+            src = f.read()
+        all_facts.append(_inventory(p, ast.parse(src, filename=p)))
+    schema = Schema()
+    exclude = set()
+    for f in all_facts:
+        exclude |= f.call_param_names
+    exclude.discard("op")
+    for facts in all_facts:
+        _ClientScan(facts, all_facts, schema, exclude).run()
+        _ServerScan(facts, all_facts, schema).run()
+        _scan_raw_calls(facts, schema)
+    return schema
+
+
+# -- timeout lattice -----------------------------------------------------------
+
+def _env_default_sites(tree, rel):
+    """{env name: [(default value, where)]} for os.environ.get sites."""
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        # os.environ.get(...) and budget.py's injected `env.get(...)`
+        if not chain or len(chain) < 2 or chain[-1] != "get" or \
+                chain[-2] not in ("environ", "env"):
+            continue
+        if not node.args:
+            continue
+        name = _str_const(node.args[0])
+        if name not in _ENV_CONSTS or len(node.args) < 2:
+            continue
+        dflt = node.args[1]
+        val = _num_const(dflt)
+        if val is None:
+            s = _str_const(dflt)
+            if s is not None:
+                try:
+                    val = float(s)
+                except ValueError:
+                    val = None
+        if val is not None:
+            out.setdefault(name, []).append(
+                (val, "%s:%d" % (rel, node.lineno)))
+    return out
+
+
+def derive_lattice(paths=None, env=None, required=None):
+    """(constants, findings): the timeout lattice recovered from the
+    sources. ``constants`` maps lattice names to ``(value, source)``;
+    ``findings`` carries lattice-incomplete / lattice-conflict
+    warnings. ``env`` (default ``os.environ``) overrides the parsed
+    defaults for env-backed knobs — so the lint checks the *configured*
+    lattice, not just the shipped one."""
+    env = os.environ if env is None else env
+    default_targets = paths is None
+    if required is None:
+        required = _LATTICE_REQUIRED if default_targets else ()
+    paths = list(_iter_sources(paths or DEFAULT_TARGETS))
+    consts, findings = {}, []
+    env_sites = {}
+    timeout_candidates = []   # (value, where)
+    for p in paths:
+        if default_targets and not os.path.exists(p):
+            continue
+        with open(p, "r", encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=p)
+        rel = os.path.relpath(p, os.path.dirname(_PKG)) \
+            if p.startswith(_PKG) else os.path.basename(p)
+        for name, sites in _env_default_sites(tree, rel).items():
+            env_sites.setdefault(name, []).extend(sites)
+        for node in ast.walk(tree):
+            # module constants named *WAIT_CAP*
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    "WAIT_CAP" in node.targets[0].id.upper():
+                v = _num_const(node.value)
+                if v is not None:
+                    consts.setdefault(
+                        "wait_cap", (v, "%s:%d" % (rel, node.lineno)))
+            # timeout= parameter defaults on __init__/call defs
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name in ("__init__", "call"):
+                fargs, fdefaults = node.args.args, node.args.defaults
+                for a, d in zip(fargs[len(fargs) - len(fdefaults):],
+                                fdefaults):
+                    if a.arg == "timeout":
+                        v = _num_const(d)
+                        if v is not None:
+                            timeout_candidates.append(
+                                (v, "%s:%d" % (rel, node.lineno)))
+            # RetryPolicy(...) shape
+            if isinstance(node, ast.Call):
+                fname = None
+                if isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                if fname == "RetryPolicy":
+                    for kw in node.keywords:
+                        v = _num_const(kw.value)
+                        if v is None:
+                            continue
+                        where = "%s:%d" % (rel, node.lineno)
+                        if kw.arg == "base_delay":
+                            consts.setdefault("retry_base", (v, where))
+                        elif kw.arg == "max_delay":
+                            consts.setdefault("retry_max", (v, where))
+                        elif kw.arg == "multiplier":
+                            consts.setdefault("retry_multiplier",
+                                              (v, where))
+    if timeout_candidates:
+        consts.setdefault("client_timeout", min(timeout_candidates))
+    for name, sites in env_sites.items():
+        values = {v for v, _w in sites}
+        if len(values) > 1:
+            findings.append(Finding(
+                "proto", "lattice-conflict", "warning",
+                "; ".join(w for _v, w in sites),
+                "env knob %s declares different defaults across modules "
+                "(%s) — one side of the timeout lattice is stale"
+                % (name, sorted(values))))
+        const = _ENV_CONSTS[name]
+        value, where = sites[0]
+        raw = env.get(name)
+        if raw not in (None, ""):
+            try:
+                value, where = float(raw), "env %s" % name
+            except ValueError:
+                pass
+        consts.setdefault(const, (value, where))
+    for const in required:
+        if const not in consts:
+            findings.append(Finding(
+                "proto", "lattice-incomplete", "warning", const,
+                "timeout-lattice constant %r could not be derived from "
+                "the scanned sources — the ordering invariants that "
+                "need it were silently skipped (did a refactor move or "
+                "rename its defining site?)" % const))
+    return consts, findings
+
+
+def _lattice_findings(consts):
+    from ..elastic import budget
+
+    values = {k: v for k, (v, _w) in consts.items()}
+    out = []
+    for v in budget.check_budgets(values):
+        srcs = ", ".join(
+            "%s=%s (%s)" % (k, consts[k][0], consts[k][1])
+            for k in sorted(consts)
+            if k.split("_")[0] in v.code or k in v.message)
+        out.append(Finding(
+            "proto", v.code, "error", srcs or "timeout lattice",
+            v.message))
+    return out
+
+
+# -- the diff ------------------------------------------------------------------
+
+def lint_protocol(paths=None, env=None):
+    """Run the full mxproto static pass: bidirectional schema diff,
+    transport-discipline check, timeout lattice. Returns findings."""
+    schema = extract_schema(paths)
+    consts, findings = derive_lattice(paths, env=env)
+    findings.extend(_lattice_findings(consts))
+
+    common_reads = set(schema.common.req_required) | \
+        set(schema.common.req_optional)
+    common_replies = set(schema.common.replies)
+    common_sent = set(schema.common.sent)
+    server_ops = {op for op, s in schema.ops.items() if s.server_sites}
+    client_ops = {op for op, s in schema.ops.items() if s.client_sites}
+
+    for op in sorted(schema.ops):
+        sch = schema.ops[op]
+        is_known = op in server_ops
+        if sch.client_sites and not is_known:
+            if server_ops:  # only when a server half is in scope at all
+                findings.append(Finding(
+                    "proto", "unknown-op", "error",
+                    sch.client_sites[0],
+                    "client sends op %r but no dispatch arm handles it "
+                    "(server ops: %s) — the server answers "
+                    "status='error' at runtime"
+                    % (op, ", ".join(sorted(server_ops)))))
+            continue
+        if sch.server_sites and not sch.client_sites:
+            findings.append(Finding(
+                "proto", "dead-arm", "info", sch.server_sites[0],
+                "dispatch arm %r has no in-package client call site "
+                "(admin/test hook, or dead protocol surface)" % op))
+        if not (sch.client_sites and sch.server_sites):
+            continue
+        reads = set(sch.req_required) | set(sch.req_optional) | \
+            common_reads
+        for field in sorted(set(sch.sent) - reads - common_sent):
+            findings.append(Finding(
+                "proto", "field-unread", "warning",
+                sch.sent[field][0],
+                "field %r is sent with op %r but no dispatch arm ever "
+                "reads it — dead payload, or a renamed field the server "
+                "half missed" % (field, op)))
+        if not sch.dynamic_send:
+            sent = set(sch.sent) | common_sent
+            for field in sorted(set(sch.req_required) - sent):
+                findings.append(Finding(
+                    "proto", "field-missing", "warning",
+                    sch.req_required[field][0],
+                    "dispatch arm %r subscripts required field %r but "
+                    "no client call site sends it — a KeyError reply "
+                    "the moment the arm runs" % (op, field)))
+        replies = set(sch.replies) | common_replies
+        for key in sorted(set(sch.resp_required) - replies):
+            findings.append(Finding(
+                "proto", "reply-missing", "error",
+                sch.resp_required[key][0],
+                "client subscripts reply key %r of op %r but no server "
+                "return for that op carries it — a client-side "
+                "KeyError on the live path" % (key, op)))
+    # common-client required reads (dynamic-op wrappers) must be
+    # satisfied by EVERY op's replies
+    for key in sorted(set(schema.common.resp_required)):
+        missing = [op for op in sorted(server_ops & client_ops)
+                   if key not in schema.ops[op].replies
+                   and key not in common_replies]
+        if missing:
+            findings.append(Finding(
+                "proto", "reply-missing", "error",
+                schema.common.resp_required[key][0],
+                "every-op client code subscripts reply key %r but ops "
+                "%s never return it" % (key, ", ".join(missing))))
+    for where in schema.raw_calls:
+        findings.append(Finding(
+            "proto", "raw-protocol-call", "warning", where,
+            "protocol.call outside the RetryPolicy/kv.coord discipline "
+            "(no *.point('kv.coord') in the enclosing function): a "
+            "transient coordinator hiccup here is fatal instead of "
+            "healed — route it through ElasticClient.call"))
+    return findings

@@ -1,0 +1,863 @@
+"""mxproto protocol simulator: deterministic message-schedule
+exploration over the REAL elastic coordinator state machine
+(``mxlint --protosim``).
+
+The static half (proto_lint.py) proves the two protocol halves agree on
+shape; this module attacks the *ordering* residue: the coordinator's
+``_dispatch`` state machine plus N client actors run in-process over a
+logical network whose delivery order, reply losses (client retries),
+duplicate deliveries (the lost-ack retry), rank crashes, admin
+evictions and restarts are all **scheduler choices** — the mxrace
+substrate (``analysis/schedule.py``) applied at message granularity
+instead of thread granularity. Every schedule derives from a
+``(seed, index)`` pair via the same ``_schedule_seed`` stream, failures
+print the same replay hint shape, and :func:`replay` re-runs exactly
+one schedule.
+
+What runs is the REAL code: a socketless ``ElasticCoordinator``
+(``bind=None``) whose ``_dispatch`` is invoked directly — GroupView,
+Aggregator, barrier generations, shard-ownership evaluation and the
+snapshot state machines are the production objects, not models. The
+actors mirror ``_ElasticDistKVStore``'s client discipline (round
+counters, stale/resync handling, rejoin-on-evicted, shard-owner
+``put_weight``), assembled as plain request dicts so one sim step is
+one protocol message. Time-based eviction is intentionally OUT of
+scope here (that is the timeout lattice's domain, proto_lint): the
+sweeper's effect is modeled by the ``evict`` admin op as an explorable
+event, so eviction *ordering* is explored without a clock.
+
+Invariants asserted over every delivered message (the Harness):
+
+- membership epoch is monotone non-decreasing;
+- each ``(key, round)`` completes exactly once, and only when its
+  recorded contributors cover the live set at completion time;
+- a degraded completion's merged value equals the surviving
+  contributions rescaled by ``world / contributors`` (all-reduce mode);
+- an accepted ``put_weight`` is never lost: the server copy equals the
+  landed weight and ``w_done`` advances (shard mode);
+- a barrier generation advances only when the arrival set covers the
+  live set (release at a consistent epoch);
+- the membership + round state round-trips through
+  ``snapshot_state``/``restore_state`` (a scheduler-chosen event).
+
+Two seeded mutants are the negative controls the survival suite must
+FIND and REPLAY: ``_EpochRegressView`` (a rejoin regresses the epoch)
+and ``_UnguardedAggregator`` (round completion without coverage — the
+exact bug class of a dropped ``live.issubset`` check).
+
+Env knobs: ``MXPROTO_SCHEDULES`` (per-leg budget, default 25),
+``MXPROTO_SEED`` (base seed) — read by the CLI legs, not here.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .findings import Finding
+from .schedule import ExploreResult, FailureReport, _schedule_seed
+
+__all__ = ["ProtoWorkload", "Harness", "explore", "replay",
+           "allreduce_workload", "shard_workload",
+           "epoch_regress_workload", "unguarded_completion_workload",
+           "survival_suite", "InvariantViolation"]
+
+_STALL_LIMIT = 60       # non-advancing polls before forcing evict/restart
+_MAX_STEPS = 6000
+
+
+class InvariantViolation(AssertionError):
+    """A protocol invariant broke under some message schedule."""
+
+
+class ProtoFailure(FailureReport):
+    def replay_hint(self):
+        if self.strategy == "random":
+            return ("replay: mxnet_tpu.analysis.protosim.replay("
+                    "<workload>, seed=%d, index=%d)  # schedule_seed=%d, "
+                    "%d decisions"
+                    % (self.base_seed, self.index, self.schedule_seed,
+                       len(self.choices)))
+        return ("replay: mxnet_tpu.analysis.protosim.replay(<workload>, "
+                "seed=%d, index=%d, choices=%r)"
+                % (self.base_seed, self.index, self.choices))
+
+
+class ProtoWorkload:
+    """One simulated job: shape + perturbation budgets + mutants."""
+
+    def __init__(self, name, world=3, keys=("w", "b"), rounds=3,
+                 shard=False, barrier_every=0, lose_budget=2,
+                 dup_budget=2, crash_budget=1, restart_budget=1,
+                 snapshot_budget=1, view_cls=None, agg_cls=None,
+                 max_steps=_MAX_STEPS, rendezvous=False):
+        self.name = name
+        self.world = int(world)
+        self.keys = tuple(keys)
+        self.rounds = int(rounds)
+        self.shard = bool(shard)
+        self.barrier_every = int(barrier_every)
+        self.lose_budget = int(lose_budget)
+        self.dup_budget = int(dup_budget)
+        self.crash_budget = int(crash_budget)
+        self.restart_budget = int(restart_budget)
+        self.snapshot_budget = int(snapshot_budget)
+        self.view_cls = view_cls
+        self.agg_cls = agg_cls
+        self.max_steps = int(max_steps)
+        self.rendezvous = bool(rendezvous)
+
+    __name__ = property(lambda self: self.name)
+
+
+def _grad(rank, key, rnd, n=4):
+    """Deterministic per-(rank, key, round) gradient — replays and the
+    harness's independent merge recomputation see identical bytes."""
+    base = (hash((key,)) % 7) + 1
+    return _np.full(n, float(rank + 1) * base + 0.25 * rnd, _np.float32)
+
+
+def _floor_rounds(resp, keys):
+    rounds = resp.get("rounds") or {}
+    if not rounds:
+        return {k: 0 for k in keys}
+    floor = min(rounds.values())
+    return {k: int(floor) for k in keys}
+
+
+def _actor(rank, wl):
+    """One worker's protocol state machine as a generator:
+    ``resp = yield request``. Mirrors kvstore._ElasticDistKVStore's
+    discipline: register → init → per-round push/pull (stale/resync
+    fast-forward, rejoin on 'evicted'), shard-owner put_weight, barrier
+    idempotency via the arrival count, graceful leave."""
+    local = {}
+    weights = {}
+    barrier_count = 0
+
+    def _register():
+        resp = yield {"op": "register", "rank": rank}
+        local.update(_floor_rounds(resp, wl.keys))
+        return resp
+
+    yield from _register()
+    if wl.shard:
+        resp = yield {"op": "set_optimizer", "rank": rank,
+                      "blob": b"sim-optimizer", "shard": True}
+        while resp.get("status") == "evicted":
+            yield from _register()
+            resp = yield {"op": "set_optimizer", "rank": rank,
+                          "blob": b"sim-optimizer", "shard": True}
+    for k in wl.keys:
+        resp = yield {"op": "init", "rank": rank, "key": k,
+                      "value": _np.zeros(4, _np.float32)}
+        while resp.get("status") == "evicted":
+            yield from _register()
+            resp = yield {"op": "init", "rank": rank, "key": k,
+                          "value": _np.zeros(4, _np.float32)}
+        # setdefault, NOT max: a (re)joiner starts every key at the
+        # group's MINIMUM round and fast-forwards through idempotent
+        # 'stale' pushes (kvstore._aligned_rounds) — adopting the
+        # per-key map here recreates the exact distributed deadlock
+        # that comment documents (pulling a frontier round this rank
+        # never contributed to)
+        local.setdefault(k, int(resp["round"]))
+        weights[k] = _np.asarray(resp["value"], _np.float32)
+
+    passes = 0
+    while any(local[k] < wl.rounds for k in wl.keys):
+        passes += 1
+        # push phase first for EVERY key, pulls after — the real store's
+        # batch order; interleaving push/pull per key deadlocks two
+        # ranks blocked on each other's unpushed keys
+        for k in wl.keys:
+            if local[k] >= wl.rounds:
+                continue
+            while True:
+                rnd = local[k] + 1
+                resp = yield {"op": "push", "rank": rank, "key": k,
+                              "round": rnd, "value": _grad(rank, k, rnd)}
+                st = resp.get("status")
+                if st == "evicted":
+                    yield from _register()
+                    continue
+                if st == "resync":
+                    local[k] = int(resp["round"])
+                    continue
+                if st == "stale":
+                    local[k] = max(rnd, int(resp["round"]))
+                else:  # ok
+                    local[k] = rnd
+                break
+        for k in wl.keys:
+            # pull phase: poll until each key's pushed round is ready
+            while True:
+                resp = yield {"op": "pull", "rank": rank, "key": k,
+                              "min_round": local[k], "wait": 0}
+                st = resp.get("status")
+                if st == "evicted":
+                    yield from _register()
+                    continue
+                if st == "update":
+                    # shard mode: this rank owns the key — apply the
+                    # "optimizer" locally and land the weight
+                    rnd = int(resp["round"])
+                    new_w = (weights[k]
+                             - 0.1 * _np.asarray(resp["value"],
+                                                 _np.float32))
+                    put = yield {"op": "put_weight", "rank": rank,
+                                 "key": k, "round": rnd, "value": new_w}
+                    if put.get("status") == "evicted":
+                        yield from _register()
+                    continue
+                if st == "pending":
+                    continue
+                local[k] = max(local[k], int(resp["round"]))
+                weights[k] = _np.asarray(resp["value"], _np.float32)
+                break
+        if wl.barrier_every and passes % wl.barrier_every == 0:
+            # round-anchored rendezvous. Only meaningful in workloads
+            # without restarts (barrier_workload): a restarted
+            # incarnation re-barriers at boundaries the group already
+            # passed, which is not the SPMD cadence the product's
+            # barrier sites have — eviction (the perturbation that
+            # matters to barrier release) is still explored
+            barrier_count += 1
+            while True:
+                resp = yield {"op": "barrier", "rank": rank,
+                              "count": barrier_count}
+                if resp.get("status") == "evicted":
+                    yield from _register()
+                    continue
+                break
+            gen, done = int(resp["gen"]), bool(resp.get("done"))
+            while not done:
+                resp = yield {"op": "barrier_wait", "rank": rank,
+                              "gen": gen, "wait": 0}
+                done = bool(resp.get("done"))
+    yield {"op": "leave", "rank": rank}
+
+
+class Harness:
+    """Wraps ``coord._dispatch``: snapshots the observable state around
+    every delivered message and asserts the protocol invariants."""
+
+    def __init__(self, coord, world):
+        self.coord = coord
+        self.world = world
+        self.contribs = {}        # (key, round) -> {rank: np.ndarray}
+        self.completed = {}       # key -> set(round)
+        self.messages = 0
+
+    def _snap(self):
+        c = self.coord
+        return {
+            "epoch": c.view.epoch,
+            "live": set(c.view.live),
+            "evicted": set(c.view.evicted),
+            "done": dict(c.agg.done),
+            "w_done": dict(c.agg.w_done),
+            "barrier_gen": c.barrier_gen,
+            "waiters": set(c._barrier_waiters),
+        }
+
+    def deliver(self, req):
+        pre = self._snap()
+        resp = self.coord._dispatch(dict(req))
+        post = self._snap()
+        self.messages += 1
+        self._check(req, resp, pre, post)
+        return resp
+
+    def _check(self, req, resp, pre, post):
+        op = req.get("op")
+        if post["epoch"] < pre["epoch"]:
+            raise InvariantViolation(
+                "membership epoch regressed %d -> %d on op %r"
+                % (pre["epoch"], post["epoch"], op))
+        # record accepted contributions before judging completions so a
+        # push that itself completes the round counts itself
+        if op == "push" and isinstance(resp, dict) and \
+                resp.get("status") == "ok":
+            self.contribs.setdefault(
+                (req["key"], int(req["round"])), {})[int(req["rank"])] = \
+                _np.array(req["value"], copy=True)
+        # an eviction drops the corpse's in-flight contributions
+        for rank in post["evicted"] - pre["evicted"]:
+            for (k, r), by_rank in self.contribs.items():
+                if r > pre["done"].get(k, 0):
+                    by_rank.pop(rank, None)
+        for k, d_post in post["done"].items():
+            d_pre = pre["done"].get(k, 0)
+            if d_post < d_pre:
+                raise InvariantViolation(
+                    "round counter of key %r regressed %d -> %d on %r"
+                    % (k, d_pre, d_post, op))
+            for r in range(d_pre + 1, d_post + 1):
+                seen = self.completed.setdefault(k, set())
+                if r in seen:
+                    raise InvariantViolation(
+                        "round %d of key %r completed TWICE (op %r)"
+                        % (r, k, op))
+                seen.add(r)
+                who = self.contribs.get((k, r), {})
+                if not post["live"] <= set(who):
+                    raise InvariantViolation(
+                        "round %d of key %r completed with contributors "
+                        "%s not covering the live set %s (op %r) — "
+                        "unguarded round completion"
+                        % (r, k, sorted(who), sorted(post["live"]), op))
+                self._check_merge(k, r, who)
+        if op == "put_weight" and isinstance(resp, dict) and \
+                resp.get("status") == "ok":
+            rnd = int(req["round"])
+            if self.coord.agg.w_done.get(req["key"], 0) < rnd:
+                raise InvariantViolation(
+                    "accepted put_weight of key %r round %d did not "
+                    "advance w_done" % (req["key"], rnd))
+            if not _np.array_equal(self.coord.agg.weights[req["key"]],
+                                   _np.asarray(req["value"])):
+                raise InvariantViolation(
+                    "accepted put_weight of key %r round %d LOST: the "
+                    "server copy differs from the landed weight"
+                    % (req["key"], rnd))
+        if post["barrier_gen"] > pre["barrier_gen"]:
+            arrivals = set(pre["waiters"])
+            if op == "barrier":
+                arrivals.add(int(req["rank"]))
+            if not post["live"] <= arrivals:
+                raise InvariantViolation(
+                    "barrier generation %d released without covering "
+                    "the live set: arrivals %s, live %s"
+                    % (post["barrier_gen"], sorted(arrivals),
+                       sorted(post["live"])))
+
+    def _check_merge(self, key, rnd, who):
+        """All-reduce mode: the completed round's stored value must be
+        the surviving contributions rescaled by world/contributors."""
+        agg = self.coord.agg
+        if agg.shard_update or agg._updater is not None or not who:
+            return
+        if agg.done.get(key, 0) != rnd:
+            return  # a later round already overwrote the stored value
+        total = _np.zeros_like(next(iter(who.values())), _np.float64)
+        for arr in who.values():
+            total += arr
+        expected = (total * (self.world / float(len(who)))).astype(
+            _np.float32)
+        if not _np.allclose(agg.weights[key], expected, rtol=1e-5):
+            raise InvariantViolation(
+                "degraded rescale mismatch on key %r round %d: stored "
+                "%s != %s from contributors %s x %d/%d"
+                % (key, rnd, agg.weights[key], expected, sorted(who),
+                   self.world, len(who)))
+
+    def snapshot_roundtrip(self):
+        """The snapshot-restore invariant: membership + round state
+        survives a state-dict round trip through the REAL
+        snapshot_state/restore_state code (what a coordinator restart
+        replays, minus the file IO)."""
+        from ..elastic.server import Aggregator, GroupView
+
+        view_st = self.coord.view.snapshot_state()
+        agg_st = self.coord.agg.snapshot_state()
+        weights = {k: _np.array(v, copy=True)
+                   for k, v in self.coord.agg.weights.items()}
+        gv = GroupView(view_st["world"], self.coord.view.evict_after)
+        gv.restore_state(view_st, now=0.0)
+        if gv.snapshot_state() != view_st:
+            raise InvariantViolation(
+                "GroupView state did not round-trip through snapshot/"
+                "restore: %r != %r" % (gv.snapshot_state(), view_st))
+        ag = Aggregator(view_st["world"])
+        ag.restore_state(agg_st, weights)
+        for k, d in self.coord.agg.done.items():
+            want = min(d, self.coord.agg.w_done.get(k, 0)) \
+                if agg_st["shard_update"] else d
+            if ag.done.get(k) != want:
+                raise InvariantViolation(
+                    "round state of key %r did not restore: %r != %r "
+                    "(done=%d w_done=%d shard=%s)"
+                    % (k, ag.done.get(k), want, d,
+                       self.coord.agg.w_done.get(k, 0),
+                       agg_st["shard_update"]))
+            if not _np.array_equal(ag.weights[k],
+                                   self.coord.agg.weights[k]):
+                raise InvariantViolation(
+                    "weights of key %r did not round-trip the snapshot"
+                    % (k,))
+
+
+# -- negative-control mutants --------------------------------------------------
+
+class _EpochRegressView:
+    """SEEDED MUTANT: a rejoin regresses the membership epoch — the bug
+    the epoch-monotone invariant exists to catch. Built lazily (the
+    real GroupView import must stay function-scoped)."""
+
+    def __new__(cls, world, evict_after):
+        from ..elastic.server import GroupView
+
+        class Mutant(GroupView):
+            def register(self, rank, now):
+                epoch, rejoined = GroupView.register(self, rank, now)
+                if rejoined:
+                    self.epoch = max(0, self.epoch - 2)
+                    epoch = self.epoch
+                return epoch, rejoined
+
+        return Mutant(world, evict_after)
+
+
+class _UnguardedAggregator:
+    """SEEDED MUTANT: round completion without the live-coverage check
+    (``complete_ready`` judged against a single rank) — the dropped
+    ``live.issubset`` bug class."""
+
+    def __new__(cls, world):
+        from ..elastic.server import Aggregator
+
+        class Mutant(Aggregator):
+            def complete_ready(self, live):
+                return Aggregator.complete_ready(
+                    self, set(sorted(live)[:1]) if live else live)
+
+        return Mutant(world)
+
+
+# -- the explorer --------------------------------------------------------------
+
+def _build(wl):
+    from ..elastic.server import ElasticCoordinator
+
+    coord = ElasticCoordinator(wl.world, bind=None, evict_after=3600.0)
+    if wl.view_cls is not None:
+        coord.view = wl.view_cls(wl.world, coord.view.evict_after)
+    if wl.agg_cls is not None:
+        coord.agg = wl.agg_cls(wl.world)
+    return coord
+
+
+class _Sim:
+    """One schedule: actors + logical network + perturbation budgets.
+    All nondeterminism flows through ``chooser(events)`` so a recorded
+    choice list replays the schedule exactly."""
+
+    def __init__(self, wl, chooser):
+        self.wl = wl
+        self.chooser = chooser
+        self.coord = _build(wl)
+        self.harness = Harness(self.coord, wl.world)
+        self.actors = {}      # rank -> generator
+        self.outbox = {}      # rank -> pending request dict
+        self.crashed = set()  # ranks down (until restarted)
+        self.lose = wl.lose_budget
+        self.dup = wl.dup_budget
+        self.crashes = wl.crash_budget
+        self.restarts = wl.restart_budget
+        self.snapshots = wl.snapshot_budget
+        self.choices = []
+        self.stall = 0
+        self.stats = {"lost": 0, "dup": 0, "crash": 0, "restart": 0,
+                      "evict": 0, "snapshot": 0}
+        for rank in range(wl.world):
+            self._spawn(rank)
+        if wl.rendezvous:
+            # barrier workloads: deliver every rank's setup ops
+            # (register/init/set_optimizer) up front. The product's
+            # barrier contract is SPMD — every live rank reaches the
+            # same barrier sites having registered before round 1; a
+            # rank whose registration is delayed past another's solo
+            # round progress has a shifted barrier cadence the
+            # generation-counted protocol is not specified for.
+            # Deterministic prefix: no choices recorded, replay-exact.
+            setup = ("register", "init", "set_optimizer")
+            progressed = True
+            while progressed:
+                progressed = False
+                for rank in sorted(self.outbox):
+                    if self.outbox[rank].get("op") in setup:
+                        self._feed(rank, self.harness.deliver(
+                            self.outbox[rank]))
+                        progressed = True
+
+    def _spawn(self, rank):
+        gen = _actor(rank, self.wl)
+        self.actors[rank] = gen
+        self.outbox[rank] = next(gen)  # first request (register)
+
+    def _feed(self, rank, resp):
+        gen = self.actors[rank]
+        try:
+            self.outbox[rank] = gen.send(resp)
+        except StopIteration:
+            del self.actors[rank]
+            self.outbox.pop(rank, None)
+
+    def _events(self):
+        ev = []
+        for rank in sorted(self.outbox):
+            if rank in self.crashed:
+                continue
+            ev.append(("deliver", rank))
+            if self.lose > 0:
+                ev.append(("lose", rank))
+            if self.dup > 0:
+                ev.append(("dup", rank))
+        live_actors = [r for r in self.actors if r not in self.crashed]
+        if self.crashes > 0 and len(live_actors) > 1:
+            for rank in live_actors:
+                ev.append(("crash", rank))
+        for rank in sorted(self.crashed):
+            if rank in self.coord.view.live:
+                ev.append(("evict", rank))
+        if self.restarts > 0:
+            for rank in sorted(self.crashed):
+                ev.append(("restart", rank))
+        if self.snapshots > 0:
+            ev.append(("snapshot", -1))
+        return ev
+
+    def _unstick(self, events):
+        """Past the stall limit, only state-changing recovery events may
+        be chosen (a crashed-but-unevicted rank wedges every pull poll
+        exactly like a real corpse wedges a round — the sweeper's job,
+        here an explicit event)."""
+        forced = [e for e in events if e[0] in ("evict", "restart")]
+        return forced or events
+
+    def run(self):
+        wl = self.wl
+        while self.actors:
+            events = self._events()
+            deliverable = [e for e in events if e[0] == "deliver"]
+            if not deliverable and not self.crashed:
+                break  # only crashed actors remain unfinished
+            if self.stall > _STALL_LIMIT:
+                forced = self._unstick(events)
+                if forced is events and not deliverable:
+                    raise InvariantViolation(
+                        "livelock: no recovery event can unstick the "
+                        "schedule (crashed=%s live=%s)"
+                        % (sorted(self.crashed),
+                           sorted(self.coord.view.live)))
+                events = forced
+            if not events:
+                break
+            if len(self.choices) >= wl.max_steps:
+                raise InvariantViolation(
+                    "schedule exceeded max_steps=%d (livelock or an "
+                    "undersized budget)" % wl.max_steps)
+            kind, rank = self.chooser(events, self)
+            self.choices.append((kind, rank))
+            self._apply(kind, rank)
+        # end-state: every surviving actor finished — the rounds they
+        # agreed to run all completed on the server
+        for k in wl.keys:
+            done = self.coord.agg.done.get(k, 0)
+            if self.actors == {} and done < wl.rounds and \
+                    self.coord.view.live:
+                raise InvariantViolation(
+                    "job finished with key %r at round %d < %d"
+                    % (k, done, wl.rounds))
+
+    def _apply(self, kind, rank):
+        advanced = True
+        if kind == "deliver":
+            self._last_deliver = rank
+            req = self.outbox[rank]
+            resp = self.harness.deliver(req)
+            st = resp.get("status") if isinstance(resp, dict) else None
+            advanced = not (st == "pending"
+                            or (req.get("op") == "barrier_wait"
+                                and not resp.get("done")))
+            self._feed(rank, resp)
+        elif kind == "lose":
+            # the reply is lost: server state advanced, client retries
+            # the SAME request (the at-least-once delivery reality the
+            # idempotent stale/first-wins paths exist for)
+            self.lose -= 1
+            self.stats["lost"] += 1
+            self.harness.deliver(dict(self.outbox[rank]))
+            advanced = False
+        elif kind == "dup":
+            # lost-ack retry: the server processes the frame twice, the
+            # client dispatches on the SECOND response
+            self.dup -= 1
+            self.stats["dup"] += 1
+            self.harness.deliver(dict(self.outbox[rank]))
+            resp = self.harness.deliver(self.outbox[rank])
+            self._feed(rank, resp)
+        elif kind == "crash":
+            self.crashes -= 1
+            self.stats["crash"] += 1
+            self.crashed.add(rank)
+        elif kind == "evict":
+            self.stats["evict"] += 1
+            self.harness.deliver({"op": "evict", "rank": rank})
+        elif kind == "restart":
+            self.restarts -= 1
+            self.stats["restart"] += 1
+            self.crashed.discard(rank)
+            self._spawn(rank)
+        elif kind == "snapshot":
+            self.snapshots -= 1
+            self.stats["snapshot"] += 1
+            self.harness.snapshot_roundtrip()
+            advanced = False
+        self.stall = 0 if advanced else self.stall + 1
+
+
+def _tel_counters(sim, found_mutant=False):
+    from .. import telemetry as _tel
+
+    if not _tel.ENABLED:
+        return
+    _tel.counter("mxproto.schedules_total").inc()
+    _tel.counter("mxproto.messages_total").inc(sim.harness.messages)
+    _tel.counter("mxproto.replies_lost_total").inc(sim.stats["lost"])
+    _tel.counter("mxproto.dup_deliveries_total").inc(sim.stats["dup"])
+    _tel.counter("mxproto.crashes_total").inc(sim.stats["crash"])
+    _tel.counter("mxproto.restarts_total").inc(sim.stats["restart"])
+    _tel.counter("mxproto.evictions_total").inc(sim.stats["evict"])
+    _tel.counter("mxproto.snapshot_checks_total").inc(
+        sim.stats["snapshot"])
+    if found_mutant:
+        _tel.counter("mxproto.mutants_found_total").inc()
+
+
+def _random_chooser(rng):
+    def choose(events, _sim):
+        return events[rng.randrange(len(events))]
+    return choose
+
+
+def _default_event(events, sim):
+    """Round-robin delivery across ranks: the DFS/scripted fallback
+    schedule. (events[0]-always would run each actor's whole life
+    sequentially — a base schedule in which no two ranks are ever
+    concurrently mid-protocol, hiding every ordering bug.)"""
+    delivers = [e for e in events if e[0] == "deliver"]
+    if not delivers:
+        return events[0]
+    last = getattr(sim, "_last_deliver", -1)
+    for e in delivers:
+        if e[1] > last:
+            return e
+    return delivers[0]
+
+
+def _scripted_chooser(script):
+    state = {"i": 0}
+
+    def choose(events, sim):
+        i, state["i"] = state["i"], state["i"] + 1
+        if i < len(script) and tuple(script[i]) in \
+                {tuple(e) for e in events}:
+            return tuple(script[i])
+        return _default_event(events, sim)
+    return choose
+
+
+def _run_one(wl, chooser):
+    """(failure tuple or None, choices, messages_stat_sim)."""
+    import traceback as _tb
+
+    sim = _Sim(wl, chooser)
+    try:
+        sim.run()
+        return None, sim.choices, sim
+    except Exception as e:  # noqa: BLE001 — the product under test
+        kind = "invariant" if isinstance(e, InvariantViolation) \
+            else "exception"
+        return (kind, "%s: %s" % (type(e).__name__, e),
+                "".join(_tb.format_exception(type(e), e,
+                                             e.__traceback__))), \
+            sim.choices, sim
+
+
+def explore(wl, schedules=25, seed=0, strategy="random",
+            max_switches=3, stop_on_first=True):
+    """Drive a :class:`ProtoWorkload` through many message schedules.
+    ``random`` draws every choice from the per-schedule seeded stream;
+    ``dfs`` deviates from the deliver-in-rank-order default at up to
+    ``max_switches`` decision points (the bounded context-switch idea
+    of the thread explorer, applied to deliveries)."""
+    import random as _random
+
+    failures, explored = [], 0
+    if strategy == "random":
+        for i in range(schedules):
+            sseed = _schedule_seed(seed, i)
+            failure, choices, sim = _run_one(
+                wl, _random_chooser(_random.Random(sseed)))
+            explored += 1
+            _tel_counters(sim, found_mutant=failure is not None)
+            if failure is not None:
+                failures.append(ProtoFailure(
+                    wl.name, "random", seed, i, sseed, choices,
+                    failure[0], failure[1], failure[2]))
+                if stop_on_first:
+                    break
+        return ExploreResult(wl.name, "random", seed, explored, failures)
+    if strategy != "dfs":
+        raise ValueError("unknown strategy %r" % (strategy,))
+    stack = [((), 0)]
+    seen = set()
+    while stack and explored < schedules:
+        prefix, switches = stack.pop()
+        if prefix in seen:
+            continue
+        seen.add(prefix)
+        enabled_log = []
+
+        def chooser(events, sim, _p=prefix, _log=enabled_log):
+            i = len(sim.choices)
+            _log.append(list(events))
+            if i < len(_p) and tuple(_p[i]) in \
+                    {tuple(e) for e in events}:
+                return tuple(_p[i])
+            return _default_event(events, sim)
+
+        failure, choices, sim = _run_one(wl, chooser)
+        explored += 1
+        _tel_counters(sim, found_mutant=failure is not None)
+        if failure is not None:
+            failures.append(ProtoFailure(
+                wl.name, "dfs", seed, explored - 1, 0, choices,
+                failure[0], failure[1], failure[2]))
+            if stop_on_first:
+                break
+        if switches >= max_switches:
+            continue
+        for i in range(len(prefix), len(enabled_log)):
+            taken = tuple(choices[i]) if i < len(choices) else None
+            for alt in enabled_log[i]:
+                if tuple(alt) == taken:
+                    continue
+                stack.append(
+                    (tuple(map(tuple, choices[:i])) + (tuple(alt),),
+                     switches + 1))
+    return ExploreResult(wl.name, "dfs", seed, explored, failures)
+
+
+def replay(wl, seed, index, choices=None):
+    """Re-run exactly one schedule (the one a failure report names).
+    Returns the reproduced ProtoFailure, or None — after a fix, None
+    IS the green light."""
+    import random as _random
+
+    if choices is not None:
+        chooser = _scripted_chooser([tuple(c) for c in choices])
+        sseed = 0
+    else:
+        sseed = _schedule_seed(seed, index)
+        chooser = _random_chooser(_random.Random(sseed))
+    failure, got, _sim = _run_one(wl, chooser)
+    if failure is None:
+        return None
+    return ProtoFailure(wl.name, "random" if choices is None else "dfs",
+                        seed, index, sseed, got, failure[0], failure[1],
+                        failure[2])
+
+
+# -- built-in workloads --------------------------------------------------------
+
+def allreduce_workload(world=3, keys=("w", "b"), rounds=3):
+    """All-reduce mode (no optimizer): merged gradients are the stored
+    values, degraded rescale is directly checkable. Perturbations on."""
+    return ProtoWorkload("proto_allreduce", world=world, keys=keys,
+                         rounds=rounds)
+
+
+def barrier_workload(world=3, rounds=4):
+    """Round-anchored barriers under reply loss, duplication and a
+    crash->evict: release-only-with-coverage, idempotent re-arrival
+    (the count path), and eviction-recheck release. No restarts: a
+    restarted incarnation re-barriers at boundaries the group already
+    passed, a cadence the product's SPMD barrier sites never have."""
+    return ProtoWorkload("proto_barrier", world=world, keys=("w",),
+                         rounds=rounds, barrier_every=2,
+                         crash_budget=1, restart_budget=0,
+                         rendezvous=True)
+
+
+def shard_workload(world=3, keys=("w", "b", "c"), rounds=2):
+    """Shard-update mode: owner hand-outs, put_weight first-writer-wins,
+    ownership reassignment across evictions."""
+    return ProtoWorkload("proto_shard", world=world, keys=keys,
+                         rounds=rounds, shard=True)
+
+
+def epoch_regress_workload():
+    """NEGATIVE CONTROL: rejoin regresses the epoch. Crash + evict +
+    restart pressure raised so a random walk meets a rejoin quickly."""
+    return ProtoWorkload("mutant_epoch_regress", world=3, keys=("w",),
+                         rounds=3, lose_budget=0, dup_budget=0,
+                         crash_budget=2, restart_budget=2,
+                         snapshot_budget=0, view_cls=_EpochRegressView)
+
+
+def unguarded_completion_workload():
+    """NEGATIVE CONTROL: round completion without live-set coverage."""
+    return ProtoWorkload("mutant_unguarded_completion", world=3,
+                         keys=("w",), rounds=2, lose_budget=0,
+                         dup_budget=0, crash_budget=0, restart_budget=0,
+                         snapshot_budget=0,
+                         agg_cls=_UnguardedAggregator)
+
+
+def survival_suite(seed=0, schedules=None):
+    """The ``mxlint --protosim`` / ``chaos --proto`` legs: both mutants
+    must be FOUND and REPLAYED from their (seed, index) pair, then the
+    clean all-reduce and shard workloads must survive every schedule.
+    Returns (findings, report_lines) in the mxrace survival shape."""
+    if schedules is None:
+        schedules = int(os.environ.get("MXPROTO_SCHEDULES", "25") or 25)
+    findings, lines = [], []
+
+    for name, wl in (("control/epoch-regress", epoch_regress_workload()),
+                     ("control/unguarded", unguarded_completion_workload())):
+        r = explore(wl, schedules=schedules, seed=seed)
+        if r.ok:
+            findings.append(Finding(
+                "protosim", "control-miss", "error", name,
+                "the simulator failed to find the SEEDED protocol "
+                "mutant %r in %d schedules — message-schedule "
+                "exploration is not actually exploring"
+                % (wl.name, r.explored)))
+            lines.append("%-22s: MISSED its seeded mutant (%d schedules)"
+                         % (name, r.explored))
+            continue
+        f = r.first_failure()
+        rep = replay(wl, seed=seed, index=f.index)
+        if rep is None:
+            findings.append(Finding(
+                "protosim", "replay-miss", "error", name,
+                "failing schedule #%d of %r did not reproduce on "
+                "replay — schedules are not deterministic"
+                % (f.index, wl.name)))
+            lines.append("%-22s: mutant found but replay MISSED" % name)
+        else:
+            lines.append(
+                "%-22s: mutant found at schedule #%d (%s), replayed "
+                "from (seed=%d, index=%d)"
+                % (name, f.index, f.kind, seed, f.index))
+
+    for name, wl in (("allreduce", allreduce_workload()),
+                     ("barriers", barrier_workload()),
+                     ("shard-update", shard_workload())):
+        r = explore(wl, schedules=schedules, seed=seed)
+        if r.ok:
+            lines.append("%-22s: survived %d schedules"
+                         % (name, r.explored))
+        else:
+            f = r.first_failure()
+            findings.append(Finding(
+                "protosim", "protocol-race", "error",
+                "%s schedule #%d" % (name, f.index),
+                "%s under an adversarial message schedule: %s — %s"
+                % (f.kind, f.message, f.replay_hint())))
+            lines.append("%-22s: FAILED at schedule #%d (%s)"
+                         % (name, f.index, f.kind))
+    return findings, lines

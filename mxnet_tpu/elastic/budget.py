@@ -1,0 +1,180 @@
+"""Timeout-budget arithmetic for the elastic protocol (mxproto).
+
+Every protocol-level timing bug this repo has paid for — the long-poll
+cap landing after the client's socket deadline (PR 7), the chaos
+heartbeat-starvation flake (healthy ranks evicted on a contended box
+because scheduler jitter ate the evict window) — was a violated
+ORDERING between timeout constants that live in different modules.
+This module is the one place that ordering is written down as code:
+
+- ``check_budgets(values)`` evaluates the invariant lattice over a dict
+  of named constants and returns the violations. The static analyzer
+  (``mxnet_tpu/analysis/proto_lint.py``, ``mxlint --proto``) derives
+  the constants from the source defaults + env and calls this; runtime
+  callers can hand in live values.
+- ``evict_after_floor(heartbeat, jitter_slack, misses)`` is the
+  smallest safe evict window: the coordinator refuses to run with an
+  env-configured window below it (``ElasticCoordinator.__init__``
+  raises the window to the floor with a warning), so the
+  spurious-eviction flake class is prevented by construction instead
+  of by "run it uncontended".
+- ``measure_scheduler_jitter()`` measures how late this box's
+  scheduler actually delivers a timed wait — the slack term. Chaos
+  (``tools/chaos.py`` elastic legs) preflight-measures it and exports
+  ``MXNET_KV_EVICT_JITTER_SLACK`` + a scaled ``MXNET_KV_EVICT_AFTER``.
+
+Kept stdlib-only and import-light on purpose: tools load it by file
+path (the trace_merge pattern) without paying the jax import.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["heartbeat_misses", "jitter_slack", "evict_after_floor",
+           "measure_scheduler_jitter", "check_budgets", "Violation"]
+
+
+def heartbeat_misses(env=None):
+    """Tolerated consecutive heartbeat misses before eviction is fair
+    game (``MXNET_KV_HEARTBEAT_MISSES``, default 3): the evict window
+    must fit this many full heartbeat periods plus the jitter slack."""
+    env = os.environ if env is None else env
+    try:
+        return max(1, int(env.get("MXNET_KV_HEARTBEAT_MISSES", "3")))
+    except ValueError:
+        return 3
+
+
+def jitter_slack(env=None):
+    """Scheduler-jitter slack term in seconds
+    (``MXNET_KV_EVICT_JITTER_SLACK``, default 1.0): how late a healthy
+    worker's heartbeat may land purely because the OS scheduler was
+    busy. Chaos preflight-measures the real value for its legs."""
+    env = os.environ if env is None else env
+    try:
+        return max(0.0, float(env.get("MXNET_KV_EVICT_JITTER_SLACK", "1")))
+    except ValueError:
+        return 1.0
+
+
+def evict_after_floor(heartbeat, slack=None, misses=None, env=None):
+    """Smallest evict window that cannot evict a healthy-but-delayed
+    rank: ``misses`` full heartbeat periods plus the jitter slack."""
+    if misses is None:
+        misses = heartbeat_misses(env)
+    if slack is None:
+        slack = jitter_slack(env)
+    return misses * float(heartbeat) + float(slack)
+
+
+def measure_scheduler_jitter(samples=25, interval=0.02):
+    """Max observed overshoot (seconds) of a timed wait on this box,
+    right now. A loaded/contended machine delivers ``Event.wait(t)``
+    late by the scheduler's latency — exactly the lateness a heartbeat
+    publish suffers. The max over a burst of short waits is a usable
+    (slightly optimistic: the box can always get busier) slack floor."""
+    ev = threading.Event()
+    worst = 0.0
+    for _ in range(int(samples)):
+        t0 = time.monotonic()
+        ev.wait(interval)
+        worst = max(worst, (time.monotonic() - t0) - interval)
+    return worst
+
+
+class Violation:
+    """One broken ordering invariant in the timeout lattice."""
+
+    __slots__ = ("code", "message")
+
+    def __init__(self, code, message):
+        self.code = code
+        self.message = message
+
+    def __repr__(self):
+        return "<Violation %s: %s>" % (self.code, self.message)
+
+
+def _get(values, name):
+    v = values.get(name)
+    return None if v is None else float(v)
+
+
+def check_budgets(values):
+    """Evaluate the ordering invariants over named constants. ``values``
+    maps constant names to numbers (missing entries skip the invariants
+    that need them — the CALLER reports incompleteness; see
+    proto_lint.derive_lattice):
+
+    - ``client_timeout``  — RPC socket timeout (ElasticClient/protocol.call)
+    - ``wait_cap``        — server long-poll park cap (_WAIT_CAP)
+    - ``pull_wait``       — client-advertised long-poll budget
+    - ``heartbeat``       — heartbeat publish period
+    - ``evict_after``     — heartbeat-lapse eviction window
+    - ``misses``          — tolerated consecutive heartbeat misses
+    - ``jitter_slack``    — scheduler-jitter slack term
+    - ``retry_attempts`` / ``retry_base`` / ``retry_max`` /
+      ``retry_multiplier`` — the RPC retry policy shape
+    - ``barrier_timeout`` — MXNET_KV_BARRIER_TIMEOUT (0 = disabled)
+
+    Returns a list of :class:`Violation`.
+    """
+    out = []
+    ct = _get(values, "client_timeout")
+    cap = _get(values, "wait_cap")
+    pw = _get(values, "pull_wait")
+    hb = _get(values, "heartbeat")
+    ev = _get(values, "evict_after")
+    misses = _get(values, "misses")
+    slack = _get(values, "jitter_slack")
+    bt = _get(values, "barrier_timeout")
+
+    if ct is not None and cap is not None and cap >= ct:
+        out.append(Violation(
+            "lattice-longpoll",
+            "server long-poll cap %.3gs >= client socket timeout %.3gs: a "
+            "not-ready reply from a HEALTHY coordinator lands after the "
+            "client's recv deadline and reads as a transport failure (the "
+            "PR 7 long-poll bug class)" % (cap, ct)))
+    if pw is not None and cap is not None and pw > cap:
+        out.append(Violation(
+            "lattice-pullwait",
+            "client long-poll budget %.3gs exceeds the server park cap "
+            "%.3gs: the client asks for a wait the server will never "
+            "honor, so every long poll degrades to an early 'pending' "
+            "spin" % (pw, cap)))
+    if hb is not None and ev is not None:
+        m = misses if misses is not None else 3.0
+        s = slack if slack is not None else 0.0
+        floor = m * hb + s
+        if ev < floor:
+            out.append(Violation(
+                "lattice-evict",
+                "evict window %.3gs < %d heartbeat period(s) x %.3gs + "
+                "%.3gs jitter slack = %.3gs: a healthy rank whose "
+                "heartbeats are merely scheduler-delayed gets evicted "
+                "(the chaos heartbeat-starvation flake class); raise "
+                "MXNET_KV_EVICT_AFTER or shorten the heartbeat"
+                % (ev, int(m), hb, s, floor)))
+    if bt is not None and bt > 0 and ct is not None:
+        attempts = _get(values, "retry_attempts") or 1.0
+        base = _get(values, "retry_base") or 0.0
+        mx = _get(values, "retry_max")
+        mult = _get(values, "retry_multiplier") or 2.0
+        backoff = 0.0
+        for a in range(1, int(attempts)):
+            d = base * (mult ** (a - 1))
+            backoff += min(d, mx) if mx is not None else d
+        budget = attempts * ct + backoff
+        if budget >= bt:
+            out.append(Violation(
+                "lattice-retry-barrier",
+                "worst-case RPC retry budget %.3gs (%d attempts x %.3gs "
+                "socket timeout + %.3gs backoff) >= barrier deadline "
+                "%.3gs: a single slow-failing coordinator op can eat the "
+                "whole barrier timeout and the diagnostic fires while "
+                "the RPC was still legitimately retrying"
+                % (budget, int(attempts), ct, backoff, bt)))
+    return out

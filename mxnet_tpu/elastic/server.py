@@ -567,7 +567,8 @@ def _atomic_pickle(path, obj):
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         try:
-            req = protocol.recv_msg(self.request)
+            peer = "%s:%s" % tuple(self.client_address[:2])
+            req = protocol.recv_msg(self.request, peer=peer, what="request")
             if req is None:
                 return
             # cross-process trace propagation (docs/how_to/
@@ -609,6 +610,27 @@ class ElasticCoordinator:
                  snapshot_prefix=None, snapshot_secs=None):
         if evict_after is None:
             evict_after = float(os.environ.get("MXNET_KV_EVICT_AFTER", "10"))
+            # jitter-aware floor (budget.check_budgets invariant,
+            # docs/how_to/static_analysis.md pass 7): an env-configured
+            # window below N heartbeat periods + scheduler-jitter slack
+            # would evict healthy-but-delayed ranks on a contended box
+            # — the chaos flake class — so the coordinator refuses to
+            # run under it. Programmatic callers passing evict_after
+            # explicitly (tests, simulators) keep full control.
+            from . import budget as _budget
+
+            hb = float(os.environ.get(
+                "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2"))
+            floor = _budget.evict_after_floor(hb)
+            if evict_after < floor:
+                logging.warning(
+                    "elastic: MXNET_KV_EVICT_AFTER=%.3gs is below the "
+                    "safe floor %.3gs (%d x %.3gs heartbeat + %.3gs "
+                    "jitter slack) — raising the evict window to the "
+                    "floor so scheduler jitter cannot evict healthy "
+                    "ranks", evict_after, floor,
+                    _budget.heartbeat_misses(), hb, _budget.jitter_slack())
+                evict_after = floor
         if snapshot_secs is None:
             snapshot_secs = float(
                 os.environ.get("MXNET_KV_SNAPSHOT_SECS", "0") or "0")
@@ -634,17 +656,41 @@ class ElasticCoordinator:
         self.snapshot_secs = float(snapshot_secs)
         self.snapshots_total = 0
         self._shard_cache = None     # (epoch, nkeys, {key: owner rank})
+        self._update_owner = {}      # key -> rank pinned at MERGE time
+        #                              for the parked shard update: a
+        #                              rejoin recomputes the shard map,
+        #                              and moving a parked hand-out to
+        #                              the rejoiner deadlocks the group
+        #                              (the rejoiner's round frontier is
+        #                              past the parked key, so it never
+        #                              polls it — found by protosim,
+        #                              replay (seed=2, index=3) of the
+        #                              shard workload). Reassigned only
+        #                              when the pinned owner leaves the
+        #                              live set (the documented
+        #                              owner-eviction handoff).
         self._wire_cache = {}        # key -> (round, mode, payload|raw)
         self._stop = threading.Event()
         if snapshot_prefix and os.path.exists(snapshot_prefix + ".meta"):
             self._restore_snapshot()
-        self._srv = _Server(bind, _Handler)
-        self._srv.coordinator = self
-        self.addr = self._srv.server_address[:2]
+        if bind is None:
+            # socketless coordinator: the protocol simulator (analysis/
+            # protosim.py) drives _dispatch directly — same state
+            # machine, no port, no background threads
+            self._srv = None
+            self.addr = None
+        else:
+            self._srv = _Server(bind, _Handler)
+            self._srv.coordinator = self
+            self.addr = self._srv.server_address[:2]
         self._threads = []
 
     # -- lifecycle -------------------------------------------------------------
     def start(self):
+        if self._srv is None:
+            raise MXNetError("socketless coordinator (bind=None) cannot "
+                             "start(): it exists to be driven through "
+                             "_dispatch by the protocol simulator")
         for name, target in (
                 ("mxtpu-elastic-serve", self._srv.serve_forever),
                 ("mxtpu-elastic-sweep", self._sweep_loop),
@@ -656,8 +702,9 @@ class ElasticCoordinator:
 
     def stop(self):
         self._stop.set()
-        self._srv.shutdown()
-        self._srv.server_close()
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
         if self.snapshot_prefix:
             try:
                 self.save_snapshot()
@@ -764,7 +811,12 @@ class ElasticCoordinator:
         """After any view change or contribution: complete coverable
         rounds, release coverable barriers, and wake every long-polling
         request so it re-evaluates against the new state."""
-        self.agg.complete_ready(self.view.live)
+        finished = self.agg.complete_ready(self.view.live)
+        if self.agg.shard_update:
+            for key in finished:
+                if self.agg.take_update(key) is not None:
+                    self._update_owner[key] = \
+                        self._shard_map_locked().get(key)
         if self._barrier_waiters and \
                 self.view.live.issubset(self._barrier_waiters.keys()):
             self.barrier_gen += 1
@@ -826,6 +878,17 @@ class ElasticCoordinator:
             return hit[2]  # racing encoder published first (same bytes)
         self._wire_cache[key] = (rnd, wire, payload)
         return payload
+
+    def _update_owner_locked(self, key):
+        """Owner of ``key``'s PARKED merged gradient: the rank pinned
+        at merge time while it stays live (it is at the round frontier
+        and will poll the key), else the current map's owner (the
+        eviction handoff)."""
+        owner = self._update_owner.get(key)
+        if owner is None or owner not in self.view.live:
+            owner = self._shard_map_locked().get(key)
+            self._update_owner[key] = owner
+        return owner
 
     def _require_live(self, rank):
         """None when rank is a member; an 'evicted' reply otherwise —
@@ -931,7 +994,7 @@ class ElasticCoordinator:
                         # correctness
                         upd = self.agg.take_update(key)
                         if upd is not None and \
-                                self._shard_map_locked().get(key) == rank:
+                                self._update_owner_locked(key) == rank:
                             rnd, grad = upd
                             return {"status": "update", "round": rnd,
                                     "epoch": self.view.epoch,

@@ -73,9 +73,27 @@ def pytest_sessionfinish(session, exitstatus):
     must contain no inversion. An inversion here means two subsystems
     really took two locks in both orders at runtime somewhere in the
     suite — a deadlock in waiting that no single test owns, so it is
-    raised at session scope where the evidence lives."""
-    from mxnet_tpu.analysis import engine_verify
+    raised at session scope where the evidence lives.
 
+    The same hook runs the mxproto clean-repo gate: the elastic RPC
+    substrate's client call sites, server dispatch arms and timeout
+    lattice must diff clean (pure AST, ~ms) — a protocol drift
+    introduced by any change in the session fails the session, not
+    some later distributed job. env={} pins the lattice to the SHIPPED
+    defaults: an exported elastic knob (a chaos run's evict window)
+    must not fail an unrelated session — the coordinator clamps a
+    misconfigured window at startup, and `mxlint --proto` run by hand
+    still checks the live environment."""
+    from mxnet_tpu.analysis import engine_verify
+    from mxnet_tpu.analysis.proto_lint import lint_protocol
+
+    proto_bad = [f for f in lint_protocol(env={})
+                 if f.severity in ("error", "warning")]
+    if proto_bad:
+        raise pytest.UsageError(
+            "mxproto suite-wide protocol gate: %d schema/lattice "
+            "finding(s) on the elastic RPC substrate:\n%s"
+            % (len(proto_bad), "\n".join(str(f) for f in proto_bad)))
     trace = engine_verify.ambient_trace(create=False)
     if trace is None:
         return

@@ -1,0 +1,28 @@
+"""mxproto seeded-bad fixture: field mismatches in both directions —
+`junk` is sent with push but never read by the arm (`field-unread`,
+warning), and the pull arm subscripts `min_round` which the client
+never sends (`field-missing`, warning)."""
+
+
+class Server:
+    def _dispatch(self, req):
+        op = req.get("op")
+        if op == "push":
+            self.store(req["key"], req["round"], req["value"])
+            return {"status": "ok", "round": 1}
+        if op == "pull":
+            return {"status": "ok", "value": self.get(req["key"]),
+                    "round": req["min_round"]}
+        return {"status": "error", "message": "unknown op"}
+
+    def store(self, key, rnd, value):
+        pass
+
+    def get(self, key):
+        return None
+
+
+def go(client, grad):
+    client.call("push", key="w", round=1, value=grad, junk=1)
+    resp = client.call("pull", key="w")
+    return resp.get("value")

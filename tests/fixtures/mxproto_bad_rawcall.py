@@ -1,0 +1,16 @@
+"""mxproto seeded-bad fixture: a raw ``protocol.call`` outside the
+RetryPolicy/kv.coord discipline (`raw-protocol-call`, warning) next to
+a disciplined twin that is clean."""
+
+from mxnet_tpu.elastic import protocol
+from mxnet_tpu.resilience import faults
+
+
+def poke(addr):
+    # undisciplined: a transient coordinator hiccup here is fatal
+    return protocol.call(addr, {"op": "view", "rank": 0})
+
+
+def poke_disciplined(addr):
+    faults.point("kv.coord")
+    return protocol.call(addr, {"op": "view", "rank": 0})

@@ -1,0 +1,16 @@
+"""mxproto seeded-bad fixture: the client subscripts a reply key
+(`live`) that no server return for that op carries (`reply-missing`,
+error) — the client-side KeyError waiting on the live path."""
+
+
+class Server:
+    def _dispatch(self, req):
+        op = req.get("op")
+        if op == "view":
+            return {"status": "ok", "epoch": self.epoch}
+        return {"status": "error", "message": "unknown op"}
+
+
+def go(client):
+    resp = client.call("view")
+    return resp["live"]

@@ -1,0 +1,22 @@
+"""mxproto seeded-bad fixture: a broken timeout lattice — the server
+long-poll cap exceeds the client socket timeout (`lattice-longpoll`),
+the client poll budget exceeds the cap (`lattice-pullwait`), and the
+evict window is smaller than the tolerated heartbeat misses plus
+jitter slack (`lattice-evict`). All errors."""
+
+import os
+
+_WAIT_CAP = 35.0  # > the 30s socket timeout below: replies land late
+
+
+def call(addr, req, timeout=30.0):
+    return None
+
+
+def config():
+    heartbeat = float(os.environ.get(
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2"))
+    evict_after = float(os.environ.get("MXNET_KV_EVICT_AFTER", "5"))
+    pull_wait = float(os.environ.get("MXNET_KV_PULL_WAIT", "40"))
+    slack = float(os.environ.get("MXNET_KV_EVICT_JITTER_SLACK", "1"))
+    return heartbeat, evict_after, pull_wait, slack

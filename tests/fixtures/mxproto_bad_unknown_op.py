@@ -1,0 +1,16 @@
+"""mxproto seeded-bad fixture: a client speaking an op no dispatch arm
+handles (`unknown-op`, error). The lone server arm is also never called
+by this file's client (`dead-arm`, info)."""
+
+
+class Server:
+    def _dispatch(self, req):
+        op = req.get("op")
+        if op == "register":
+            return {"status": "ok", "epoch": 1}
+        return {"status": "error", "message": "unknown op %r" % (op,)}
+
+
+def go(client):
+    resp = client.call("frobnicate", key=1)
+    return resp.get("status")

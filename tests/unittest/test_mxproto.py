@@ -1,0 +1,458 @@
+"""mxproto tests (proto_lint + protosim + protocol framing + budget).
+
+Covers the tentpole end to end: every proto_lint detector catches its
+seeded-bad fixture at the right severity, the real elastic substrate
+diffs clean (the clean-repo gate CI relies on), the timeout lattice
+derives every constant and flags broken orderings (including live env
+overrides), the framing layer raises attributable ProtocolErrors on
+torn/oversized/garbage frames, the socketless coordinator drives the
+simulator, and the simulator finds + replays both seeded protocol
+mutants while the clean workloads survive every explored message
+schedule — including the rejoin-owner deadlock schedule the simulator
+originally caught in the real server (pinned as a regression).
+"""
+import json
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mxnet_tpu.analysis import proto_lint, protosim
+from mxnet_tpu.analysis.cli import main as mxlint_main
+from mxnet_tpu.elastic import budget, protocol
+from mxnet_tpu.elastic.protocol import ProtocolError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures")
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name + ".py")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def lint_fixture(name, env=None):
+    return proto_lint.lint_protocol([fixture(name)],
+                                    env={} if env is None else env)
+
+
+# -- proto_lint: seeded-bad fixtures -------------------------------------------
+
+def test_unknown_op_fixture():
+    fs = lint_fixture("mxproto_bad_unknown_op")
+    errors = [f for f in fs if f.severity == "error"]
+    assert codes(errors) == ["unknown-op"]
+    assert "frobnicate" in errors[0].message
+    # the uncalled register arm is a deliberate info, not a failure
+    assert codes([f for f in fs if f.severity == "info"]) == ["dead-arm"]
+
+
+def test_field_mismatch_fixture_both_directions():
+    fs = lint_fixture("mxproto_bad_fields")
+    assert sorted(codes(fs)) == ["field-missing", "field-unread"]
+    assert all(f.severity == "warning" for f in fs)
+    by_code = {f.code: f for f in fs}
+    assert "junk" in by_code["field-unread"].message
+    assert "min_round" in by_code["field-missing"].message
+
+
+def test_reply_missing_fixture():
+    fs = lint_fixture("mxproto_bad_reply")
+    assert codes(fs) == ["reply-missing"]
+    assert fs[0].severity == "error"
+    assert "'live'" in fs[0].message and "'view'" in fs[0].message
+
+
+def test_raw_protocol_call_fixture_discipline_split():
+    """The bare protocol.call is flagged; the twin with the kv.coord
+    fault point in the same function is not."""
+    fs = lint_fixture("mxproto_bad_rawcall")
+    assert codes(fs) == ["raw-protocol-call"]
+    assert fs[0].severity == "warning"
+    # exactly one of the two call sites — line 11 (poke), not 16
+    assert len(fs) == 1
+
+
+def test_timeout_lattice_fixture_all_three_orderings():
+    fs = lint_fixture("mxproto_bad_timeout")
+    assert sorted(codes(fs)) == ["lattice-evict", "lattice-longpoll",
+                                 "lattice-pullwait"]
+    assert all(f.severity == "error" for f in fs)
+    [lp] = [f for f in fs if f.code == "lattice-longpoll"]
+    assert "35" in lp.message and "30" in lp.message
+
+
+def test_lattice_env_override_checks_configured_values():
+    """The lint checks the CONFIGURED lattice: an env override that
+    shrinks the evict window below misses x heartbeat + slack is an
+    error even though the shipped defaults are fine."""
+    fs = proto_lint.lint_protocol(env={"MXNET_KV_EVICT_AFTER": "1"})
+    assert "lattice-evict" in codes(fs)
+    [f] = [x for x in fs if x.code == "lattice-evict"]
+    assert "env MXNET_KV_EVICT_AFTER" in f.where
+
+
+def test_lattice_conflicting_defaults_warn(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("import os\n"
+                 "E = float(os.environ.get('MXNET_KV_EVICT_AFTER', '10'))\n")
+    b.write_text("import os\n"
+                 "E = float(os.environ.get('MXNET_KV_EVICT_AFTER', '20'))\n")
+    _c, fs = proto_lint.derive_lattice([str(a), str(b)], env={})
+    assert codes(fs) == ["lattice-conflict"]
+
+
+def test_lattice_incomplete_names_the_missing_constant(tmp_path):
+    p = tmp_path / "bare.py"
+    p.write_text("X = 1\n")
+    _c, fs = proto_lint.derive_lattice([str(p)], env={},
+                                       required=("wait_cap",))
+    assert codes(fs) == ["lattice-incomplete"]
+    assert "wait_cap" in fs[0].message
+
+
+# -- proto_lint: clean-repo gate -----------------------------------------------
+
+def test_repo_protocol_lint_clean():
+    """The acceptance contract: zero errors and zero warnings over the
+    real elastic substrate; the only findings are the two deliberate
+    dead-arm infos (the 'evict' admin hook and 'snapshot')."""
+    fs = proto_lint.lint_protocol(env={})
+    bad = [f for f in fs if f.severity in ("error", "warning")]
+    assert bad == [], "\n".join(str(f) for f in bad)
+    infos = [f for f in fs if f.severity == "info"]
+    assert sorted("evict" in f.message or "snapshot" in f.message
+                  for f in infos) == [True] * len(infos)
+
+
+def test_schema_extraction_matches_the_real_protocol():
+    sch = proto_lint.extract_schema()
+    # the wrappers and the pull_fields **-expansion both resolved
+    assert set(sch.ops["pull"].sent) >= {"key", "min_round", "wire",
+                                         "wait"}
+    assert set(sch.ops["push"].sent) == {"key", "round", "value"}
+    assert "register" in sch.ops and sch.ops["register"].client_sites
+    # transport-assembly common fields
+    assert {"op", "rank"} <= set(sch.common.sent)
+    # server halves merged across the preamble guard and the arm
+    assert "blob" in sch.ops["set_optimizer"].req_required
+    assert "value" in sch.ops["pull"].replies
+
+
+def test_lattice_derives_every_constant_from_source():
+    consts, fs = proto_lint.derive_lattice(env={})
+    assert fs == [], fs
+    values = {k: v for k, (v, _w) in consts.items()}
+    assert values["client_timeout"] == 30.0
+    assert values["wait_cap"] == 25.0
+    assert values["heartbeat"] == 2.0
+    assert values["evict_after"] == 10.0
+    assert values["pull_wait"] == 0.25
+    assert values["retry_attempts"] == 4.0
+    assert values["misses"] == 3.0 and values["jitter_slack"] == 1.0
+
+
+# -- budget: the invariant oracle ----------------------------------------------
+
+def test_check_budgets_each_invariant():
+    ok = {"client_timeout": 30, "wait_cap": 25, "pull_wait": 0.25,
+          "heartbeat": 2, "evict_after": 10, "misses": 3,
+          "jitter_slack": 1, "barrier_timeout": 0}
+    assert budget.check_budgets(ok) == []
+    v = budget.check_budgets(dict(ok, wait_cap=31))
+    assert [x.code for x in v] == ["lattice-longpoll"]
+    v = budget.check_budgets(dict(ok, pull_wait=26))
+    assert [x.code for x in v] == ["lattice-pullwait"]
+    v = budget.check_budgets(dict(ok, evict_after=5))
+    assert [x.code for x in v] == ["lattice-evict"]
+    v = budget.check_budgets(dict(
+        ok, barrier_timeout=60, retry_attempts=4, retry_base=0.05,
+        retry_max=1.0))
+    assert [x.code for x in v] == ["lattice-retry-barrier"]
+    # a generous barrier deadline passes
+    assert budget.check_budgets(dict(
+        ok, barrier_timeout=300, retry_attempts=4, retry_base=0.05,
+        retry_max=1.0)) == []
+
+
+def test_evict_after_floor_and_jitter_measure():
+    assert budget.evict_after_floor(2.0, slack=1.0, misses=3) == 7.0
+    assert budget.heartbeat_misses({"MXNET_KV_HEARTBEAT_MISSES": "5"}) == 5
+    assert budget.jitter_slack({}) == 1.0
+    j = budget.measure_scheduler_jitter(samples=3, interval=0.001)
+    assert j >= 0.0
+
+
+def test_coordinator_env_path_clamps_to_the_floor(monkeypatch):
+    """An env-configured evict window below the jitter-aware floor is
+    raised to it (spurious-eviction prevention by construction); an
+    explicit evict_after argument is the caller's deliberate choice."""
+    from mxnet_tpu.elastic import ElasticCoordinator
+
+    monkeypatch.setenv("MXNET_KV_EVICT_AFTER", "0.5")
+    c = ElasticCoordinator(world=1, bind=None)
+    assert c.view.evict_after == pytest.approx(7.0)  # 3 x 2s + 1s slack
+    c2 = ElasticCoordinator(world=1, bind=None, evict_after=0.5)
+    assert c2.view.evict_after == 0.5
+
+
+# -- protocol framing hardening ------------------------------------------------
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_framing_roundtrip_and_clean_close():
+    a, b = _pair()
+    try:
+        protocol.send_msg(a, {"op": "x", "n": 1})
+        assert protocol.recv_msg(b) == {"op": "x", "n": 1}
+        a.close()
+        assert protocol.recv_msg(b) is None  # clean close between frames
+    finally:
+        b.close()
+
+
+def test_truncated_header_names_the_peer():
+    a, b = _pair()
+    try:
+        a.sendall(b"\x00\x00")  # 2 of 4 header bytes
+        a.close()
+        with pytest.raises(ProtocolError) as ei:
+            protocol.recv_msg(b, peer="10.0.0.9:77", what="request")
+        assert "10.0.0.9:77" in str(ei.value)
+        assert "2 of 4" in str(ei.value)
+    finally:
+        b.close()
+
+
+def test_oversized_length_prefix_rejected():
+    a, b = _pair()
+    try:
+        a.sendall(struct.pack(">I", (1 << 30) + 1))
+        with pytest.raises(ProtocolError) as ei:
+            protocol.recv_msg(b, peer="p:1")
+        assert "exceeds" in str(ei.value) and "p:1" in str(ei.value)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_mid_frame_disconnect_is_a_protocol_error():
+    a, b = _pair()
+    try:
+        payload = pickle.dumps({"op": "push"})
+        a.sendall(struct.pack(">I", len(payload)) + payload[:3])
+        a.close()
+        with pytest.raises(ProtocolError) as ei:
+            protocol.recv_msg(b, peer="w:2", what="reply to 'push'")
+        msg = str(ei.value)
+        assert "mid-frame" in msg and "w:2" in msg and "push" in msg
+    finally:
+        b.close()
+
+
+def test_garbage_payload_is_a_protocol_error_not_unpickling_noise():
+    a, b = _pair()
+    try:
+        junk = b"\x80\x99not-a-pickle"
+        a.sendall(struct.pack(">I", len(junk)) + junk)
+        with pytest.raises(ProtocolError) as ei:
+            protocol.recv_msg(b, peer="c:3")
+        assert "undecodable" in str(ei.value) and "c:3" in str(ei.value)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_error_is_retryable_transport_failure():
+    """ProtocolError subclasses ConnectionError (and MXNetError): the
+    retry discipline heals a torn frame like any transient."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.resilience.retry import RetryPolicy
+
+    assert issubclass(ProtocolError, ConnectionError)
+    assert issubclass(ProtocolError, MXNetError)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise ProtocolError("torn frame")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0,
+                      sleep=lambda _s: None)
+    assert pol.call(flaky) == "ok" and len(calls) == 2
+
+
+def test_call_raises_protocol_error_on_torn_reply():
+    """End-to-end: a server that tears the reply mid-frame surfaces as
+    ProtocolError naming the op — not unpickling garbage."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = srv.getsockname()
+
+    def serve_torn():
+        conn, _ = srv.accept()
+        protocol.recv_msg(conn, peer="test")
+        payload = pickle.dumps({"status": "ok"})
+        conn.sendall(struct.pack(">I", len(payload)) + payload[:2])
+        conn.close()
+
+    t = threading.Thread(target=serve_torn, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ProtocolError) as ei:
+            protocol.call(addr, {"op": "view", "rank": 0}, timeout=5.0)
+        assert "'view'" in str(ei.value)
+    finally:
+        t.join(5.0)
+        srv.close()
+
+
+# -- socketless coordinator ----------------------------------------------------
+
+def test_socketless_coordinator_dispatches_without_a_port():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.elastic import ElasticCoordinator
+
+    c = ElasticCoordinator(world=2, bind=None, evict_after=30)
+    assert c.addr is None and c._srv is None
+    with pytest.raises(MXNetError):
+        c.start()
+    resp = c._dispatch({"op": "register", "rank": 0})
+    assert resp["status"] == "ok" and resp["epoch"] == 1
+    resp = c._dispatch({"op": "view", "rank": 0})
+    assert resp["live"] == [0]
+    c.stop()  # no socket to close: must not raise
+
+
+# -- protosim ------------------------------------------------------------------
+
+def test_sim_allreduce_survives_seeded_schedules():
+    r = protosim.explore(protosim.allreduce_workload(), schedules=12,
+                         seed=0)
+    assert r.ok, r.first_failure()
+
+
+def test_sim_barrier_workload_survives():
+    r = protosim.explore(protosim.barrier_workload(), schedules=12,
+                         seed=1)
+    assert r.ok, r.first_failure()
+
+
+def test_sim_shard_workload_survives():
+    r = protosim.explore(protosim.shard_workload(), schedules=12,
+                         seed=0)
+    assert r.ok, r.first_failure()
+
+
+def test_sim_finds_and_replays_epoch_regress_mutant():
+    wl = protosim.epoch_regress_workload()
+    r = protosim.explore(wl, schedules=25, seed=0)
+    assert not r.ok, "epoch-regress mutant not found in 25 schedules"
+    f = r.first_failure()
+    assert f.kind == "invariant" and "regressed" in f.message
+    assert "protosim.replay" in f.replay_hint()
+    rep = protosim.replay(wl, seed=0, index=f.index)
+    assert rep is not None and "regressed" in rep.message
+
+
+def test_sim_finds_and_replays_unguarded_completion_mutant():
+    wl = protosim.unguarded_completion_workload()
+    r = protosim.explore(wl, schedules=25, seed=0)
+    assert not r.ok, "unguarded-completion mutant not found"
+    f = r.first_failure()
+    assert "not covering the live set" in f.message
+    rep = protosim.replay(wl, seed=0, index=f.index)
+    assert rep is not None and "not covering" in rep.message
+
+
+def test_sim_dfs_strategy_finds_mutant_and_replays_choices():
+    wl = protosim.unguarded_completion_workload()
+    r = protosim.explore(wl, schedules=15, seed=0, strategy="dfs")
+    assert not r.ok
+    f = r.first_failure()
+    assert "choices=" in f.replay_hint()
+    rep = protosim.replay(wl, seed=0, index=f.index, choices=f.choices)
+    assert rep is not None and "not covering" in rep.message
+
+
+def test_sim_rejoin_owner_deadlock_regression():
+    """The schedule that exposed the real server bug this PR fixed: a
+    rejoin recomputed the shard map and moved a PARKED merged gradient
+    to the rejoiner, whose round frontier was already past the parked
+    key — distributed deadlock. With ownership pinned at merge time
+    (server._update_owner) the exact schedule must pass."""
+    rep = protosim.replay(protosim.shard_workload(), seed=2, index=3)
+    assert rep is None, "the rejoin-owner deadlock is back:\n%s" % rep
+
+
+def test_sim_fixed_workload_replay_green_is_the_green_light():
+    """replay() of a passing schedule returns None (the green light)."""
+    assert protosim.replay(protosim.allreduce_workload(),
+                           seed=0, index=0) is None
+
+
+def test_sim_survival_suite_smoke():
+    fs, lines = protosim.survival_suite(seed=0, schedules=8)
+    assert fs == [], "\n".join(str(f) for f in fs)
+    assert sum("mutant found" in ln for ln in lines) == 2
+    assert sum("survived" in ln for ln in lines) == 3
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def test_cli_proto_clean_on_repo_and_nonzero_on_fixtures(capsys):
+    assert mxlint_main(["--proto"]) == 0
+    assert mxlint_main(["--proto", fixture("mxproto_bad_reply")]) == 1
+    # field findings are warnings: default --fail-on error passes,
+    # strict mode fails
+    assert mxlint_main(["--proto", fixture("mxproto_bad_fields")]) == 0
+    assert mxlint_main(["--proto", fixture("mxproto_bad_fields"),
+                        "--fail-on", "warning"]) == 1
+    out = capsys.readouterr().out
+    assert "reply-missing" in out and "field-unread" in out
+
+
+def test_cli_proto_json(capsys):
+    assert mxlint_main(["--proto", fixture("mxproto_bad_timeout"),
+                        "--json"]) == 1
+    recs = json.loads(capsys.readouterr().out)
+    assert {r["code"] for r in recs} == {
+        "lattice-longpoll", "lattice-pullwait", "lattice-evict"}
+    assert all(r["pass"] == "proto" for r in recs)
+
+
+def test_cli_protosim_leg(capsys):
+    assert mxlint_main(["--protosim", "--proto-count", "6",
+                        "--proto-seed", "4"]) == 0
+    err = capsys.readouterr().err
+    assert "mutant found" in err and "survived" in err
+
+
+def test_cli_end_to_end_subprocess_proto():
+    """The checkout-tree launcher running the protocol lint — the CI
+    gate invocation (also what conftest's session gate enforces)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+         "--proto"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    assert "0 error(s), 0 warning(s)" in res.stdout

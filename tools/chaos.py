@@ -19,6 +19,7 @@ Usage::
     python tools/chaos.py --elastic     # SIGKILL/rejoin survival legs
     python tools/chaos.py --guardian    # grad.nan/loss.spike survival legs
     python tools/chaos.py --schedules   # thread-schedule survival legs
+    python tools/chaos.py --proto       # protocol message-schedule legs
 
 The spec is derived deterministically from --seed: per point, a fire
 probability in [0.02, 0.15] and a per-point RNG seed. Same seed, same
@@ -431,16 +432,70 @@ _OK_RE = re.compile(r"rank (\d+)/%d: elastic fit OK acc=([0-9.]+)"
                     % _ELASTIC_N)
 
 
+def _load_budget():
+    """mxnet_tpu/elastic/budget.py by file path (the trace_merge
+    pattern): the harness must not pay the jax import to do timeout
+    arithmetic."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_chaos_budget",
+        os.path.join(REPO, "mxnet_tpu", "elastic", "budget.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_TIMING = None
+
+
+def _elastic_timing():
+    """(env dict, restart_delay): the elastic legs' heartbeat/evict
+    budget, with the evict window scaled by PREFLIGHT-MEASURED
+    scheduler jitter instead of a hardcoded 3s. On a contended box a
+    healthy rank's heartbeats land late by the scheduler's latency;
+    sizing the window below misses x period + that slack evicts
+    healthy ranks in the fault-free baseline leg — the documented
+    spurious-eviction flake, now prevented by construction (the
+    budget.evict_after_floor invariant the mxlint --proto lattice also
+    checks)."""
+    global _TIMING
+    if _TIMING is None:
+        budget = _load_budget()
+        hb = 0.3
+        jitter = budget.measure_scheduler_jitter()
+        # 6x headroom over the instantaneous measurement: the box can
+        # always get busier than the preflight burst saw (the legs
+        # themselves add 4 workers + a coordinator of load)
+        slack = max(0.5, 6.0 * jitter)
+        evict = max(3.0, budget.evict_after_floor(hb, slack=slack,
+                                                  misses=3))
+        print("chaos: preflight scheduler jitter %.0fms -> jitter "
+              "slack %.2fs, evict window %.2fs (%.1fs heartbeat x 3 "
+              "tolerated misses + slack)" % (jitter * 1e3, slack,
+                                             evict, hb))
+        # restart hold: eviction lands at worst evict_after + one sweep
+        # interval (the sweeper runs every evict/4) + scheduling slack;
+        # a flat +2s margin would re-race the sweep for windows > 8s
+        restart_delay = evict + max(2.0, evict / 4.0 + slack)
+        _TIMING = ({
+            "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "%g" % hb,
+            "MXNET_KV_EVICT_AFTER": "%.2f" % evict,
+            "MXNET_KV_EVICT_JITTER_SLACK": "%.2f" % slack,
+        }, restart_delay)
+    return _TIMING
+
+
 def _run_elastic_leg(tag, scratch, port, timeout, extra_env=None,
                      launch_args=()):
     """One tools/launch.py --elastic run of dist_elastic_fit.py.
     Returns (returncode, {rank: acc}, folded journal counters, output)."""
+    timing_env, _restart_delay = _elastic_timing()
     env = dict(os.environ)
+    env.update(timing_env)
     env.update({
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
-        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.3",
-        "MXNET_KV_EVICT_AFTER": "3",
         "MXNET_TELEMETRY": "1",
         # per-rank journals: launch.py expands {rank}
         "MXNET_TELEMETRY_JOURNAL": os.path.join(
@@ -556,18 +611,20 @@ def run_elastic(args):
     print("chaos --elastic: rejoin leg (SIGKILL rank 3, restart held past "
           "the evict window, rejoin)")
     mark = tempfile.mkdtemp(prefix="mark-", dir=scratch)
-    # --restart-delay 5 > MXNET_KV_EVICT_AFTER=3 (+ sweep cadence):
-    # the dead incarnation is always EVICTED before the respawn
-    # re-registers, so rejoins_total >= 1 is deterministic. Without the
-    # hold, warm jit caches respawn the worker inside the 3s window and
-    # its register is a plain (uncounted) re-admission — the
-    # pre-existing rejoin-leg flake (PR 9 NB).
+    # --restart-delay > the (jitter-scaled) MXNET_KV_EVICT_AFTER plus
+    # sweep cadence: the dead incarnation is always EVICTED before the
+    # respawn re-registers, so rejoins_total >= 1 is deterministic.
+    # Without the hold, warm jit caches respawn the worker inside the
+    # evict window and its register is a plain (uncounted)
+    # re-admission — the pre-existing rejoin-leg flake (PR 9 NB).
+    _timing_env, restart_delay = _elastic_timing()
     rc2, accs2, c2, out2 = _run_elastic_leg(
         "rejoin", scratch, port + 2, per_leg,
         extra_env={"MXNET_ELASTIC_TEST_DIE_RANK": "3",
                    "MXNET_ELASTIC_TEST_DIE_AT": "15",
                    "MXNET_ELASTIC_TEST_MARK": mark},
-        launch_args=["--max-restarts", "1", "--restart-delay", "5"])
+        launch_args=["--max-restarts", "1",
+                     "--restart-delay", "%.1f" % restart_delay])
     if rc2 != 0 or len(accs2) != _ELASTIC_N:
         failures.append("rejoin leg: not every rank (incl. the restarted "
                         "one) finished (rc=%d, done=%s)\n%s"
@@ -597,6 +654,10 @@ def run_elastic(args):
                             "(%s)" % e)
 
     print("\n=== elastic survival report ===")
+    timing_env, _rd = _elastic_timing()
+    print("evict window    : %ss (jitter slack %ss)"
+          % (timing_env["MXNET_KV_EVICT_AFTER"],
+             timing_env["MXNET_KV_EVICT_JITTER_SLACK"]))
     print("baseline acc    : %s"
           % ("%.4f" % base_acc if base_acc is not None else "FAILED"))
     print("evict leg       : rc=%d survivors=%s accs=%s"
@@ -830,6 +891,77 @@ def run_schedules(args):
     return 0
 
 
+# -- protocol message-schedule survival legs -----------------------------------
+# The ISSUE-11 acceptance contract: the mxproto simulator
+# (mxnet_tpu/analysis/protosim.py) runs the REAL coordinator dispatch
+# state machine under explorable delivery orders, reply losses,
+# duplicate deliveries, crashes, evictions and restarts; both seeded
+# protocol mutants (epoch-regress-on-rejoin, unguarded round
+# completion) must be found and replayed from their (seed, index)
+# pair, then the all-reduce, barrier and shard-update workloads must
+# survive every explored schedule. Runs with telemetry on so the
+# survival report folds the simulator's message/perturbation counters
+# from the journal.
+
+def run_proto(args):
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-proto-")
+    journal = os.path.join(scratch, "proto-journal.jsonl")
+    # env set BEFORE the mxnet_tpu import: telemetry reads it at load
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TELEMETRY_JOURNAL"] = journal
+    import time as _time
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.analysis.protosim import survival_suite
+
+    budget = int(os.environ.get("MXPROTO_SCHEDULES", "0") or 0) or 50
+    print("chaos --proto: seed=%d, %d message schedules per leg"
+          % (args.seed, budget))
+    t0 = _time.time()
+    findings, lines = survival_suite(seed=args.seed, schedules=budget)
+    wall = _time.time() - t0
+    telemetry.flush(mark="exit")
+    counters = fold_telemetry(journal)
+
+    print("\n=== protocol survival report ===")
+    print("seed            : %d" % args.seed)
+    print("wall time       : %.1fs" % wall)
+    for ln in lines:
+        print(ln)
+    print("-- simulator counters (mxtel journal) --")
+    if counters:
+        print("schedules       : %d explored, %d messages delivered"
+              % (counters.get("mxproto.schedules_total", 0),
+                 counters.get("mxproto.messages_total", 0)))
+        print("perturbations   : %d replies lost, %d duplicated, "
+              "%d crashes, %d restarts, %d evictions, %d snapshot "
+              "round-trips"
+              % (counters.get("mxproto.replies_lost_total", 0),
+                 counters.get("mxproto.dup_deliveries_total", 0),
+                 counters.get("mxproto.crashes_total", 0),
+                 counters.get("mxproto.restarts_total", 0),
+                 counters.get("mxproto.evictions_total", 0),
+                 counters.get("mxproto.snapshot_checks_total", 0)))
+        print("mutants found   : %d"
+              % counters.get("mxproto.mutants_found_total", 0))
+    else:
+        print("(no journal counters — telemetry produced no snapshots)")
+    if findings:
+        print("\nRESULT: FAIL")
+        for f in findings:
+            print(" - %s" % f)
+        return 8
+    print("\nRESULT: SURVIVED — both seeded protocol mutants were "
+          "found and replayed from their (seed, index) pairs; the "
+          "all-reduce, barrier and shard-update workloads survived "
+          "every explored message schedule (delivery reorder, reply "
+          "loss, duplication, crash, eviction, restart, snapshot "
+          "round-trip). Rerun with the same --seed to reproduce.")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run the test suite under a seeded fault spec")
@@ -874,6 +1006,15 @@ def main(argv=None):
                          "elastic aggregator round protocol must "
                          "survive every explored schedule (MXRACE_"
                          "SCHEDULES overrides the per-leg budget)")
+    ap.add_argument("--proto", action="store_true",
+                    help="run the mxproto message-schedule survival "
+                         "legs (ISSUE 11): the protocol simulator must "
+                         "find + replay both seeded protocol mutants, "
+                         "then the all-reduce, barrier and shard-update "
+                         "workloads must survive every explored "
+                         "delivery/loss/duplication/crash/restart "
+                         "schedule (MXPROTO_SCHEDULES overrides the "
+                         "per-leg budget)")
     ap.add_argument("tests", nargs="*",
                     help="explicit test paths (default: smoke set)")
     args = ap.parse_args(argv)
@@ -886,6 +1027,8 @@ def main(argv=None):
         return run_quantized(args)
     if args.schedules:
         return run_schedules(args)
+    if args.proto:
+        return run_proto(args)
 
     points = [p.strip() for p in args.points.split(",") if p.strip()]
     spec = args.spec or build_spec(args.seed, points, args.mode)
