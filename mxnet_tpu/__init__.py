@@ -71,3 +71,8 @@ from . import torch as torch_plugin  # noqa: E402
 from .torch import th  # noqa: E402
 from . import parallel  # noqa: E402
 from . import models  # noqa: E402
+from . import control  # noqa: E402
+
+# mxctl in-process embedding: a no-op unless MXCTL_ENABLE is set (the
+# mxtel/mxdash off-by-default gating pattern, docs/how_to/control_plane.md)
+control.maybe_start()

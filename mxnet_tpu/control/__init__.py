@@ -1,0 +1,83 @@
+"""mxctl: SLO-driven closed-loop control plane (detect -> decide ->
+act -> journal).
+
+Everything the framework already exposes read-only — mxdash's
+``/metrics``/``/servingz``/``/enginez`` endpoints, trace_merge
+straggler attribution, the elastic coordinator's membership view,
+guardian escalation — feeds a controller that *acts*: restart a dead
+serving replica, evict-and-replace a persistent training straggler,
+drain-then-restart a degraded replica. Supervision/recovery as a
+system service rather than an operator runbook is the TensorFlow
+coordination-layer design (PAPERS.md, arXiv:1605.08695).
+
+Layers (docs/how_to/control_plane.md):
+
+========================  ====================================================
+``supervisor.py``         process spawn/respawn machinery, shared with
+                          tools/launch.py (stdlib-only, file-path loadable)
+``probes.py``             mxdash HTTP + elastic-coordinator scrapers
+``rules.py``              declarative SLO rules + hysteresis state machine
+``actuators.py``          pluggable action catalog (restart / drain-restart /
+                          evict-replace), per-action retry
+``controller.py``         the loop, rate limiting, dry-run, mxctl.* telemetry
+``__main__.py``           the daemon: ``python -m mxnet_tpu.control``
+========================  ====================================================
+
+Off by default, the mxtel/mxdash gating pattern: with no ``MXCTL_*``
+env set, :func:`maybe_start` is a pure no-op — no controller thread, no
+sockets, no journal records. ``MXCTL_ENABLE=1`` embeds a controller
+thread in this process (the launcher / rank-0 hosting pattern);
+``python -m mxnet_tpu.control`` runs the standalone daemon.
+"""
+from __future__ import annotations
+
+import os
+
+from . import supervisor
+from .actuators import (ActionError, Actuator, DrainRestart, EvictReplace,
+                        RestartReplica, build_actuators, register)
+from .config import ControlConfig, parse_targets
+from .controller import Controller, build_from_env
+from .probes import CoordinatorProbe, HttpProbe, ProbeError, TargetSample
+from .rules import (DEFAULT_RULES, Decision, Rule, RuleEngine,
+                    RuleSyntaxError, parse_rules)
+from .supervisor import EVICTED_EXIT_CODE, Supervisor
+
+__all__ = [
+    "Controller", "ControlConfig", "Rule", "RuleEngine", "Decision",
+    "parse_rules", "parse_targets", "RuleSyntaxError", "DEFAULT_RULES",
+    "HttpProbe", "CoordinatorProbe", "TargetSample", "ProbeError",
+    "Actuator", "ActionError", "RestartReplica", "DrainRestart",
+    "EvictReplace", "build_actuators", "register", "Supervisor",
+    "EVICTED_EXIT_CODE", "supervisor", "build_from_env",
+    "enabled", "maybe_start", "stop",
+]
+
+_controller = None
+
+
+def enabled():
+    """True when ``MXCTL_ENABLE`` requests the in-process controller."""
+    return os.environ.get("MXCTL_ENABLE", "").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
+def maybe_start():
+    """Start the in-process controller thread iff ``MXCTL_ENABLE`` is
+    set (called from package init). With it unset this is a pure no-op:
+    no thread, no sockets, no journal records — the off-by-default
+    contract pinned by test_mxctl.py."""
+    global _controller
+    if not enabled() or _controller is not None:
+        return None
+    _controller = build_from_env()
+    _controller.start()
+    return _controller
+
+
+def stop():
+    """Stop + discard the in-process controller (tests)."""
+    global _controller
+    if _controller is not None:
+        _controller.stop()
+        _controller = None

@@ -1,0 +1,125 @@
+"""mxctl controller daemon: ``python -m mxnet_tpu.control``.
+
+Configuration comes from ``MXCTL_*`` env vars (docs/env_vars.md);
+``--replica NAME=CMD`` additionally puts serving replicas under this
+controller's OWN supervision (spawned here, restartable by the
+``restart_replica``/``drain_restart`` actuators). A supervised replica
+whose name appears in ``MXCTL_TARGETS`` is spawned with its mxdash
+endpoint pre-wired: ``MXNET_TELEMETRY=1`` plus ``MXNET_TELEMETRY_HTTP``
+derived from the target URL, and a per-replica journal from
+``MXCTL_REPLICA_JOURNAL`` (``{name}`` templating, the tools/launch.py
+journal discipline).
+
+SIGTERM/SIGINT stop the loop, gracefully drain supervised replicas
+(SIGTERM -> drain contract, SIGKILL after the grace window), flush the
+journal, and exit 0 — the chaos harness's teardown path
+(tools/chaos.py --controller).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import sys
+import threading
+import urllib.parse
+
+from .. import telemetry as _tel
+from .config import ControlConfig
+from .controller import Controller
+from .supervisor import Supervisor
+
+
+def _replica_env(name, cfg):
+    env = dict(os.environ)
+    url = cfg.targets.get(name)
+    if url:
+        u = urllib.parse.urlparse(url)
+        if u.port:
+            env["MXNET_TELEMETRY"] = "1"
+            env["MXNET_TELEMETRY_HTTP"] = "%s:%d" % (u.hostname or
+                                                     "127.0.0.1", u.port)
+            # a supervised replica starts NOT-ready: /readyz must not
+            # answer 200 during package import, or the controller
+            # latches "this incarnation was ready" before warmup and
+            # the warmup's not-ready phase reads as a real outage
+            env["MXNET_TELEMETRY_READY"] = "0"
+    if cfg.replica_journal:
+        env["MXNET_TELEMETRY_JOURNAL"] = cfg.replica_journal.format(
+            name=name)
+    else:
+        # never let a replica inherit the CONTROLLER's journal: two
+        # processes appending to one JSONL interleave mid-line and
+        # write two mark="exit" snapshots, doubling every folded
+        # counter (the per-process dedup flag cannot reach across
+        # processes)
+        env.pop("MXNET_TELEMETRY_JOURNAL", None)
+    env["MXCTL_REPLICA_NAME"] = name
+    return env
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.control", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="NAME=CMD",
+                    help="spawn + supervise a serving replica (repeatable); "
+                         "CMD is shell-split")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="probe cadence override (MXCTL_INTERVAL)")
+    ap.add_argument("--once", type=int, default=None, metavar="N",
+                    help="run N cycles then exit (tests/smoke)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="journal decisions, execute nothing "
+                         "(MXCTL_DRY_RUN)")
+    args = ap.parse_args(argv)
+
+    cfg = ControlConfig.from_env()
+    if args.interval is not None:
+        cfg.interval = max(0.05, args.interval)
+    if args.dry_run:
+        cfg.dry_run = True
+
+    sup = None
+    if args.replica:
+        sup = Supervisor()
+        for spec in args.replica:
+            name, sep, cmd = spec.partition("=")
+            if not sep or not name.strip() or not cmd.strip():
+                ap.error("--replica %r is not NAME=CMD" % spec)
+            name = name.strip()
+            log = (cfg.replica_log.format(name=name)
+                   if cfg.replica_log else None)
+            sup.spawn(name, shlex.split(cmd), env=_replica_env(name, cfg),
+                      log_path=log)
+
+    ctl = Controller(cfg, supervisor=sup)
+    stop = threading.Event()
+
+    def _sig(_signo, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    print("mxctl: %d target(s), %d rule(s), interval %.2fs%s"
+          % (len(cfg.targets) + (1 if (cfg.coord or cfg.journals_glob)
+                                 else 0),
+             len(cfg.rules), cfg.interval,
+             " [DRY RUN]" if cfg.dry_run else ""), flush=True)
+    for r in cfg.rules:
+        print("mxctl: rule %s" % r.describe(), flush=True)
+    try:
+        ctl.run(stop=stop, max_cycles=args.once)
+    finally:
+        if sup is not None:
+            sup.stop_all(signal.SIGTERM, wait=cfg.drain_grace)
+        ctl._write_state()
+        if _tel.ENABLED:
+            _tel.flush(mark="exit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""The pluggable actuator layer: what mxctl can DO.
+
+Every actuator is a named, idempotent-ish operation on one target,
+executed by the controller under a per-action
+:class:`~..resilience.retry.RetryPolicy` and journaled as an
+``mxctl.action`` event whatever the outcome. The catalog
+(docs/how_to/control_plane.md):
+
+``restart_replica``
+    Replace a dead (or wedged) supervised serving replica: SIGKILL any
+    leftover incarnation, respawn the recorded command (the
+    tools/launch.py respawn machinery via control/supervisor.py). The
+    liveness action — the SIGKILL chaos leg's recovery path.
+
+``drain_restart``
+    Graceful replacement for a replica that is alive but degraded (cold
+    jit cache, leaking latency): SIGTERM first — the serve-replica
+    contract is SIGTERM -> ``Engine.drain()`` -> finish in-flight ->
+    exit 0 — escalating to SIGKILL after ``drain_grace`` seconds, then
+    respawn.
+
+``evict_replace``
+    Training straggler remediation: admin-evict the rank through the
+    elastic coordinator (``ElasticClient.evict`` — the same ``evict``
+    op the chaos harness uses), dropping its in-flight contributions so
+    the group completes degraded. The *replace* half rides the worker's
+    supervisor: with ``MXNET_ELASTIC_EXIT_ON_EVICT=1`` the evicted
+    worker exits (code 43) and ``tools/launch.py --max-restarts``
+    respawns a fresh incarnation that rejoins.
+
+Custom actuators register by name via :func:`register` before the
+controller is built (plugins configure rules that name them).
+"""
+from __future__ import annotations
+
+import signal
+
+__all__ = ["Actuator", "ActionError", "RestartReplica", "DrainRestart",
+           "EvictReplace", "build_actuators", "register"]
+
+
+class ActionError(RuntimeError):
+    """An actuator attempt failed (retried under the action policy)."""
+
+
+class Actuator:
+    """Base: subclasses set ``name`` and implement :meth:`execute`."""
+
+    name = None
+
+    def execute(self, decision, ctx):
+        """Perform the action for ``decision`` (rules.Decision) using
+        ``ctx`` (the controller: ``.supervisor``, ``.cfg``). Returns a
+        plain-data detail dict for the journal; raises ActionError."""
+        raise NotImplementedError
+
+    def _replica(self, decision, ctx):
+        sup = ctx.supervisor
+        if sup is None or sup.get(decision.target) is None:
+            raise ActionError(
+                "target %r is not supervised by this controller — "
+                "%s needs process ownership" % (decision.target, self.name))
+        return sup
+
+
+class RestartReplica(Actuator):
+    name = "restart_replica"
+
+    def execute(self, decision, ctx):
+        sup = self._replica(decision, ctx)
+        old_pid = sup.pid(decision.target)
+        if sup.alive(decision.target):
+            # the rule said dead-or-wedged; a live process here is hung
+            # past its probes — replace, don't negotiate
+            sup.send_signal(decision.target, signal.SIGKILL)
+            sup.get(decision.target).proc.wait()
+        pid = sup.spawn(decision.target,
+                        sup.get(decision.target).cmd,
+                        env=sup.get(decision.target).env)
+        return {"old_pid": old_pid, "pid": pid,
+                "spawns": sup.get(decision.target).spawns}
+
+
+class DrainRestart(Actuator):
+    name = "drain_restart"
+
+    def execute(self, decision, ctx):
+        sup = self._replica(decision, ctx)
+        rep = sup.get(decision.target)
+        old_pid = rep.pid()
+        drained = False
+        if rep.alive():
+            sup.send_signal(decision.target, signal.SIGTERM)
+            try:
+                rep.proc.wait(timeout=ctx.cfg.drain_grace)
+                drained = True
+            except Exception:  # noqa: BLE001 - drain grace expired
+                sup.send_signal(decision.target, signal.SIGKILL)
+                rep.proc.wait()
+        pid = sup.spawn(decision.target, rep.cmd, env=rep.env)
+        return {"old_pid": old_pid, "pid": pid, "drained": drained,
+                "spawns": rep.spawns}
+
+
+class EvictReplace(Actuator):
+    name = "evict_replace"
+
+    def __init__(self):
+        self._client = None
+
+    def execute(self, decision, ctx):
+        coord = ctx.cfg.coord
+        if not coord:
+            raise ActionError("evict_replace needs MXCTL_COORD")
+        if not decision.target.startswith("rank"):
+            raise ActionError("evict_replace target %r is not a rank"
+                              % decision.target)
+        try:
+            rank = int(decision.target[len("rank"):])
+        except ValueError:
+            raise ActionError("evict_replace target %r is not a rank"
+                              % decision.target)
+        if self._client is None:
+            from ..elastic.client import ElasticClient
+
+            self._client = ElasticClient(coord, rank=-1)
+        client = self._client
+        try:
+            resp = client.evict(rank)
+        except Exception as e:  # noqa: BLE001 - coordinator RPC failed
+            raise ActionError("coordinator evict(%d) failed: %s" % (rank, e))
+        return {"rank": rank, "epoch": resp.get("epoch"),
+                "live": resp.get("live")}
+
+
+_REGISTRY = {}
+
+
+def register(actuator):
+    """Add a (custom) actuator instance to the catalog by its name."""
+    if not actuator.name:
+        raise ValueError("actuator has no name")
+    _REGISTRY[actuator.name] = actuator
+    return actuator
+
+
+for _cls in (RestartReplica, DrainRestart, EvictReplace):
+    register(_cls())
+
+
+def build_actuators(extra=None):
+    """The catalog: {name: Actuator}. ``extra`` overrides/extends (the
+    unit tests inject recording fakes)."""
+    out = dict(_REGISTRY)
+    if extra:
+        out.update(extra)
+    return out

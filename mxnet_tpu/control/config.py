@@ -1,0 +1,124 @@
+"""``MXCTL_*`` environment configuration for the mxctl controller.
+
+The mxtel/mxdash gating pattern: everything is off by default — with no
+``MXCTL_*`` variable set, :func:`ControlConfig.from_env` yields a
+config with no targets and :func:`mxnet_tpu.control.maybe_start` is a
+pure no-op (no thread, no sockets, no journal records). The env table
+lives in docs/env_vars.md; the grammar in
+docs/how_to/control_plane.md.
+"""
+from __future__ import annotations
+
+import os
+
+from .rules import DEFAULT_RULES, parse_rules
+
+__all__ = ["ControlConfig", "parse_targets"]
+
+
+def _env(name, default=""):
+    return os.environ.get(name, default).strip()
+
+
+def _env_float(name, default):
+    raw = _env(name)
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    raw = _env(name)
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_on(name):
+    return _env(name).lower() not in ("", "0", "false", "off", "no")
+
+
+def parse_targets(spec):
+    """``MXCTL_TARGETS`` -> ordered {name: base_url}. Format:
+    ``name=http://host:port`` pairs, comma-separated."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, url = part.partition("=")
+        name, url = name.strip(), url.strip().rstrip("/")
+        if not sep or not name or not url:
+            raise ValueError(
+                "MXCTL_TARGETS entry %r is not name=http://host:port" % part)
+        out[name] = url
+    return out
+
+
+class ControlConfig:
+    """Plain-data controller configuration (env-derived or test-built)."""
+
+    def __init__(self, targets=None, rules=None, interval=1.0,
+                 dry_run=False, max_actions=8, actions_window=60.0,
+                 action_retries=2, coord=None, journals_glob=None,
+                 straggler_min_wait=2.0, state_path=None,
+                 replica_journal=None, replica_log=None, drain_grace=15.0,
+                 startup_grace=10.0):
+        self.targets = dict(targets or {})      # name -> mxdash base url
+        self.rules = list(rules if rules is not None
+                          else parse_rules(DEFAULT_RULES))
+        self.interval = float(interval)
+        self.dry_run = bool(dry_run)
+        self.max_actions = int(max_actions)     # per actions_window
+        self.actions_window = float(actions_window)
+        self.action_retries = max(1, int(action_retries))
+        self.coord = coord                      # elastic coordinator host:port
+        self.journals_glob = journals_glob      # per-rank journals (straggler)
+        self.straggler_min_wait = float(straggler_min_wait)
+        self.state_path = state_path            # JSON state file for harnesses
+        self.replica_journal = replica_journal  # {name}-templated journal path
+        self.replica_log = replica_log          # {name}-templated log path
+        self.drain_grace = float(drain_grace)   # SIGTERM->SIGKILL escalation
+        # a freshly (re)spawned replica gets this long to bind its
+        # mxdash socket before alive=0 counts against it — without it
+        # the liveness rule re-kills every cold start mid-import
+        self.startup_grace = float(startup_grace)
+
+    @classmethod
+    def from_env(cls):
+        """Build from ``MXCTL_*`` (docs/env_vars.md). Raises on a
+        malformed MXCTL_RULES/MXCTL_TARGETS value — a controller that
+        silently drops a typo'd rule is worse than one that won't
+        start."""
+        rules_spec = _env("MXCTL_RULES") or DEFAULT_RULES
+        return cls(
+            targets=parse_targets(_env("MXCTL_TARGETS")),
+            rules=parse_rules(rules_spec),
+            interval=max(0.05, _env_float("MXCTL_INTERVAL", 1.0)),
+            dry_run=_env_on("MXCTL_DRY_RUN"),
+            max_actions=_env_int("MXCTL_MAX_ACTIONS", 8),
+            actions_window=_env_float("MXCTL_ACTIONS_WINDOW", 60.0),
+            action_retries=_env_int("MXCTL_ACTION_RETRIES", 2),
+            coord=_env("MXCTL_COORD") or None,
+            journals_glob=_env("MXCTL_JOURNALS") or None,
+            straggler_min_wait=_env_float("MXCTL_STRAGGLER_MIN_WAIT", 2.0),
+            state_path=_env("MXCTL_STATE") or None,
+            replica_journal=_env("MXCTL_REPLICA_JOURNAL") or None,
+            replica_log=_env("MXCTL_REPLICA_LOG") or None,
+            drain_grace=_env_float("MXCTL_DRAIN_GRACE", 15.0),
+            startup_grace=_env_float("MXCTL_STARTUP_GRACE", 10.0),
+        )
+
+    def describe(self):
+        return {
+            "targets": dict(self.targets),
+            "rules": [r.describe() for r in self.rules],
+            "interval": self.interval,
+            "dry_run": self.dry_run,
+            "max_actions": self.max_actions,
+            "actions_window": self.actions_window,
+            "coord": self.coord,
+            "journals_glob": self.journals_glob,
+        }

@@ -1,0 +1,286 @@
+"""The mxctl control loop: detect -> decide -> act -> journal.
+
+One :class:`Controller` owns a probe set (probes.py), a rule engine
+(rules.py), the actuator catalog (actuators.py) and optionally a
+replica supervisor (supervisor.py). Every cycle it scrapes all targets,
+evaluates every rule, and dispatches the decisions that fired —
+dry-run, rate-limit and per-action retry discipline applied here, so
+actuators stay single-purpose.
+
+Every probe/decision/action lands in mxtel:
+
+- counters/gauges/histograms under ``mxctl.*`` (the observability.md
+  catalog — ``mxctl.actions_total`` is the chaos harness's proof the
+  loop actually closed);
+- ``mxctl.rule`` / ``mxctl.action`` / ``mxctl.recovery`` journal events
+  sharing one minted trace id per firing, so
+  ``tools/telemetry_report.py`` renders "what the controller did and
+  why" as a timeline, and the trace links to the affected replica via
+  the target/url/pid fields.
+
+The controller never acts implicitly: with no ``MXCTL_*`` env set
+nothing here is constructed (config.py), and ``dry_run`` journals every
+decision while executing none — the safe-rollout mode.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .. import telemetry as _tel
+from ..resilience.retry import RetryPolicy
+from . import actuators as _actuators
+from . import probes as _probes
+from .config import ControlConfig
+from .rules import RuleEngine
+
+__all__ = ["Controller", "build_from_env"]
+
+
+class Controller:
+    """The closed loop. ``clock`` is injectable (monotonic seconds) so
+    unit tests script hysteresis windows deterministically."""
+
+    def __init__(self, cfg, probes=None, actuators=None, supervisor=None,
+                 clock=time.monotonic):
+        self.cfg = cfg
+        self.supervisor = supervisor
+        self.actuators = actuators if actuators is not None \
+            else _actuators.build_actuators()
+        self.engine = RuleEngine(cfg.rules)
+        self._clock = clock
+        self._action_times = []        # executed-action stamps (rate limit)
+        self._last_samples = {}
+        self._ready_incarnation = {}   # target -> spawns# that reached ready
+        self._spawn_seen = {}          # (target, spawns#) -> first-seen now
+        self._now = 0.0                # current cycle's clock reading
+        self._thread = None
+        self._stop = threading.Event()
+        self._breaches_seen = 0
+        if probes is not None:
+            self.probes = list(probes)
+        else:
+            self.probes = [_probes.HttpProbe(name, url)
+                           for name, url in cfg.targets.items()]
+            if cfg.coord or cfg.journals_glob:
+                self.probes.append(_probes.CoordinatorProbe(
+                    cfg.coord, journals_glob=cfg.journals_glob,
+                    min_wait=cfg.straggler_min_wait))
+
+    # -- one cycle -----------------------------------------------------------
+    def step(self, now=None):
+        """One detect->decide->act->journal cycle; returns the
+        decisions that fired (executed or not)."""
+        now = self._clock() if now is None else now
+        t0 = time.monotonic()
+        if self.supervisor is not None:
+            self.supervisor.tick()
+            self.supervisor.poll()
+        self._now = now
+        samples = []
+        for probe in self.probes:
+            try:
+                got = probe.sample(now)
+            except Exception as e:  # noqa: BLE001 - a probe must not kill the loop
+                if _tel.ENABLED:
+                    _tel.counter("mxctl.probe_errors_total").inc()
+                    _tel.event("mxctl.probe_error", error=str(e))
+                continue
+            samples.extend(got if isinstance(got, list) else [got])
+        self._last_samples = {s.target: s for s in samples}
+        decisions = []
+        for s in samples:
+            if self._in_startup_grace(s):
+                continue
+            decisions.extend(self.engine.evaluate(s.target, s.metrics, now,
+                                                  scope=s.scope))
+        for d in decisions:
+            self._dispatch(d, now)
+        self._note_recoveries(now)
+        if _tel.ENABLED:
+            _tel.counter("mxctl.probes_total").inc()
+            delta = self.engine.breaches - self._breaches_seen
+            if delta:
+                _tel.counter("mxctl.breaches_total").inc(delta)
+            _tel.gauge("mxctl.targets_alive").set(
+                sum(1 for s in samples if s.metrics.get("alive")))
+            _tel.gauge("mxctl.targets_ready").set(
+                sum(1 for s in samples if s.metrics.get("ready")))
+            _tel.histogram("mxctl.probe_secs").observe(
+                time.monotonic() - t0)
+        self._breaches_seen = self.engine.breaches
+        self._write_state(decisions)
+        return decisions
+
+    def _in_startup_grace(self, sample):
+        """A supervised replica's STARTING window: from (re)spawn until
+        the incarnation first reports ready, bounded by
+        ``startup_grace`` seconds. Inside it no rule is evaluated —
+        otherwise the liveness rule kills every cold import before its
+        mxdash socket binds, and the readiness rule kills every warmup
+        (a replica marks not-ready while it compiles). Once an
+        incarnation HAS been ready, a later not-ready is real (a drain,
+        a wedge) and is evaluated normally; past the grace bound a
+        never-ready replica is evaluated too, so a wedged startup still
+        gets replaced."""
+        if self.supervisor is None:
+            return False
+        rep = self.supervisor.get(sample.target)
+        if rep is None or rep.last_spawn_t is None:
+            return False
+        if sample.metrics.get("ready"):
+            self._ready_incarnation[sample.target] = rep.spawns
+            return False
+        if self._ready_incarnation.get(sample.target) == rep.spawns:
+            return False
+        # the grace window runs on the CONTROLLER's clock (first probe
+        # that saw this incarnation), not wall monotonic: the rest of
+        # the hysteresis machine uses the injectable clock, and mixing
+        # domains would make grace expiry unscriptable in tests
+        key = (sample.target, rep.spawns)
+        first_seen = self._spawn_seen.setdefault(key, self._now)
+        if len(self._spawn_seen) > 4 * len(self._last_samples) + 64:
+            self._spawn_seen = {key: first_seen}  # bound stale entries
+        return self._now - first_seen < self.cfg.startup_grace
+
+    # -- act -----------------------------------------------------------------
+    def _rate_limited(self, now):
+        window = self.cfg.actions_window
+        self._action_times = [t for t in self._action_times
+                              if now - t <= window]
+        return len(self._action_times) >= self.cfg.max_actions
+
+    def _dispatch(self, decision, now):
+        rule = decision.rule
+        trace = _tel.mint_trace() if _tel.ENABLED else None
+        decision.trace = trace
+        meta = self._last_samples.get(decision.target)
+        if _tel.ENABLED:
+            _tel.counter("mxctl.rules_fired_total").inc()
+            _tel.event("mxctl.rule", trace=trace, rule=rule.name,
+                       metric=rule.metric, value=decision.value,
+                       threshold=rule.threshold, op=rule.op,
+                       target=decision.target, action=rule.action,
+                       **(meta.meta if meta is not None else {}))
+        outcome, detail, error = None, {}, None
+        t0 = time.monotonic()
+        if self.cfg.dry_run:
+            outcome = "dry-run"
+            if _tel.ENABLED:
+                _tel.counter("mxctl.actions_dryrun_total").inc()
+            self.engine.note_action(decision, now, executed=False)
+        elif self._rate_limited(now):
+            outcome = "rate-limited"
+            if _tel.ENABLED:
+                _tel.counter("mxctl.actions_ratelimited_total").inc()
+            self.engine.note_action(decision, now, executed=False)
+        else:
+            act = self.actuators.get(rule.action)
+            if act is None:
+                outcome, error = "failed", ("unknown action %r"
+                                            % rule.action)
+            else:
+                policy = RetryPolicy(max_attempts=self.cfg.action_retries,
+                                     base_delay=0.2, max_delay=2.0)
+
+                def _run():
+                    return act.execute(decision, self)
+
+                _run.__name__ = "mxctl %s" % rule.action
+                try:
+                    detail = policy.call(_run) or {}
+                    outcome = "ok"
+                except Exception as e:  # noqa: BLE001 - journaled failure
+                    outcome, error = "failed", str(e)
+            if outcome == "ok":
+                self._action_times.append(now)
+                self.engine.note_action(decision, now, executed=True,
+                                        trace=trace)
+                if _tel.ENABLED:
+                    _tel.counter("mxctl.actions_total").inc()
+            else:
+                self.engine.note_action(decision, now, executed=False)
+                if _tel.ENABLED:
+                    _tel.counter("mxctl.actions_failed_total").inc()
+        if _tel.ENABLED:
+            fields = dict(detail)
+            if error is not None:
+                fields["error"] = error
+            _tel.event("mxctl.action", dur=time.monotonic() - t0,
+                       trace=trace, action=rule.action,
+                       target=decision.target, outcome=outcome, **fields)
+        return outcome
+
+    def _note_recoveries(self, now):
+        for rec in self.engine.drain_recoveries():
+            if _tel.ENABLED:
+                _tel.counter("mxctl.recoveries_total").inc()
+                _tel.histogram("mxctl.recovery_secs").observe(rec["dur"])
+                _tel.event("mxctl.recovery", dur=rec["dur"],
+                           trace=rec["trace"], rule=rec["rule"].name,
+                           target=rec["target"],
+                           action=rec["rule"].action)
+
+    # -- state file ----------------------------------------------------------
+    def _write_state(self, decisions=()):
+        path = self.cfg.state_path
+        if not path:
+            return
+        state = {
+            "t": time.time(),
+            "targets": {
+                s.target: {"scope": s.scope, "metrics": s.metrics,
+                           **{k: v for k, v in s.meta.items()
+                              if isinstance(v, (str, int, float))}}
+                for s in self._last_samples.values()
+            },
+            "replicas": (self.supervisor.state()
+                         if self.supervisor is not None else {}),
+            "last_decisions": [repr(d) for d in decisions],
+        }
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(state, f, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # harness convenience — never worth killing the loop
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self, stop=None, max_cycles=None):
+        """Foreground loop at ``cfg.interval`` cadence until ``stop``
+        (an Event) is set, or ``max_cycles`` elapse."""
+        stop = stop if stop is not None else self._stop
+        n = 0
+        while not stop.is_set():
+            self.step()
+            n += 1
+            if max_cycles is not None and n >= max_cycles:
+                break
+            stop.wait(self.cfg.interval)
+        return n
+
+    def start(self):
+        """Background-thread mode (the ``MXCTL_ENABLE=1`` in-process
+        embedding). Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self._thread
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, name="mxctl",
+                                        daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        self._thread = None
+
+
+def build_from_env(supervisor=None):
+    """Controller from ``MXCTL_*`` env (config.py)."""
+    return Controller(ControlConfig.from_env(), supervisor=supervisor)

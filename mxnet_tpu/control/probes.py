@@ -1,0 +1,235 @@
+"""Telemetry probes: turn read-only surfaces into per-target samples.
+
+Two probe families feed the rule engine (rules.py):
+
+- :class:`HttpProbe` scrapes one replica's mxdash surface
+  (telemetry/server.py): ``/healthz`` -> ``alive``, ``/readyz`` ->
+  ``ready`` (alive-but-draining reports 0), ``/servingz`` -> queue
+  depth / TTFT percentiles / tokens-per-s / draining, ``/statusz`` ->
+  jit-cache hit rate. A scrape failure IS the liveness signal: the
+  sample degrades to ``alive=0`` rather than vanishing, so the
+  liveness rule can fire on a SIGKILLed replica whose socket is gone.
+
+- :class:`CoordinatorProbe` reads the elastic coordinator's membership
+  view (``stats`` op through :class:`~..elastic.client.ElasticClient`,
+  the kv.coord retry discipline) and runs trace_merge straggler
+  attribution over the per-rank journals (``MXCTL_JOURNALS``), yielding
+  one ``rank<N>`` target per known rank with ``alive`` /
+  ``wait_share`` / ``straggler``. Attribution only ARMS once the
+  group's total barrier wait passes ``MXCTL_STRAGGLER_MIN_WAIT``
+  seconds — the least-wait vote always names someone, and a healthy
+  group's ambient jitter must never read as a straggler.
+
+Samples are plain dicts, so the unit tests script probe sequences
+without sockets (the ``FakeProbe`` pattern in test_mxctl.py).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os as _os
+import urllib.error
+import urllib.request
+
+__all__ = ["TargetSample", "HttpProbe", "CoordinatorProbe",
+           "serving_metrics", "ProbeError"]
+
+
+class ProbeError(Exception):
+    pass
+
+
+class TargetSample:
+    """One target's probe result: a metric mapping plus context the
+    journal events carry (scope, scrape error, endpoint)."""
+
+    __slots__ = ("target", "scope", "metrics", "meta")
+
+    def __init__(self, target, scope, metrics, meta=None):
+        self.target = target
+        self.scope = scope          # "serving" | "training"
+        self.metrics = dict(metrics)
+        self.meta = dict(meta or {})
+
+    def __repr__(self):
+        return "TargetSample(%s, %s)" % (self.target, self.metrics)
+
+
+def _fetch(url, timeout):
+    """(status_code, body) — transport failures return (None, err)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:       # non-2xx still answers
+        try:
+            body = e.read().decode("utf-8", "replace")
+        except Exception:
+            body = ""
+        return e.code, body
+    except Exception as e:  # noqa: BLE001 - any transport failure = down
+        return None, "%s: %s" % (type(e).__name__, e)
+
+
+def serving_metrics(servingz, statusz=None):
+    """Pure mapping from /servingz (+/statusz) JSON payloads to rule
+    metrics — the unit-testable half of HttpProbe. Aggregates across a
+    process's live engines (queue depths sum; latency percentiles take
+    the worst engine)."""
+    out = {}
+    engines = (servingz or {}).get("engines", [])
+    if engines:
+        stats = [e.get("stats", {}) for e in engines]
+        out["engines"] = float(len(engines))
+        out["queue_depth"] = float(sum(s.get("queue_depth", 0)
+                                       for s in stats))
+        out["active"] = float(sum(s.get("active", 0) for s in stats))
+        out["tokens_per_s"] = float(sum(s.get("tokens_per_s_window", 0.0)
+                                        or 0.0 for s in stats))
+        p99s = [s.get("ttft_p99_s") for s in stats
+                if s.get("ttft_p99_s") is not None]
+        if p99s:
+            out["ttft_p99"] = float(max(p99s))
+        out["draining"] = float(any(e.get("draining") for e in engines))
+    comp = (statusz or {}).get("compile", {})
+    hits = comp.get("compile.jit_cache_hits", 0)
+    misses = comp.get("compile.jit_cache_misses", 0)
+    if hits + misses:
+        out["cache_hit_rate"] = float(hits) / float(hits + misses)
+    return out
+
+
+class HttpProbe:
+    """Scrape one replica's mxdash endpoints into a TargetSample."""
+
+    def __init__(self, name, base_url, timeout=2.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout = float(timeout)
+
+    def sample(self, now=None):
+        code, body = _fetch(self.base_url + "/healthz", self.timeout)
+        if code != 200:
+            return TargetSample(self.name, "serving",
+                                {"alive": 0.0, "ready": 0.0},
+                                {"url": self.base_url, "error": body})
+        metrics = {"alive": 1.0}
+        rcode, _rbody = _fetch(self.base_url + "/readyz", self.timeout)
+        metrics["ready"] = 1.0 if rcode == 200 else 0.0
+        meta = {"url": self.base_url}
+        for path, key in (("/servingz", "servingz"), ("/statusz", "statusz")):
+            pcode, pbody = _fetch(self.base_url + path, self.timeout)
+            if pcode == 200:
+                try:
+                    meta[key] = json.loads(pbody)
+                except ValueError:
+                    pass
+        metrics.update(serving_metrics(meta.pop("servingz", None),
+                                       meta.pop("statusz", None)))
+        return TargetSample(self.name, "serving", metrics, meta)
+
+
+class CoordinatorProbe:
+    """Membership + straggler attribution over the training group."""
+
+    def __init__(self, coord, journals_glob=None, min_wait=2.0,
+                 timeout=5.0):
+        self.coord = coord
+        self.journals_glob = journals_glob
+        self.min_wait = float(min_wait)
+        self.timeout = float(timeout)
+        self._client = None
+        self._merge_cache = None   # (total_bytes, result tuple)
+
+    def _coord_client(self):
+        # lazy: the controller config may name a coordinator that only
+        # exists once the training job starts
+        if self._client is None:
+            from ..elastic.client import ElasticClient
+
+            # rank -1: an observer, never a member — the coordinator
+            # answers view/stats for any rank
+            self._client = ElasticClient(self.coord, rank=-1,
+                                         timeout=self.timeout)
+        return self._client
+
+    def _attribution(self):
+        """(straggler_rank|None, {rank: wait_s}, total_wait_s) from the
+        per-rank journals, or (None, {}, 0.0) when unavailable."""
+        if not self.journals_glob:
+            return None, {}, 0.0
+        paths = sorted(_glob.glob(self.journals_glob))
+        if len(paths) < 2:
+            return None, {}, 0.0
+        # merge() re-parses every journal from scratch, and journals
+        # grow for the whole run — re-merging each probe cycle would be
+        # O(total-bytes) per cycle, O(n^2) cumulative. Only re-merge
+        # once the corpus grew materially (>=5% or >=1 MB); attribution
+        # over a slightly stale window is exactly as good.
+        try:
+            total = sum(_os.path.getsize(p) for p in paths)
+        except OSError:
+            total = -1
+        if self._merge_cache is not None and total >= 0:
+            seen, cached = self._merge_cache
+            if total < seen * 1.05 and total - seen < (1 << 20):
+                return cached
+        from ..telemetry import merge as _merge
+
+        try:
+            merged = _merge.merge(paths)
+            rep = _merge.straggler_report(merged)
+        except Exception as e:  # noqa: BLE001 - mid-run journals are torn
+            raise ProbeError("straggler attribution failed: %s" % e)
+        waits = {}
+        for row in rep.get("per_epoch", []):
+            for r, w in row.get("waits", {}).items():
+                waits[r] = waits.get(r, 0.0) + float(w)
+        out = (rep.get("straggler"), waits, sum(waits.values()))
+        if total >= 0:
+            self._merge_cache = (total, out)
+        return out
+
+    def sample(self, now=None):
+        """[TargetSample] — one per rank the coordinator or the
+        journals know about. Raises ProbeError when the coordinator is
+        unreachable AND no journals exist (nothing to report on)."""
+        live, world = None, None
+        try:
+            client = self._coord_client()
+            resp = client.stats()
+            live = set(resp.get("live", []))
+            world = resp.get("world")
+        except Exception as e:  # noqa: BLE001 - coordinator not up (yet)
+            coord_err = "%s: %s" % (type(e).__name__, e)
+        else:
+            coord_err = None
+        straggler, waits, total_wait = self._attribution()
+        armed = total_wait >= self.min_wait
+        ranks = set(waits)
+        if live is not None:
+            ranks |= live
+        if straggler is not None:
+            # a truncated-journal straggler may have no wait rows and
+            # already be out of the live set — it still needs a target
+            # for the rules to act on
+            ranks.add(straggler)
+        if coord_err is not None and not ranks:
+            raise ProbeError("coordinator %s unreachable (%s) and no "
+                             "journals matched %r"
+                             % (self.coord, coord_err, self.journals_glob))
+        out = []
+        for rank in sorted(ranks):
+            metrics = {
+                "alive": 1.0 if (live is None or rank in live) else 0.0,
+                "wait_s": waits.get(rank, 0.0),
+                "wait_share": (waits.get(rank, 0.0) / total_wait
+                               if total_wait > 0 else 0.0),
+                "straggler": 1.0 if (armed and rank == straggler) else 0.0,
+            }
+            meta = {"coord": self.coord, "world": world,
+                    "total_wait_s": total_wait}
+            if coord_err:
+                meta["coord_error"] = coord_err
+            out.append(TargetSample("rank%d" % rank, "training",
+                                    metrics, meta))
+        return out

@@ -1,0 +1,248 @@
+"""Declarative SLO rules + the hysteresis state machine (mxctl).
+
+A rule is one line of the ``MXCTL_RULES`` grammar
+(docs/how_to/control_plane.md)::
+
+    <metric><op><threshold>:for=<K>:action=<name>
+        [:cooldown=<secs>][:scope=<serving|training>][:max=<N>]
+
+e.g. ``alive<1:for=3:action=restart_replica:cooldown=15``. Rules are
+evaluated per probe cycle against every target's sample (probes.py);
+semicolons separate rules.
+
+The flap guard is structural, not tuned: a rule FIRES only after
+``for=K`` *consecutive* breaching probes (one healthy probe resets the
+streak), every firing opens a ``cooldown`` window during which the
+breach streak does not even accumulate, and after the cooldown the
+breach must re-sustain the full ``for=K`` streak before the rule can
+fire again. ``max=N`` bounds a rule's lifetime firings per target
+(safety valve for destructive actions like evict-and-replace). The
+acceptance shape: a noisy-but-healthy replica — metrics that breach for
+fewer than K consecutive probes — triggers exactly zero actions
+(tools/chaos.py --controller flap leg).
+
+Everything here is pure state-machine code over (sample, now) pairs: no
+sockets, no clocks of its own — the unit tests drive it with scripted
+fake telemetry.
+"""
+from __future__ import annotations
+
+__all__ = ["Rule", "RuleEngine", "Decision", "parse_rules",
+           "RuleSyntaxError", "DEFAULT_RULES"]
+
+#: the out-of-the-box ruleset: liveness only. SLO thresholds (TTFT,
+#: queue depth, cache hit rate, straggler share) are deployment policy
+#: and must be written down by the operator, not defaulted.
+DEFAULT_RULES = "alive<1:for=3:action=restart_replica:cooldown=15"
+
+_OPS = {
+    ">": lambda v, t: v > t,
+    "<": lambda v, t: v < t,
+    ">=": lambda v, t: v >= t,
+    "<=": lambda v, t: v <= t,
+    "==": lambda v, t: v == t,
+    "!=": lambda v, t: v != t,
+}
+
+
+class RuleSyntaxError(ValueError):
+    """A rule that does not parse must fail the controller at startup —
+    a typo'd rule silently never firing is the worst failure mode a
+    control plane can have."""
+
+
+class Rule:
+    """One parsed SLO rule."""
+
+    __slots__ = ("name", "metric", "op", "threshold", "for_count",
+                 "action", "cooldown", "scope", "max_fires")
+
+    def __init__(self, metric, op, threshold, for_count, action,
+                 cooldown=30.0, scope=None, max_fires=None):
+        self.metric = metric
+        self.op = op
+        self.threshold = float(threshold)
+        self.for_count = max(1, int(for_count))
+        self.action = action
+        self.cooldown = float(cooldown)
+        self.scope = scope          # None = any target
+        self.max_fires = max_fires  # per target, lifetime; None = unbounded
+        self.name = "%s%s%g" % (metric, op, self.threshold)
+
+    def breached(self, value):
+        return _OPS[self.op](value, self.threshold)
+
+    def describe(self):
+        return ("%s:for=%d:action=%s:cooldown=%g%s%s"
+                % (self.name, self.for_count, self.action, self.cooldown,
+                   ":scope=%s" % self.scope if self.scope else "",
+                   ":max=%d" % self.max_fires if self.max_fires else ""))
+
+
+def parse_rules(spec):
+    """``MXCTL_RULES`` text -> [Rule]. Raises RuleSyntaxError."""
+    rules = []
+    for raw in (spec or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = [p.strip() for p in raw.split(":")]
+        head = parts[0]
+        op = None
+        for cand in (">=", "<=", "==", "!=", ">", "<"):  # longest first
+            if cand in head:
+                op = cand
+                break
+        if op is None:
+            raise RuleSyntaxError(
+                "rule %r: no comparator (use one of %s)"
+                % (raw, " ".join(sorted(_OPS))))
+        metric, _, thr = head.partition(op)
+        metric = metric.strip()
+        try:
+            threshold = float(thr)
+        except ValueError:
+            raise RuleSyntaxError("rule %r: threshold %r is not a number"
+                                  % (raw, thr))
+        if not metric:
+            raise RuleSyntaxError("rule %r: empty metric name" % raw)
+        opts = {}
+        for p in parts[1:]:
+            k, sep, v = p.partition("=")
+            if not sep:
+                raise RuleSyntaxError("rule %r: option %r is not key=value"
+                                      % (raw, p))
+            opts[k.strip()] = v.strip()
+        unknown = set(opts) - {"for", "action", "cooldown", "scope", "max"}
+        if unknown:
+            raise RuleSyntaxError("rule %r: unknown option(s) %s"
+                                  % (raw, sorted(unknown)))
+        if "action" not in opts:
+            raise RuleSyntaxError("rule %r: action= is required" % raw)
+        scope = opts.get("scope")
+        if scope is not None and scope not in ("serving", "training"):
+            raise RuleSyntaxError("rule %r: scope must be serving|training"
+                                  % raw)
+        try:
+            rules.append(Rule(
+                metric, op, threshold,
+                for_count=int(opts.get("for", "1")),
+                action=opts["action"],
+                cooldown=float(opts.get("cooldown", "30")),
+                scope=scope,
+                max_fires=int(opts["max"]) if "max" in opts else None))
+        except ValueError as e:
+            raise RuleSyntaxError("rule %r: %s" % (raw, e))
+    return rules
+
+
+class Decision:
+    """One firing: rule R breached for K consecutive probes on target T
+    — the detect->decide hand-off the controller turns into an action."""
+
+    __slots__ = ("rule", "target", "value", "trace")
+
+    def __init__(self, rule, target, value, trace=None):
+        self.rule = rule
+        self.target = target
+        self.value = value
+        self.trace = trace
+
+    def __repr__(self):
+        return ("Decision(%s on %s, value=%g -> %s)"
+                % (self.rule.name, self.target, self.value,
+                   self.rule.action))
+
+
+class _State:
+    __slots__ = ("streak", "cooldown_until", "fires", "awaiting_recovery",
+                 "action_t", "trace")
+
+    def __init__(self):
+        self.streak = 0
+        self.cooldown_until = 0.0
+        self.fires = 0
+        self.awaiting_recovery = False
+        self.action_t = None
+        self.trace = None
+
+
+class RuleEngine:
+    """Evaluates every rule against every target's sample and owns the
+    per-(rule, target) hysteresis state."""
+
+    def __init__(self, rules):
+        self.rules = list(rules)
+        self._state = {}
+        #: monotonically-increasing evaluation tallies (the controller
+        #: mirrors them into mxctl.* counters)
+        self.breaches = 0
+        self.recoveries = []   # drained by the controller each cycle
+
+    def _st(self, rule, target):
+        key = (rule.name, rule.action, target)
+        st = self._state.get(key)
+        if st is None:
+            st = self._state[key] = _State()
+        return st
+
+    def evaluate(self, target, sample, now, scope=None):
+        """One probe cycle for one target. Returns the Decisions that
+        fired. ``sample`` is a {metric: value} mapping; a rule whose
+        metric is absent holds its state (a failed scrape must neither
+        fire nor clear anything — liveness rules key on ``alive``,
+        which the probe always synthesizes)."""
+        decisions = []
+        for rule in self.rules:
+            if rule.scope is not None and scope is not None \
+                    and rule.scope != scope:
+                continue
+            value = sample.get(rule.metric)
+            if value is None:
+                continue
+            st = self._st(rule, target)
+            breach = rule.breached(float(value))
+            if breach:
+                self.breaches += 1
+            if st.awaiting_recovery and not breach:
+                # first healthy probe after an executed action: the
+                # closed-loop proof point (recovery-time measurement)
+                self.recoveries.append({
+                    "rule": rule, "target": target,
+                    "dur": now - st.action_t, "trace": st.trace,
+                })
+                st.awaiting_recovery = False
+            if now < st.cooldown_until:
+                # cooldown holds the streak at zero: after it lapses
+                # the breach must re-sustain the full for=K window
+                st.streak = 0
+                continue
+            if not breach:
+                st.streak = 0
+                continue
+            st.streak += 1
+            if st.streak < rule.for_count:
+                continue
+            st.streak = 0
+            st.cooldown_until = now + rule.cooldown
+            if rule.max_fires is not None and st.fires >= rule.max_fires:
+                continue
+            decisions.append(Decision(rule, target, float(value)))
+        return decisions
+
+    def note_action(self, decision, now, executed, trace=None):
+        """Record that a decision's action ran (or was dry-run /
+        rate-limited / failed: ``executed=False`` — no recovery
+        tracking, and no ``max=N`` budget consumed, for an action that
+        never happened: a transient actuator failure or a dry-run must
+        not permanently disable a capped rule)."""
+        st = self._st(decision.rule, decision.target)
+        if executed:
+            st.fires += 1
+            st.awaiting_recovery = True
+            st.action_t = now
+            st.trace = trace
+
+    def drain_recoveries(self):
+        out, self.recoveries = self.recoveries, []
+        return out

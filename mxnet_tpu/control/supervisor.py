@@ -1,0 +1,252 @@
+"""Process supervision shared by tools/launch.py and the mxctl
+controller (docs/how_to/control_plane.md).
+
+One replica = one named child process the owner may kill, respawn (with
+an optional hold — the launch.py ``--restart-delay`` semantics: holding
+a respawn past the coordinator's evict window makes rejoin accounting
+deterministic), and poll for exits. :meth:`Supervisor.run_to_completion`
+is the batch-job shape (tools/launch.py: every worker runs to exit,
+failures respawn against a restart budget); the mxctl controller drives
+:meth:`poll`/:meth:`tick`/:meth:`respawn` directly from its probe loop
+instead (replicas are long-lived — there is no "completion").
+
+Deliberately stdlib-only and import-free of the framework: the launcher
+loads this file by path (the trace_merge pattern) so supervising N
+workers never pays the jax import.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+__all__ = ["Replica", "Supervisor", "EVICTED_EXIT_CODE"]
+
+#: exit code a worker uses for "evicted from the elastic group — replace
+#: me" (MXNET_ELASTIC_EXIT_ON_EVICT, kvstore.py). Supervisors treat it
+#: like any nonzero exit: respawn against the restart budget.
+EVICTED_EXIT_CODE = 43
+
+
+class Replica:
+    """One supervised child process and its respawn bookkeeping."""
+
+    __slots__ = ("name", "cmd", "env", "proc", "spawns", "last_spawn_t",
+                 "pending_until", "last_rc", "done", "log_path")
+
+    def __init__(self, name, cmd, env=None, log_path=None):
+        self.name = name
+        self.cmd = list(cmd)
+        self.env = dict(env) if env is not None else None
+        self.log_path = log_path
+        self.proc = None
+        self.spawns = 0
+        self.last_spawn_t = None
+        self.pending_until = None    # monotonic deadline of a held respawn
+        self.last_rc = None
+        self.done = False            # exited and will not be respawned
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+
+class Supervisor:
+    """Named-child-process supervisor (spawn / poll / respawn / stop)."""
+
+    def __init__(self, poll_interval=0.2):
+        self.poll_interval = float(poll_interval)
+        self._replicas = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def spawn(self, name, cmd, env=None, log_path=None, **popen_kw):
+        """Start (or restart) the named replica now. Returns its pid.
+
+        ``log_path`` (sticky across respawns) redirects the child's
+        stdout+stderr to a file, append mode so incarnations share one
+        log. Supervised children must never inherit a pipe nobody
+        drains: a replica that fills a 64 KB pipe buffer blocks on its
+        next write and turns into exactly the alive-but-wedged state
+        the controller exists to kill."""
+        rep = self._replicas.get(name)
+        if rep is None:
+            rep = Replica(name, cmd, env=env, log_path=log_path)
+            self._replicas[name] = rep
+        else:
+            rep.cmd = list(cmd)
+            if env is not None:
+                rep.env = dict(env)
+            if log_path is not None:
+                rep.log_path = log_path
+        log_f = None
+        if rep.log_path and "stdout" not in popen_kw:
+            log_f = open(rep.log_path, "ab")
+            popen_kw["stdout"] = log_f
+            popen_kw["stderr"] = subprocess.STDOUT
+        try:
+            rep.proc = subprocess.Popen(rep.cmd, env=rep.env, **popen_kw)
+        finally:
+            if log_f is not None:
+                log_f.close()  # the child holds its own dup
+        rep.spawns += 1
+        rep.last_spawn_t = time.monotonic()
+        rep.pending_until = None
+        rep.done = False
+        return rep.proc.pid
+
+    def respawn(self, name, delay=0.0):
+        """Re-run a replica's recorded command, after ``delay`` seconds
+        (deferred, non-blocking: :meth:`tick` performs due respawns —
+        the launch.py ``--restart-delay`` discipline)."""
+        rep = self._replicas[name]
+        if delay > 0:
+            rep.pending_until = time.monotonic() + float(delay)
+            rep.done = False
+            return None
+        return self.spawn(name, rep.cmd, env=rep.env)
+
+    def tick(self, now=None):
+        """Spawn every respawn whose hold expired; returns their names."""
+        now = time.monotonic() if now is None else now
+        due = [r.name for r in self._replicas.values()
+               if r.pending_until is not None and now >= r.pending_until]
+        for name in due:
+            rep = self._replicas[name]
+            rep.pending_until = None
+            self.spawn(name, rep.cmd, env=rep.env)
+        return due
+
+    def poll(self):
+        """Reap exits since the last poll: {name: returncode}."""
+        out = {}
+        for rep in self._replicas.values():
+            if rep.proc is None or rep.done or rep.pending_until is not None:
+                continue
+            rc = rep.proc.poll()
+            if rc is None:
+                continue
+            rep.last_rc = rc
+            rep.done = True
+            out[rep.name] = rc
+        return out
+
+    def send_signal(self, name, sig):
+        """Deliver ``sig`` to a live replica; False when it is not
+        running (already exited, or held for respawn)."""
+        rep = self._replicas.get(name)
+        if rep is None or not rep.alive():
+            return False
+        try:
+            rep.proc.send_signal(sig)
+            return True
+        except OSError:
+            return False
+
+    def stop_all(self, sig=signal.SIGTERM, wait=5.0, kill_after=True):
+        """Graceful stop: signal every live replica, wait up to ``wait``
+        seconds for exits (``None`` = wait forever — the launcher's
+        Ctrl-C contract: a worker mid-checkpoint-flush must never be
+        SIGKILLed into a torn write), then SIGKILL the rest. Cancels
+        held respawns."""
+        for rep in self._replicas.values():
+            rep.pending_until = None
+            if rep.alive():
+                try:
+                    rep.proc.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = (time.monotonic() + float(wait)
+                    if wait is not None else None)
+        for rep in self._replicas.values():
+            if rep.proc is None:
+                continue
+            try:
+                if deadline is None:
+                    rep.proc.wait()
+                else:
+                    rep.proc.wait(
+                        timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                if kill_after:
+                    try:
+                        rep.proc.kill()
+                    except OSError:
+                        pass
+                    rep.proc.wait()
+            rep.last_rc = rep.proc.returncode
+            rep.done = True
+
+    # -- introspection -------------------------------------------------------
+    def names(self):
+        return sorted(self._replicas)
+
+    def get(self, name):
+        return self._replicas.get(name)
+
+    def alive(self, name):
+        rep = self._replicas.get(name)
+        return rep is not None and rep.alive()
+
+    def pid(self, name):
+        rep = self._replicas.get(name)
+        return rep.pid() if rep is not None else None
+
+    def state(self):
+        """Plain-data snapshot (the mxctl state file's ``replicas``)."""
+        out = {}
+        for name, rep in sorted(self._replicas.items()):
+            out[name] = {
+                "pid": rep.pid(), "alive": rep.alive(),
+                "spawns": rep.spawns, "last_rc": rep.last_rc,
+                "pending_respawn": rep.pending_until is not None,
+            }
+        return out
+
+    # -- batch-job supervision (tools/launch.py) -----------------------------
+    def run_to_completion(self, max_restarts=0, restart_delay=0.0,
+                          on_restart=None):
+        """Supervise until every replica exits and no respawn is held.
+
+        A zero exit retires the replica; a nonzero exit consumes one
+        restart from the shared budget (respawned after
+        ``restart_delay``) or, with the budget spent, lands in the
+        returned ``{name: rc}`` — each name's FINAL incarnation only
+        (tools/launch.py's ``--max-restarts`` contract). ``on_restart``
+        is called as ``(name, rc, restarts_left, delay)``.
+        """
+        restarts_left = int(max_restarts)
+        failed = {}
+        while any(not r.done or r.pending_until is not None
+                  for r in self._replicas.values()):
+            time.sleep(self.poll_interval)
+            self.tick()
+            for name, rc in self.poll().items():
+                if rc == 0:
+                    failed.pop(name, None)
+                    continue
+                if restarts_left > 0:
+                    restarts_left -= 1
+                    if on_restart is not None:
+                        on_restart(name, rc, restarts_left, restart_delay)
+                    self.respawn(name, delay=restart_delay)
+                else:
+                    failed[name] = rc
+        return failed
+
+
+def _selftest():  # pragma: no cover - manual smoke hook
+    import sys
+
+    sup = Supervisor()
+    sup.spawn("t", [sys.executable, "-c", "import time; time.sleep(30)"])
+    assert sup.alive("t")
+    sup.stop_all()
+    assert not sup.alive("t")
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _selftest()
+    print("supervisor selftest OK")

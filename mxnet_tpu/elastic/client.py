@@ -82,7 +82,10 @@ class ElasticClient:
         offsets against the coordinator's clock."""
         req = dict(fields)
         req["op"] = op
-        req["rank"] = self.rank
+        if "rank" not in req:
+            # admin ops (evict) address ANOTHER rank explicitly; every
+            # ordinary op speaks for this client's own rank
+            req["rank"] = self.rank
         # clock stamps taken INSIDE the attempt, around the single
         # round trip: retry backoff between attempts must not widen the
         # t0..t1 bracket (srv_t comes from the final attempt's reply,
@@ -180,6 +183,14 @@ class ElasticClient:
 
     def stats(self):
         return self.call("stats")
+
+    def evict(self, rank):
+        """Admin eviction of ``rank`` (the coordinator's force-evict
+        hook): bumps the membership epoch and drops the rank's in-flight
+        contributions without waiting for its heartbeat lapse. The
+        mxctl ``evict_replace`` actuator's RPC
+        (docs/how_to/control_plane.md)."""
+        return self.call("evict", rank=int(rank))
 
     def wait_ready(self, deadline=30.0):
         """Block until the coordinator answers (launcher/test startup)."""

@@ -1413,6 +1413,34 @@ class _AsyncDistKVStore(KVStore):
         raise MXNetError("timed out waiting for %s" % k)
 
 
+#: exit code of the fail-fast eviction policy below — the supervisor
+#: side (control/supervisor.py EVICTED_EXIT_CODE) keys respawns on "any
+#: nonzero exit", so the value only matters for log forensics
+_EVICTED_EXIT_CODE = 43
+
+
+def _maybe_exit_on_evict(rank):
+    """``MXNET_ELASTIC_EXIT_ON_EVICT=1``: an evicted rank exits (code
+    43) instead of transparently rejoining, so its supervisor
+    (tools/launch.py ``--max-restarts``, or mxctl's evict-and-replace
+    loop) spawns a fresh incarnation. ``os._exit`` on purpose: the
+    rejoin can trigger from the heartbeat thread, where ``sys.exit``
+    would kill only that thread and leave a zombie member training on.
+    The journal is flushed first (best effort) so the eviction survives
+    into the chaos report."""
+    if os.environ.get("MXNET_ELASTIC_EXIT_ON_EVICT", "").strip().lower() \
+            in ("", "0", "false", "off", "no"):
+        return
+    warnings.warn(
+        "elastic kvstore: rank %d evicted — exiting for supervised "
+        "replacement (MXNET_ELASTIC_EXIT_ON_EVICT)" % rank, stacklevel=2)
+    try:
+        _tel.flush(mark="exit")
+    except Exception:  # noqa: BLE001 - exiting anyway
+        pass
+    os._exit(_EVICTED_EXIT_CODE)
+
+
 class _ElasticDistKVStore(KVStore):
     """dist_sync with elastic membership (``MXNET_KV_ELASTIC=1``).
 
@@ -1530,7 +1558,17 @@ class _ElasticDistKVStore(KVStore):
         racing a restart): re-register, adopt the server's weights and
         round counters, and continue at the next round. Runs under the
         ``kv.rejoin`` fault point + retry policy, so an injected or
-        transient rejoin failure backs off instead of dying."""
+        transient rejoin failure backs off instead of dying.
+
+        With ``MXNET_ELASTIC_EXIT_ON_EVICT=1`` the transparent rejoin
+        is replaced by fail-fast replacement: the process exits (code
+        43) so its supervisor — ``tools/launch.py --max-restarts`` or
+        the mxctl controller — respawns a FRESH incarnation that
+        re-registers. An admin eviction (a straggling or misbehaving
+        rank the control plane removed on purpose) must produce a new
+        process, not the same wedged one sneaking back in."""
+        _maybe_exit_on_evict(self._rank)
+
         def _do():
             _faults.point("kv.rejoin")
             return self._client.register()

@@ -214,6 +214,8 @@ class Engine:
         self._thread = None
         self._stop = False
         self._last_rate = 0.0
+        self._draining = False
+        self._drained = False
         _live_engines.add(self)
 
     # -- intake --------------------------------------------------------------
@@ -231,6 +233,11 @@ class Engine:
                     self.sched.max_request_tokens(),
                     self.model.max_blocks * self.cfg.block_size)
         with self._lock:
+            if self._draining:
+                self._reject()
+                raise QueueFullError(
+                    "engine draining — admissions closed (resume() "
+                    "reopens)")
             if total > limit:
                 self._reject()
                 raise MXNetError(
@@ -262,6 +269,82 @@ class Engine:
         with self._lock:
             self.sched.cancel(req)
             self._work.notify_all()
+
+    # -- graceful drain ------------------------------------------------------
+    def drain(self, wait=False, timeout=None):
+        """Stop admissions; everything already accepted (queued or
+        active) runs to completion. New ``submit`` calls raise
+        :class:`QueueFullError` (counted as rejections — the upstream
+        load balancer sheds to other replicas). When the last in-flight
+        request finishes, a deterministic ``drained`` event lands in
+        the scheduler event log, ``serve.drained`` in the journal, and
+        ``/servingz`` reports ``drained: true`` — the primitive behind
+        mxctl's drain-then-restart action and any clean shutdown.
+
+        ``wait=True`` blocks until drained (the caller must be driving
+        steps, or have ``start()`` running). Returns True when drained.
+        """
+        with self._lock:
+            if not self._draining:
+                self._draining = True
+                if _tel.ENABLED:
+                    _tel.counter("serving.drains_total").inc()
+                self._check_drained_locked()
+                self._work.notify_all()
+            if not wait:
+                return self._drained
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
+            while not self._drained:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._work.wait(timeout=remaining if remaining is not None
+                                else 0.5)
+            return True
+
+    def resume(self):
+        """Reopen admissions after :meth:`drain` (a replica held in
+        reserve, or a flap-guard test flipping readiness)."""
+        with self._lock:
+            if self._draining:
+                self._draining = False
+                self._drained = False
+                self._work.notify_all()
+
+    def accepting(self):
+        """True while ``submit`` admits work — the /readyz signal
+        (telemetry/server.py): a draining replica is alive but not
+        ready."""
+        with self._lock:
+            return not self._draining
+
+    @property
+    def draining(self):
+        with self._lock:
+            return self._draining
+
+    @property
+    def drained(self):
+        with self._lock:
+            return self._drained
+
+    def _check_drained_locked(self):
+        """Latch the drained state once the last accepted request is
+        gone (caller holds ``_lock``)."""
+        if (self._draining and not self._drained
+                and not self.sched.queue and not self.sched.active):
+            self._drained = True
+            self.sched.note_drained()
+            if _tel.ENABLED:
+                _tel.event("serve.drained",
+                           completed=self._stats["completed"],
+                           cancelled=self._stats["cancelled"])
+            # every caller holds _lock (the _locked-suffix contract) —
+            # _work is Condition(self._lock), so this notify is locked
+            self._work.notify_all()  # mxlint: disable
 
     def _reject(self):
         self._stats["rejected"] += 1
@@ -563,6 +646,7 @@ class Engine:
                 del self._by_rid[rid]
             elif req.state == FINISHED:
                 del self._by_rid[rid]
+        self._check_drained_locked()
 
     def _update_gauges(self):
         util = self.pool.utilization()
@@ -614,6 +698,8 @@ class Engine:
                 "kv_pool_hwm_blocks": self.pool.high_water_mark(),
                 "queue_depth": len(self.sched.queue),
                 "active": len(self.sched.active),
+                "draining": self._draining,
+                "drained": self._drained,
                 "tokens_per_s_window": self._last_rate,
                 "ttft_p50_s": pct(self._ttfts, 50),
                 "ttft_p99_s": pct(self._ttfts, 99),
@@ -646,6 +732,8 @@ class Engine:
                 })
             out = {
                 "policy": self.cfg.policy,
+                "draining": self._draining,
+                "drained": self._drained,
                 "requests": reqs,
                 "pool": {
                     "capacity_blocks": self.pool.capacity,

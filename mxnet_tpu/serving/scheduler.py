@@ -178,6 +178,12 @@ class Scheduler:
         condition trips)."""
         self._finish(req, FINISHED, "complete")
 
+    def note_drained(self):
+        """Record the engine's drain completion in the deterministic
+        event log (rid -1: a lifecycle event, not a request)."""
+        self.events.append(("drained", -1))
+        self.counts["drained"] += 1
+
     def _sweep_cancelled(self):
         for req in [r for r in self.active if r.cancel_requested]:
             self._finish(req, CANCELLED, "cancel")

@@ -146,6 +146,7 @@ def flush(mark=None):
     a metrics snapshot record tagged with it (``periodic`` from the
     flusher, ``test_end`` from the suite fixture, ``exit`` at
     shutdown). No-op without a configured journal."""
+    global _exit_snapshot_done
     if _path is None:
         return
     with _lock:
@@ -155,6 +156,12 @@ def flush(mark=None):
         recs, _buffer[:] = list(_buffer), []
         if mark is not None:
             recs.append(_metrics_record(mark))
+        if mark == "exit":
+            # an explicit exit flush (controller/replica teardown,
+            # chaos workloads) must suppress the atexit hook's own exit
+            # snapshot: counter-folding harnesses SUM exit records, and
+            # a doubled snapshot doubles every total
+            _exit_snapshot_done = True
         if _file is None or not recs:
             return
         for r in recs:

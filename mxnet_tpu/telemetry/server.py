@@ -8,6 +8,9 @@ module serves the in-process mxtel state over plain HTTP:
 
 ====================  =========================================================
 ``/healthz``          liveness probe (200 ``ok``)
+``/readyz``           readiness probe: alive AND accepting work — 503 while
+                      the process is marked starting/stopping
+                      (:func:`mark_ready`) or any serving engine is draining
 ``/metrics``          Prometheus exposition text (export.prometheus_text)
 ``/statusz``          uptime, rank/world, MXNET_* env config, jit-cache +
                       compile counters (JSON)
@@ -44,13 +47,53 @@ import time
 from . import registry as _registry
 from . import tracing as _tracing
 
-__all__ = ["configure", "port", "running"]
+__all__ = ["configure", "port", "running", "mark_ready", "is_ready"]
 
 _lock = threading.Lock()
 _server = None
 _thread = None
 _bound = None        # (host, port) actually bound
 _started_t = None
+
+# process-level readiness: /readyz (alive AND accepting work) vs
+# /healthz (alive). Defaults ready so plain jobs need no opt-in; a
+# replica that warms up before taking traffic calls
+# mark_ready(False, "starting") first — but user code only runs AFTER
+# package import, and the server answers DURING it, so a supervisor
+# that must never see a booting replica as ready exports
+# MXNET_TELEMETRY_READY=0 (mxctl does this for supervised replicas):
+# the process then starts not-ready until its own mark_ready(True).
+# Serving engines additionally gate readiness on their drain state
+# (Engine.accepting()).
+_ready = os.environ.get("MXNET_TELEMETRY_READY", "").strip().lower() \
+    not in ("0", "false", "off", "no")
+_ready_reason = "" if _ready else "starting (MXNET_TELEMETRY_READY=0)"
+
+
+def mark_ready(flag, reason=""):
+    """Set the process-level readiness flag (the starting/stopping
+    states a liveness probe must not see as dead)."""
+    global _ready, _ready_reason
+    _ready = bool(flag)
+    _ready_reason = reason if not flag else ""
+
+
+def is_ready():
+    """(ready, reasons): the /readyz verdict — the process flag AND
+    every live serving engine accepting admissions. Importable for
+    in-process checks; never CREATES anything."""
+    reasons = []
+    if not _ready:
+        reasons.append(_ready_reason or "marked not ready")
+    srv_mod = sys.modules.get("mxnet_tpu.serving.engine")
+    # getattr guard: a scrape can land DURING package import, when the
+    # module is in sys.modules but not yet initialized
+    live = getattr(srv_mod, "live_engines", None) if srv_mod else None
+    if live is not None:
+        for e in live():
+            if not e.accepting():
+                reasons.append("serving engine %#x draining" % id(e))
+    return not reasons, reasons
 
 
 def running():
@@ -136,13 +179,19 @@ def _build(spec):
                            % (path, " ".join(sorted(_ROUTES))))
                 return
             try:
-                ctype, body = fn(_params(query))
+                out = fn(_params(query))
             except Exception as e:  # introspection must never kill the job
                 logging.exception("mxdash: %s handler failed", path)
                 self._send(500, "text/plain; charset=utf-8",
                            "%s: %s\n" % (type(e).__name__, e))
                 return
-            self._send(200, ctype, body)
+            # handlers return (ctype, body) for 200, or
+            # (code, ctype, body) — /readyz answers 503 when draining
+            if len(out) == 3:
+                code, ctype, body = out
+            else:
+                code, (ctype, body) = 200, out
+            self._send(code, ctype, body)
 
         def _send(self, code, ctype, body):
             data = body.encode("utf-8")
@@ -182,6 +231,18 @@ def _json(obj):
 
 def _healthz(params):
     return ("text/plain; charset=utf-8", "ok\n")
+
+
+def _readyz(params):
+    """Readiness split from liveness (docs/how_to/control_plane.md): a
+    draining or still-starting replica is alive (200 /healthz) but not
+    accepting work (503 here) — external probes and the mxctl
+    controller must not conflate the two."""
+    ready, reasons = is_ready()
+    if ready:
+        return ("text/plain; charset=utf-8", "ready\n")
+    return (503, "text/plain; charset=utf-8",
+            "not ready: %s\n" % "; ".join(reasons))
 
 
 def _metrics(params):
@@ -269,6 +330,7 @@ _ROUTES = {
                     "mxdash endpoints: %s\n" % " ".join(
                         sorted(k for k in _ROUTES if k != "/"))),
     "/healthz": _healthz,
+    "/readyz": _readyz,
     "/metrics": _metrics,
     "/statusz": _statusz,
     "/tracez": _tracez,

@@ -10,6 +10,17 @@ and rejoin legs deterministic:
   MXNET_ELASTIC_TEST_MARK       marker dir: die only if no marker yet
                                 (so a restarted incarnation survives —
                                 the rejoin leg)
+  MXNET_ELASTIC_TEST_SLOW_RANK  rank that drags every gradient round
+                                (tools/chaos.py --controller straggler
+                                leg: the mxctl controller must attribute
+                                and evict-replace it)
+  MXNET_ELASTIC_TEST_SLOW_SECS  per-batch sleep of the slow rank
+                                (default 0.4)
+
+The slow rank is slow only in its FIRST incarnation (marker-dir
+discipline, like the die-once rejoin leg): a supervised replacement —
+mxctl evicts, the worker exits via MXNET_ELASTIC_EXIT_ON_EVICT=1, the
+launcher respawns — comes back healthy, which is what "replace" means.
 
 Launch (docs/how_to/elastic_training.md)::
 
@@ -52,6 +63,29 @@ def _maybe_die_callback(rank):
     return _cb
 
 
+def _maybe_slow_callback(rank):
+    slow_rank = int(os.environ.get("MXNET_ELASTIC_TEST_SLOW_RANK", "-1"))
+    slow_secs = float(os.environ.get("MXNET_ELASTIC_TEST_SLOW_SECS", "0.4"))
+    mark_dir = os.environ.get("MXNET_ELASTIC_TEST_MARK", "")
+    if rank != slow_rank or slow_secs <= 0:
+        return None
+    marker = os.path.join(mark_dir, "slow-rank-%d" % rank) if mark_dir else ""
+    if marker and os.path.exists(marker):
+        return None  # replacement incarnation: healthy
+    if marker:
+        with open(marker, "w") as f:
+            f.write("first (slow) incarnation pid %d\n" % os.getpid())
+    import time
+
+    def _cb(param):
+        # dragging AFTER the round lands means every peer's next
+        # round_wait carries this rank's lateness — exactly the
+        # barrier-wait-share signature trace_merge attributes
+        time.sleep(slow_secs)
+
+    return _cb
+
+
 def main():
     kv = mx.kvstore.create("dist_sync")
     assert type(kv).__name__ == "_ElasticDistKVStore", \
@@ -64,7 +98,8 @@ def main():
     val = mx.io.MNISTIter(batch_size=32, num_synthetic=320, seed=4,
                           flat=True, shuffle=False)
     mod = mx.module.Module(mx.models.get_mlp(), context=mx.cpu(0))
-    cbs = [cb for cb in [_maybe_die_callback(rank)] if cb]
+    cbs = [cb for cb in [_maybe_die_callback(rank),
+                         _maybe_slow_callback(rank)] if cb]
     mod.fit(
         train, num_epoch=int(os.environ.get("MXNET_ELASTIC_TEST_EPOCHS", "3")),
         kvstore=kv, optimizer_params={"learning_rate": 0.1, "momentum": 0.9},

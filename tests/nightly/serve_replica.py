@@ -1,0 +1,141 @@
+"""One supervised serving replica: the mxctl chaos-leg workload.
+
+A tiny transformer Engine under continuous self-generated load, with
+its mxdash surface up (the controller's scrape target) and the
+graceful-drain contract wired to SIGTERM:
+
+  SIGTERM  ->  Engine.drain() (admissions closed, /readyz 503),
+               in-flight requests finish, journal flushed, exit 0
+
+so mxctl's ``drain_restart`` actuator and the controller's own
+teardown replace replicas without dropping streamed tokens. SIGKILL
+(the chaos injection) obviously skips all of that — that is the point.
+
+Env knobs (all optional):
+
+  SERVE_REPLICA_LOAD   "batch,interval_s,max_new" open-loop generator
+                       (default "3,0.25,8")
+  SERVE_REPLICA_FLAP   "period_s,down_s": every period, drain for
+                       down_s then resume — the noisy-but-healthy
+                       flap-guard negative control (readiness dips
+                       shorter than any rule's for= window)
+  SERVE_REPLICA_SEED   prompt RNG seed (default 0)
+
+The controller provides MXNET_TELEMETRY / MXNET_TELEMETRY_HTTP /
+MXNET_TELEMETRY_JOURNAL via MXCTL_TARGETS + MXCTL_REPLICA_JOURNAL
+(mxnet_tpu/control/__main__.py).
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu.serving import Engine, QueueFullError, ServingConfig  # noqa: E402
+
+_STOP = {"flag": False}
+
+
+def _parse3(raw, default):
+    parts = (raw or "").split(",")
+    try:
+        vals = [float(p) for p in parts if p.strip() != ""]
+    except ValueError:
+        vals = []
+    return vals if vals else list(default)
+
+
+def main():
+    # not ready until the engine is built and warm: a probe during jit
+    # compilation must read alive-but-not-ready, never dead
+    telemetry.server.mark_ready(False, "starting")
+
+    import jax
+
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+
+    name = os.environ.get("MXCTL_REPLICA_NAME", "replica")
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, d_model=32,
+                            num_heads=2, d_ff=64, max_seq_len=96,
+                            dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = Engine(params, cfg, ServingConfig(
+        block_size=8, num_blocks=96, max_batch=4, max_active=8,
+        prefill_chunk=16, max_queue_depth=64))
+    engine.start()
+
+    batch, interval, max_new = _parse3(
+        os.environ.get("SERVE_REPLICA_LOAD"), (3, 0.25, 8))
+    flap = _parse3(os.environ.get("SERVE_REPLICA_FLAP"), ())
+    if len(flap) < 2:
+        flap = []   # needs period,down — anything else means no flapping
+    rng = np.random.RandomState(int(os.environ.get("SERVE_REPLICA_SEED",
+                                                   "0")))
+
+    def _sigterm(_signo, _frame):
+        _STOP["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    # warmup: a mixed-length batch through prefill+decode so "ready"
+    # means "the common bucketed programs are compiled", not "about to
+    # spend 30s in XLA on the first real burst" — a late cold compile
+    # stalls the loop and stretches every latency the controller
+    # watches
+    engine.generate([rng.randint(0, 61, (n,)).astype(np.int32)
+                     for n in (5, 6, 9, 12, 13, 14, 15, 16)],
+                    max_new_tokens=4)
+    telemetry.server.mark_ready(True)
+    print("serve_replica %s: ready (pid %d, mxdash port %s)"
+          % (name, os.getpid(), telemetry.server.port()), flush=True)
+
+    shed = 0
+    if flap:
+        # dedicated flap thread: the dip length must be governed by a
+        # thread that does nothing else — the load loop below stalls
+        # for seconds behind jit tracing's GIL bursts, and a stretched
+        # dip would turn the flap-guard negative control into a real
+        # outage
+        import threading
+
+        def _flap_loop():
+            while not _STOP["flag"]:
+                time.sleep(flap[0])
+                if _STOP["flag"]:
+                    return
+                engine.drain()           # noisy: briefly not-ready ...
+                time.sleep(flap[1])
+                engine.resume()          # ... but always healthy again
+
+        threading.Thread(target=_flap_loop, name="flap",
+                         daemon=True).start()
+    while not _STOP["flag"]:
+        if engine.accepting():
+            for _ in range(int(batch)):
+                prompt = rng.randint(0, 61, (int(rng.randint(5, 17)),))
+                try:
+                    engine.submit(prompt.astype(np.int32),
+                                  max_new_tokens=int(max_new))
+                except QueueFullError:
+                    shed += 1            # overload: the SLO signal
+        time.sleep(interval)
+
+    # graceful drain: stop admissions, let in-flight requests finish
+    telemetry.server.mark_ready(False, "stopping")
+    engine.drain(wait=True, timeout=30.0)
+    engine.stop()
+    engine.note_idle()
+    stats = engine.stats()
+    if telemetry.ENABLED:
+        telemetry.flush(mark="exit")
+    print("serve_replica %s: drained clean (completed=%d shed=%d)"
+          % (name, stats["completed"], shed), flush=True)
+
+
+if __name__ == "__main__":
+    main()

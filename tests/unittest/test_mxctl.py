@@ -1,0 +1,715 @@
+"""mxctl control-plane tests (ISSUE 12): rule grammar + hysteresis
+state machine (seeded fake telemetry, no sockets), actuator dry-run and
+rate-limit discipline, the supervisor, probes against a live mxdash
+server, the serving drain primitive's controller-facing surfaces, and a
+tier-1 in-proc leg driving a scripted probe sequence through
+detect -> decide -> act -> journal.
+
+The load-bearing acceptance properties:
+
+- a rule fires only after ``for=K`` CONSECUTIVE breaching probes, and a
+  flapping signal (breaches shorter than K) fires NOTHING — the
+  hysteresis the chaos flap leg proves multi-process;
+- with ``MXCTL_*`` unset there is no controller thread and
+  ``maybe_start`` is a pure no-op (off-by-default zero overhead);
+- dry-run journals decisions without executing actions;
+- one firing's rule/action/recovery journal events share a trace id.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import mxnet_tpu  # noqa: F401 - package init (control rides along)
+from mxnet_tpu import telemetry
+from mxnet_tpu import control
+from mxnet_tpu.control import (ActionError, Actuator, ControlConfig,
+                               Controller, RuleEngine, RuleSyntaxError,
+                               Supervisor, TargetSample, parse_rules,
+                               parse_targets)
+from mxnet_tpu.control.probes import HttpProbe, serving_metrics
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# -- helpers -------------------------------------------------------------------
+class FakeProbe:
+    """Scripted telemetry: one TargetSample per step, no sockets."""
+
+    def __init__(self, seq, target="r0", scope="serving"):
+        self.seq = list(seq)
+        self.target = target
+        self.scope = scope
+        self.i = 0
+
+    def sample(self, now=None):
+        s = self.seq[min(self.i, len(self.seq) - 1)]
+        self.i += 1
+        return TargetSample(self.target, self.scope, s, {"url": "fake://"})
+
+
+class RecordingActuator(Actuator):
+    def __init__(self, name="restart_replica", fail=False):
+        self.name = name
+        self.calls = []
+        self.fail = fail
+
+    def execute(self, decision, ctx):
+        self.calls.append((decision.target, decision.rule.name))
+        if self.fail:
+            raise ActionError("injected actuator failure")
+        return {"pid": 4242}
+
+
+def _controller(rules, seq, actuator=None, **cfg_kw):
+    cfg_kw.setdefault("interval", 0.01)
+    cfg_kw.setdefault("action_retries", 1)
+    cfg = ControlConfig(rules=parse_rules(rules), **cfg_kw)
+    act = actuator or RecordingActuator()
+    ctl = Controller(cfg, probes=[FakeProbe(seq)],
+                     actuators={act.name: act})
+    return ctl, act
+
+
+def _drive(ctl, n, start=0.0, dt=1.0):
+    fired = []
+    for i in range(n):
+        fired.extend(ctl.step(now=start + i * dt))
+    return fired
+
+
+# -- rule grammar --------------------------------------------------------------
+class TestRuleGrammar:
+    def test_parse_full_rule(self):
+        (r,) = parse_rules(
+            "ttft_p99>0.5:for=3:action=drain_restart:cooldown=60"
+            ":scope=serving:max=2")
+        assert r.metric == "ttft_p99" and r.op == ">"
+        assert r.threshold == 0.5 and r.for_count == 3
+        assert r.action == "drain_restart" and r.cooldown == 60.0
+        assert r.scope == "serving" and r.max_fires == 2
+        assert r.breached(0.6) and not r.breached(0.5)
+
+    def test_defaults_and_multiple_rules(self):
+        rs = parse_rules("alive<1:action=restart_replica;"
+                         "queue_depth>=10:for=5:action=drain_restart")
+        assert len(rs) == 2
+        assert rs[0].for_count == 1 and rs[0].cooldown == 30.0
+        assert rs[1].op == ">=" and rs[1].breached(10)
+
+    def test_default_ruleset_parses(self):
+        assert parse_rules(control.DEFAULT_RULES)
+
+    @pytest.mark.parametrize("bad", [
+        "alive:action=x",                 # no comparator
+        "alive<one:action=x",             # non-numeric threshold
+        "alive<1",                        # no action
+        "alive<1:action=x:bogus=1",       # unknown option
+        "alive<1:action=x:scope=desert",  # bad scope
+        "alive<1:for=nope:action=x",      # non-numeric for
+    ])
+    def test_malformed_rules_raise(self, bad):
+        with pytest.raises(RuleSyntaxError):
+            parse_rules(bad)
+
+    def test_targets_grammar(self):
+        t = parse_targets("r0=http://127.0.0.1:8321, r1=http://h:9/")
+        assert t == {"r0": "http://127.0.0.1:8321", "r1": "http://h:9"}
+        with pytest.raises(ValueError):
+            parse_targets("not-a-pair")
+
+
+# -- hysteresis state machine --------------------------------------------------
+class TestHysteresis:
+    RULE = "alive<1:for=3:action=restart_replica:cooldown=10"
+
+    def _engine(self):
+        return RuleEngine(parse_rules(self.RULE))
+
+    def test_fires_only_after_k_consecutive_breaches(self):
+        eng = self._engine()
+        assert eng.evaluate("t", {"alive": 0.0}, 0.0) == []
+        assert eng.evaluate("t", {"alive": 0.0}, 1.0) == []
+        (d,) = eng.evaluate("t", {"alive": 0.0}, 2.0)
+        assert d.rule.action == "restart_replica" and d.target == "t"
+
+    def test_flapping_never_fires(self):
+        """The flap-guard acceptance shape: breach streaks shorter than
+        for=K, indefinitely, produce zero decisions (but are counted)."""
+        eng = self._engine()
+        pattern = [0.0, 0.0, 1.0] * 20   # never 3 consecutive breaches
+        for i, v in enumerate(pattern):
+            assert eng.evaluate("t", {"alive": v}, float(i)) == []
+        assert eng.breaches == 40
+
+    def test_cooldown_blocks_and_requires_resustain(self):
+        eng = self._engine()
+        now = 0.0
+        for i in range(3):
+            ds = eng.evaluate("t", {"alive": 0.0}, now + i)
+        assert ds
+        # still breaching inside the cooldown: nothing fires
+        for i in range(3, 12):
+            assert eng.evaluate("t", {"alive": 0.0}, now + i) == []
+        # past the cooldown the streak must RE-SUSTAIN for=3
+        assert eng.evaluate("t", {"alive": 0.0}, 13.0) == []
+        assert eng.evaluate("t", {"alive": 0.0}, 14.0) == []
+        assert eng.evaluate("t", {"alive": 0.0}, 15.0) != []
+
+    def test_healthy_probe_resets_streak(self):
+        eng = self._engine()
+        eng.evaluate("t", {"alive": 0.0}, 0.0)
+        eng.evaluate("t", {"alive": 0.0}, 1.0)
+        eng.evaluate("t", {"alive": 1.0}, 2.0)   # reset
+        assert eng.evaluate("t", {"alive": 0.0}, 3.0) == []
+        assert eng.evaluate("t", {"alive": 0.0}, 4.0) == []
+        assert eng.evaluate("t", {"alive": 0.0}, 5.0) != []
+
+    def test_missing_metric_holds_state(self):
+        eng = self._engine()
+        eng.evaluate("t", {"alive": 0.0}, 0.0)
+        eng.evaluate("t", {"alive": 0.0}, 1.0)
+        eng.evaluate("t", {}, 2.0)               # failed scrape: hold
+        assert eng.evaluate("t", {"alive": 0.0}, 3.0) != []
+
+    def test_max_fires_bounds_executed_actions(self):
+        eng = RuleEngine(parse_rules(
+            "alive<1:for=1:action=evict_replace:cooldown=1:max=1"))
+        (d,) = eng.evaluate("t", {"alive": 0.0}, 0.0)
+        eng.note_action(d, 0.0, executed=True)
+        for i in range(1, 20):
+            assert eng.evaluate("t", {"alive": 0.0}, float(i * 3)) == []
+
+    def test_max_fires_not_consumed_by_failed_or_dryrun_actions(self):
+        """A transient actuator failure (or a dry-run) must not burn
+        the max=N budget — otherwise one coordinator hiccup disables a
+        capped evict rule for the rest of the run."""
+        eng = RuleEngine(parse_rules(
+            "alive<1:for=1:action=evict_replace:cooldown=1:max=1"))
+        (d,) = eng.evaluate("t", {"alive": 0.0}, 0.0)
+        eng.note_action(d, 0.0, executed=False)   # failed / dry-run
+        (d2,) = eng.evaluate("t", {"alive": 0.0}, 3.0)  # fires again
+        eng.note_action(d2, 3.0, executed=True)
+        assert eng.evaluate("t", {"alive": 0.0}, 6.0) == []  # now capped
+
+    def test_scope_filters_targets(self):
+        eng = RuleEngine(parse_rules(
+            "straggler>0:for=1:action=evict_replace:scope=training"))
+        assert eng.evaluate("r0", {"straggler": 1.0}, 0.0,
+                            scope="serving") == []
+        assert eng.evaluate("rank2", {"straggler": 1.0}, 0.0,
+                            scope="training") != []
+
+    def test_recovery_tracked_only_for_executed_actions(self):
+        eng = self._engine()
+        for i in range(3):
+            ds = eng.evaluate("t", {"alive": 0.0}, float(i))
+        eng.note_action(ds[0], 2.0, executed=True, trace="tr-1")
+        assert eng.evaluate("t", {"alive": 1.0}, 8.0) == []
+        (rec,) = eng.drain_recoveries()
+        assert rec["target"] == "t" and rec["dur"] == 6.0
+        assert rec["trace"] == "tr-1"
+        assert eng.drain_recoveries() == []
+
+    def test_per_target_state_is_independent(self):
+        eng = self._engine()
+        for i in range(3):
+            eng.evaluate("a", {"alive": 0.0}, float(i))
+            ds_b = eng.evaluate("b", {"alive": 1.0}, float(i))
+        assert ds_b == []
+        # b starts its own streak from scratch
+        assert eng.evaluate("b", {"alive": 0.0}, 3.0) == []
+
+
+# -- controller dispatch: dry-run, rate limit, retry, failure ------------------
+class TestDispatch:
+    SEQ_DEAD = [{"alive": 1.0}] + [{"alive": 0.0}] * 10
+
+    def test_act_executes_and_counts(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        telemetry.reset()
+        telemetry.reload()
+        ctl, act = _controller(
+            "alive<1:for=3:action=restart_replica:cooldown=100",
+            self.SEQ_DEAD)
+        _drive(ctl, 6)
+        assert act.calls == [("r0", "alive<1")]
+        c = telemetry.snapshot()["counters"]
+        assert c["mxctl.actions_total"] == 1
+        assert c["mxctl.rules_fired_total"] == 1
+        assert c["mxctl.probes_total"] == 6
+        assert c["mxctl.breaches_total"] == 5
+
+    def test_dry_run_journals_but_never_executes(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        telemetry.reset()
+        telemetry.reload()
+        ctl, act = _controller(
+            "alive<1:for=2:action=restart_replica:cooldown=1",
+            self.SEQ_DEAD, dry_run=True)
+        _drive(ctl, 12)
+        assert act.calls == []
+        c = telemetry.snapshot()["counters"]
+        assert c.get("mxctl.actions_total", 0) == 0
+        assert c["mxctl.actions_dryrun_total"] >= 2   # re-fires each window
+        assert c["mxctl.rules_fired_total"] == c["mxctl.actions_dryrun_total"]
+
+    def test_rate_limit(self):
+        ctl, act = _controller(
+            "alive<1:for=1:action=restart_replica:cooldown=2",
+            self.SEQ_DEAD, max_actions=2, actions_window=1000.0)
+        _drive(ctl, 40, dt=3.0)   # every probe past cooldown can fire
+        assert len(act.calls) == 2   # the window cap held
+
+    def test_action_failure_counted_not_raised(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        telemetry.reset()
+        telemetry.reload()
+        act = RecordingActuator(fail=True)
+        ctl, _ = _controller(
+            "alive<1:for=2:action=restart_replica:cooldown=100",
+            self.SEQ_DEAD, actuator=act)
+        _drive(ctl, 5)
+        assert len(act.calls) == 1
+        c = telemetry.snapshot()["counters"]
+        assert c["mxctl.actions_failed_total"] == 1
+        assert c.get("mxctl.actions_total", 0) == 0
+
+    def test_action_retry_policy(self):
+        calls = []
+
+        class FlakyActuator(Actuator):
+            name = "restart_replica"
+
+            def execute(self, decision, ctx):
+                calls.append(1)
+                if len(calls) < 2:
+                    raise ActionError("transient")
+                return {}
+
+        ctl, _ = _controller(
+            "alive<1:for=2:action=restart_replica:cooldown=100",
+            self.SEQ_DEAD, actuator=FlakyActuator(), action_retries=2)
+        _drive(ctl, 5)
+        assert len(calls) == 2   # first attempt healed by the policy
+
+    def test_unknown_action_is_a_failure(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        telemetry.reset()
+        telemetry.reload()
+        ctl, _ = _controller("alive<1:for=1:action=nonesuch:cooldown=100",
+                             self.SEQ_DEAD)
+        _drive(ctl, 3)
+        c = telemetry.snapshot()["counters"]
+        assert c["mxctl.actions_failed_total"] == 1
+
+
+# -- the tier-1 in-proc leg: detect -> decide -> act -> journal ----------------
+class TestClosedLoopJournal:
+    def test_scripted_kill_restart_recover_journal(self, monkeypatch,
+                                                   tmp_path):
+        """The whole loop against scripted telemetry: healthy ->
+        dead x3 -> rule fires -> actuator 'restarts' -> healthy ->
+        recovery. Asserts the journal carries mxctl.rule /
+        mxctl.action / mxctl.recovery sharing ONE trace id, with the
+        counters the chaos harness folds."""
+        journal = tmp_path / "ctl.jsonl"
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_JOURNAL", str(journal))
+        telemetry.reset()
+        telemetry.reload()
+        try:
+            seq = ([{"alive": 1.0, "queue_depth": 0.0}]
+                   + [{"alive": 0.0}] * 3
+                   + [{"alive": 1.0, "queue_depth": 1.0}] * 2)
+            ctl, act = _controller(
+                "alive<1:for=3:action=restart_replica:cooldown=30",
+                seq, state_path=str(tmp_path / "state.json"))
+            _drive(ctl, 6)
+            telemetry.flush(mark="exit")
+        finally:
+            monkeypatch.delenv("MXNET_TELEMETRY_JOURNAL")
+        assert act.calls == [("r0", "alive<1")]
+        records = [json.loads(l) for l in
+                   journal.read_text().splitlines() if l.strip()]
+        events = {r["name"]: r for r in records
+                  if r.get("kind") == "span"
+                  and str(r.get("name", "")).startswith("mxctl.")}
+        assert {"mxctl.rule", "mxctl.action", "mxctl.recovery"} <= \
+            set(events)
+        trace = events["mxctl.rule"]["trace"]
+        assert trace is not None
+        assert events["mxctl.action"]["trace"] == trace
+        assert events["mxctl.recovery"]["trace"] == trace
+        assert events["mxctl.action"]["outcome"] == "ok"
+        assert events["mxctl.action"]["target"] == "r0"
+        assert events["mxctl.recovery"]["dur"] == pytest.approx(1.0)
+        # the state file reflects the final healthy sample
+        state = json.loads((tmp_path / "state.json").read_text())
+        assert state["targets"]["r0"]["metrics"]["alive"] == 1.0
+        # counters present in the final snapshot (what chaos folds)
+        final = [r for r in records if r.get("kind") == "metrics"][-1]
+        assert final["counters"]["mxctl.actions_total"] == 1
+        assert final["counters"]["mxctl.recoveries_total"] == 1
+
+    def test_startup_grace_covers_warmup_until_first_ready(self):
+        """A supervised replica is not evaluated between (re)spawn and
+        its incarnation's first ready: a warmup marked not-ready must
+        not read as an outage. Once ready has been seen, a later
+        not-ready is real and counts."""
+        sup = Supervisor()
+        sup.spawn("r0", [sys.executable, "-c",
+                         "import time; time.sleep(60)"])
+        try:
+            seq = ([{"alive": 1.0, "ready": 0.0}] * 6     # warmup
+                   + [{"alive": 1.0, "ready": 1.0}]       # first ready
+                   + [{"alive": 1.0, "ready": 0.0}] * 4)  # REAL outage
+            cfg = ControlConfig(
+                rules=parse_rules(
+                    "ready<1:for=3:action=restart_replica:cooldown=100"),
+                startup_grace=3600.0)
+            act = RecordingActuator()
+            ctl = Controller(cfg, probes=[FakeProbe(seq)],
+                             actuators={act.name: act}, supervisor=sup)
+            for i in range(7):
+                assert ctl.step(now=float(i)) == [], i  # grace holds
+            assert ctl.engine.breaches == 0
+            fired = _drive(ctl, 4, start=7.0)
+            assert len(fired) == 1          # post-ready outage counts
+            assert len(act.calls) == 1
+        finally:
+            sup.stop_all(signal.SIGKILL, wait=2.0)
+
+    def test_probe_error_counted_loop_survives(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        telemetry.reset()
+        telemetry.reload()
+
+        class BrokenProbe:
+            def sample(self, now=None):
+                raise RuntimeError("scrape exploded")
+
+        cfg = ControlConfig(rules=parse_rules(control.DEFAULT_RULES))
+        ctl = Controller(cfg, probes=[BrokenProbe()], actuators={})
+        assert ctl.step(now=0.0) == []
+        c = telemetry.snapshot()["counters"]
+        assert c["mxctl.probe_errors_total"] == 1
+
+
+# -- off-by-default zero overhead ----------------------------------------------
+class TestOffByDefault:
+    def test_no_thread_without_env(self, monkeypatch):
+        monkeypatch.delenv("MXCTL_ENABLE", raising=False)
+        assert not control.enabled()
+        assert control.maybe_start() is None
+        assert [t for t in threading.enumerate()
+                if t.name == "mxctl"] == []
+
+    def test_enable_starts_and_stop_stops(self, monkeypatch):
+        monkeypatch.setenv("MXCTL_ENABLE", "1")
+        monkeypatch.setenv("MXCTL_INTERVAL", "0.05")
+        monkeypatch.delenv("MXCTL_TARGETS", raising=False)
+        try:
+            ctl = control.maybe_start()
+            assert ctl is not None
+            assert any(t.name == "mxctl" for t in threading.enumerate())
+        finally:
+            control.stop()
+        assert [t for t in threading.enumerate()
+                if t.name == "mxctl"] == []
+
+    def test_from_env_defaults_are_empty(self, monkeypatch):
+        for k in list(os.environ):
+            if k.startswith("MXCTL_"):
+                monkeypatch.delenv(k, raising=False)
+        cfg = ControlConfig.from_env()
+        assert cfg.targets == {} and cfg.coord is None
+        assert not cfg.dry_run
+        assert [r.describe() for r in cfg.rules] == \
+            [r.describe() for r in parse_rules(control.DEFAULT_RULES)]
+
+
+# -- supervisor ----------------------------------------------------------------
+class TestSupervisor:
+    def test_spawn_poll_respawn_stop(self):
+        sup = Supervisor(poll_interval=0.05)
+        sup.spawn("w", [sys.executable, "-c",
+                        "import time; time.sleep(60)"])
+        pid = sup.pid("w")
+        assert sup.alive("w") and pid
+        assert sup.send_signal("w", signal.SIGKILL)
+        sup.get("w").proc.wait()
+        assert sup.poll() == {"w": -signal.SIGKILL}
+        assert not sup.alive("w")
+        sup.respawn("w")
+        assert sup.alive("w") and sup.pid("w") != pid
+        assert sup.get("w").spawns == 2
+        sup.stop_all(wait=2.0)
+        assert not sup.alive("w")
+        st = sup.state()["w"]
+        assert st["spawns"] == 2 and not st["alive"]
+
+    def test_deferred_respawn_waits_for_tick(self):
+        sup = Supervisor()
+        sup.spawn("w", [sys.executable, "-c", "pass"])
+        sup.get("w").proc.wait()
+        sup.poll()
+        sup.respawn("w", delay=30.0)
+        assert not sup.alive("w")
+        assert sup.tick() == []            # hold not yet expired
+        sup.get("w").pending_until = 0.0   # force expiry
+        assert sup.tick() == ["w"]
+        sup.get("w").proc.wait()
+        sup.stop_all(wait=1.0)
+
+    def test_run_to_completion_respawn_budget(self, tmp_path):
+        marker = tmp_path / "mark"
+        # exits 1 until the marker exists, then writes nothing and exits 0
+        prog = ("import os,sys\n"
+                "m=%r\n"
+                "if os.path.exists(m): sys.exit(0)\n"
+                "open(m,'w').close(); sys.exit(1)\n" % str(marker))
+        sup = Supervisor(poll_interval=0.05)
+        sup.spawn("0", [sys.executable, "-c", prog])
+        failed = sup.run_to_completion(max_restarts=1)
+        assert failed == {}
+        assert sup.get("0").spawns == 2
+
+    def test_run_to_completion_exhausted_budget_fails(self):
+        sup = Supervisor(poll_interval=0.05)
+        sup.spawn("0", [sys.executable, "-c", "import sys; sys.exit(7)"])
+        failed = sup.run_to_completion(max_restarts=0)
+        assert failed == {"0": 7}
+
+    def test_log_path_redirects_and_appends(self, tmp_path):
+        log = tmp_path / "w.log"
+        sup = Supervisor()
+        sup.spawn("w", [sys.executable, "-c", "print('one')"],
+                  log_path=str(log))
+        sup.get("w").proc.wait()
+        sup.respawn("w")   # log_path sticky across respawns
+        sup.get("w").proc.wait()
+        assert log.read_text().splitlines() == ["one", "one"]
+
+
+# -- probes --------------------------------------------------------------------
+class TestProbes:
+    def test_serving_metrics_mapping(self):
+        servingz = {"engines": [
+            {"draining": True,
+             "stats": {"queue_depth": 3, "active": 2,
+                       "tokens_per_s_window": 10.0, "ttft_p99_s": 0.5}},
+            {"draining": False,
+             "stats": {"queue_depth": 1, "active": 1,
+                       "tokens_per_s_window": 5.0, "ttft_p99_s": 0.25}},
+        ]}
+        statusz = {"compile": {"compile.jit_cache_hits": 30,
+                               "compile.jit_cache_misses": 10}}
+        m = serving_metrics(servingz, statusz)
+        assert m["queue_depth"] == 4.0 and m["active"] == 3.0
+        assert m["tokens_per_s"] == 15.0 and m["ttft_p99"] == 0.5
+        assert m["draining"] == 1.0
+        assert m["cache_hit_rate"] == pytest.approx(0.75)
+        assert serving_metrics({}, None) == {}
+
+    def test_http_probe_against_live_mxdash(self, monkeypatch):
+        """HttpProbe against the real server: alive+ready when healthy,
+        ready 0 while a serving engine drains, alive 0 when the
+        socket is gone."""
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_HTTP", "0")
+        telemetry.reset()
+        assert telemetry.reload() is True
+        try:
+            port = telemetry.server.port()
+            probe = HttpProbe("r0", "http://127.0.0.1:%d" % port)
+            s = probe.sample()
+            assert s.metrics["alive"] == 1.0 and s.metrics["ready"] == 1.0
+            telemetry.server.mark_ready(False, "starting")
+            s = probe.sample()
+            assert s.metrics["alive"] == 1.0 and s.metrics["ready"] == 0.0
+            telemetry.server.mark_ready(True)
+        finally:
+            monkeypatch.delenv("MXNET_TELEMETRY_HTTP")
+            telemetry.reload()
+        dead = HttpProbe("r0", "http://127.0.0.1:%d" % port, timeout=0.5)
+        s = dead.sample()
+        assert s.metrics == {"alive": 0.0, "ready": 0.0}
+        assert "error" in s.meta
+
+
+# -- actuators -----------------------------------------------------------------
+class TestActuators:
+    def _ctx(self, sup):
+        cfg = ControlConfig(drain_grace=5.0)
+        return type("Ctx", (), {"supervisor": sup, "cfg": cfg})()
+
+    def test_restart_replica_respawns_dead_process(self):
+        sup = Supervisor()
+        sup.spawn("r0", [sys.executable, "-c",
+                         "import time; time.sleep(60)"])
+        old = sup.pid("r0")
+        sup.send_signal("r0", signal.SIGKILL)
+        sup.get("r0").proc.wait()
+        d = control.Decision(parse_rules(
+            "alive<1:for=1:action=restart_replica")[0], "r0", 0.0)
+        out = control.RestartReplica().execute(d, self._ctx(sup))
+        assert out["old_pid"] == old and out["pid"] != old
+        assert sup.alive("r0")
+        sup.stop_all(wait=2.0)
+
+    def test_drain_restart_sigterms_first(self):
+        # a child that exits 0 on SIGTERM = the serve_replica contract
+        prog = ("import signal, sys, time\n"
+                "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+                "while True: time.sleep(0.1)\n")
+        sup = Supervisor()
+        sup.spawn("r0", [sys.executable, "-c", prog])
+        time.sleep(0.5)   # let the handler install
+        d = control.Decision(parse_rules(
+            "cache_hit_rate<0.5:for=1:action=drain_restart")[0], "r0", 0.0)
+        out = control.DrainRestart().execute(d, self._ctx(sup))
+        assert out["drained"] is True and sup.alive("r0")
+        sup.stop_all(signal.SIGKILL, wait=2.0)
+
+    def test_unsupervised_target_is_action_error(self):
+        d = control.Decision(parse_rules(
+            "alive<1:for=1:action=restart_replica")[0], "ghost", 0.0)
+        with pytest.raises(ActionError):
+            control.RestartReplica().execute(d, self._ctx(Supervisor()))
+
+    def test_evict_replace_validates_target(self):
+        cfg = ControlConfig(coord="127.0.0.1:1")
+        ctx = type("Ctx", (), {"supervisor": None, "cfg": cfg})()
+        d = control.Decision(parse_rules(
+            "straggler>0:for=1:action=evict_replace")[0], "r0", 1.0)
+        with pytest.raises(ActionError):
+            control.EvictReplace().execute(d, ctx)   # not a rank target
+        cfg2 = ControlConfig(coord=None)
+        ctx2 = type("Ctx", (), {"supervisor": None, "cfg": cfg2})()
+        d2 = control.Decision(d.rule, "rank2", 1.0)
+        with pytest.raises(ActionError):
+            control.EvictReplace().execute(d2, ctx2)  # no coordinator
+
+
+# -- fail-fast eviction policy (MXNET_ELASTIC_EXIT_ON_EVICT) -------------------
+class TestExitOnEvict:
+    def test_off_by_default_no_exit(self, monkeypatch):
+        from mxnet_tpu import kvstore as kv
+
+        called = []
+        monkeypatch.setattr(os, "_exit", lambda code: called.append(code))
+        monkeypatch.delenv("MXNET_ELASTIC_EXIT_ON_EVICT", raising=False)
+        kv._maybe_exit_on_evict(3)
+        assert called == []
+
+    def test_exits_with_evicted_code_when_enabled(self, monkeypatch):
+        from mxnet_tpu import kvstore as kv
+
+        called = []
+        monkeypatch.setattr(os, "_exit", lambda code: called.append(code))
+        monkeypatch.setenv("MXNET_ELASTIC_EXIT_ON_EVICT", "1")
+        with pytest.warns(UserWarning, match="supervised replacement"):
+            kv._maybe_exit_on_evict(3)
+        assert called == [control.EVICTED_EXIT_CODE]
+        assert kv._EVICTED_EXIT_CODE == control.EVICTED_EXIT_CODE
+
+
+# -- report rendering ----------------------------------------------------------
+class TestControllerReport:
+    def test_report_renders_decision_timeline(self, tmp_path):
+        journal = tmp_path / "j.jsonl"
+        recs = [
+            {"kind": "span", "name": "mxctl.rule", "t": 100.0, "dur": 0,
+             "trace": "tr-9", "rule": "alive<1", "metric": "alive",
+             "value": 0.0, "threshold": 1.0, "op": "<", "target": "r1",
+             "action": "restart_replica"},
+            {"kind": "span", "name": "mxctl.action", "t": 100.1,
+             "dur": 0.02, "trace": "tr-9", "action": "restart_replica",
+             "target": "r1", "outcome": "ok", "old_pid": 11, "pid": 22},
+            {"kind": "span", "name": "mxctl.recovery", "t": 103.0,
+             "dur": 2.9, "trace": "tr-9", "rule": "alive<1",
+             "target": "r1", "action": "restart_replica"},
+            {"kind": "metrics", "t": 104.0, "mark": "exit",
+             "counters": {"mxctl.actions_total": 1,
+                          "mxctl.probes_total": 40},
+             "gauges": {}, "histograms": {}},
+        ]
+        journal.write_text(
+            "\n".join(json.dumps(r) for r in recs) + "\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "telemetry_report.py"),
+             str(journal)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        text = out.stdout
+        assert "control plane (mxctl)" in text
+        assert "RULE    alive<1 on r1" in text
+        assert "ACTION  restart_replica on r1" in text and "-> ok" in text
+        assert "pid 11->22" in text
+        assert "RECOVER r1" in text and "tr-9" in text
+        assert "actions_total=1" in text
+
+    def test_report_without_mxctl_records_has_no_section(self, tmp_path):
+        journal = tmp_path / "j.jsonl"
+        journal.write_text(json.dumps(
+            {"kind": "metrics", "t": 1.0, "mark": "exit",
+             "counters": {"engine.push_total": 1}, "gauges": {},
+             "histograms": {}}) + "\n")
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "telemetry_report.py"),
+             str(journal)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0
+        assert "control plane" not in out.stdout
+
+
+# -- elastic client admin surface ----------------------------------------------
+class TestEvictWrapper:
+    def test_evict_addresses_the_target_rank(self, monkeypatch):
+        """The admin evict wrapper must address the TARGET rank, not
+        the client's own identity (the rank-override in call())."""
+        from mxnet_tpu.elastic.client import ElasticClient
+        from mxnet_tpu.elastic import protocol
+
+        seen = {}
+
+        def fake_call(addr, req, timeout=30.0):
+            seen.update(req)
+            return {"status": "ok", "epoch": 4, "live": [0, 1]}
+
+        monkeypatch.setattr(protocol, "call", fake_call)
+        client = ElasticClient("127.0.0.1:9", rank=-1)
+        resp = client.evict(2)
+        assert seen["op"] == "evict" and seen["rank"] == 2
+        assert resp["epoch"] == 4 and resp["live"] == [0, 1]
+        # ordinary ops still speak the client's own rank
+        client.view()
+        assert seen["op"] == "view" and seen["rank"] == -1
+
+
+# -- multi-process legs (slow) -------------------------------------------------
+@pytest.mark.slow
+class TestChaosControllerLegs:
+    def test_chaos_flap_leg(self):
+        """The cheapest multi-process proof: a real controller + a real
+        flapping replica, zero actions. The serving/straggler legs run
+        via tools/chaos.py --controller (docs recipe)."""
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chaos.py"),
+             "--controller", "--controller-legs", "flap",
+             "--timeout", "1000"],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+        assert "RESULT: SURVIVED" in out.stdout

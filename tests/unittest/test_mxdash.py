@@ -157,6 +157,84 @@ class TestServer:
         sz = json.loads(_get("/servingz"))
         assert isinstance(sz["engines"], list)
 
+    def test_readyz_split_from_healthz(self, monkeypatch):
+        """ISSUE 12 satellite: /readyz is readiness (accepting work),
+        /healthz liveness — a process marked starting/stopping answers
+        alive-but-not-ready (503 with the reason)."""
+        _enable(monkeypatch, http="0")
+        port = telemetry.server.port()
+        assert _get("/readyz") == "ready\n"
+        telemetry.server.mark_ready(False, "starting")
+        try:
+            assert _get("/healthz") == "ok\n"     # still alive
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:%d/readyz" % port, timeout=10)
+            assert ei.value.code == 503
+            assert "starting" in ei.value.read().decode()
+        finally:
+            telemetry.server.mark_ready(True)
+        assert _get("/readyz") == "ready\n"
+
+    def test_readyz_reflects_engine_drain(self, monkeypatch, tmp_path):
+        """A draining serving engine makes the process not-ready (the
+        controller's drain-then-restart observation point) without
+        touching liveness."""
+        _enable(monkeypatch, http="0")
+        port = telemetry.server.port()
+        import jax
+
+        from mxnet_tpu.models.transformer import (TransformerConfig,
+                                                  init_params)
+        from mxnet_tpu.serving import Engine, ServingConfig
+
+        cfg = TransformerConfig(vocab_size=31, num_layers=1, d_model=16,
+                                num_heads=2, d_ff=32, max_seq_len=32,
+                                dtype="float32")
+        eng = Engine(init_params(cfg, jax.random.PRNGKey(0)), cfg,
+                     ServingConfig(block_size=8, num_blocks=9,
+                                   max_batch=2, prefill_chunk=8))
+        eng.drain()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:%d/readyz" % port, timeout=10)
+            assert ei.value.code == 503
+            assert "draining" in ei.value.read().decode()
+            assert _get("/healthz") == "ok\n"
+            sz = json.loads(_get("/servingz"))
+            assert sz["engines"][0]["draining"] is True
+            assert sz["engines"][0]["drained"] is True
+        finally:
+            eng.resume()
+        assert _get("/readyz") == "ready\n"
+
+    def test_ready_env_initial_state(self, monkeypatch):
+        """MXNET_TELEMETRY_READY=0 boots the process not-ready (the
+        supervised-replica contract: /readyz must not say ready during
+        package import, before user code can mark 'starting')."""
+        from mxnet_tpu.telemetry import server as srv
+
+        monkeypatch.setattr(srv, "_ready", False)
+        monkeypatch.setattr(srv, "_ready_reason",
+                            "starting (MXNET_TELEMETRY_READY=0)")
+        ok, reasons = srv.is_ready()
+        assert not ok and "MXNET_TELEMETRY_READY" in reasons[0]
+        srv.mark_ready(True)
+        assert srv.is_ready() == (True, [])
+        # the initializer itself honors the env spelling
+        import subprocess as sp
+        import sys as _sys
+
+        out = sp.run(
+            [_sys.executable, "-c",
+             "import mxnet_tpu.telemetry.server as s; "
+             "print(s.is_ready()[0])"],
+            env=dict(os.environ, MXNET_TELEMETRY_READY="0",
+                     JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120)
+        assert out.stdout.strip() == "False", out.stderr
+
     def test_unknown_endpoint_404(self, monkeypatch):
         _enable(monkeypatch, http="0")
         port = telemetry.server.port()

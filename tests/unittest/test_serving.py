@@ -406,3 +406,82 @@ def test_telemetry_report_serving_section(model, monkeypatch, tmp_path):
     assert "serving engine (mxserve)" in r.stdout
     assert "ttft" in r.stdout and "per-token" in r.stdout
     assert "admitted=3" in r.stdout and "completed=3" in r.stdout
+
+
+class TestDrain:
+    """Graceful drain (ISSUE 12 satellite): admissions stop, in-flight
+    requests finish losslessly, the drained state is deterministic and
+    introspectable, resume() reopens."""
+
+    def test_drain_rejects_new_finishes_inflight(self, model):
+        eng = _mk_engine(model)
+        rng = np.random.RandomState(5)
+        handles = [eng.submit(p, max_new_tokens=5)
+                   for p in _prompts(rng, 3, model[0].vocab_size)]
+        assert eng.accepting()
+        assert eng.drain() is False          # in-flight work remains
+        assert not eng.accepting()
+        before = eng.stats()["rejected"]
+        with pytest.raises(QueueFullError):
+            eng.submit(_prompts(rng, 1, model[0].vocab_size)[0])
+        assert eng.stats()["rejected"] == before + 1
+        eng.run_until_idle()
+        assert eng.drained
+        # nothing the clients were promised was lost
+        for h in handles:
+            assert len(h.result()) == 5 and h.status == "finished"
+        st = eng.stats()
+        assert st["draining"] and st["drained"]
+        assert ("drained", -1) in eng.sched.events
+        assert eng.sched.counts["drained"] == 1
+
+    def test_drain_on_idle_engine_latches_immediately(self, model):
+        eng = _mk_engine(model)
+        assert eng.drain() is True
+        assert eng.drained and not eng.accepting()
+
+    def test_resume_reopens_admissions(self, model):
+        eng = _mk_engine(model)
+        eng.drain()
+        assert eng.drained
+        eng.resume()
+        assert eng.accepting() and not eng.drained and not eng.draining
+        rng = np.random.RandomState(6)
+        h = eng.submit(_prompts(rng, 1, model[0].vocab_size)[0],
+                       max_new_tokens=3)
+        eng.run_until_idle()
+        assert len(h.result()) == 3
+
+    def test_drain_wait_blocks_until_background_loop_finishes(self, model):
+        eng = _mk_engine(model)
+        rng = np.random.RandomState(7)
+        handles = [eng.submit(p, max_new_tokens=4)
+                   for p in _prompts(rng, 2, model[0].vocab_size)]
+        eng.start()
+        try:
+            assert eng.drain(wait=True, timeout=60.0) is True
+            assert eng.drained
+            for h in handles:
+                assert len(h.result()) == 4
+        finally:
+            eng.stop()
+
+    def test_introspect_reports_drain_state(self, model):
+        eng = _mk_engine(model)
+        out = eng.introspect()
+        assert out["draining"] is False and out["drained"] is False
+        eng.drain()
+        out = eng.introspect()
+        assert out["draining"] is True and out["drained"] is True
+
+    def test_drain_counted_in_telemetry(self, model, monkeypatch):
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        tel.reset()
+        tel.reload()
+        eng = _mk_engine(model)
+        eng.drain()
+        snap = tel.snapshot()["counters"]
+        assert snap["serving.drains_total"] == 1
+        # the drained completion is a journaled event (serve.drained)
+        names = [r["name"] for r in tel.span_tail(20)]
+        assert "serve.drained" in names

@@ -20,6 +20,7 @@ Usage::
     python tools/chaos.py --guardian    # grad.nan/loss.spike survival legs
     python tools/chaos.py --schedules   # thread-schedule survival legs
     python tools/chaos.py --proto       # protocol message-schedule legs
+    python tools/chaos.py --controller  # mxctl closed-loop autonomy legs
 
 The spec is derived deterministically from --seed: per point, a fire
 probability in [0.02, 0.15] and a per-point RNG seed. Same seed, same
@@ -962,6 +963,471 @@ def run_proto(args):
     return 0
 
 
+# -- mxctl closed-loop control-plane survival legs -----------------------------
+# The ISSUE-12 acceptance contract: the mxctl controller
+# (python -m mxnet_tpu.control, docs/how_to/control_plane.md) must
+# close the loop end-to-end, asserted entirely from journals:
+#   (a) SIGKILL a serving replica -> the liveness rule fires, the
+#       restart_replica actuator respawns it, capacity and the
+#       queue-depth SLO recover within a bounded window
+#       (mxctl.actions_total >= 1, mxctl.recovery event with its
+#       duration in the report);
+#   (b) an injected persistent training straggler -> trace_merge
+#       attribution names it, the controller admin-evicts it through
+#       the elastic coordinator, the worker exits
+#       (MXNET_ELASTIC_EXIT_ON_EVICT) and the launcher respawns a
+#       healthy incarnation that rejoins; survivors finish within
+#       accuracy tolerance;
+#   (c) flap-guard negative control: a noisy-but-healthy replica
+#       (readiness dips shorter than every rule's for= window) breaches
+#       rules but triggers ZERO actions — hysteresis holds.
+
+def _http_ok(url, timeout=2.0):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status == 200
+    except Exception:  # noqa: BLE001 - any failure = not serving
+        return False
+
+
+def _wait_until(fn, deadline_s, interval=0.5):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _read_state(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _journal_events(path, prefix="mxctl."):
+    """The controller's decision journal: every span/event record whose
+    name starts with ``prefix``, in file order."""
+    out = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "span" and \
+                        str(rec.get("name", "")).startswith(prefix):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _stop_proc(proc, log_path, grace=30.0):
+    """SIGTERM -> wait -> killpg. Returns (rc, log text). The
+    controller and its replicas write to a LOG FILE, never a pipe the
+    harness forgets to drain — a supervised child blocking on a full
+    pipe buffer is indistinguishable from the wedged replica the
+    controller hunts (found the hard way)."""
+    import signal as _signal
+
+    hung = ""
+    if proc.poll() is None:
+        proc.terminate()
+    try:
+        proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        hung = "\n<controller HUNG: SIGKILLed>"
+    try:
+        with open(log_path, "r", encoding="utf-8", errors="replace") as f:
+            out = f.read()
+    except OSError:
+        out = ""
+    return proc.returncode, out + hung
+
+
+def _spawn_logged(cmd, env, log_path):
+    log_f = open(log_path, "ab")
+    try:
+        return subprocess.Popen(cmd, cwd=REPO, env=env, stdout=log_f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+    finally:
+        log_f.close()
+
+
+def _controller_env(scratch, tag, extra):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": os.path.join(
+            scratch, tag + "-mxctl-journal.jsonl"),
+        "MXNET_TELEMETRY_FLUSH_SECS": "1",
+        "MXCTL_STATE": os.path.join(scratch, tag + "-state.json"),
+        "MXCTL_REPLICA_LOG": os.path.join(scratch, tag + "-{name}.log"),
+        # respawns must come back warm: a shared persistent jit cache
+        # is what makes restart-recovery fast enough to matter
+        "MXNET_COMPILE_CACHE_DIR": os.path.join(scratch, "jit-cache"),
+    })
+    for k in list(env):
+        if k.startswith("MXCTL_") and k not in ("MXCTL_STATE",):
+            if k not in extra:
+                del env[k]
+    env.update(extra)
+    return env
+
+
+def _replica_ready(port):
+    """Truly ready: /readyz answers 200 (the replica passed warmup and
+    called mark_ready) AND /servingz lists a live engine. /readyz alone
+    is not enough — a process still importing reports the default
+    process-level ready with no engine behind it."""
+    import urllib.request
+
+    if not _http_ok("http://127.0.0.1:%d/readyz" % port):
+        return False
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/servingz" % port, timeout=2) as r:
+            return bool(json.load(r).get("engines"))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _serving_leg(scratch, base_port, per_leg, failures):
+    """Leg (a): SIGKILL a serving replica; the controller restores it."""
+    tag = "serve"
+    serve = os.path.join(REPO, "tests", "nightly", "serve_replica.py")
+    targets = {"r0": base_port, "r1": base_port + 1}
+    env = _controller_env(scratch, tag, {
+        "MXCTL_TARGETS": ",".join(
+            "%s=http://127.0.0.1:%d" % (n, p)
+            for n, p in sorted(targets.items())),
+        "MXCTL_RULES": "alive<1:for=3:action=restart_replica:cooldown=10",
+        "MXCTL_INTERVAL": "0.4",
+        # a contended box can hold a cold import past the default 10s
+        # grace; a startup restart is harmless but muddies the report
+        "MXCTL_STARTUP_GRACE": "45",
+        "MXCTL_REPLICA_JOURNAL": os.path.join(
+            scratch, tag + "-{name}-journal.jsonl"),
+    })
+    cmd = [sys.executable, "-m", "mxnet_tpu.control"]
+    for n in sorted(targets):
+        cmd += ["--replica", "%s=%s %s" % (n, sys.executable, serve)]
+    print("chaos --controller: serving leg (SIGKILL replica r1, "
+          "controller restores capacity)")
+    t_start = time.time()
+    ctl_log = os.path.join(scratch, tag + "-controller.log")
+    proc = _spawn_logged(cmd, env, ctl_log)
+    state_path = env["MXCTL_STATE"]
+    journal = env["MXNET_TELEMETRY_JOURNAL"]
+    try:
+        ready = _wait_until(
+            lambda: all(_replica_ready(p) for p in targets.values()),
+            min(0.6 * per_leg, 240))
+        if not ready:
+            failures.append("serving leg: replicas never became ready")
+            return {}
+        warm_s = time.time() - t_start
+        old_pid = _read_state(state_path).get(
+            "replicas", {}).get("r1", {}).get("pid")
+        if not old_pid:
+            failures.append("serving leg: no r1 pid in the state file")
+            return {}
+        os.kill(int(old_pid), 9)  # the chaos injection
+        t_kill = time.time()
+        recovered = _wait_until(
+            lambda: (_http_ok("http://127.0.0.1:%d/healthz"
+                              % targets["r1"])
+                     and _read_state(state_path).get("replicas", {})
+                     .get("r1", {}).get("pid") not in (None, old_pid)),
+            min(0.35 * per_leg, 150))
+        recovery_wall = time.time() - t_kill
+        if not recovered:
+            failures.append("serving leg: controller did not restore "
+                            "replica r1 within %.0fs"
+                            % min(0.35 * per_leg, 150))
+        # wait for the respawned incarnation to finish warmup (fast —
+        # the shared jit cache), then let it actually serve: the
+        # SLO-recovery assertions below read ITS journal, which only
+        # lands if the graceful teardown reaches a warmed replica
+        if not _wait_until(lambda: _replica_ready(targets["r1"]),
+                           min(0.25 * per_leg, 120)):
+            failures.append("serving leg: restored r1 never became "
+                            "ready again")
+        time.sleep(3)  # let the restored replica serve
+    finally:
+        rc, out = _stop_proc(proc, ctl_log)
+    if rc != 0:
+        failures.append("serving leg: controller exited %d\n%s"
+                        % (rc, out[-2000:]))
+    counters = fold_telemetry(journal)
+    if counters.get("mxctl.actions_total", 0) < 1:
+        failures.append("serving leg: mxctl.actions_total=0 — the loop "
+                        "never closed (counters: %s)" % counters)
+    events = _journal_events(journal)
+    actions = [e for e in events if e["name"] == "mxctl.action"
+               and e.get("outcome") == "ok"]
+    if not any(e.get("action") == "restart_replica"
+               and e.get("target") == "r1" for e in actions):
+        failures.append("serving leg: no successful restart_replica "
+                        "action on r1 in the journal (%s)"
+                        % [(e.get("action"), e.get("target"),
+                            e.get("outcome")) for e in events
+                           if e["name"] == "mxctl.action"])
+    recoveries = [e for e in events if e["name"] == "mxctl.recovery"
+                  and e.get("target") == "r1"]
+    if not recoveries:
+        failures.append("serving leg: no mxctl.recovery event for r1 — "
+                        "the SLO never came back")
+    rec_s = recoveries[0]["dur"] if recoveries else None
+    if rec_s is not None and rec_s > 60.0:
+        failures.append("serving leg: recovery took %.1fs (> 60s bound)"
+                        % rec_s)
+    # the rule trace must link detect->act->recover as ONE causal chain
+    rules_fired = [e for e in events if e["name"] == "mxctl.rule"
+                   and e.get("target") == "r1"]
+    if rules_fired and actions:
+        traces = {e.get("trace") for e in rules_fired}
+        if not any(a.get("trace") in traces for a in actions):
+            failures.append("serving leg: action events do not share the "
+                            "firing rule's trace id")
+    # SLO recovery from the REPLICA's journal: the respawned
+    # incarnation admitted work and its queue is not saturated
+    rj = os.path.join(scratch, tag + "-r1-journal.jsonl")
+    rcounters = fold_telemetry(rj)
+    if rcounters.get("serving.requests_admitted", 0) < 1:
+        failures.append("serving leg: restored r1 admitted no requests "
+                        "(journal %s: %s)" % (rj, rcounters))
+    qd = fold_gauges(rj).get("serving.queue_depth")
+    if qd is not None and qd >= 64:
+        failures.append("serving leg: restored r1's queue is saturated "
+                        "(depth %g)" % qd)
+    return {"warm_s": warm_s, "recovery_s": rec_s,
+            "recovery_wall_s": recovery_wall, "counters": counters}
+
+
+def _straggler_leg(scratch, port, per_leg, base_acc, failures):
+    """Leg (b): persistent training straggler -> evict-and-replace."""
+    tag = "straggler"
+    mark = tempfile.mkdtemp(prefix="slowmark-", dir=scratch)
+    env = _controller_env(scratch, tag, {
+        "MXCTL_COORD": "127.0.0.1:%d" % port,
+        # digit-only glob: the coordinator's own journal (-coord) must
+        # never enter worker straggler attribution
+        "MXCTL_JOURNALS": os.path.join(scratch,
+                                       tag + "-journal-[0-9]*.jsonl"),
+        "MXCTL_RULES": ("straggler>0:for=3:action=evict_replace"
+                        ":cooldown=300:scope=training:max=1"),
+        "MXCTL_INTERVAL": "1.5",
+        "MXCTL_STRAGGLER_MIN_WAIT": "3.0",
+    })
+    print("chaos --controller: straggler leg (rank 2 drags every round; "
+          "controller evicts, launcher replaces)")
+    ctl_log = os.path.join(scratch, tag + "-controller.log")
+    ctl = _spawn_logged([sys.executable, "-m", "mxnet_tpu.control"],
+                        env, ctl_log)
+    try:
+        rc, accs, c, out = _run_elastic_leg(
+            tag, scratch, port, per_leg,
+            extra_env={
+                "MXNET_ELASTIC_TEST_SLOW_RANK": "2",
+                "MXNET_ELASTIC_TEST_SLOW_SECS": "0.4",
+                "MXNET_ELASTIC_TEST_MARK": mark,
+                "MXNET_ELASTIC_EXIT_ON_EVICT": "1",
+            },
+            launch_args=["--max-restarts", "1", "--restart-delay", "1"])
+    finally:
+        ctl_rc, ctl_out = _stop_proc(ctl, ctl_log)
+    if rc != 0 or len(accs) != _ELASTIC_N:
+        failures.append("straggler leg: not every rank (incl. the "
+                        "replaced straggler) finished (rc=%d done=%s)\n%s"
+                        % (rc, sorted(accs), out[-2000:]))
+    if base_acc is not None and accs and \
+            base_acc - min(accs.values()) > _ELASTIC_ACC_TOL:
+        failures.append("straggler leg: accuracy %.3f fell more than "
+                        "%.2f below fault-free %.3f"
+                        % (min(accs.values()), _ELASTIC_ACC_TOL, base_acc))
+    journal = env["MXNET_TELEMETRY_JOURNAL"]
+    counters = fold_telemetry(journal)
+    events = _journal_events(journal)
+    evicts = [e for e in events if e["name"] == "mxctl.action"
+              and e.get("action") == "evict_replace"
+              and e.get("outcome") == "ok"]
+    if not evicts:
+        failures.append(
+            "straggler leg: no successful evict_replace action in the "
+            "controller journal (rc=%d, events: %s)\n%s"
+            % (ctl_rc, [(e.get("name"), e.get("action"), e.get("target"),
+                         e.get("outcome")) for e in events],
+               ctl_out[-1500:]))
+    elif evicts[0].get("target") != "rank2":
+        failures.append("straggler leg: controller evicted %s, not the "
+                        "injected straggler rank2"
+                        % evicts[0].get("target"))
+    if c.get("kvstore.evictions_total", 0) < 1:
+        failures.append("straggler leg: workers saw no eviction "
+                        "(counters: %s)" % c)
+    if c.get("kvstore.rejoins_total", 0) < 1:
+        failures.append("straggler leg: the replacement never rejoined "
+                        "(counters: %s)" % c)
+    return {"counters": counters, "worker_counters": c,
+            "accs": accs, "evict_target": (evicts[0].get("target")
+                                           if evicts else None)}
+
+
+def _flap_leg(scratch, port, per_leg, failures):
+    """Leg (c): noisy-but-healthy replica -> zero actions."""
+    tag = "flap"
+    serve = os.path.join(REPO, "tests", "nightly", "serve_replica.py")
+    env = _controller_env(scratch, tag, {
+        "MXCTL_TARGETS": "r0=http://127.0.0.1:%d" % port,
+        # for=10 @ 0.5s = 5s sustained: the injected dips are ~0.6-1.5s
+        # (flap thread sleep granularity + GIL stalls), leaving >3x
+        # margin on a busy box while every dip still lands >=1 probe
+        "MXCTL_RULES": ("ready<1:for=10:action=restart_replica:cooldown=30;"
+                        "alive<1:for=10:action=restart_replica:cooldown=30"),
+        "MXCTL_INTERVAL": "0.5",
+        "MXCTL_STARTUP_GRACE": "45",
+        "MXCTL_REPLICA_JOURNAL": os.path.join(
+            scratch, tag + "-{name}-journal.jsonl"),
+        # drain for 0.6s every 2.5s via the replica's dedicated flap
+        # thread: readiness dips 1-3 probes long, never 10 consecutive
+        "SERVE_REPLICA_FLAP": "2.5,0.6",
+        # lighter load: fewer distinct late-compiling shapes churning
+        # the GIL while the negative control measures
+        "SERVE_REPLICA_LOAD": "2,0.4,6",
+    })
+    print("chaos --controller: flap-guard leg (readiness flaps, "
+          "hysteresis must hold: zero actions)")
+    ctl_log = os.path.join(scratch, tag + "-controller.log")
+    proc = _spawn_logged(
+        [sys.executable, "-m", "mxnet_tpu.control",
+         "--replica", "r0=%s %s" % (sys.executable, serve)],
+        env, ctl_log)
+    try:
+        ready = _wait_until(lambda: _replica_ready(port),
+                            min(0.6 * per_leg, 240))
+        if ready:
+            time.sleep(20)  # measure across ~6 flap cycles, ~40 probes
+        else:
+            failures.append("flap leg: replica never came up")
+    finally:
+        rc, out = _stop_proc(proc, ctl_log)
+    if rc != 0:
+        failures.append("flap leg: controller exited %d\n%s"
+                        % (rc, out[-2000:]))
+    counters = fold_telemetry(env["MXNET_TELEMETRY_JOURNAL"])
+    if counters.get("mxctl.breaches_total", 0) < 1:
+        failures.append("flap leg: the replica never actually breached "
+                        "(counters: %s) — the negative control proves "
+                        "nothing" % counters)
+    acted = (counters.get("mxctl.actions_total", 0)
+             + counters.get("mxctl.actions_dryrun_total", 0)
+             + counters.get("mxctl.actions_failed_total", 0))
+    if acted:
+        failures.append("flap leg: a noisy-but-healthy replica drew %d "
+                        "action(s) — hysteresis failed (counters: %s)"
+                        % (acted, counters))
+    return {"counters": counters}
+
+
+def run_controller(args):
+    """The mxctl closed-loop survival legs (ISSUE 12)."""
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-mxctl-")
+    base_port = 29820 + (args.seed % 97) * 8
+    legs = [s.strip() for s in (args.controller_legs or "all").split(",")]
+    run_all = "all" in legs
+    per_leg = args.timeout / 4.0
+    failures = []
+    serve_rep = strag_rep = flap_rep = None
+    base_acc = None
+
+    if run_all or "serving" in legs:
+        serve_rep = _serving_leg(scratch, base_port, per_leg, failures)
+    if run_all or "straggler" in legs:
+        print("chaos --controller: straggler baseline (fault-free)")
+        rc0, accs0, _c0, out0 = _run_elastic_leg(
+            "cbase", scratch, base_port + 2, per_leg)
+        if rc0 != 0 or len(accs0) != _ELASTIC_N:
+            failures.append("straggler baseline failed (rc=%d done=%s)\n%s"
+                            % (rc0, sorted(accs0), out0[-2000:]))
+        else:
+            base_acc = sum(accs0.values()) / len(accs0)
+        strag_rep = _straggler_leg(scratch, base_port + 3, per_leg,
+                                   base_acc, failures)
+    if run_all or "flap" in legs:
+        flap_rep = _flap_leg(scratch, base_port + 7, per_leg, failures)
+
+    print("\n=== controller survival report ===")
+    if serve_rep is not None:
+        c = serve_rep.get("counters", {})
+        print("serving leg     : warm %.1fs, recovery %s (wall %.1fs), "
+              "probes=%d actions=%d failed=%d recoveries=%d"
+              % (serve_rep.get("warm_s", -1),
+                 "%.1fs" % serve_rep["recovery_s"]
+                 if serve_rep.get("recovery_s") is not None else "NONE",
+                 serve_rep.get("recovery_wall_s", -1),
+                 c.get("mxctl.probes_total", 0),
+                 c.get("mxctl.actions_total", 0),
+                 c.get("mxctl.actions_failed_total", 0),
+                 c.get("mxctl.recoveries_total", 0)))
+    if strag_rep is not None:
+        c = strag_rep.get("counters", {})
+        w = strag_rep.get("worker_counters", {})
+        print("straggler leg   : evicted=%s actions=%d evictions=%d "
+              "rejoins=%d accs=%s (baseline %s)"
+              % (strag_rep.get("evict_target"),
+                 c.get("mxctl.actions_total", 0),
+                 w.get("kvstore.evictions_total", 0),
+                 w.get("kvstore.rejoins_total", 0),
+                 {r: round(a, 3)
+                  for r, a in sorted(strag_rep.get("accs", {}).items())},
+                 "%.4f" % base_acc if base_acc is not None else "FAILED"))
+    if flap_rep is not None:
+        c = flap_rep.get("counters", {})
+        print("flap leg        : breaches=%d fired=%d actions=%d "
+              "(zero required)"
+              % (c.get("mxctl.breaches_total", 0),
+                 c.get("mxctl.rules_fired_total", 0),
+                 c.get("mxctl.actions_total", 0)
+                 + c.get("mxctl.actions_dryrun_total", 0)))
+    if failures:
+        print("\nRESULT: FAIL")
+        for f in failures:
+            print(" - %s" % f)
+        return 9
+    proofs = []
+    if serve_rep is not None:
+        proofs.append("detected a SIGKILLed serving replica and "
+                      "restored capacity within the SLO window")
+    if strag_rep is not None:
+        proofs.append("attributed and evict-replaced a persistent "
+                      "training straggler (survivors within %.2f of "
+                      "fault-free accuracy)" % _ELASTIC_ACC_TOL)
+    if flap_rep is not None:
+        proofs.append("held every action back from a noisy-but-healthy "
+                      "replica")
+    print("\nRESULT: SURVIVED — the controller %s — all proven from "
+          "the mxctl decision journal." % "; ".join(proofs))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run the test suite under a seeded fault spec")
@@ -1015,10 +1481,25 @@ def main(argv=None):
                          "delivery/loss/duplication/crash/restart "
                          "schedule (MXPROTO_SCHEDULES overrides the "
                          "per-leg budget)")
+    ap.add_argument("--controller", action="store_true",
+                    help="run the mxctl closed-loop survival legs "
+                         "(ISSUE 12): SIGKILL a serving replica -> the "
+                         "controller restores capacity and the SLO "
+                         "recovers; an injected training straggler is "
+                         "attributed, evicted and replaced; a noisy-but-"
+                         "healthy replica draws ZERO actions (hysteresis "
+                         "negative control) — all asserted from the "
+                         "mxctl.* decision journal")
+    ap.add_argument("--controller-legs", default="all",
+                    metavar="LEGS",
+                    help="comma subset of the --controller legs: "
+                         "serving,straggler,flap (default all)")
     ap.add_argument("tests", nargs="*",
                     help="explicit test paths (default: smoke set)")
     args = ap.parse_args(argv)
 
+    if args.controller:
+        return run_controller(args)
     if args.elastic:
         return run_elastic(args)
     if args.guardian:

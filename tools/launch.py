@@ -43,6 +43,21 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _load_supervisor():
+    """mxnet_tpu/control/supervisor.py by file path (the trace_merge
+    pattern): the launcher shares the respawn machinery with the mxctl
+    control plane without paying the framework/jax import just to
+    supervise processes."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_launch_supervisor",
+        os.path.join(REPO, "mxnet_tpu", "control", "supervisor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _worker_env(args, rank):
     env = dict(os.environ)
     env.update({
@@ -92,6 +107,15 @@ def _start_coordinator(args):
         coord_cmd += ["--snapshot-secs", str(args.snapshot_secs)]
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the coordinator is NOT a rank: expand the {rank} journal template
+    # as "coord" (a literal "{rank}" file with rank-0 meta poisons
+    # trace_merge straggler attribution over the worker glob), and drop
+    # the introspection port — the plain-base-port fan-out would have
+    # it collide with rank 0's
+    journal = env.get("MXNET_TELEMETRY_JOURNAL", "")
+    if "{rank}" in journal:
+        env["MXNET_TELEMETRY_JOURNAL"] = journal.format(rank="coord")
+    env.pop("MXNET_TELEMETRY_HTTP", None)
     proc = subprocess.Popen(coord_cmd, env=env)
     deadline = time.monotonic() + 30.0
     while time.monotonic() < deadline:
@@ -110,62 +134,43 @@ def _start_coordinator(args):
 
 def launch_local(args, cmd):
     coordinator = _start_coordinator(args) if args.elastic else None
-    procs = {r: subprocess.Popen(cmd, env=_worker_env(args, r))
-             for r in range(args.num_workers)}
+    sup = _load_supervisor().Supervisor()
+    for r in range(args.num_workers):
+        sup.spawn(str(r), cmd, env=_worker_env(args, r))
     # restarts only make sense in elastic mode: a respawned worker can
     # rejoin the elastic group, but a formed jax.distributed job can
     # never re-admit it — the restart would just wedge the collectives
-    restarts_left = args.max_restarts if args.elastic else 0
-    failed = {}  # rank -> exit code of its FINAL incarnation
-    pending = {}  # rank -> monotonic respawn deadline (--restart-delay)
+    restarts = args.max_restarts if args.elastic else 0
+
+    def _on_restart(name, rc, restarts_left, delay):
+        # a deferred respawn (--restart-delay, non-blocking: other
+        # workers stay supervised) held past the coordinator's
+        # MXNET_KV_EVICT_AFTER window guarantees the dead incarnation
+        # is EVICTED before the new one registers — so the rejoin
+        # counter proves a real recovery instead of racing the
+        # eviction sweep (chaos.py --elastic)
+        print("launch: worker %s exited %d — restarting "
+              "(%d restart(s) left%s)"
+              % (name, rc, restarts_left,
+                 ", after %.1fs" % delay if delay > 0 else ""),
+              file=sys.stderr)
+
     try:
-        while procs or pending:
-            time.sleep(0.2)
-            now = time.monotonic()
-            for rank in [r for r, t in pending.items() if now >= t]:
-                del pending[rank]
-                procs[rank] = subprocess.Popen(
-                    cmd, env=_worker_env(args, rank))
-            for rank, p in list(procs.items()):
-                rc = p.poll()
-                if rc is None:
-                    continue
-                del procs[rank]
-                if rc == 0:
-                    failed.pop(rank, None)
-                    continue
-                if restarts_left > 0:
-                    restarts_left -= 1
-                    print("launch: worker %d exited %d — restarting "
-                          "(%d restart(s) left%s)"
-                          % (rank, rc, restarts_left,
-                             ", after %.1fs" % args.restart_delay
-                             if args.restart_delay > 0 else ""),
-                          file=sys.stderr)
-                    if args.restart_delay > 0:
-                        # deferred respawn (non-blocking: other workers
-                        # stay supervised): holding the replacement past
-                        # the coordinator's MXNET_KV_EVICT_AFTER window
-                        # guarantees the dead incarnation is EVICTED
-                        # before the new one registers — so the rejoin
-                        # counter proves a real recovery instead of
-                        # racing the eviction sweep (chaos.py --elastic)
-                        pending[rank] = now + args.restart_delay
-                    else:
-                        procs[rank] = subprocess.Popen(
-                            cmd, env=_worker_env(args, rank))
-                else:
-                    failed[rank] = rc
+        failed = sup.run_to_completion(
+            max_restarts=restarts, restart_delay=args.restart_delay,
+            on_restart=_on_restart)
     except KeyboardInterrupt:
-        for p in procs.values():
-            p.send_signal(signal.SIGTERM)
-        for p in procs.values():
-            p.wait()
+        # wait=None: SIGTERM then wait indefinitely, never escalating —
+        # a worker flushing its journal or finishing an atomic .params
+        # write must not be SIGKILLed into a torn file (the original
+        # launcher's Ctrl-C contract)
+        sup.stop_all(signal.SIGTERM, wait=None)
         return 1
     finally:
         if coordinator is not None:
             coordinator.terminate()
             coordinator.wait()
+    failed = {int(r): rc for r, rc in failed.items()}
     if failed and len(failed) > args.tolerate:
         print("launch: worker(s) %s failed (exit codes %s), tolerate=%d"
               % (sorted(failed), failed, args.tolerate), file=sys.stderr)

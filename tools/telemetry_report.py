@@ -16,6 +16,12 @@ run post-mortem starts from:
    snapshot (per-task engine latency, batch fetch, step time, ...),
    plus the final counter and gauge values.
 
+Journals carrying serving or control-plane activity additionally get a
+serving section (tokens/s timeline, TTFT percentiles) and an mxctl
+section: the controller's decision journal rendered as a timeline —
+rule fired -> action taken -> outcome -> recovery, trace ids linking
+each firing to the affected replica's spans.
+
 Given SEVERAL journals (one per rank of an elastic job), a cross-rank
 section is prepended: per-rank step-time / barrier-wait table plus the
 straggler attribution, sharing tools/trace_merge.py's merge machinery
@@ -173,6 +179,62 @@ def serving_section(records):
     return lines
 
 
+def controller_section(records):
+    """Rendered lines for the mxctl decision journal, or [] when the
+    journal has no control-plane records: the detect->decide->act->
+    recover timeline (rule fired -> action taken -> outcome), with each
+    firing's trace id — the same id the affected replica's own spans
+    can be grepped for (docs/how_to/control_plane.md)."""
+    events = [r for r in records
+              if r.get("kind") == "span"
+              and str(r.get("name", "")).startswith("mxctl.")
+              and r.get("name") != "mxctl.probe_error"]
+    final = final_metrics(records)
+    counters = (final or {}).get("counters", {})
+    mx_counters = {k: v for k, v in sorted(counters.items())
+                   if k.startswith("mxctl.")}
+    if not events and not mx_counters:
+        return []
+    lines = ["", "-- control plane (mxctl) --"]
+    events.sort(key=lambda r: r.get("t", 0.0))
+    t0 = events[0].get("t", 0.0) if events else 0.0
+    for e in events:
+        dt = e.get("t", 0.0) - t0
+        name = e["name"]
+        if name == "mxctl.rule":
+            lines.append(
+                "  t+%7.1fs RULE    %s on %-8s %s=%.4g (threshold %s%g)"
+                "  [trace %s]"
+                % (dt, e.get("rule", "?"), e.get("target", "?"),
+                   e.get("metric", "?"), e.get("value", float("nan")),
+                   e.get("op", "?"), e.get("threshold", float("nan")),
+                   e.get("trace")))
+        elif name == "mxctl.action":
+            extra = ""
+            if e.get("pid"):
+                extra = " pid %s->%s" % (e.get("old_pid"), e.get("pid"))
+            if e.get("error"):
+                extra += " (%s)" % e["error"]
+            lines.append(
+                "  t+%7.1fs ACTION  %s on %-8s -> %s in %.2fs%s"
+                % (dt, e.get("action", "?"), e.get("target", "?"),
+                   e.get("outcome", "?"), e.get("dur", 0.0), extra))
+        elif name == "mxctl.recovery":
+            lines.append(
+                "  t+%7.1fs RECOVER %-8s healthy %.1fs after %s"
+                "  [trace %s]"
+                % (dt, e.get("target", "?"), e.get("dur", 0.0),
+                   e.get("action", "the action"), e.get("trace")))
+        else:
+            lines.append("  t+%7.1fs %s %s"
+                         % (dt, name, e.get("target", "")))
+    if mx_counters:
+        lines.append("  counters: " + "  ".join(
+            "%s=%d" % (k.split("mxctl.")[-1], v)
+            for k, v in mx_counters.items()))
+    return lines
+
+
 def _human_bytes(n):
     for unit in ("B", "KB", "MB", "GB"):
         if n < 1024.0 or unit == "GB":
@@ -215,6 +277,7 @@ def render_report(records, top=10):
                wire / logical, logical / wire if wire else float("inf")))
 
     lines.extend(serving_section(records))
+    lines.extend(controller_section(records))
 
     lines.append("")
     lines.append("-- top spans by total time --")
