@@ -45,8 +45,17 @@ import time
 
 import numpy as np
 
-PEAK_BF16 = 197e12  # v5e chip peak, docs/perf_analysis.md
 MFU_TARGET = 0.40
+
+
+def _peak_bf16():
+    # v5e chip peak (docs/perf_analysis.md), promoted into the library
+    # so this leg, /profilez and tools/perf_gate.py share one MFU
+    # denominator. Imported lazily: bench.py's cold-start leg must not
+    # inherit a module-level mxnet_tpu import from this module.
+    from mxnet_tpu.telemetry.prof import DEFAULT_PEAK_BF16
+
+    return DEFAULT_PEAK_BF16
 
 
 def model_flops_per_token(cfg, seq_len):
@@ -140,7 +149,7 @@ def main():
     tokens_per_step = batch * seq
     tok_s = tokens_per_step * steps_run / dt
     flops = model_flops_per_token(cfg, seq) * tok_s
-    mfu = flops / PEAK_BF16
+    mfu = flops / _peak_bf16()
     print(json.dumps({
         "metric": "transformer_lm_train_throughput",
         "value": round(tok_s, 1),

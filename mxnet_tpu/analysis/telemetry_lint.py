@@ -57,8 +57,13 @@ _DOC_TOKEN_RE = re.compile(r"`([^`]+)`")
 _NAME_RE = re.compile(r"^[a-z0-9_*]+(\.[a-z0-9_*]+)+$")
 
 #: files whose counter()/gauge()/histogram() calls are the telemetry
-#: plumbing itself, not metric registrations
-_SKIP_DIRS = (os.path.join("mxnet_tpu", "telemetry"),)
+#: plumbing itself, not metric registrations. prof.py is deliberately
+#: NOT here: mxprof registers real prof.* metrics from inside the
+#: telemetry package and the catalog gate must see them.
+_SKIP_FILES = frozenset(
+    os.path.join("mxnet_tpu", "telemetry", f)
+    for f in ("__init__.py", "registry.py", "export.py", "tracing.py",
+              "server.py", "merge.py"))
 
 
 def _pattern_from_arg(node):
@@ -95,13 +100,13 @@ def collect_code_metrics(pkg_path=None):
     dynamic = []
     for root, dirs, files in os.walk(pkg_path):
         dirs[:] = [d for d in dirs if d != "__pycache__"]
-        if any(root.endswith(s) or (s + os.sep) in root
-               for s in _SKIP_DIRS):
-            continue
         for fname in sorted(files):
             if not fname.endswith(".py"):
                 continue
             path = os.path.join(root, fname)
+            rel_pkg = os.path.relpath(path, os.path.dirname(pkg_path))
+            if rel_pkg in _SKIP_FILES:
+                continue
             with open(path, "r", encoding="utf-8") as f:
                 src = f.read()
             rel = os.path.relpath(path, os.path.dirname(pkg_path))

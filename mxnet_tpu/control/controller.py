@@ -62,7 +62,13 @@ class Controller:
         if probes is not None:
             self.probes = list(probes)
         else:
-            self.probes = [_probes.HttpProbe(name, url)
+            # fetch /tracez only when a rule actually reads the
+            # tracez:<span>:p* namespace — the span-tail pull + sort is
+            # wasted scrape work otherwise
+            want_tracez = any(r.metric.startswith("tracez:")
+                              for r in self.engine.rules)
+            self.probes = [_probes.HttpProbe(name, url,
+                                             tracez=want_tracez)
                            for name, url in cfg.targets.items()]
             if cfg.coord or cfg.journals_glob:
                 self.probes.append(_probes.CoordinatorProbe(

@@ -6,7 +6,11 @@ Two probe families feed the rule engine (rules.py):
   (telemetry/server.py): ``/healthz`` -> ``alive``, ``/readyz`` ->
   ``ready`` (alive-but-draining reports 0), ``/servingz`` -> queue
   depth / TTFT percentiles / tokens-per-s / draining, ``/statusz`` ->
-  jit-cache hit rate. A scrape failure IS the liveness signal: the
+  jit-cache hit rate, and ``/tracez`` -> per-span latency percentiles
+  under the ``tracez:<span>:p50|p95|p99`` metric namespace (computed
+  over the finished-span tail), so rules can key on RPC latency — e.g.
+  ``tracez:elastic.rpc.pull:p99>0.5:for=3:action=...`` — instead of
+  only engine-local stats. A scrape failure IS the liveness signal: the
   sample degrades to ``alive=0`` rather than vanishing, so the
   liveness rule can fire on a SIGKILLed replica whose socket is gone.
 
@@ -32,7 +36,7 @@ import urllib.error
 import urllib.request
 
 __all__ = ["TargetSample", "HttpProbe", "CoordinatorProbe",
-           "serving_metrics", "ProbeError"]
+           "serving_metrics", "tracez_metrics", "ProbeError"]
 
 
 class ProbeError(Exception):
@@ -98,13 +102,61 @@ def serving_metrics(servingz, statusz=None):
     return out
 
 
-class HttpProbe:
-    """Scrape one replica's mxdash endpoints into a TargetSample."""
+def _percentile(sorted_durs, q):
+    """Exact linear-interpolated percentile over a sorted list (the
+    registry.Histogram method, stdlib-only — probes must not need
+    numpy)."""
+    n = len(sorted_durs)
+    if n == 0:
+        return None
+    pos = (q / 100.0) * (n - 1)
+    lo = int(pos)
+    frac = pos - lo
+    if lo + 1 >= n:
+        return float(sorted_durs[-1])
+    return float(sorted_durs[lo] * (1.0 - frac)
+                 + sorted_durs[lo + 1] * frac)
 
-    def __init__(self, name, base_url, timeout=2.0):
+
+def tracez_metrics(tracez):
+    """Pure mapping from a /tracez JSON payload to rule metrics:
+    ``tracez:<span name>:{p50,p95,p99,count}`` per span name present in
+    the finished-span tail (percentiles over the tail's durations —
+    recent behavior, same window philosophy as the registry's reservoir
+    histograms). Lets SLO rules key on RPC/step latency percentiles
+    (the mxctl follow-up from the PR 12 sketch)."""
+    out = {}
+    by_name = {}
+    for rec in (tracez or {}).get("recent", []):
+        name = rec.get("name")
+        dur = rec.get("dur")
+        if name is None or dur is None:
+            continue
+        by_name.setdefault(name, []).append(float(dur))
+    for name, durs in by_name.items():
+        durs.sort()
+        out["tracez:%s:count" % name] = float(len(durs))
+        for q, label in ((50.0, "p50"), (95.0, "p95"), (99.0, "p99")):
+            v = _percentile(durs, q)
+            if v is not None:
+                out["tracez:%s:%s" % (name, label)] = v
+    return out
+
+
+class HttpProbe:
+    """Scrape one replica's mxdash endpoints into a TargetSample.
+
+    ``tracez=True`` additionally fetches ``/tracez`` and derives the
+    ``tracez:<span>:p*`` metric namespace — opt-in, because pulling and
+    sorting a ~512-span tail per replica per cycle is wasted work for a
+    controller whose rules never reference a tracez metric (the
+    controller enables it automatically when one does)."""
+
+    def __init__(self, name, base_url, timeout=2.0, tracez=False):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.timeout = float(timeout)
+        self.tracez = bool(tracez)
 
     def sample(self, now=None):
         code, body = _fetch(self.base_url + "/healthz", self.timeout)
@@ -116,7 +168,10 @@ class HttpProbe:
         rcode, _rbody = _fetch(self.base_url + "/readyz", self.timeout)
         metrics["ready"] = 1.0 if rcode == 200 else 0.0
         meta = {"url": self.base_url}
-        for path, key in (("/servingz", "servingz"), ("/statusz", "statusz")):
+        endpoints = [("/servingz", "servingz"), ("/statusz", "statusz")]
+        if self.tracez:
+            endpoints.append(("/tracez?n=512", "tracez"))
+        for path, key in endpoints:
             pcode, pbody = _fetch(self.base_url + path, self.timeout)
             if pcode == 200:
                 try:
@@ -125,6 +180,8 @@ class HttpProbe:
                     pass
         metrics.update(serving_metrics(meta.pop("servingz", None),
                                        meta.pop("statusz", None)))
+        if self.tracez:
+            metrics.update(tracez_metrics(meta.pop("tracez", None)))
         return TargetSample(self.name, "serving", metrics, meta)
 
 

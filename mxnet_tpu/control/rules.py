@@ -79,6 +79,34 @@ class Rule:
                    ":max=%d" % self.max_fires if self.max_fires else ""))
 
 
+def _split_head(raw):
+    """(metric, op, threshold_text, option_parts) for one rule.
+
+    The comparator is located by a left-to-right scan (2-char ops tried
+    first at each position) over the WHOLE rule, so metric names may
+    themselves contain colons — the `/tracez`-derived namespace
+    (``tracez:elastic.rpc.pull:p99<0.5:for=3:action=...``) needs that;
+    a naive split-on-":" would truncate the metric at its first
+    segment. Everything after the comparator up to the next ``:`` is
+    the threshold; the remainder splits into ``key=value`` options."""
+    op = None
+    idx = -1
+    for i in range(len(raw)):
+        for cand in (">=", "<=", "==", "!=", ">", "<"):  # longest first
+            if raw.startswith(cand, i):
+                op, idx = cand, i
+                break
+        if op is not None:
+            break
+    if op is None:
+        return None, None, None, None
+    metric = raw[:idx].strip()
+    rest = raw[idx + len(op):]
+    thr, _, tail = rest.partition(":")
+    parts = [p.strip() for p in tail.split(":")] if tail else []
+    return metric, op, thr.strip(), parts
+
+
 def parse_rules(spec):
     """``MXCTL_RULES`` text -> [Rule]. Raises RuleSyntaxError."""
     rules = []
@@ -86,19 +114,11 @@ def parse_rules(spec):
         raw = raw.strip()
         if not raw:
             continue
-        parts = [p.strip() for p in raw.split(":")]
-        head = parts[0]
-        op = None
-        for cand in (">=", "<=", "==", "!=", ">", "<"):  # longest first
-            if cand in head:
-                op = cand
-                break
+        metric, op, thr, parts = _split_head(raw)
         if op is None:
             raise RuleSyntaxError(
                 "rule %r: no comparator (use one of %s)"
                 % (raw, " ".join(sorted(_OPS))))
-        metric, _, thr = head.partition(op)
-        metric = metric.strip()
         try:
             threshold = float(thr)
         except ValueError:
@@ -107,7 +127,7 @@ def parse_rules(spec):
         if not metric:
             raise RuleSyntaxError("rule %r: empty metric name" % raw)
         opts = {}
-        for p in parts[1:]:
+        for p in parts:
             k, sep, v = p.partition("=")
             if not sep:
                 raise RuleSyntaxError("rule %r: option %r is not key=value"

@@ -33,6 +33,7 @@ import numpy as _np
 
 from . import compile as _compile
 from . import telemetry as _tel
+from .telemetry import prof as _prof
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray, zeros
@@ -235,6 +236,11 @@ class Executor:
 
         self._outputs_nd = None
         self._grad_cache = None  # (arg_versions, grads)
+        # mxprof: entry points attributed (AOT cost/memory analysis)
+        # lazily at first dispatch, when the concrete args exist
+        self._prof_done = set()
+        self._prof_analytic_memo = None
+        self._prof_ghash = None
 
     # -- hybrid (host-segmented) engine ----------------------------------------
     def _graph_meta(self):
@@ -656,6 +662,54 @@ class Executor:
         (grads,) = vjp_fn(list(head_grads))
         return outs, new_aux, grads
 
+    # -- mxprof attribution ----------------------------------------------------
+    def _prof_analytic(self):
+        """Analytic DAG cost for this bind (memoized; jax-free walk)."""
+        if self._prof_analytic_memo is None:
+            try:
+                self._prof_analytic_memo = _prof.graph_cost(
+                    self._symbol,
+                    {n: a.shape for n, a in zip(self._arg_names,
+                                                self.arg_arrays)},
+                    {n: a.dtype for n, a in zip(self._arg_names,
+                                                self.arg_arrays)})
+            except Exception:
+                self._prof_analytic_memo = {}
+        return self._prof_analytic_memo or None
+
+    def _prof_attribute(self, tag, fn, args):
+        """Swap a jitted entry point for its AOT-compiled, cost-
+        attributed form on first dispatch (MXNET_PROF=1 only; the
+        jitted paths are fixed-shape per bind so the compiled callable
+        is a drop-in). Returns the callable to dispatch."""
+        if tag in self._prof_done or self._hybrid or self._multi_device \
+                or self.arg_arrays is None:
+            return fn
+        self._prof_done.add(tag)
+        sig = ",".join(
+            "%s=%s:%s" % (n, "x".join(str(d) for d in a.shape), a.dtype)
+            for n, a in zip(self._arg_names, self.arg_arrays))
+        if self._prof_ghash is None:
+            # graph identity: attribute_jit's memo must never hand one
+            # bind's compiled program to a DIFFERENT program whose arg
+            # shapes happen to coincide — the symbol fingerprint covers
+            # op params (relu-vs-tanh), grad_req covers which args the
+            # vjp differentiates (frozen-param binds are different
+            # fwd_bwd programs at identical shapes)
+            try:
+                self._prof_ghash = "%s|req=%s" % (
+                    _prof.symbol_fingerprint(self._exec_symbol),
+                    ",".join(self._reqs))
+            except Exception:
+                self._prof_ghash = "%x" % id(self._exec_symbol)
+        out = _prof.attribute_jit(
+            "executor|%s|%s" % (tag, sig), fn, args,
+            site="executor.%s" % tag, analytic=self._prof_analytic(),
+            meta={"outputs": self._output_names},
+            graph_key=self._prof_ghash)
+        setattr(self, "_" + tag, out)  # tag IS the entry-point attr name
+        return out
+
     # -- helpers ---------------------------------------------------------------
     def _release_device_arrays(self):
         """Free this executor's device arg/grad/aux arrays while keeping
@@ -800,6 +854,10 @@ class Executor:
             # as parallel/symbol_trainer.py).
             self._outputs_shape_probe()
             hg = [g for g in self._default_head_grads() if g is not None]
+            if _prof.ENABLED:
+                self._prof_attribute(
+                    "fwd_bwd", self._fwd_bwd,
+                    (self._arg_vals(), self._aux_vals(), rng, hg))
             outs, new_aux, grads = self._fwd_bwd(
                 self._arg_vals(), self._aux_vals(), rng, hg
             )
@@ -807,6 +865,15 @@ class Executor:
             self._write_aux(new_aux)
             self._grad_cache = (self._versions(), grads)
         else:
+            if _prof.ENABLED:
+                if is_train:
+                    self._prof_attribute(
+                        "fwd_train", self._fwd_train,
+                        (self._arg_vals(), self._aux_vals(), rng))
+                else:
+                    self._prof_attribute(
+                        "fwd_infer", self._fwd_infer,
+                        (self._arg_vals(), self._aux_vals(), None))
             outs, new_aux = (
                 self._fwd_train(self._arg_vals(), self._aux_vals(), rng)
                 if is_train

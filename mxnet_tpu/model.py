@@ -17,6 +17,7 @@ from collections import namedtuple
 import numpy as _np
 
 from . import telemetry as _tel
+from .telemetry import prof as _prof
 from .base import MXNetError
 from .resilience import faults as _faults
 from .resilience import guardian as _guardian
@@ -156,9 +157,27 @@ def _scan_flush(trainer, buf, epoch, nbatch0, guardian=None):
         snap = None
         if guardian is not None and guardian.snapshot_due():
             snap = trainer.snapshot_state()
-        staged = trainer.stage_chunk(buf)
-        outs = trainer.run_chunk(staged)
-        return (outs, trainer.take_step_flags(), snap, buf, epoch, nbatch0)
+        if _prof.ENABLED:
+            # mxprof step decomposition: staging is the host/input
+            # phase, run_chunk the dispatch phase; the drain that runs
+            # alongside the NEXT flush measures device + D2H. A chunk
+            # whose dispatch performed the attribution compile is NOT
+            # recorded — seconds of XLA build inside the window would
+            # drown the steady-state phase shares.
+            n_attr = _prof.attribution_count()
+            t0 = time.monotonic()
+            staged = trainer.stage_chunk(buf)
+            t1 = time.monotonic()
+            outs = trainer.run_chunk(staged)
+            t2 = time.monotonic()
+            prof_ctx = (trainer.last_program_key, t1 - t0, t2 - t1) \
+                if _prof.attribution_count() == n_attr else None
+        else:
+            staged = trainer.stage_chunk(buf)
+            outs = trainer.run_chunk(staged)
+            prof_ctx = None
+        return (outs, trainer.take_step_flags(), snap, buf, epoch, nbatch0,
+                prof_ctx)
 
 
 def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
@@ -175,12 +194,23 @@ def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
     predicted labels."""
     if pending is None:
         return "ok"
-    outs, flags, snap, bufs, epoch, nbatch0 = pending
+    outs, flags, snap, bufs, epoch, nbatch0, prof_ctx = pending
     if guardian is not None:
         # the snapshot captured at this chunk's flush is the PREVIOUS
         # chunk's result, verified by the drain that ran alongside that
         # flush — commit it before accounting this chunk's flags
         guardian.commit_snapshot(snap)
+    if prof_ctx is not None:
+        # device phase: how long the drain truly blocks on the chunk's
+        # compute (block-until-ready delta — zero when the device
+        # already finished while the host staged the next chunk)
+        td = time.monotonic()
+        for o in outs:
+            bur = getattr(o, "block_until_ready", None)
+            if bur is not None:
+                bur()
+        t_device = time.monotonic() - td
+        td = time.monotonic()
     if (type(eval_metric) is metric_mod.Accuracy and len(outs) == 1
             and getattr(outs[0], "ndim", 0) == 3):
         import jax.numpy as jnp
@@ -188,6 +218,16 @@ def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
         host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
     else:
         host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
+    if prof_ctx is not None:
+        key, t_host, t_dispatch = prof_ctx
+        samples = None
+        if host_outs and getattr(host_outs[0], "ndim", 0) >= 2:
+            samples = int(host_outs[0].shape[0] * host_outs[0].shape[1])
+        _prof.note_step(
+            "train.scanned",
+            {"host": t_host, "dispatch": t_dispatch, "device": t_device,
+             "d2h": time.monotonic() - td},
+            key=key, batches=len(bufs), samples=samples)
     losses = [] if guardian is not None else None
     for k, b in enumerate(bufs):
         labels = [NDArray(_np.asarray(
@@ -445,11 +485,47 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
         train.* metrics)."""
         with _tel.span("batch"):
             step_tic = time.monotonic() if _tel.ENABLED else 0.0
+            # mxprof (MXNET_PROF=1): fenced sub-phase stamps — host
+            # input prep, fwd/bwd dispatch, optimizer update, metric
+            # D2H — emitted as one step_breakdown record per batch
+            prof_t = {"update": 0.0, "d2h": 0.0} if _prof.ENABLED else None
+            n_attr = _prof.attribution_count() if prof_t is not None else 0
+
+            def _timed(fn, slot):
+                if prof_t is None:
+                    return fn()
+                t = time.monotonic()
+                try:
+                    return fn()
+                finally:
+                    prof_t[slot] += time.monotonic() - t
+
+            t0 = time.monotonic() if prof_t is not None else 0.0
             executor_manager.load_data_batch(data_batch)
             if monitor is not None:
                 monitor.tic()
+            t1 = time.monotonic() if prof_t is not None else 0.0
             executor_manager.forward(is_train=True)
             executor_manager.backward()
+            if prof_t is not None:
+                t2 = time.monotonic()
+                prof_t["host"] = t1 - t0
+                prof_t["dispatch"] = t2 - t1
+                # device phase: forward/backward are ASYNC dispatches on
+                # accelerator backends — without a fence here the device
+                # seconds would land in d2h/update and a compute-bound
+                # run would misread as host-bound. Blocking on the
+                # gradient leaves (the last values the step produces) is
+                # the cost of the fenced decomposition, paid only under
+                # MXNET_PROF=1.
+                for glist in executor_manager.grad_arrays:
+                    for g in (glist or []):
+                        if g is None:
+                            continue
+                        bur = getattr(g._data, "block_until_ready", None)
+                        if bur is not None:
+                            bur()
+                prof_t["device"] = time.monotonic() - t2
 
             def _do_update():
                 if update_on_kvstore:
@@ -464,21 +540,23 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
                         kvstore=kvstore)
 
             if guard is None:
-                _do_update()
+                _timed(_do_update, "update")
                 if monitor is not None:
                     monitor.toc_print()
-                executor_manager.update_metric(eval_metric, data_batch.label)
+                _timed(lambda: executor_manager.update_metric(
+                    eval_metric, data_batch.label), "d2h")
             else:
                 # metric BEFORE the guarded update: outputs don't
                 # depend on it, and the guardian's loss feed reads this
                 # batch's metric delta for the z-score channel
-                executor_manager.update_metric(eval_metric, data_batch.label)
-                action = guard.guard_batch(
+                _timed(lambda: executor_manager.update_metric(
+                    eval_metric, data_batch.label), "d2h")
+                action = _timed(lambda: guard.guard_batch(
                     _do_update,
                     grad_arrays_fn=lambda: [
                         g[0] for g in executor_manager.grad_arrays
                         if g and g[0] is not None],
-                    updater=guard_updater)
+                    updater=guard_updater), "update")
                 if action == "rollback":
                     guard.rollback(_guard_restore,
                                    disk_restore_fn=_guard_disk_restore,
@@ -487,6 +565,11 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
                     guard.maybe_snapshot(_guard_snapshot)
                 if monitor is not None:
                     monitor.toc_print()
+            if prof_t is not None and _prof.attribution_count() == n_attr:
+                # a batch whose dispatch performed the attribution
+                # compile is not recorded (see _scan_flush)
+                _prof.note_step("train.batch", prof_t, batches=1,
+                                samples=train_data.batch_size)
             if _tel.ENABLED:
                 dt = time.monotonic() - step_tic
                 _tel.histogram("train.step_secs").observe(dt)

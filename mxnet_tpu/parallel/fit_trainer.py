@@ -176,6 +176,15 @@ class FitTrainer:
         from ..resilience import faults as _flt
         from ..resilience import guardian as _grd
 
+        # mxprof (telemetry/prof.py): the scanned K-step loop is the
+        # training hot program — keep what's needed to attribute it
+        # (analytic DAG cost + the staged shapes that key the record)
+        self._symbol = symbol
+        self._input_shapes = dict(input_shapes)
+        self._prof_analytic = None
+        self._prof_keys = {}
+        self.last_program_key = None
+
         self._aux_names = symbol.list_auxiliary_states()
         self._guard_on = _grd.enabled()
         self._guard_max_norm = (
@@ -393,6 +402,52 @@ class FitTrainer:
                 # program — the compile layer's cache-hit counters say
                 # whether it loaded from disk or compiled cold
                 _tel.counter("executor.jit_builds_total").inc()
+            from ..telemetry import prof as _prof
+
+            if _prof.ENABLED:
+                # mxprof: AOT-compile the loop through attribute_jit so
+                # the cost/memory record IS this program's one compile
+                # (docs/how_to/profiling.md); falls back to the plain
+                # jitted fn on any analysis failure
+                if self._prof_analytic is None:
+                    try:
+                        self._prof_analytic = _prof.graph_cost(
+                            self._symbol, self._input_shapes)
+                    except Exception:
+                        self._prof_analytic = {}
+                sig = ",".join(
+                    "%s=%s" % (n, "x".join(str(d) for d in batches[n].shape))
+                    for n in sorted(batches))
+                pkey = "fit_trainer|K=%d|%s" % (K, sig)
+                # graph identity for the attribution memo: the traced
+                # program depends on the symbol, the optimizer's traced
+                # update (class + static scalar config), and the
+                # compute dtype — not just the staged shapes
+                opt = self.optimizer
+                # graph identity must cover EVERYTHING _make_loop traces
+                # as a constant: the symbol, the optimizer's static
+                # scalar config, the compute dtype, AND the guardian /
+                # fault-injection switches — an unguarded trainer's
+                # cached program handed to a guarded one would silently
+                # disable the sentinel
+                ghash = _prof.graph_hash("%s|%s|%s|%s|g=%d,%s,%d" % (
+                    _prof.symbol_fingerprint(self._symbol),
+                    type(opt).__name__,
+                    sorted((k, v) for k, v in vars(opt).items()
+                           if isinstance(v, (int, float, str, bool))),
+                    self._cdt, self._guard_on, self._guard_max_norm,
+                    self._inject))
+                self._jit_cache[K] = _prof.attribute_jit(
+                    pkey, self._jit_cache[K],
+                    (self.params, self.opt_states, self.aux, batches, lrs,
+                     ts, rngs, mults),
+                    site="fit_trainer.scan",
+                    analytic=self._prof_analytic or None,
+                    meta={"K": K, "steps_per_call": K},
+                    graph_key=ghash)
+                self._prof_keys[K] = _prof.program_key_for(
+                    pkey, graph_key=ghash)
+        self.last_program_key = self._prof_keys.get(K)
         (self.params, self.opt_states, self.aux, stacked,
          self._last_flags) = self._jit_cache[K](
             self.params, self.opt_states, self.aux, batches, lrs, ts, rngs,

@@ -35,6 +35,7 @@ the key sequence), see :func:`cp_prefill_kv`.
 from __future__ import annotations
 
 import functools
+import time
 
 from ..models.transformer import TransformerConfig, _layer_norm
 
@@ -76,6 +77,7 @@ class ServingModel:
         self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
         self.chunk_buckets = tuple(sorted(set(int(c) for c in chunk_buckets)))
         self._jitted = {}  # (B, C) -> compiled step
+        self._prof_keys = {}  # (B, C) -> mxprof program key
 
     # -- the step program ----------------------------------------------------
     def _step_impl(self, params, kpool, vpool, tokens, start, chunk_len,
@@ -219,6 +221,10 @@ class ServingModel:
             pad = np.full((B - a.shape[0],) + a.shape[1:], fill, a.dtype)
             return np.concatenate([a, pad], axis=0)
 
+        from ..telemetry import prof as _prof
+
+        prof_on = _prof.ENABLED
+        t0 = time.monotonic() if prof_on else 0.0
         tok = np.zeros((B, C), np.int32)
         tok[:B_real, :C_real] = tokens
         start = padb(np.asarray(start, np.int32))
@@ -227,10 +233,50 @@ class ServingModel:
         bt[:B_real] = block_tables
         act = np.zeros((B,), bool)
         act[:B_real] = active
-        nxt, logits, kp, vp = self._compiled(B, C)(
+        fn = self._compiled(B, C)
+        attributed_now = False
+        if prof_on and (B, C) not in self._prof_keys:
+            attributed_now = True
+            # mxprof: attribute this bucket's ragged-step program (AOT
+            # compile = the bucket's one compile); the compiled
+            # callable replaces the jitted one in the bucket cache
+            cfg = self.cfg
+            key = "serve.step|B=%d|C=%d" % (B, C)
+            # graph identity: the FULL model geometry (heads/d_ff/vocab
+            # included — two configs sharing L and d_model are still
+            # different programs) + the paged-pool layout
+            ghash = _prof.graph_hash("%r|bs=%d|W=%d" % (
+                cfg, self.block_size, self.max_blocks))
+            fn = _prof.attribute_jit(
+                key, fn,
+                (params, kpool, vpool, tok, start, chunk_len, bt, act),
+                site="serving.step",
+                meta={"batch_bucket": B, "chunk_bucket": C},
+                graph_key=ghash)
+            self._jitted[(B, C)] = fn
+            self._prof_keys[(B, C)] = _prof.program_key_for(
+                key, graph_key=ghash)
+        t1 = time.monotonic() if prof_on else 0.0
+        nxt, logits, kp, vp = fn(
             params, kpool, vpool, tok, start, chunk_len, bt, act)
-        return (np.asarray(nxt)[:B_real], np.asarray(logits)[:B_real],
-                kp, vp)
+        if prof_on:
+            t2 = time.monotonic()
+            bur = getattr(nxt, "block_until_ready", None)
+            if bur is not None:
+                bur()
+            t3 = time.monotonic()
+        out = (np.asarray(nxt)[:B_real], np.asarray(logits)[:B_real],
+               kp, vp)
+        if prof_on and not attributed_now:
+            # the bucket's first step carried the attribution compile —
+            # recording it would drown the steady-state phase shares
+            _prof.note_step(
+                "serve.decode" if C == 1 else "serve.prefill",
+                {"host": t1 - t0, "dispatch": t2 - t1,
+                 "device": t3 - t2, "d2h": time.monotonic() - t3},
+                key=self._prof_keys.get((B, C)),
+                tokens=int(np.sum(np.asarray(chunk_len)[:B_real])))
+        return out
 
     def warmup(self, params, pool, batch_sizes=None):
         """Pre-compile the decode programs (and let the persistent jit

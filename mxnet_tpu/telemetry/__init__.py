@@ -21,6 +21,10 @@ Enablement contract::
                                       # server (mxdash, server.py):
                                       # /metrics /healthz /statusz
                                       # /tracez /enginez /servingz
+                                      # /profilez
+    MXNET_PROF=1                      # mxprof attribution layer
+                                      # (prof.py, its own off-by-default
+                                      # switch; docs/how_to/profiling.md)
 
 Instrumented hot paths guard on the module attribute ``ENABLED``::
 
@@ -45,6 +49,7 @@ from . import registry as _registry_mod
 from . import tracing
 from . import export
 from . import server
+from . import prof
 from .registry import Counter, Gauge, Histogram, Registry, default_registry
 from .tracing import (
     span, current_span, span_aggregates, span_tail,
@@ -61,6 +66,7 @@ __all__ = [
     "wire_context", "mint_trace", "open_spans", "event",
     "Counter", "Gauge", "Histogram", "Registry", "default_registry",
     "console_summary", "prometheus_text", "journal_path", "flush_at_exit",
+    "prof",
 ]
 
 #: subsystem import time — /statusz uptime (telemetry is imported at
@@ -102,6 +108,9 @@ def reload():
     http_spec = server.parse_spec(
         os.environ.get("MXNET_TELEMETRY_HTTP")) if ENABLED else None
     server.configure(http_spec)
+    # mxprof (prof.py) has its own master switch (MXNET_PROF) but rides
+    # the same reload cycle so one env round-trip configures both
+    prof.reload()
     return ENABLED
 
 
@@ -137,6 +146,7 @@ def reset():
     enable flag or the journal file."""
     _registry_mod.default_registry().reset()
     tracing.reset()
+    prof.reset()
 
 
 reload()

@@ -219,8 +219,15 @@ def _prom_name(name):
 
 def prometheus_text():
     """Prometheus exposition-format dump of the live registry.
-    Histograms render as summaries (quantile-labelled gauges plus
-    ``_count``/``_sum``)."""
+
+    Histograms render as REAL histogram families — cumulative
+    ``_bucket{le="..."}`` series over the registry's fixed bounds plus
+    ``_sum``/``_count`` — so server-side aggregation (rate, quantile
+    estimation across ranks) works the way Prometheus intends. The
+    pre-PR-13 quantile-labelled lines (reservoir-exact p50/p95/p99)
+    ride along under the same metric name for dashboard backward
+    compatibility; scrapers that only understand the histogram family
+    ignore them."""
     lines = []
     for m in _registry.default_registry().metrics():
         pn = _prom_name(m.name)
@@ -232,12 +239,17 @@ def prometheus_text():
             lines.append("%s %g" % (pn, m.value))
         else:
             s = m.summary()
-            lines.append("# TYPE %s summary" % pn)
+            lines.append("# TYPE %s histogram" % pn)
+            for le, cum in m.bucket_counts():
+                lines.append('%s_bucket{le="%s"} %d'
+                             % (pn, "+Inf" if le == float("inf")
+                                else ("%g" % le), cum))
+            lines.append("%s_sum %g" % (pn, s["sum"]))
+            lines.append("%s_count %d" % (pn, s["count"]))
+            # backward-compat: the reservoir-exact percentile gauges
             for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
                 if s[key] is not None:
                     lines.append('%s{quantile="%g"} %g' % (pn, q, s[key]))
-            lines.append("%s_count %d" % (pn, s["count"]))
-            lines.append("%s_sum %g" % (pn, s["sum"]))
     return "\n".join(lines) + ("\n" if lines else "")
 
 

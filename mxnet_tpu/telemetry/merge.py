@@ -40,7 +40,7 @@ import re
 
 __all__ = ["WAIT_SPANS", "load_journal", "clock_offset", "merge",
            "epoch_rows", "straggler_report", "cross_rank_rows",
-           "chrome_trace", "render_summary"]
+           "prof_rows", "chrome_trace", "render_summary"]
 
 #: span names that mean "blocked waiting on peers" (not computing)
 WAIT_SPANS = ("kvstore.round_wait", "kvstore.barrier_wait")
@@ -248,6 +248,54 @@ def cross_rank_rows(merged):
     return out
 
 
+def fold_breakdowns(records):
+    """Fold ``prof.step_breakdown`` journal records (MXNET_PROF=1,
+    docs/how_to/profiling.md) into per-path aggregates:
+    ``{path: {count, batches, total, phases: {p: secs},
+    bound: {verdict: votes}}}``. THE one implementation of this fold —
+    telemetry_report's profiling section and the cross-rank
+    :func:`prof_rows` both consume it, so the single-journal and merged
+    reports can never disagree about the same records."""
+    per_path = {}
+    for r in records:
+        if r.get("kind") != "prof" or r.get("event") != "step_breakdown":
+            continue
+        st = per_path.setdefault(r.get("path", "?"), {
+            "count": 0, "batches": 0, "total": 0.0, "phases": {},
+            "bound": {}})
+        st["count"] += 1
+        st["batches"] += r.get("batches", 1)
+        st["total"] += r.get("total_s", 0.0)
+        for p, v in (r.get("phases") or {}).items():
+            st["phases"][p] = st["phases"].get(p, 0.0) + v
+        b = r.get("bound", "?")
+        st["bound"][b] = st["bound"].get(b, 0) + 1
+    return per_path
+
+
+def prof_rows(merged):
+    """Per-(rank, path) mxprof step-breakdown attribution rows — the
+    cross-rank form of the ``prof.step_breakdown`` journal records.
+    Each row: phase-share percentages plus the majority
+    input/compute/host-bound verdict, so a merged timeline says not
+    just WHO straggled but what kind of bound each rank ran at."""
+    rows = []
+    for rank in sorted(merged["ranks"]):
+        per_path = fold_breakdowns(merged["ranks"][rank]["records"])
+        for path in sorted(per_path):
+            st = per_path[path]
+            tot = st["total"] or 1e-12
+            rows.append({
+                "rank": rank, "path": path, "steps": st["count"],
+                "batches": st["batches"], "total_s": st["total"],
+                "phase_share": {p: v / tot
+                                for p, v in st["phases"].items()},
+                "bound": max(st["bound"], key=lambda b: st["bound"][b])
+                if st["bound"] else None,
+            })
+    return rows
+
+
 def chrome_trace(merged):
     """Chrome trace-event JSON (Perfetto-loadable): one process per
     rank, one thread per journal thread, one complete ("X") event per
@@ -306,6 +354,22 @@ def render_summary(merged, top_traces=5):
                 row["rank"], row["epoch"], row["dur"], row["wait_s"],
                 row["compute_s"], 100.0 * row["wait_frac"],
                 row["batches"]))
+    profs = prof_rows(merged)
+    if profs:
+        lines.append("")
+        lines.append("-- per-rank step decomposition (mxprof) --")
+        lines.append("  %-5s %-14s %6s %9s %9s %9s %9s %9s  %s" % (
+            "rank", "path", "steps", "host%", "disp%", "dev%", "d2h%",
+            "upd%", "bound"))
+        for row in profs:
+            sh = row["phase_share"]
+            lines.append(
+                "  %-5d %-14s %6d %8.1f%% %8.1f%% %8.1f%% %8.1f%% "
+                "%8.1f%%  %s-bound"
+                % (row["rank"], row["path"], row["steps"],
+                   100 * sh.get("host", 0.0), 100 * sh.get("dispatch", 0.0),
+                   100 * sh.get("device", 0.0), 100 * sh.get("d2h", 0.0),
+                   100 * sh.get("update", 0.0), row["bound"]))
     lines.append("")
     if rep["truncated"]:
         lines.append("truncated journals (killed/wedged rank?): %s"

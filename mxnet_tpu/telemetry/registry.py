@@ -19,6 +19,7 @@ Design constraints, in priority order:
 """
 from __future__ import annotations
 
+import bisect
 import threading
 
 import numpy as _np
@@ -95,12 +96,20 @@ class Histogram:
     """
 
     __slots__ = ("name", "capacity", "_buf", "_n", "_sum", "_min", "_max",
-                 "_lock")
+                 "_bucket_counts", "_lock")
 
     kind = "histogram"
 
     DEFAULT_CAPACITY = 2048
     QUANTILES = (50.0, 95.0, 99.0)
+    #: fixed Prometheus bucket upper bounds (seconds-oriented, covering
+    #: sub-ms engine tasks through multi-minute epochs); the terminal
+    #: +Inf bucket is implicit (``bucket_counts`` appends it). Fixed
+    #: bounds — unlike the reservoir percentiles — aggregate correctly
+    #: across scrapes and ranks, which is what makes the ``_bucket``
+    #: exposition families on /metrics real histograms.
+    BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0)
 
     def __init__(self, name, capacity=DEFAULT_CAPACITY):
         if capacity < 1:
@@ -113,18 +122,36 @@ class Histogram:
         self._sum = 0.0
         self._min = None
         self._max = None
+        # per-bound observation counts (non-cumulative; +Inf overflow
+        # bucket last) — cumulated on read, O(1) per observe
+        self._bucket_counts = [0] * (len(self.BOUNDS) + 1)
         self._lock = threading.Lock()
 
     def observe(self, v):
         v = float(v)
+        idx = bisect.bisect_left(self.BOUNDS, v)
         with self._lock:
             self._buf[self._n % self.capacity] = v
             self._n += 1
             self._sum += v
+            self._bucket_counts[idx] += 1
             if self._min is None or v < self._min:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+
+    def bucket_counts(self):
+        """[(upper_bound, cumulative_count)] over the FULL stream (not
+        the reservoir window), Prometheus ``le`` semantics — the last
+        entry is ``(inf, total count)``."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+        out = []
+        cum = 0
+        for le, c in zip(self.BOUNDS + (float("inf"),), counts):
+            cum += c
+            out.append((le, cum))
+        return out
 
     @property
     def count(self):

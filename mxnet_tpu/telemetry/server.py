@@ -20,6 +20,10 @@ module serves the in-process mxtel state over plain HTTP:
                       task dump (the PR 2 wait-watchdog introspection, live)
 ``/servingz``         live serving-request table, KV-pool utilization,
                       scheduler event tail for every serving Engine
+``/profilez``         mxprof attribution (prof.py, ``MXNET_PROF=1``): top
+                      programs by device time with XLA flops/bytes/memory,
+                      step-time decomposition, derived MFU/roofline%, HBM
+                      live/peak
 ====================  =========================================================
 
 Enablement: ``MXNET_TELEMETRY=1`` plus ``MXNET_TELEMETRY_HTTP=<port>``
@@ -317,6 +321,19 @@ def _enginez(params):
     return _json(snap)
 
 
+def _profilez(params):
+    """mxprof live attribution (docs/how_to/profiling.md). Answers with
+    ``enabled: false`` (not an error) when MXNET_PROF is unset — a
+    scraper can always tell "off" from "down"."""
+    from . import prof as _prof
+
+    try:
+        n = max(1, int(params.get("n", "20")))
+    except ValueError:
+        n = 20
+    return _json(_prof.snapshot(top=n))
+
+
 def _servingz(params):
     srv_mod = sys.modules.get("mxnet_tpu.serving.engine")
     if srv_mod is None:
@@ -336,4 +353,5 @@ _ROUTES = {
     "/tracez": _tracez,
     "/enginez": _enginez,
     "/servingz": _servingz,
+    "/profilez": _profilez,
 }

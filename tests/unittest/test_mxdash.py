@@ -275,11 +275,15 @@ class TestServer:
                   batch_end_callback=scrape_cb)
         assert seen, "callback never scraped"
         # valid Prometheus exposition lines, with live training metrics
+        # (histograms are real _bucket/_sum/_count families since PR 13,
+        # with the quantile gauges kept for backward compat)
         for line in seen["prom"].splitlines():
-            assert re.match(r"^(# TYPE \S+ (counter|gauge|summary)|"
-                            r'\S+({quantile="[\d.]+"})? [-+0-9.eginf]+)$',
-                            line), line
+            assert re.match(
+                r"^(# TYPE \S+ (counter|gauge|summary|histogram)|"
+                r'\S+({(quantile="[\d.]+"|le="[^"]+")})? [-+0-9.eginf]+)$',
+                line), line
         assert "mxtpu_train_step_secs" in seen["prom"]
+        assert 'mxtpu_train_step_secs_bucket{le="+Inf"}' in seen["prom"]
         open_names = [r["name"] for r in seen["tracez"]["open"]]
         assert "epoch" in open_names and "batch" in open_names
         ep = next(r for r in seen["tracez"]["open"] if r["name"] == "epoch")

@@ -1586,6 +1586,28 @@ def main(argv=None):
               % counters.get("io.records_skipped_total", 0))
     else:
         print("(no journal counters — telemetry produced no snapshots)")
+    # perf-gate smoke leg (tools/perf_gate.py, docs/how_to/profiling.md):
+    # the regression gate's own mechanics must hold the line — a clean
+    # journal passes, a seeded regression exits nonzero, a missing
+    # baseline is loud — or chaos/CI perf gating is theater
+    print("-- perf gate (tools/perf_gate.py --selftest) --")
+    try:
+        gate_proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+             "--selftest"], capture_output=True, text=True, timeout=60)
+        gate_out = gate_proc.stdout + gate_proc.stderr
+        gate_ok = gate_proc.returncode == 0
+        gate_why = "rc %d" % gate_proc.returncode
+    except (subprocess.TimeoutExpired, OSError) as e:
+        # a wedged/missing gate must grade as a survival FAIL, not an
+        # unhandled traceback that eats the RESULT line
+        gate_out, gate_ok = "", False
+        gate_why = "%s: %s" % (type(e).__name__, e)
+    for line in gate_out.strip().splitlines():
+        print("  " + line)
+    print("perf gate       : %s"
+          % ("OK — pass/regress/missing legs behaved" if gate_ok
+             else "BROKEN (%s)" % gate_why))
     if hung:
         print("\nRESULT: FAIL — the suite hung under faults (a watchdog "
               "or deadline is missing). Last output:\n%s" % out[-2000:])
@@ -1594,9 +1616,15 @@ def main(argv=None):
         print("\nRESULT: FAIL — in-place-corrupted checkpoint file(s): "
               "atomic-rename discipline violated.")
         return 3
-    print("\nRESULT: SURVIVED — completed with zero hangs and zero "
-          "in-place-corrupted checkpoints. Failures above are injected "
-          "casualties; rerun with the same --seed to reproduce them.")
+    if not gate_ok:
+        print("\nRESULT: FAIL — the perf regression gate's selftest "
+              "broke (pass/regress/missing-baseline legs misbehaved); "
+              "perf gating would silently hold no line.")
+        return 4
+    print("\nRESULT: SURVIVED — completed with zero hangs, zero "
+          "in-place-corrupted checkpoints, and a working perf gate. "
+          "Failures above are injected casualties; rerun with the same "
+          "--seed to reproduce them.")
     return 0
 
 

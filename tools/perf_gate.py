@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff a run's journal-derived headline metrics
+against a baseline, exit nonzero on regression.
+
+The headline numbers (step p50, samples/s, tokens/s, MFU, peak HBM)
+have so far been re-derived by hand in bench scripts; this gate makes
+them continuously accounted: any run that journals with
+``MXNET_TELEMETRY=1`` (+ ``MXNET_PROF=1`` for the MFU/HBM channels,
+docs/how_to/profiling.md) can be held against a recorded baseline by
+CI or the chaos harness.
+
+Usage::
+
+    # capture a baseline from a known-good run's journal
+    python tools/perf_gate.py --journal good.jsonl --write-baseline perf.json
+
+    # gate a new run against it (exit 0 pass, 1 regression, 2 no
+    # baseline overlap)
+    python tools/perf_gate.py --journal run.jsonl --baseline perf.json
+
+    # gate against a judged bench record instead
+    python tools/perf_gate.py --journal run.jsonl --baseline BENCH_r06.json
+
+    python tools/perf_gate.py --selftest   # pass/regress/missing legs
+
+Derived metrics (whatever the journal can answer; missing channels are
+simply not compared):
+
+==================  ==========================================================
+``step_p50_s``      ``train.step_secs`` p50, final snapshot (lower is better)
+``prof_step_p50_s`` ``prof.step_secs`` p50 — chunk/step decomposition total
+``samples_per_sec`` max ``train.samples_per_sec`` over the run's snapshots
+``tokens_per_s``    max ``serving.tokens_per_s`` over the run's snapshots
+``mfu``             last ``prof.mfu`` (mxprof derived, prof.py)
+``peak_hbm_bytes``  max ``prof.hbm_peak_bytes`` (lower is better)
+==================  ==========================================================
+
+Baselines are either this tool's own ``--write-baseline`` output
+(``{"metrics": {name: value}}``), a flat ``{name: value}`` JSON, or a
+judged ``BENCH_r*.json`` (JSONL of ``{"parsed": {...}}`` records —
+recognized fields like ``mfu`` are lifted). The tolerance band
+(``--tolerance``, default 10%) absorbs run-to-run noise; direction
+comes from the metric (throughput up, latency/HBM down).
+
+Exit codes: 0 = within band (improvements included), 1 = regression,
+2 = no baseline overlap / no derivable metrics (a gate that silently
+passes because nothing was measured would hold no line at all).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: metrics where smaller is better; everything else is a throughput
+LOWER_IS_BETTER = frozenset((
+    "step_p50_s", "prof_step_p50_s", "peak_hbm_bytes", "cold_start_jit_s",
+    "ttft_p99_s",
+))
+
+#: parsed-record fields a BENCH_r*.json baseline contributes
+_BENCH_FIELDS = ("mfu", "tokens_per_s", "step_p50_s", "samples_per_sec",
+                 "peak_hbm_bytes", "prof_step_p50_s")
+
+
+def load_journal(path):
+    records = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                pass  # torn tail from a killed run
+    return records
+
+
+def derive_metrics(records):
+    """Journal records -> {metric: value}. Only channels the run
+    actually measured appear."""
+    out = {}
+    snapshots = [r for r in records if r.get("kind") == "metrics"]
+    final = snapshots[-1] if snapshots else None
+    if final is not None:
+        for hist, name in (("train.step_secs", "step_p50_s"),
+                           ("prof.step_secs", "prof_step_p50_s")):
+            h = final.get("histograms", {}).get(hist)
+            if h and h.get("p50") is not None:
+                out[name] = float(h["p50"])
+    for gauge, name, agg in (
+            ("train.samples_per_sec", "samples_per_sec", max),
+            ("serving.tokens_per_s", "tokens_per_s", max),
+            ("prof.hbm_peak_bytes", "peak_hbm_bytes", max)):
+        vals = [float(s.get("gauges", {}).get(gauge))
+                for s in snapshots
+                if s.get("gauges", {}).get(gauge) is not None]
+        vals = [v for v in vals if v > 0]
+        if vals:
+            out[name] = agg(vals)
+    mfus = [float(s.get("gauges", {}).get("prof.mfu"))
+            for s in snapshots
+            if s.get("gauges", {}).get("prof.mfu") is not None]
+    if mfus:
+        out["mfu"] = mfus[-1]
+    # prof step_breakdown records carry samples/tokens rates even when
+    # no snapshot landed (short runs flushed only at exit)
+    if "samples_per_sec" not in out:
+        rates = [r["samples_per_s"] for r in records
+                 if r.get("kind") == "prof"
+                 and r.get("event") == "step_breakdown"
+                 and r.get("samples_per_s")]
+        if rates:
+            out["samples_per_sec"] = max(rates)
+    return out
+
+
+def load_baseline(path):
+    """Baseline file -> {metric: value}. Accepts the --write-baseline
+    schema, a flat mapping, or a BENCH_r*.json judged record."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        metrics = doc.get("metrics", doc)
+        out = {}
+        for k, v in metrics.items():
+            if isinstance(v, dict):
+                v = v.get("value")
+            try:
+                out[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        # a BENCH record loaded whole: lift the parsed fields
+        if "parsed" in doc and isinstance(doc["parsed"], dict):
+            out.update(_lift_bench(doc["parsed"]))
+            out.pop("parsed", None)
+        for k in ("n", "rc", "cmd", "tail"):
+            out.pop(k, None)
+        return out
+    # JSONL (BENCH trajectory files): fold every parsed record
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.update(_lift_bench(rec.get("parsed", rec)))
+    return out
+
+
+def _lift_bench(parsed):
+    out = {}
+    if not isinstance(parsed, dict):
+        return out
+    for k in _BENCH_FIELDS:
+        if k in parsed:
+            try:
+                out[k] = float(parsed[k])
+            except (TypeError, ValueError):
+                pass
+    return out
+
+
+def gate(current, baseline, tolerance):
+    """Compare overlapping metrics. Returns (verdicts, n_regressions):
+    verdicts is [(metric, base, cur, status)] with status in
+    PASS/IMPROVED/REGRESS."""
+    verdicts = []
+    regressions = 0
+    for name in sorted(set(current) & set(baseline)):
+        base, cur = baseline[name], current[name]
+        if base == 0:
+            status = "PASS"  # nothing to hold a ratio against
+        elif name in LOWER_IS_BETTER:
+            if cur > base * (1.0 + tolerance):
+                status = "REGRESS"
+            elif cur < base * (1.0 - tolerance):
+                status = "IMPROVED"
+            else:
+                status = "PASS"
+        else:
+            if cur < base * (1.0 - tolerance):
+                status = "REGRESS"
+            elif cur > base * (1.0 + tolerance):
+                status = "IMPROVED"
+            else:
+                status = "PASS"
+        if status == "REGRESS":
+            regressions += 1
+        verdicts.append((name, base, cur, status))
+    return verdicts, regressions
+
+
+def run_gate(journals, baseline_path, tolerance, write_baseline=None,
+             out=sys.stdout):
+    records = []
+    for j in journals:
+        records.extend(load_journal(j))
+    current = derive_metrics(records)
+    if write_baseline:
+        doc = {"kind": "perf_baseline", "tolerance": tolerance,
+               "metrics": current}
+        with open(write_baseline, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print("perf_gate: wrote baseline %s (%d metrics)"
+              % (write_baseline, len(current)), file=out)
+        if baseline_path is None:
+            return 0
+    if not current:
+        print("perf_gate: journal(s) carry no derivable headline metrics "
+              "(run with MXNET_TELEMETRY=1, and MXNET_PROF=1 for the "
+              "MFU/HBM channels)", file=out)
+        return 2
+    if baseline_path is None or not os.path.exists(baseline_path):
+        print("perf_gate: no baseline at %r — nothing to hold the line "
+              "against" % (baseline_path,), file=out)
+        return 2
+    baseline = load_baseline(baseline_path)
+    verdicts, regressions = gate(current, baseline, tolerance)
+    if not verdicts:
+        print("perf_gate: no metric overlap between journal %s and "
+              "baseline %s (journal: %s; baseline: %s)"
+              % (journals, baseline_path, sorted(current),
+                 sorted(baseline)), file=out)
+        return 2
+    print("perf_gate: %d metric(s) vs %s (tolerance %.0f%%)"
+          % (len(verdicts), baseline_path, 100 * tolerance), file=out)
+    for name, base, cur, status in verdicts:
+        print("  %-18s base %-14.6g now %-14.6g %s"
+              % (name, base, cur, status), file=out)
+    if regressions:
+        print("perf_gate: REGRESSION — %d metric(s) outside the band"
+              % regressions, file=out)
+        return 1
+    print("perf_gate: PASS", file=out)
+    return 0
+
+
+# -- selftest (the chaos.py smoke leg) ----------------------------------------
+def _fake_journal(path, step_p50, samples, mfu, hbm):
+    rec = {
+        "kind": "metrics", "t": 0.0, "mark": "exit",
+        "counters": {},
+        "gauges": {"train.samples_per_sec": samples, "prof.mfu": mfu,
+                   "prof.hbm_peak_bytes": hbm},
+        "histograms": {"train.step_secs": {
+            "count": 100, "sum": step_p50 * 100, "min": step_p50,
+            "max": step_p50, "p50": step_p50, "p95": step_p50,
+            "p99": step_p50}},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"kind": "meta", "t": 0.0, "pid": 0, "rank": 0,
+                            "world": 1}) + "\n")
+        f.write(json.dumps(rec) + "\n")
+
+
+def selftest(out=sys.stdout):
+    """Three legs proving the gate's mechanics without a live run:
+    a clean journal passes against its own baseline, a seeded
+    regression (slower steps, lower throughput, fatter HBM) exits 1,
+    and a baseline with no overlap exits 2. Returns 0 only when all
+    three behave — tools/chaos.py folds this into its survival
+    report."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="mxtpu-perfgate-")
+    good = os.path.join(d, "good.jsonl")
+    bad = os.path.join(d, "bad.jsonl")
+    basefile = os.path.join(d, "baseline.json")
+    _fake_journal(good, step_p50=0.020, samples=5000.0, mfu=0.68,
+                  hbm=1.0e9)
+    _fake_journal(bad, step_p50=0.030, samples=3900.0, mfu=0.50,
+                  hbm=1.6e9)
+    rc_base = run_gate([good], None, 0.10, write_baseline=basefile,
+                       out=out)
+    rc_pass = run_gate([good], basefile, 0.10, out=out)
+    rc_regress = run_gate([bad], basefile, 0.10, out=out)
+    empty = os.path.join(d, "empty-baseline.json")
+    with open(empty, "w", encoding="utf-8") as f:
+        f.write("{\"metrics\": {\"some_other_metric\": 1.0}}\n")
+    rc_missing = run_gate([good], empty, 0.10, out=out)
+    ok = (rc_base == 0 and rc_pass == 0 and rc_regress == 1
+          and rc_missing == 2)
+    print("perf_gate selftest: baseline=%d pass=%d regress=%d missing=%d "
+          "-> %s" % (rc_base, rc_pass, rc_regress, rc_missing,
+                     "OK" if ok else "BROKEN"), file=out)
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff journal-derived headline perf metrics against "
+                    "a baseline; exit 1 on regression")
+    ap.add_argument("--journal", action="append", default=[],
+                    metavar="PATH", help="mxtel run journal(s) (JSONL)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (perf_gate --write-baseline "
+                         "output, flat {metric: value}, or BENCH_r*.json)")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative band before a delta counts as a "
+                         "regression (default 0.10)")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="capture the journal's derived metrics as a "
+                         "baseline file (then exits 0 unless --baseline "
+                         "is also given)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the pass/regress/missing-baseline legs on "
+                         "synthetic journals (chaos.py smoke leg)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.journal:
+        ap.error("--journal is required (or --selftest)")
+    return run_gate(args.journal, args.baseline, args.tolerance,
+                    write_baseline=args.write_baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,6 +179,87 @@ def serving_section(records):
     return lines
 
 
+def prof_records(records):
+    return [r for r in records if r.get("kind") == "prof"]
+
+
+def profiling_section(records):
+    """Rendered lines for the mxprof attribution layer (MXNET_PROF=1,
+    docs/how_to/profiling.md), or [] when the journal carries no
+    ``prof`` records: step-time decomposition per path (host / dispatch
+    / device / d2h shares + the input-vs-compute-vs-host-bound
+    verdict), top programs by accumulated device time with their XLA
+    flops/bytes, and the HBM peak line."""
+    profs = prof_records(records)
+    if not profs:
+        return []
+    lines = ["", "-- profiling (mxprof) --"]
+    # step-breakdown table: the shared fold (merge.fold_breakdowns —
+    # same implementation the cross-rank prof_rows uses)
+    paths = load_merge_module().fold_breakdowns(profs)
+    dev_by_key = {}
+    for r in profs:
+        if r.get("event") != "step_breakdown" or not r.get("key"):
+            continue
+        d = dev_by_key.setdefault(r["key"], [0, 0.0])
+        d[0] += 1
+        d[1] += (r.get("phases") or {}).get("device", 0.0)
+    if paths:
+        phase_names = ("host", "dispatch", "device", "d2h", "update")
+        lines.append("  %-14s %6s %8s %10s" % ("path", "steps", "batches",
+                                               "total_s")
+                     + "".join(" %9s" % ("%s%%" % p) for p in phase_names)
+                     + "  bound")
+        for path in sorted(paths):
+            st = paths[path]
+            tot = st["total"] or 1e-12
+            verdict = max(st["bound"], key=lambda b: st["bound"][b]) \
+                if st["bound"] else "?"
+            lines.append(
+                "  %-14s %6d %8d %10.3f" % (path, st["count"],
+                                            st["batches"], st["total"])
+                + "".join(" %8.1f%%"
+                          % (100.0 * st["phases"].get(p, 0.0) / tot)
+                          for p in phase_names)
+                + "  %s-bound" % verdict)
+    # top programs by device time (program records carry the static
+    # cost; the step records above carry the measured device seconds)
+    progs = {r["key"]: r for r in profs
+             if r.get("event") == "program" and r.get("key")}
+    if progs:
+        ranked = sorted(
+            progs.values(),
+            key=lambda r: -dev_by_key.get(r["key"], [0, 0.0])[1])
+        lines.append("  top programs by device time:")
+        lines.append("  %-24s %6s %12s %14s %14s" % (
+            "site", "calls", "device_s", "xla_flops", "bytes_accessed"))
+        for r in ranked[:10]:
+            calls, dev = dev_by_key.get(r["key"], [0, 0.0])
+            lines.append("  %-24s %6d %12.4f %14.6g %14.6g" % (
+                r.get("site", "?"), calls, dev, r.get("flops") or 0,
+                r.get("bytes_accessed") or 0))
+    hbm_peaks = [s.get("gauges", {}).get("prof.hbm_peak_bytes")
+                 for s in metrics_records(records)]
+    hbm_peaks = [v for v in hbm_peaks if v]
+    statics = [((r.get("memory") or {}).get("static_peak") or 0)
+               for r in progs.values()]
+    if hbm_peaks:
+        lines.append("  HBM peak: %s (device allocator)"
+                     % _human_bytes(max(hbm_peaks)))
+    elif any(statics):
+        lines.append("  HBM peak: %s (static estimate — largest "
+                     "program args+outputs+temp)"
+                     % _human_bytes(max(statics)))
+    final = final_metrics(records)
+    gauges = (final or {}).get("gauges", {})
+    if gauges.get("prof.mfu") is not None:
+        lines.append("  derived: MFU %.4f%s" % (
+            gauges["prof.mfu"],
+            ("  roofline %.1f%%" % gauges["prof.roofline_pct"])
+            if gauges.get("prof.roofline_pct") is not None else ""))
+    return lines
+
+
 def controller_section(records):
     """Rendered lines for the mxctl decision journal, or [] when the
     journal has no control-plane records: the detect->decide->act->
@@ -276,6 +357,7 @@ def render_report(records, top=10):
             % (_human_bytes(wire), _human_bytes(logical),
                wire / logical, logical / wire if wire else float("inf")))
 
+    lines.extend(profiling_section(records))
     lines.extend(serving_section(records))
     lines.extend(controller_section(records))
 
