@@ -76,7 +76,7 @@ def main():
             ("cifar", _run_cifar_ibn), ("packed_io", _run_packed_io),
             ("cold_start", _run_cold_start),
             ("comm_bandwidth", _run_comm_bandwidth),
-            ("prof", _run_prof)]
+            ("prof", _run_prof), ("data_service", _run_data_service)]
     by_name = dict(legs)
     if model:
         if model not in by_name:
@@ -330,6 +330,95 @@ def _run_packed_io():
         _emit("packed_recordio_read_throughput", "img/s", rates,
               BASELINE_PACKED_IO_IMG_S,
               extra={"images": n_images, "jpeg_side": side})
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _run_data_service():
+    """Sharded streaming input-service throughput
+    (docs/how_to/data_service.md): packed-RecordIO records streamed
+    through the DataCoordinator → DataServiceIter pipeline at 1 and 4
+    workers, records/s, against the same 3,000 img/s single-host
+    packed-RecordIO floor as the local-read leg. The 4-worker leg runs
+    the consumers as threads against one in-process coordinator (the
+    wire, flow control and frontier machinery are all real; only the
+    process boundary is elided)."""
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.data_service.client import DataServiceIter
+    from mxnet_tpu.data_service.server import DataCoordinator
+
+    n_records = int(os.environ.get("BENCH_DS_RECORDS", "4096"))
+    batch = int(os.environ.get("BENCH_DS_BATCH", "64"))
+    dim = int(os.environ.get("BENCH_DS_DIM", "1024"))  # 4 KB/record
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    scratch = tempfile.mkdtemp(prefix="mxtpu-bench-ds-")
+    try:
+        rec_path = os.path.join(scratch, "bench.rec")
+        writer = recordio.MXRecordIO(rec_path, "w")
+        payload = np.zeros(dim, np.float32)
+        for i in range(n_records):
+            payload[0] = float(i)
+            writer.write(recordio.pack(
+                recordio.IRHeader(0, float(i % 10), i, 0),
+                payload.tobytes()))
+        writer.close()
+
+        def one_world(world):
+            coord = DataCoordinator(
+                world, bind=("127.0.0.1", 0), evict_after=3600.0).start()
+            addr = "%s:%d" % coord.addr
+            try:
+                iters = [DataServiceIter(
+                    files=[rec_path], batch_size=batch, data_shape=(dim,),
+                    addr=addr, rank=r, heartbeat=False)
+                    for r in range(world)]
+                counts = [0] * world
+
+                def consume(r):
+                    for b in iters[r]:
+                        counts[r] += b.data[0].shape[0] - b.pad
+                    iters[r].reset()
+
+                rates = []
+                for _rep in range(repeats + 1):  # first pass = warmup
+                    for r in range(world):
+                        counts[r] = 0
+                    t0 = time.perf_counter()
+                    if world == 1:
+                        consume(0)
+                    else:
+                        ts = [threading.Thread(target=consume, args=(r,))
+                              for r in range(world)]
+                        for t in ts:
+                            t.start()
+                        for t in ts:
+                            t.join()
+                    dt = time.perf_counter() - t0
+                    if _rep:  # drop the warmup window
+                        rates.append(sum(counts) / dt)
+                for it in iters:
+                    it.close()
+                return rates
+            finally:
+                coord.stop()
+
+        rates1 = one_world(1)
+        rates4 = one_world(4)
+        med1 = statistics.median(rates1)
+        _emit("data_service_stream_throughput", "img/s", rates4,
+              BASELINE_PACKED_IO_IMG_S,
+              extra={"records": n_records, "record_bytes": 4 * dim,
+                     "workers": 4,
+                     "img_s_1worker": round(med1, 2),
+                     "scaling_4w": round(
+                         statistics.median(rates4) / med1, 3)})
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
 

@@ -266,12 +266,21 @@ def main(argv=None):
         findings.extend(fs)
         n_targets += 1
     if args.protosim:
+        from .datasim import data_survival_suite
         from .protosim import survival_suite as proto_suite
 
         fs, lines = proto_suite(seed=args.proto_seed,
                                 schedules=args.proto_count)
         for ln in lines:
             print("mxproto: %s" % ln, file=sys.stderr)
+        findings.extend(fs)
+        # the data-service half of the protocol surface
+        # (docs/how_to/data_service.md): same explorer, its own
+        # coordinator, mutants and invariants
+        fs, lines = data_survival_suite(seed=args.proto_seed,
+                                        schedules=args.proto_count)
+        for ln in lines:
+            print("mxdata: %s" % ln, file=sys.stderr)
         findings.extend(fs)
         n_targets += 1
 

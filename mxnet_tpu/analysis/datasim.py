@@ -1,0 +1,544 @@
+"""mxproto simulator, data-plane edition: deterministic message-schedule
+exploration over the REAL data-service coordinator
+(``mxlint --protosim``, second half; docs/how_to/data_service.md).
+
+The elastic simulator (protosim.py) proves the gradient-round protocol
+under adversarial delivery; this module applies the identical machinery
+— same ``(seed, index)`` streams, same replay contract, same explorer —
+to the streaming input service's ops (``register``/``configure``/
+``next``/``seek``/``leave``/``evict``), whose exactness story is the
+whole point of the subsystem. A socketless
+:class:`~mxnet_tpu.data_service.server.DataCoordinator` (``bind=None``)
+is driven through ``_dispatch`` directly; actors mirror
+``DataServiceIter``'s discipline (piggybacked cumulative acks,
+re-register on ``evicted``, pass-boundary reset).
+
+Invariants asserted over every delivered message (the Harness):
+
+- membership epoch is monotone non-decreasing;
+- **single ownership** — every shard is owned by exactly one live rank
+  per membership epoch (the deterministic map's defining property);
+- **no double consumption** — no record index is ever acknowledged
+  twice within one data pass (the exactness contract chaos replays
+  byte-for-byte);
+- **frontier monotonicity** — a shard's frontier never regresses
+  within a pass except through an explicit ``seek`` (the guardian's
+  rollback op, which this simulator does not issue);
+- coverage — when every surviving actor finishes a pass, the union of
+  acknowledged ranges is the full record range, gap-free.
+
+Two seeded mutants are the negative controls the survival suite must
+FIND and REPLAY — the two bug classes the frontier design exists to
+prevent:
+
+- ``_DoubleDeliverCoordinator`` — a rebalance resets the moved shard's
+  cursor to the shard START instead of the frontier, so already-acked
+  records are re-streamed to (and re-acked by) the new owner: the
+  double-delivery-on-rebalance bug.
+- ``_FrontierRegressCoordinator`` — a rejoin zeroes the frontiers of
+  the shards handed to the rejoiner (the "re-derive the read position
+  from scratch" behavior this subsystem replaces): frontier regression
+  on rejoin.
+"""
+from __future__ import annotations
+
+import os
+
+from .findings import Finding
+from .protosim import (InvariantViolation, ProtoWorkload, explore,
+                       replay)
+
+__all__ = ["DataHarness", "data_workload", "double_deliver_workload",
+           "frontier_regress_workload", "data_survival_suite"]
+
+_RECORDS = 24          # records in the simulated pack
+_RECORD_BYTES = 8
+
+
+class _SimRecordIO:
+    """In-memory stand-in wired through DataCoordinator._readers: the
+    simulator must not touch the filesystem, and a logical pack is all
+    the protocol can observe. API-compatible with the slice of
+    MXRecordIO the server uses (seek_record/read/tell/num_skipped)."""
+
+    def __init__(self, n):
+        self._n = n
+        self._pos = 0
+        self.num_skipped = 0
+
+    def _record_offsets(self):
+        return [i * _RECORD_BYTES for i in range(self._n)]
+
+    def seek_record(self, offset):
+        self._pos = int(offset)
+
+    def tell(self):
+        return self._pos * _RECORD_BYTES
+
+    def read(self):
+        if self._pos >= self._n:
+            return None
+        rec = b"r%06d" % self._pos
+        self._pos += 1
+        return rec
+
+    def close(self):
+        pass
+
+
+def _build_coordinator(wl):
+    from ..data_service.server import DataCoordinator, DatasetSpec
+
+    cls = getattr(wl, "coord_cls", None) or DataCoordinator
+    coord = cls(wl.world, bind=None, evict_after=3600.0)
+    # install the logical dataset without touching disk: a spec whose
+    # reader is the in-memory pack above
+    spec = DatasetSpec.__new__(DatasetSpec)
+    spec.files = ["<sim>"]
+    spec.batch_size = wl.sim_batch
+    spec.num_shards = wl.sim_shards
+    spec.corrupt = "raise"
+    coord.spec = spec
+    from ..data_service.server import _Shard
+
+    per = -(-_RECORDS // wl.sim_shards)
+    shards, sid, lo = {}, 0, 0
+    while lo < _RECORDS:
+        hi = min(_RECORDS, lo + per)
+        shards[sid] = _Shard(sid, 0, lo, hi)
+        sid += 1
+        lo = hi
+    coord.shards = shards
+    coord._assign_epoch = -1
+    coord._io._readers[0] = _SimRecordIO(_RECORDS)
+    return coord
+
+
+class DataWorkload(ProtoWorkload):
+    """Data-service shape on the protosim workload chassis: ``rounds``
+    becomes the number of full passes each actor must finish."""
+
+    def __init__(self, name, world=3, passes=2, sim_shards=6,
+                 sim_batch=3, coord_cls=None, **kw):
+        super().__init__(name, world=world, keys=(), rounds=passes, **kw)
+        self.sim_shards = int(sim_shards)
+        self.sim_batch = int(sim_batch)
+        self.coord_cls = coord_cls
+        self.sim_cls = _DataSim
+
+
+def _data_actor(rank, wl):
+    """One worker's client state machine as a generator (``resp =
+    yield request``), mirroring DataServiceIter: register, stream with
+    piggybacked acks, re-register on 'evicted', reset at pass
+    boundaries, graceful leave."""
+    def _register():
+        resp = yield {"op": "register", "rank": rank}
+        return int(resp.get("data_epoch", 0))
+
+    dpass = yield from _register()
+    last_seq = -1
+    done_passes = 0
+    while done_passes < wl.rounds:
+        resp = yield {"op": "next", "rank": rank, "ack": last_seq,
+                      "credits": 2, "data_epoch": dpass, "wait": 0}
+        st = resp.get("status")
+        if st == "evicted":
+            dpass = yield from _register()
+            last_seq = -1
+            continue
+        if st == "pending":
+            continue
+        if st == "end_epoch":
+            done_passes += 1
+            dpass = int(resp["data_epoch"])
+            continue
+        last_seq = int(resp["seq"])
+    yield {"op": "leave", "rank": rank, "ack": last_seq}
+
+
+class DataHarness:
+    """Wraps ``coord._dispatch`` and asserts the exactness invariants
+    around every delivered message."""
+
+    def __init__(self, coord, world):
+        self.coord = coord
+        self.world = world
+        self.messages = 0
+        self.acked = {}        # (pass, sid) -> set(record idx)
+
+    def _frontiers(self):
+        return {sid: sh.frontier
+                for sid, sh in self.coord.shards.items()}
+
+    def deliver(self, req):
+        pre_epoch = self.coord.view.epoch
+        pre_pass = self.coord.data_epoch
+        pre_fr = self._frontiers()
+        resp = self.coord._dispatch(dict(req))
+        self.messages += 1
+        self._check(req, resp, pre_epoch, pre_pass, pre_fr)
+        return resp
+
+    def _check_delivery(self, req, resp):
+        """No record may be DELIVERED again once acknowledged (within a
+        pass): redelivery is legitimate only for unacked in-flight work
+        — streaming past the frontier is the double-delivery bug class.
+        (The server's defensive ``max()`` in ack processing keeps the
+        frontier itself monotone under that bug, so only the delivery
+        stream betrays it.)"""
+        if req.get("op") != "next" or not isinstance(resp, dict) or \
+                resp.get("status") != "ok":
+            return
+        dpass = int(resp.get("data_epoch", 0))
+        sid = int(resp["shard"])
+        seen = self.acked.get((dpass, sid), set())
+        for i in range(int(resp["lo"]), int(resp["lo"]) + int(resp["n"])):
+            if i in seen:
+                raise InvariantViolation(
+                    "record %d of shard %d DELIVERED after being "
+                    "acknowledged in pass %d — double delivery on "
+                    "rebalance" % (i, sid, dpass))
+
+    def _check(self, req, resp, pre_epoch, pre_pass, pre_fr):
+        op = req.get("op")
+        c = self.coord
+        self._check_delivery(req, resp)
+        if c.view.epoch < pre_epoch:
+            raise InvariantViolation(
+                "membership epoch regressed %d -> %d on op %r"
+                % (pre_epoch, c.view.epoch, op))
+        # single ownership: the current map assigns each shard exactly
+        # one live rank and covers every shard when anyone is live
+        assign = dict(c._assign)
+        for sid, owner in assign.items():
+            if owner not in c.view.live:
+                raise InvariantViolation(
+                    "shard %d assigned to non-live rank %s (live %s, "
+                    "op %r)" % (sid, owner, sorted(c.view.live), op))
+        if c.view.live and c.spec is not None and \
+                c._assign_epoch == c.view.epoch:
+            missing = set(c.shards) - set(assign)
+            if missing:
+                raise InvariantViolation(
+                    "shards %s unassigned at epoch %d despite live "
+                    "ranks %s (op %r)" % (sorted(missing), c.view.epoch,
+                                          sorted(c.view.live), op))
+        same_pass = c.data_epoch == pre_pass
+        for sid, fr in self._frontiers().items():
+            if same_pass and op != "seek" and fr < pre_fr.get(sid, fr):
+                raise InvariantViolation(
+                    "frontier of shard %d regressed %d -> %d within "
+                    "pass %d (op %r)" % (sid, pre_fr[sid], fr,
+                                         c.data_epoch, op))
+            # frontier advance == acknowledgement of the covered
+            # records: each index exactly once per pass. A message that
+            # COMPLETES the pass resets frontiers to lo, so its final
+            # delta runs to the shard end, credited to the old pass.
+            end = fr if same_pass else c.shards[sid].hi
+            self._note_acked(pre_pass, sid, pre_fr.get(sid, end), end, op)
+        if not same_pass:
+            # a completed pass must have covered every record gap-free
+            for sid, sh in c.shards.items():
+                seen = self.acked.get((pre_pass, sid), set())
+                if seen != set(range(sh.lo, sh.hi)):
+                    raise InvariantViolation(
+                        "pass %d completed with shard %d coverage %s "
+                        "!= [%d, %d) — lost records"
+                        % (pre_pass, sid, sorted(seen), sh.lo, sh.hi))
+
+    def _note_acked(self, dpass, sid, lo, hi, op):
+        seen = self.acked.setdefault((dpass, sid), set())
+        for i in range(lo, hi):
+            if i in seen:
+                raise InvariantViolation(
+                    "record %d of shard %d acknowledged TWICE in pass "
+                    "%d (op %r) — double delivery" % (i, sid, dpass, op))
+            seen.add(i)
+
+    def snapshot_roundtrip(self):
+        """Frontier state survives snapshot_state/restore_state (what a
+        coordinator restart replays, minus the file IO). Restored onto
+        a FRESH coordinator and compared shard by shard."""
+        st = self.coord.snapshot_state()
+        import pickle
+
+        st2 = pickle.loads(pickle.dumps(st))
+        for rec in st2.get("shards", []):
+            sh = self.coord.shards.get(rec["sid"])
+            if sh is None or sh.frontier != rec["frontier"]:
+                raise InvariantViolation(
+                    "shard %s frontier did not round-trip the "
+                    "snapshot: %r vs live %r"
+                    % (rec["sid"], rec["frontier"],
+                       sh and sh.frontier))
+
+
+class _DataSim:
+    """One schedule of the data workload: actors + logical network +
+    perturbation budgets — the protosim._Sim surface (run/choices/
+    harness/stats) on the data coordinator."""
+
+    def __init__(self, wl, chooser):
+        self.wl = wl
+        self.chooser = chooser
+        self.coord = _build_coordinator(wl)
+        self.harness = DataHarness(self.coord, wl.world)
+        self.actors = {}
+        self.outbox = {}
+        self.crashed = set()
+        self.lose = wl.lose_budget
+        self.dup = wl.dup_budget
+        self.crashes = wl.crash_budget
+        self.restarts = wl.restart_budget
+        self.snapshots = wl.snapshot_budget
+        self.choices = []
+        self.stall = 0
+        self.stats = {"lost": 0, "dup": 0, "crash": 0, "restart": 0,
+                      "evict": 0, "snapshot": 0}
+        for rank in range(wl.world):
+            self._spawn(rank)
+
+    def _spawn(self, rank):
+        gen = _data_actor(rank, self.wl)
+        self.actors[rank] = gen
+        self.outbox[rank] = next(gen)
+
+    def _feed(self, rank, resp):
+        gen = self.actors[rank]
+        try:
+            self.outbox[rank] = gen.send(resp)
+        except StopIteration:
+            del self.actors[rank]
+            self.outbox.pop(rank, None)
+
+    def _events(self):
+        ev = []
+        for rank in sorted(self.outbox):
+            if rank in self.crashed:
+                continue
+            ev.append(("deliver", rank))
+            if self.lose > 0:
+                ev.append(("lose", rank))
+            if self.dup > 0:
+                ev.append(("dup", rank))
+        live_actors = [r for r in self.actors if r not in self.crashed]
+        if self.crashes > 0 and len(live_actors) > 1:
+            for rank in live_actors:
+                ev.append(("crash", rank))
+        for rank in sorted(self.crashed):
+            if rank in self.coord.view.live:
+                ev.append(("evict", rank))
+        if self.restarts > 0:
+            for rank in sorted(self.crashed):
+                ev.append(("restart", rank))
+        if self.snapshots > 0:
+            ev.append(("snapshot", -1))
+        return ev
+
+    def run(self):
+        from .protosim import _STALL_LIMIT
+
+        wl = self.wl
+        while self.actors:
+            events = self._events()
+            deliverable = [e for e in events if e[0] == "deliver"]
+            if not deliverable and not self.crashed:
+                break
+            if self.stall > _STALL_LIMIT:
+                forced = [e for e in events
+                          if e[0] in ("evict", "restart")]
+                if not forced and not deliverable:
+                    raise InvariantViolation(
+                        "livelock: no recovery event can unstick the "
+                        "schedule (crashed=%s live=%s)"
+                        % (sorted(self.crashed),
+                           sorted(self.coord.view.live)))
+                events = forced or events
+            if not events:
+                break
+            if len(self.choices) >= wl.max_steps:
+                raise InvariantViolation(
+                    "schedule exceeded max_steps=%d (livelock or an "
+                    "undersized budget)" % wl.max_steps)
+            kind, rank = self.chooser(events, self)
+            self.choices.append((kind, rank))
+            self._apply(kind, rank)
+
+    def _apply(self, kind, rank):
+        advanced = True
+        if kind == "deliver":
+            self._last_deliver = rank
+            req = self.outbox[rank]
+            resp = self.harness.deliver(req)
+            st = resp.get("status") if isinstance(resp, dict) else None
+            advanced = st not in ("pending",)
+            self._feed(rank, resp)
+        elif kind == "lose":
+            self.lose -= 1
+            self.stats["lost"] += 1
+            self.harness.deliver(dict(self.outbox[rank]))
+            advanced = False
+        elif kind == "dup":
+            self.dup -= 1
+            self.stats["dup"] += 1
+            self.harness.deliver(dict(self.outbox[rank]))
+            resp = self.harness.deliver(self.outbox[rank])
+            self._feed(rank, resp)
+        elif kind == "crash":
+            self.crashes -= 1
+            self.stats["crash"] += 1
+            self.crashed.add(rank)
+        elif kind == "evict":
+            self.stats["evict"] += 1
+            self.harness.deliver({"op": "evict", "rank": rank})
+        elif kind == "restart":
+            self.restarts -= 1
+            self.stats["restart"] += 1
+            self.crashed.discard(rank)
+            self._spawn(rank)
+        elif kind == "snapshot":
+            self.snapshots -= 1
+            self.stats["snapshot"] += 1
+            self.harness.snapshot_roundtrip()
+            advanced = False
+        self.stall = 0 if advanced else self.stall + 1
+
+
+# -- negative-control mutants --------------------------------------------------
+
+class _DoubleDeliverCoordinator:
+    """SEEDED MUTANT: a rebalance hands the moved shard's ALREADY
+    ACKNOWLEDGED prefix to the next owner as fresh work — the
+    double-delivery-on-rebalance bug class. (The naive form — cursor
+    reset to the shard start — is already neutralized server-side by
+    the fill validation's ``frontier > lo`` guard, so this mutant
+    injects the replayed batch past that guard, the way a buggy
+    hand-off protocol would.)"""
+
+    def __new__(cls, world, **kw):
+        from ..data_service.server import DataCoordinator, _Batch
+
+        class Mutant(DataCoordinator):
+            def _drop_shard_work_locked(self, sid):
+                DataCoordinator._drop_shard_work_locked(self, sid)
+                sh = self.shards.get(sid)
+                if sh is None or self.spec is None or \
+                        sh.frontier <= sh.lo:
+                    return
+                owner = self._assign.get(sid)
+                if owner is None:
+                    return
+                n = min(self.spec.batch_size, sh.frontier - sh.lo)
+                self._outbox.setdefault(owner, []).append(_Batch(
+                    sid, sh.lo, n, [b"replayed"] * n, 0,
+                    self.data_epoch))
+
+        return Mutant(world, **kw)
+
+
+class _FrontierRegressCoordinator:
+    """SEEDED MUTANT: a rejoin re-derives the rejoiner's read position
+    from scratch — frontiers of the shards handed to it reset to the
+    shard start (the exact pre-data-service behavior)."""
+
+    def __new__(cls, world, **kw):
+        from ..data_service.server import DataCoordinator
+
+        class Mutant(DataCoordinator):
+            def _dispatch(self, req):
+                rejoin = req.get("op") == "register" and \
+                    int(req.get("rank", -1)) in self.view.seen and \
+                    int(req.get("rank", -1)) not in self.view.live
+                resp = DataCoordinator._dispatch(self, req)
+                if rejoin:
+                    with self._lock:
+                        assign = self._assignment_locked()
+                        for sid, owner in assign.items():
+                            if owner == int(req.get("rank", -1)):
+                                sh = self.shards[sid]
+                                sh.frontier = sh.lo
+                                sh.cursor = sh.lo
+                return resp
+
+        return Mutant(world, **kw)
+
+
+# -- built-in workloads --------------------------------------------------------
+
+def data_workload(world=3, passes=2):
+    """Clean streaming under reply loss, duplication, crash → evict →
+    restart (the full rebalance/rejoin surface)."""
+    return DataWorkload("data_stream", world=world, passes=passes)
+
+
+def double_deliver_workload():
+    """NEGATIVE CONTROL: double delivery on rebalance. Crash/evict
+    pressure raised so a random walk meets a rebalance quickly."""
+    return DataWorkload("mutant_data_double_deliver", world=3, passes=1,
+                        lose_budget=0, dup_budget=0, crash_budget=2,
+                        restart_budget=2, snapshot_budget=0,
+                        coord_cls=_DoubleDeliverCoordinator)
+
+
+def frontier_regress_workload():
+    """NEGATIVE CONTROL: frontier regression on rejoin."""
+    return DataWorkload("mutant_data_frontier_regress", world=3,
+                        passes=1, lose_budget=0, dup_budget=0,
+                        crash_budget=2, restart_budget=2,
+                        snapshot_budget=0,
+                        coord_cls=_FrontierRegressCoordinator)
+
+
+def data_survival_suite(seed=0, schedules=None):
+    """The data-service half of ``mxlint --protosim``: both seeded
+    mutants FOUND and REPLAYED, then the clean streaming workload
+    survives every schedule. Same report shape as
+    ``protosim.survival_suite``."""
+    if schedules is None:
+        schedules = int(os.environ.get("MXPROTO_SCHEDULES", "25") or 25)
+    findings, lines = [], []
+    for name, wl in (
+            ("control/data-double-deliver", double_deliver_workload()),
+            ("control/data-frontier-regress",
+             frontier_regress_workload())):
+        r = explore(wl, schedules=schedules, seed=seed)
+        if r.ok:
+            findings.append(Finding(
+                "protosim", "control-miss", "error", name,
+                "the simulator failed to find the SEEDED data-service "
+                "mutant %r in %d schedules — message-schedule "
+                "exploration is not actually exploring"
+                % (wl.name, r.explored)))
+            lines.append("%-28s: MISSED its seeded mutant (%d schedules)"
+                         % (name, r.explored))
+            continue
+        f = r.first_failure()
+        rep = replay(wl, seed=seed, index=f.index)
+        if rep is None:
+            findings.append(Finding(
+                "protosim", "replay-miss", "error", name,
+                "failing schedule #%d of %r did not reproduce on "
+                "replay — schedules are not deterministic"
+                % (f.index, wl.name)))
+            lines.append("%-28s: mutant found but replay MISSED" % name)
+        else:
+            lines.append(
+                "%-28s: mutant found at schedule #%d (%s), replayed "
+                "from (seed=%d, index=%d)"
+                % (name, f.index, f.kind, seed, f.index))
+    wl = data_workload()
+    r = explore(wl, schedules=schedules, seed=seed)
+    if r.ok:
+        lines.append("%-28s: survived %d schedules"
+                     % ("data-stream", r.explored))
+    else:
+        f = r.first_failure()
+        findings.append(Finding(
+            "protosim", "protocol-race", "error",
+            "data-stream schedule #%d" % f.index,
+            "%s under an adversarial message schedule: %s — %s"
+            % (f.kind, f.message, f.replay_hint())))
+        lines.append("%-28s: FAILED at schedule #%d (%s)"
+                     % ("data-stream", f.index, f.kind))
+    return findings, lines

@@ -82,6 +82,13 @@ DEFAULT_TARGETS = (
     os.path.join(_PKG, "elastic", "budget.py"),
     os.path.join(_PKG, "kvstore.py"),
     os.path.join(_PKG, "analysis", "protosim.py"),
+    # the data-plane speakers (docs/how_to/data_service.md) share the
+    # op namespace deliberately: register/beat/leave/evict/stats carry
+    # identical shapes on both coordinators, and the diff covers the
+    # union of arms
+    os.path.join(_PKG, "data_service", "client.py"),
+    os.path.join(_PKG, "data_service", "server.py"),
+    os.path.join(_PKG, "analysis", "datasim.py"),
 )
 
 #: constants the lattice must recover from DEFAULT_TARGETS; an explicit

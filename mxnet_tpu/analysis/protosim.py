@@ -663,7 +663,10 @@ def _run_one(wl, chooser):
     """(failure tuple or None, choices, messages_stat_sim)."""
     import traceback as _tb
 
-    sim = _Sim(wl, chooser)
+    # workloads may carry their own simulator (analysis/datasim.py
+    # drives the data-service coordinator through the same explorer)
+    sim_cls = getattr(wl, "sim_cls", None) or _Sim
+    sim = sim_cls(wl, chooser)
     try:
         sim.run()
         return None, sim.choices, sim

@@ -36,7 +36,8 @@ import urllib.error
 import urllib.request
 
 __all__ = ["TargetSample", "HttpProbe", "CoordinatorProbe",
-           "serving_metrics", "tracez_metrics", "ProbeError"]
+           "DataServiceProbe", "serving_metrics", "tracez_metrics",
+           "data_metrics", "ProbeError"]
 
 
 class ProbeError(Exception):
@@ -183,6 +184,81 @@ class HttpProbe:
         if self.tracez:
             metrics.update(tracez_metrics(meta.pop("tracez", None)))
         return TargetSample(self.name, "serving", metrics, meta)
+
+
+def data_metrics(stats):
+    """Pure mapping from the data coordinator's ``stats`` reply to rule
+    metrics (the unit-testable half of :class:`DataServiceProbe`):
+    shards per rank, the widest unacknowledged frontier window, and the
+    flow-control stall rate — the signals an input-starvation rule
+    keys on (``docs/how_to/data_service.md``). Returns
+    ``(aggregate metrics, {rank: per-rank metrics})``."""
+    agg = {}
+    per_rank = {}
+    if not stats:
+        return agg, per_rank
+    agg["data_epoch"] = float(stats.get("data_epoch", 0))
+    agg["frontier_lag_max"] = float(stats.get("frontier_lag_max", 0))
+    agg["stall_rate"] = float(stats.get("stall_rate", 0.0))
+    ctr = stats.get("counters", {})
+    agg["shards_rebalanced"] = float(ctr.get("shards_rebalanced", 0))
+    agg["records_skipped"] = float(ctr.get("records_skipped", 0))
+    live = set(stats.get("live", []))
+    shards = stats.get("shards", {}) or {}
+    spr = stats.get("shards_per_rank", {}) or {}
+    for rank in sorted(live | set(spr)):
+        lag = max((int(s.get("cursor", 0)) - int(s.get("frontier", 0))
+                   for s in shards.values() if s.get("rank") == rank),
+                  default=0)
+        per_rank[rank] = {
+            "alive": 1.0 if rank in live else 0.0,
+            "shards": float(spr.get(rank, 0)),
+            "frontier_lag": float(lag),
+        }
+    return agg, per_rank
+
+
+class DataServiceProbe:
+    """Scrape the data coordinator's ``stats`` op (the kv.coord retry
+    discipline through DataServiceClient) into one aggregate ``data``
+    target plus a ``data-rank<N>`` target per known rank — so mxctl
+    rules can fire on input starvation (``stall_rate``/``frontier_lag``
+    sustained high = the consumers are outrunning the reader, or a rank
+    stopped draining its shards)."""
+
+    def __init__(self, coord, timeout=5.0):
+        self.coord = coord
+        self.timeout = float(timeout)
+        self._client = None
+
+    def _data_client(self):
+        if self._client is None:
+            from ..data_service.client import DataServiceClient
+
+            # rank -1: an observer, never a member
+            self._client = DataServiceClient(self.coord, rank=-1,
+                                             timeout=self.timeout)
+        return self._client
+
+    def sample(self, now=None):
+        """[TargetSample]; the coordinator being unreachable degrades
+        to a dead aggregate target (``alive=0``) rather than raising —
+        the socket being gone IS the signal, exactly as HttpProbe."""
+        try:
+            stats = self._data_client().stats()
+        except Exception as e:  # noqa: BLE001 - down = the finding
+            return [TargetSample(
+                "data", "training", {"alive": 0.0},
+                {"coord": self.coord,
+                 "error": "%s: %s" % (type(e).__name__, e)})]
+        agg, per_rank = data_metrics(stats)
+        agg["alive"] = 1.0
+        out = [TargetSample("data", "training", agg,
+                            {"coord": self.coord})]
+        for rank, metrics in sorted(per_rank.items()):
+            out.append(TargetSample("data-rank%d" % rank, "training",
+                                    metrics, {"coord": self.coord}))
+        return out
 
 
 class CoordinatorProbe:

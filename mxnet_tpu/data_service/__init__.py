@@ -1,0 +1,49 @@
+"""mxdata: sharded streaming input service (docs/how_to/data_service.md).
+
+The distributed data plane the ROADMAP names: instead of every worker
+reading its own RecordIO locally — re-deriving its read position from
+scratch on elastic rejoin and fast-forwarding an *approximate* batch
+count after a guardian rollback — a coordinator owns shard assignment
+over packed RecordIO files (deterministic shard→rank map keyed by the
+membership epoch), streams batches to workers with credit-based flow
+control and bounded prefetch, rebalances shards on eviction/rejoin,
+and checkpoints per-shard read frontiers so recovery is an *exact*
+resume: the acknowledged record stream is identical to an
+uninterrupted run's.
+
+Layering (the TensorFlow input-service role, Abadi et al. 2016):
+
+- :mod:`.server` — ``DataCoordinator``: GroupView membership (the
+  elastic state machine, reused), shard table + frontiers, per-rank
+  credit-bounded outboxes, eviction sweeper, crash-safe frontier
+  snapshots (``model._write_params_atomic``'s tmp→fsync→rename
+  discipline via ``elastic.server._atomic_pickle``).
+- :mod:`.client` — ``DataServiceClient`` (the ElasticClient RPC
+  discipline: ``kv.coord`` fault point + ``MXNET_KV_RETRIES`` backoff)
+  and ``DataServiceIter``, a drop-in :class:`~mxnet_tpu.io.DataIter`
+  that re-registers through evictions and exposes
+  ``mark()``/``restore_mark()`` for the guardian's exact rollback.
+
+Everything is off by default: with no ``MXNET_DATA_*`` env set and no
+coordinator constructed, no thread starts, no socket opens, and no
+journal record is written — the existing local-read iterators are
+untouched.
+"""
+from __future__ import annotations
+
+__all__ = ["DataCoordinator", "DataServiceClient", "DataServiceIter"]
+
+
+def __getattr__(name):
+    # lazy: importing mxnet_tpu.data_service must stay cheap and
+    # jax-free until a coordinator or iterator is actually built
+    if name == "DataCoordinator":
+        from .server import DataCoordinator
+
+        return DataCoordinator
+    if name in ("DataServiceClient", "DataServiceIter"):
+        from . import client as _client
+
+        return getattr(_client, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,0 +1,67 @@
+"""Standalone data coordinator: ``python -m mxnet_tpu.data_service``.
+
+tools/launch.py --data-service spawns exactly this; run it by hand to
+host the input service away from the launch machine, or to resume a
+crashed coordinator from its frontier snapshot (``--snapshot-prefix``
+pointing at an existing ``<prefix>.meta`` restores assignments and
+resumes the stream with zero duplicate acknowledged records).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+# the coordinator never needs an accelerator, and grabbing one would
+# steal it from a co-located worker
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="sharded streaming data coordinator (see "
+                    "docs/how_to/data_service.md)")
+    ap.add_argument("--world", type=int, required=True,
+                    help="nominal worker count")
+    ap.add_argument("--bind", default="127.0.0.1:9878",
+                    help="host:port to listen on (port 0 = ephemeral). "
+                         "TRUSTED NETWORKS ONLY: the wire protocol is "
+                         "pickle — keep it loopback/cluster-private")
+    ap.add_argument("--files", nargs="*", default=None,
+                    help="packed .rec files to stream (omit to let the "
+                         "first worker's configure install the spec)")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="records per streamed batch (with --files)")
+    ap.add_argument("--num-shards", type=int, default=0,
+                    help="shard count (default: 2x world)")
+    ap.add_argument("--corrupt", choices=["raise", "skip"],
+                    default="raise", help="bad-record policy for the "
+                    "server-side readers (docs/how_to/fault_tolerance.md)")
+    ap.add_argument("--evict-after", type=float, default=None,
+                    help="heartbeat lapse (secs) before eviction "
+                         "(default: MXNET_DATA_EVICT_AFTER or 10)")
+    ap.add_argument("--snapshot-prefix", default=None,
+                    help="frontier-snapshot path prefix (<prefix>.meta); "
+                         "restores from it if present")
+    ap.add_argument("--snapshot-secs", type=float, default=None,
+                    help="snapshot cadence (default: "
+                         "MXNET_DATA_SNAPSHOT_SECS or off)")
+    args = ap.parse_args(argv)
+
+    from ..elastic.client import parse_addr
+    from .server import DatasetSpec, serve
+
+    spec = None
+    if args.files:
+        if not args.batch_size:
+            ap.error("--files requires --batch-size")
+        spec = DatasetSpec(args.files, args.batch_size,
+                           num_shards=args.num_shards,
+                           corrupt=args.corrupt)
+    serve(args.world, parse_addr(args.bind),
+          evict_after=args.evict_after,
+          snapshot_prefix=args.snapshot_prefix,
+          snapshot_secs=args.snapshot_secs, spec=spec)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,425 @@
+"""Worker half of the data service: RPC client + drop-in DataIter.
+
+``DataServiceClient`` speaks the coordinator protocol under the exact
+discipline of :class:`~mxnet_tpu.elastic.client.ElasticClient` — the
+``kv.coord`` fault point inside every attempt, ``MXNET_KV_RETRIES``
+exponential backoff, trace context on the wire — so the mxproto lint
+and the resilience chaos harness see one transport idiom, not two.
+
+``DataServiceIter`` is the drop-in :class:`~mxnet_tpu.io.DataIter`:
+``FeedForward.fit``/``Module.fit`` consume it unchanged. Delivery is
+pull-based with piggybacked cumulative acks — a batch is acknowledged
+by the *following* ``next`` RPC, so a worker SIGKILLed mid-batch leaves
+its unacknowledged tail to be redelivered to the shard's next owner
+(at-least-once at the crash boundary, exactly-once in the coordinator's
+acked frontier stream). An ``evicted`` reply re-registers transparently
+(the kvstore zombie-rejoin path) and resumes at the server's exact
+frontier. ``mark()``/``restore_mark()`` give the guardian byte-exact
+rollback: mark the consumed frontier at snapshot time, seek the
+coordinator back to it on rollback — replacing the approximate
+``MXNET_GUARDIAN_FF_BATCHES`` skip.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+
+import numpy as _np
+
+from .. import telemetry as _tel
+from ..base import MXNetError
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy
+from ..elastic import protocol
+from ..elastic.client import parse_addr, _pull_wait
+from ..io import DataIter
+
+__all__ = ["DataServiceClient", "DataServiceIter", "default_decode"]
+
+
+class DataServiceClient:
+    """One worker's handle on the data coordinator. Stateless between
+    calls (survives coordinator restarts)."""
+
+    def __init__(self, addr, rank, timeout=30.0):
+        self.addr = parse_addr(addr) if isinstance(addr, str) else tuple(addr)
+        self.rank = int(rank)
+        self.timeout = float(timeout)
+        attempts = max(1, int(os.environ.get("MXNET_KV_RETRIES", "4")))
+        self._policy = RetryPolicy(max_attempts=attempts, base_delay=0.05,
+                                   max_delay=1.0, jitter=0.25)
+
+    def call(self, op, check=True, **fields):
+        """One RPC under the retry discipline; ``error`` status raises
+        (when ``check``), 'pending'/'evicted'/'end_epoch' are protocol
+        answers the caller dispatches on."""
+        req = dict(fields)
+        req["op"] = op
+        if "rank" not in req:
+            req["rank"] = self.rank
+
+        def _rpc():
+            _faults.point("kv.coord")
+            return protocol.call(self.addr, req, timeout=self.timeout)
+
+        _rpc.__name__ = "mxdata %s" % op
+        if not _tel.ENABLED:
+            resp = self._policy.call(_rpc)
+        else:
+            with _tel.span("mxdata.rpc.%s" % op):
+                req["_trace"] = _tel.wire_context()
+                resp = self._policy.call(_rpc)
+        if check and resp.get("status") == "error":
+            raise MXNetError("data coordinator rejected %s: %s"
+                             % (op, resp.get("message", "(no message)")))
+        return resp
+
+    # -- per-op wrappers (the proto_lint client schema) ------------------------
+    def register(self):
+        return self.call("register")
+
+    def beat(self):
+        return self.call("beat")
+
+    def view(self):
+        return self.call("view")
+
+    def configure(self, spec):
+        """Install the dataset spec (first configure wins — the
+        set_optimizer discipline; later workers adopt the reply's
+        authoritative spec)."""
+        return self.call("configure", spec=spec)
+
+    def next_batch(self, ack, credits, data_epoch, wait=None):
+        """One streaming poll: cumulative ``ack`` of the last consumed
+        sequence number, this worker's credit grant, and the data pass
+        it believes it is in. Long-polls ``wait`` seconds server-side
+        (default ``MXNET_KV_PULL_WAIT``)."""
+        w = _pull_wait() if wait is None else wait
+        return self.call("next", check=False, ack=ack, credits=credits,
+                         data_epoch=data_epoch, wait=w)
+
+    def seek(self, frontiers, data_epoch):
+        """Rewind this rank's shards to ``frontiers`` ({shard: record
+        index}) — the guardian's exact-restore RPC."""
+        return self.call("seek", check=False, frontiers=frontiers,
+                         data_epoch=data_epoch)
+
+    def leave(self, ack=-1):
+        """Graceful departure, landing the final cumulative ack first
+        (an exact hand-off: the next owner resumes past everything this
+        worker consumed)."""
+        return self.call("leave", ack=ack)
+
+    def stats(self):
+        return self.call("stats")
+
+    def evict(self, rank):
+        """Admin eviction (chaos/mxctl hook)."""
+        return self.call("evict", rank=int(rank))
+
+    def snapshot(self):
+        """Force a frontier checkpoint (chaos hook)."""
+        return self.call("snapshot")
+
+    def wait_ready(self, deadline=30.0):
+        end = _time.monotonic() + deadline
+        last = None
+        while _time.monotonic() < end:
+            try:
+                return self.view()
+            except Exception as e:  # noqa: BLE001 - startup polling
+                last = e
+                _time.sleep(0.05)
+        raise MXNetError("data coordinator at %s:%d not ready after "
+                         "%.0fs: %s" % (self.addr[0], self.addr[1],
+                                        deadline, last))
+
+
+def default_decode(records, data_shape, label_width, dtype=_np.float32):
+    """Raw-tensor decode: each record is ``pack(IRHeader, payload)``
+    with the payload a flat ``dtype`` array of ``prod(data_shape)``
+    elements; the label rides the header. (Image datasets pass a custom
+    ``decode`` that runs their PIL/native pipeline instead.)"""
+    from .. import recordio as _recordio
+
+    n = len(records)
+    size = 1
+    for d in data_shape:
+        size *= d
+    data = _np.empty((n,) + tuple(data_shape), dtype)
+    labels = _np.zeros((n, label_width), _np.float32)
+    for i, rec in enumerate(records):
+        header, payload = _recordio.unpack(rec)
+        arr = _np.frombuffer(payload, dtype=dtype, count=size)
+        data[i] = arr.reshape(data_shape)
+        lab = _np.asarray(header.label, _np.float32).reshape(-1)
+        labels[i, :min(label_width, lab.size)] = lab[:label_width]
+    if label_width == 1:
+        labels = labels.reshape(n)
+    return data, labels
+
+
+class DataServiceIter(DataIter):
+    """Streaming DataIter over the shard service (drop-in for
+    ``ImageRecordIter``-shaped fit loops; docs/how_to/data_service.md).
+
+    One epoch = one full pass over every shard this rank is assigned
+    (plus whatever rebalancing hands it mid-pass); ``next()`` raises
+    StopIteration when the coordinator announces the pass boundary, and
+    ``reset()`` moves to the next pass — the standard epoch protocol.
+    Short tail batches are padded by repeating the final record, with
+    the pad count in ``DataBatch.pad`` (the NDArrayIter convention).
+    """
+
+    def __init__(self, files=None, batch_size=None, data_shape=None,
+                 label_width=1, addr=None, rank=None, num_shards=None,
+                 credits=None, decode=None, corrupt="raise",
+                 data_name="data", label_name="softmax_label",
+                 dtype=_np.float32, heartbeat=True):
+        super().__init__()
+        addr = addr if addr is not None else \
+            os.environ.get("MXNET_DATA_COORD", "")
+        if not addr:
+            raise MXNetError(
+                "DataServiceIter needs addr= or MXNET_DATA_COORD "
+                "(tools/launch.py --data-service exports it)")
+        if rank is None:
+            rank = int(os.environ.get("MXNET_PROC_ID", "0"))
+        if data_shape is None:
+            raise MXNetError("DataServiceIter requires data_shape=")
+        self.data_shape = tuple(data_shape)
+        self.label_width = int(label_width)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self._decode = decode
+        if credits is None:
+            credits = int(os.environ.get("MXNET_DATA_CREDITS", "4") or 4)
+        self.credits = max(1, int(credits))
+        self._client = DataServiceClient(addr, rank)
+        self.rank = self._client.rank
+        self.num_skipped = 0
+        self._last_seq = -1
+        self._consumed = {}      # shard -> consumed-up-to record index
+        self._next_epoch = None  # server's pass at the last end_epoch
+        self._mark = None        # guardian frontier mark
+        self._closed = False
+        self._hb_stop = None
+        resp = self._client.register()
+        self.data_epoch = int(resp.get("data_epoch", 0))
+        spec = resp.get("spec")
+        if spec is None:
+            if files is None or batch_size is None:
+                raise MXNetError(
+                    "data service is unconfigured: the first "
+                    "DataServiceIter must pass files= and batch_size=")
+            wire = {"files": list(files) if not isinstance(files, str)
+                    else [files],
+                    "batch_size": int(batch_size),
+                    "num_shards": int(num_shards or 0),
+                    "corrupt": corrupt}
+            spec = self._client.configure(wire)["spec"]
+        self.batch_size = int(spec["batch_size"])
+        if heartbeat:
+            self._start_heartbeat()
+
+    # -- liveness --------------------------------------------------------------
+    def _start_heartbeat(self):
+        # same cadence knob as the elastic store so one env sizes both
+        # membership planes; the coordinator also treats every `next`
+        # as a beat, so this only matters across long compute gaps
+        try:
+            interval = float(os.environ.get(
+                "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "2"))
+        except ValueError:
+            interval = 2.0
+        stop = threading.Event()
+        client = self._client
+
+        def _beat_loop():
+            # closes over the CLIENT and the stop event only — never
+            # self: a daemon thread referencing the iterator would keep
+            # an abandoned iterator alive forever (its __del__ could
+            # never run to stop the beats), and a rank that stopped
+            # consuming would keep looking alive instead of being
+            # evicted and rebalanced away
+            while not stop.wait(interval):
+                try:
+                    client.beat()
+                except Exception:  # noqa: BLE001 - next() heals/raises
+                    pass
+
+        t = threading.Thread(target=_beat_loop, daemon=True,
+                             name="mxdata-beat-%d" % self.rank)
+        t.start()
+        self._hb_stop = stop
+
+    def close(self):
+        """Graceful departure: land the final ack, stop heartbeating.
+        After close() the shards rebalance to the remaining workers
+        with nothing lost and nothing replayed."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        try:
+            self._client.leave(ack=self._last_seq)
+        except Exception:  # noqa: BLE001 - coordinator already gone
+            pass
+
+    def __del__(self):
+        try:
+            if self._hb_stop is not None:
+                self._hb_stop.set()
+        except Exception:
+            pass
+
+    # -- DataIter protocol -----------------------------------------------------
+    @property
+    def provide_data(self):
+        from ..io import DataDesc
+
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape, self.dtype)]
+
+    @property
+    def provide_label(self):
+        from ..io import DataDesc
+
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def reset(self):
+        """Advance to the next data pass (epoch protocol): the server
+        already rolled its frontiers; we adopt ITS counter from the
+        ``end_epoch`` reply — a rank that owns no shards can fall more
+        than one pass behind between polls, and a local ``+= 1`` creep
+        would make every later epoch look instantly empty."""
+        nxt = self._next_epoch
+        self.data_epoch = nxt if (nxt is not None
+                                  and nxt > self.data_epoch) \
+            else self.data_epoch + 1
+        self._next_epoch = None
+        self._consumed = {}
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._peek.data
+
+    def getlabel(self):
+        return self._peek.label
+
+    def getindex(self):
+        return self._peek.index
+
+    def getpad(self):
+        return self._peek.pad
+
+    def next(self):
+        if not _tel.ENABLED:
+            return self._next_impl()
+        t0 = _time.monotonic()
+        batch = self._next_impl()
+        _tel.histogram("io.batch_fetch_secs").observe(
+            _time.monotonic() - t0)
+        return batch
+
+    def _next_impl(self):
+        from ..io import DataBatch
+
+        while True:
+            resp = self._client.next_batch(
+                self._last_seq, self.credits, self.data_epoch)
+            st = resp.get("status")
+            if st == "evicted":
+                # zombie/restarted incarnation: re-register and resume
+                # at the coordinator's exact frontier (nothing acked is
+                # replayed; nothing unacked is lost)
+                reg = self._client.register()
+                self.data_epoch = int(reg.get("data_epoch",
+                                              self.data_epoch))
+                self._last_seq = -1
+                continue
+            if st == "pending":
+                continue
+            if st == "end_epoch":
+                # the reply's data_epoch is the server's CURRENT pass —
+                # reset() adopts it (authoritative, not local += 1)
+                self._next_epoch = int(resp.get(
+                    "data_epoch", self.data_epoch + 1))
+                raise StopIteration
+            if st == "error":
+                raise MXNetError("data service next failed: %s"
+                                 % resp.get("message"))
+            self._last_seq = int(resp["seq"])
+            records = resp["records"]
+            skipped = int(resp.get("skipped", 0))
+            if skipped:
+                self.num_skipped += skipped
+                if _tel.ENABLED:
+                    _tel.counter("io.records_skipped_total").inc(skipped)
+            sid = int(resp["shard"])
+            self._consumed[sid] = int(resp["lo"]) + int(resp["n"])
+            if not records:
+                continue  # an all-corrupt range: nothing decodable
+            data, labels = self._run_decode(records)
+            pad = self.batch_size - len(records)
+            if pad > 0:
+                reps = [data] + [data[-1:]] * pad
+                data = _np.concatenate(reps, axis=0)
+                lab_tail = labels[-1:] if labels.ndim else labels
+                labels = _np.concatenate(
+                    [labels] + [lab_tail] * pad, axis=0)
+            from ..ndarray import array as _array
+
+            return DataBatch(data=[_array(data)], label=[_array(labels)],
+                             pad=max(0, pad), index=None)
+
+    def _run_decode(self, records):
+        if self._decode is not None:
+            data, labels = self._decode(records)
+            return _np.asarray(data), _np.asarray(labels)
+        return default_decode(records, self.data_shape,
+                              self.label_width, dtype=self.dtype)
+
+    # -- guardian exact-resume bridge ------------------------------------------
+    def mark(self):
+        """Record the consumed frontier (guardian snapshot time): the
+        positions training has incorporated up to now."""
+        self._mark = {"data_epoch": self.data_epoch,
+                      "frontiers": dict(self._consumed)}
+        return self._mark
+
+    def restore_mark(self):
+        """Seek the coordinator back to the last :meth:`mark` — the
+        exact-rollback path that replaces ``MXNET_GUARDIAN_FF_BATCHES``
+        skipping. Returns the restored shard ids ([] when no mark or
+        the pass has moved on)."""
+        if self._mark is None or \
+                self._mark["data_epoch"] != self.data_epoch:
+            return []
+        resp = self._client.seek(self._mark["frontiers"],
+                                 self._mark["data_epoch"])
+        restored = list(resp.get("restored", []))
+        if restored:
+            # everything after the mark will be redelivered: the local
+            # consumed map rolls back with the server
+            for sid in restored:
+                self._consumed[sid] = self._mark["frontiers"][sid]
+        return restored

@@ -1,0 +1,1010 @@
+"""Data-plane coordinator: shard assignment, batch streaming, exact
+frontiers (docs/how_to/data_service.md).
+
+One process owns the packed RecordIO dataset and streams record batches
+to registered workers over the elastic RPC substrate
+(``elastic/protocol.py``). The design collapses three recovery stories
+into one authority:
+
+- **Shards** — each packed file's record range is cut into contiguous
+  shards ``[lo, hi)`` (record indices via the cached offset table,
+  ``recordio.record_index``). The shard→rank map is a *deterministic
+  function of the membership epoch*: sorted live ranks, shard ``i`` →
+  ``ranks[i % n]`` — any two coordinators that saw the same view agree
+  on ownership without negotiation.
+- **Frontiers** — per shard, ``frontier`` is the first record index not
+  yet ACKNOWLEDGED and ``cursor`` the first not yet queued. Delivery is
+  sequential per shard; a batch's records move from cursor-space into
+  frontier-space only when the consuming worker acknowledges them
+  (piggybacked on its next request). The acked stream per shard is
+  therefore contiguous, monotone, and duplicate-free — the property
+  chaos asserts byte-for-byte against an uninterrupted baseline.
+- **Flow control** — the worker grants credits (its prefetch depth);
+  the coordinator prepares at most that many batches ahead per rank
+  (the bounded outbox). A slow consumer therefore bounds the
+  coordinator's memory at ``credits × batch`` per rank, and the
+  ``mxdata.flow_control_stalls_total`` counter says how often the
+  reader out-ran the grants.
+- **Rebalance** — eviction (heartbeat lapse past
+  ``MXNET_DATA_EVICT_AFTER``, the elastic sweeper pattern), graceful
+  leave, and rejoin all bump the membership epoch; shards whose owner
+  changed roll their cursor back to the frontier, so unacknowledged
+  in-flight work is redelivered to the new owner (at-least-once at
+  membership boundaries, exactly-once in the acked frontier stream).
+- **Snapshots** — frontiers + in-flight descriptors + membership land
+  in ``<prefix>.meta`` through the same tmp→fsync→rename discipline as
+  model checkpoints (``_atomic_pickle``); a restarted coordinator
+  restores assignments and resumes the stream with zero duplicate
+  acknowledged records (in-flight batch payloads are re-read lazily
+  through ``seek_record`` — descriptors, not data, are persisted).
+
+The server is jax-free (stdlib + recordio) and runs socketless
+(``bind=None``) under the protocol simulator, which explores delivery
+orderings against the invariants above (``analysis/datasim.py``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import socketserver
+import threading
+import time
+
+from ..base import MXNetError
+from ..resilience import faults as _faults
+from .. import telemetry as _tel
+from ..elastic import protocol
+from ..elastic.server import GroupView, _Server, _WAIT_CAP, _atomic_pickle
+
+__all__ = ["DataCoordinator", "DatasetSpec", "serve"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+def _warm_record_indices(files):
+    """Build (or load) every file's record-offset table NOW, outside
+    any lock: the O(records) header walk of a cold multi-GB pack under
+    the coordinator's state lock would stall every heartbeat behind it
+    and time peers out. The locked spec install then hits warm
+    ``.recidx`` caches."""
+    from .. import recordio as _recordio
+
+    for p in files:
+        _recordio.record_index(p)
+
+
+def _open_seekable_reader(path, corrupt):
+    """A reader pinned to the plain-python file path. The class attr is
+    consulted by ``open()`` DURING ``__init__`` — flipping an instance
+    attr after construction would be too late, and the native
+    prefetcher tears down/respawns its producer thread on every seek
+    (the opposite of what the per-batch ``seek_record`` path wants)."""
+    from .. import recordio as _recordio
+
+    class _SeekableRecordIO(_recordio.MXRecordIO):
+        _USE_NATIVE = False
+
+    return _SeekableRecordIO(path, "r", corrupt=corrupt)
+
+
+class DatasetSpec:
+    """What the service streams: packed files + batch geometry. Built
+    from the ``configure`` op's dict (first configure wins, the
+    set_optimizer discipline — every worker ships the same spec)."""
+
+    def __init__(self, files, batch_size, num_shards=0, corrupt="raise"):
+        self.files = [str(f) for f in files]
+        if not self.files:
+            raise MXNetError("data service: empty file list")
+        self.batch_size = int(batch_size)
+        if self.batch_size < 1:
+            raise MXNetError("data service: batch_size must be >= 1")
+        self.num_shards = int(num_shards)
+        if corrupt not in ("raise", "skip"):
+            raise MXNetError('data service: corrupt must be "raise" or '
+                             '"skip", got %r' % (corrupt,))
+        self.corrupt = corrupt
+
+    def to_wire(self):
+        return {"files": list(self.files), "batch_size": self.batch_size,
+                "num_shards": self.num_shards, "corrupt": self.corrupt}
+
+    @classmethod
+    def from_wire(cls, d):
+        return cls(d["files"], d["batch_size"],
+                   num_shards=d.get("num_shards", 0),
+                   corrupt=d.get("corrupt", "raise"))
+
+
+class _Shard:
+    __slots__ = ("sid", "file_idx", "lo", "hi", "cursor", "frontier")
+
+    def __init__(self, sid, file_idx, lo, hi):
+        self.sid = sid
+        self.file_idx = file_idx
+        self.lo = lo
+        self.hi = hi
+        self.cursor = lo      # first record not yet queued into a batch
+        self.frontier = lo    # first record not yet ACKED
+
+    def state(self):
+        return {"sid": self.sid, "file_idx": self.file_idx,
+                "lo": self.lo, "hi": self.hi, "frontier": self.frontier}
+
+
+class _Batch:
+    """One prepared (or delivered) batch: shard-range descriptor plus
+    the record payloads. Only the descriptor is ever persisted —
+    payloads re-read through the seek index on redelivery."""
+
+    __slots__ = ("seq", "sid", "lo", "n", "records", "skipped", "dpass")
+
+    def __init__(self, sid, lo, n, records, skipped, dpass, seq=None):
+        self.seq = seq
+        self.sid = sid
+        self.lo = lo
+        self.n = n
+        self.records = records
+        self.skipped = skipped
+        self.dpass = dpass
+
+
+class _ReaderPool:
+    """Per-file RecordIO readers behind their own IO mutex (one disk —
+    reads serialize; the coordinator's STATE lock is never held across
+    a read). A separate object so the coordinator class owns exactly
+    one lock and the ``*_locked`` discipline stays mechanically
+    checkable."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._readers = {}
+
+    def read_records(self, spec, file_idx, lo, n):
+        """(records, skipped): up to ``n`` record payloads starting at
+        record index ``lo``. Under corrupt="skip", damaged records
+        inside the range resync past and are counted — the index range
+        [lo, lo+n) is consumed either way, so frontier arithmetic
+        stays exact in index space."""
+        with self._mu:
+            reader = self._readers.get(file_idx)
+            if reader is None:
+                reader = _open_seekable_reader(spec.files[file_idx],
+                                               spec.corrupt)
+                self._readers[file_idx] = reader
+            offsets = reader._record_offsets()
+            reader.seek_record(lo)
+            end_pos = offsets[lo + n] if lo + n < len(offsets) else None
+            skipped0 = reader.num_skipped
+            records = []
+            while len(records) < n:
+                if end_pos is not None and reader.tell() >= end_pos:
+                    break
+                rec = reader.read()
+                if rec is None:
+                    break
+                if end_pos is not None and reader.tell() > end_pos:
+                    # resync under corrupt="skip" jumped past the
+                    # planned range: the record belongs to a later
+                    # index position, not this batch
+                    break
+                records.append(rec)
+            skipped = reader.num_skipped - skipped0
+        return records, skipped
+
+    def close(self):
+        with self._mu:
+            for r in self._readers.values():
+                try:
+                    r.close()
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
+            self._readers.clear()
+
+
+class _DataHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            peer = "%s:%s" % tuple(self.client_address[:2])
+            req = protocol.recv_msg(self.request, peer=peer, what="request")
+            if req is None:
+                return
+            wire = req.pop("_trace", None) if isinstance(req, dict) else None
+            try:
+                with _tel.span("mxdata.serve.%s" % req.get("op"),
+                               wire=wire):
+                    resp = self.server.coordinator._dispatch(req)
+            except MXNetError as e:
+                resp = {"status": "error", "message": str(e)}
+            if _tel.ENABLED and isinstance(resp, dict):
+                resp.setdefault("_srv_t", time.time())
+            protocol.send_msg(self.request, resp)
+        except (OSError, protocol.ProtocolError):
+            pass  # a dying client mid-frame must not log-spam the server
+
+
+class DataCoordinator:
+    """The input-service coordinator. One state lock guards membership,
+    shards, outboxes and counters; record reads drop the lock (the
+    ``_wire_value_droplock`` discipline — disk time must not stall
+    heartbeats)."""
+
+    def __init__(self, world, bind=("127.0.0.1", 0), evict_after=None,
+                 snapshot_prefix=None, snapshot_secs=None, spec=None):
+        if evict_after is None:
+            evict_after = _env_float("MXNET_DATA_EVICT_AFTER", 10.0)
+        if snapshot_secs is None:
+            snapshot_secs = _env_float("MXNET_DATA_SNAPSHOT_SECS", 0.0)
+        from ..analysis.engine_verify import maybe_trace_lock
+
+        self._lock = maybe_trace_lock(
+            threading.Lock(), "data_service.DataCoordinator._lock")
+        self._cond = threading.Condition(self._lock)
+        self.view = GroupView(world, evict_after)
+        self.spec = None
+        self.shards = {}            # sid -> _Shard
+        self.data_epoch = 0         # completed full passes over the set
+        self._assign = {}           # sid -> owner rank
+        self._assign_epoch = -1     # membership epoch the map was built at
+        self._outbox = {}           # rank -> [prepared _Batch] (no seq)
+        self._inflight = {}         # rank -> [delivered _Batch] (seq'd)
+        self._credits = {}          # rank -> granted prefetch depth
+        self._next_seq = {}         # rank -> next delivery sequence no.
+        self._filling = set()       # ranks with a droplock fill in flight:
+        #                             two concurrent fillers (prefetcher +
+        #                             an inline handler fill) would publish
+        #                             their reads in disk-completion order,
+        #                             scrambling — and at an eviction
+        #                             boundary LOSING — the per-shard
+        #                             record sequence the frontier
+        #                             contract guarantees
+        self._io = _ReaderPool()
+        self._t0 = time.monotonic()
+        self.snapshot_prefix = snapshot_prefix
+        self.snapshot_secs = float(snapshot_secs)
+        # counters (plain ints; mirrored into mxdata.* when telemetry on)
+        self.batches_streamed = 0
+        self.records_streamed = 0
+        self.records_skipped = 0
+        self.shards_rebalanced = 0
+        self.flow_control_stalls = 0
+        self.frontier_checkpoints = 0
+        self.frontier_restores = 0
+        self._stop = threading.Event()
+        self._threads = []
+        if spec is not None:
+            with self._lock:
+                self._install_spec_locked(
+                    spec if isinstance(spec, DatasetSpec)
+                    else DatasetSpec.from_wire(spec))
+        if snapshot_prefix and os.path.exists(snapshot_prefix + ".meta"):
+            self._restore_snapshot()
+        if bind is None:
+            # socketless: analysis/datasim.py drives _dispatch directly
+            self._srv = None
+            self.addr = None
+        else:
+            self._srv = _Server(bind, _DataHandler)
+            self._srv.coordinator = self
+            self.addr = self._srv.server_address[:2]
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self):
+        if self._srv is None:
+            raise MXNetError("socketless data coordinator (bind=None) "
+                             "cannot start(): it exists to be driven "
+                             "through _dispatch by the simulator")
+        for name, target in (
+                ("mxtpu-data-serve", self._srv.serve_forever),
+                ("mxtpu-data-sweep", self._sweep_loop),
+                ("mxtpu-data-prefetch", self._prefetch_loop),
+                ("mxtpu-data-snap", self._snapshot_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._lock:
+            self._cond.notify_all()
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+        if self.snapshot_prefix:
+            try:
+                self.save_snapshot()
+            except Exception:
+                logging.exception("data service: final snapshot failed")
+        self._io.close()
+
+    # -- dataset ---------------------------------------------------------------
+    def _install_spec_locked(self, spec):
+        """Open the dataset: build (or load) each file's record index
+        and cut the shard table. First spec wins. (The configure
+        dispatch arm pre-validates the spec outside the lock; the index
+        load here is an mmap-light scan cached beside the .rec.)"""
+        from .. import recordio as _recordio
+
+        counts = [len(_recordio.record_index(p)) for p in spec.files]
+        total = sum(counts)
+        if total == 0:
+            raise MXNetError("data service: dataset %s holds no records"
+                             % (spec.files,))
+        nsh = spec.num_shards
+        if nsh <= 0:
+            # enough shards that every rank owns >= 2 at full strength:
+            # rebalance then has granularity to move work without
+            # stripping any survivor to zero
+            nsh = max(2 * self.view.world, 1)
+            spec.num_shards = nsh
+        shard_size = max(1, -(-total // nsh))
+        shards = {}
+        sid = 0
+        for fi, n in enumerate(counts):
+            lo = 0
+            while lo < n:
+                hi = min(n, lo + shard_size)
+                shards[sid] = _Shard(sid, fi, lo, hi)
+                sid += 1
+                lo = hi
+        self.spec = spec
+        self.shards = shards
+        self._assign_epoch = -1  # force a rebuild at the current epoch
+
+    def _read_records(self, sid, lo, n):
+        """(records, skipped) for shard ``sid``'s index range
+        [lo, lo+n). Runs WITHOUT the state lock — disk time is the
+        reader pool's IO mutex only (spec and each shard's file_idx
+        are immutable once installed)."""
+        sh = self.shards[sid]
+        return self._io.read_records(self.spec, sh.file_idx, lo, n)
+
+    # -- assignment ------------------------------------------------------------
+    def _assignment_locked(self):
+        """Deterministic shard→rank map for the CURRENT membership
+        epoch: sorted live ranks, shard i → ranks[i % n]. Rebuilt only
+        when the epoch moved; shards whose owner changed roll their
+        cursor back to the frontier (in-flight redelivery) and count
+        into ``shards_rebalanced``."""
+        if self._assign_epoch == self.view.epoch:
+            return self._assign
+        ranks = sorted(self.view.live)
+        new = {}
+        if ranks:
+            for i, sid in enumerate(sorted(self.shards)):
+                new[sid] = ranks[i % len(ranks)]
+        had_map = bool(self._assign)
+        moved = [sid for sid in self.shards
+                 if self._assign.get(sid) != new.get(sid)]
+        for sid in moved:
+            self._drop_shard_work_locked(sid)
+        if had_map and moved:
+            self.shards_rebalanced += len(moved)
+            if _tel.ENABLED:
+                _tel.counter("mxdata.shards_rebalanced_total").inc(
+                    len(moved))
+            logging.info(
+                "data service: epoch %d rebalanced %d shard(s) across "
+                "live ranks %s", self.view.epoch, len(moved), ranks)
+        self._assign = new
+        self._assign_epoch = self.view.epoch
+        self._cond.notify_all()
+        return self._assign
+
+    def _drop_shard_work_locked(self, sid):
+        """Forget every prepared/delivered-but-unacked batch of shard
+        ``sid`` and roll its cursor back to the frontier — the records
+        will be redelivered (in order) to the shard's current owner."""
+        sh = self.shards.get(sid)
+        if sh is None:
+            return
+        for box in (self._outbox, self._inflight):
+            for rank in box:
+                box[rank] = [b for b in box[rank] if b.sid != sid]
+        sh.cursor = sh.frontier
+
+    def _drop_rank_work_locked(self, rank):
+        """A dead/restarted incarnation's queued and in-flight batches
+        are returned to their shards (cursor → frontier)."""
+        touched = {b.sid for b in self._outbox.get(rank, [])}
+        touched |= {b.sid for b in self._inflight.get(rank, [])}
+        self._outbox.pop(rank, None)
+        self._inflight.pop(rank, None)
+        self._next_seq.pop(rank, None)
+        for sid in touched:
+            self._drop_shard_work_locked(sid)
+
+    # -- frontier / pass machinery ---------------------------------------------
+    def _ack_locked(self, rank, ack):
+        """Advance frontiers for every in-flight batch of ``rank`` with
+        ``seq <= ack`` (cumulative acknowledgement). The acked ranges
+        are journaled — they ARE the record sequence chaos replays
+        against a baseline."""
+        if ack is None or ack < 0:
+            return
+        inflight = self._inflight.get(rank)
+        if not inflight:
+            return
+        acked, inflight[:] = ([b for b in inflight if b.seq <= ack],
+                              [b for b in inflight if b.seq > ack])
+        for b in acked:
+            sh = self.shards.get(b.sid)
+            if sh is None or b.dpass != self.data_epoch:
+                continue  # a pass boundary already moved past it
+            sh.frontier = max(sh.frontier, b.lo + b.n)
+            b.records = None
+            if _tel.ENABLED:
+                from ..telemetry import export as _export
+
+                _export.emit({"kind": "mxdata", "event": "ack",
+                              "rank": rank, "shard": b.sid, "lo": b.lo,
+                              "hi": b.lo + b.n, "pass": b.dpass})
+        if acked:
+            self._maybe_advance_pass_locked()
+
+    def _maybe_advance_pass_locked(self):
+        """All shards fully acknowledged → the pass is complete: reset
+        every frontier for the next data epoch and wake parked polls
+        (they answer ``end_epoch``)."""
+        if self.spec is None or not self.shards:
+            return
+        if any(sh.frontier < sh.hi for sh in self.shards.values()):
+            return
+        self.data_epoch += 1
+        for sh in self.shards.values():
+            sh.cursor = sh.lo
+            sh.frontier = sh.lo
+        for box in (self._outbox, self._inflight):
+            for rank in box:
+                box[rank] = []
+        logging.info("data service: pass %d complete (%d shards reset)",
+                     self.data_epoch - 1, len(self.shards))
+        self._cond.notify_all()
+
+    # -- batch preparation (bounded prefetch + flow control) -------------------
+    def _headroom_locked(self, rank):
+        credit = self._credits.get(rank, 0)
+        used = len(self._outbox.get(rank, [])) + \
+            len(self._inflight.get(rank, []))
+        return credit - used
+
+    def _plan_batch_locked(self, rank):
+        """Reserve the next batch range for ``rank``: lowest-id owned
+        shard with unqueued records. Advances the cursor (the
+        reservation) and returns ``(sid, lo, n)`` or None."""
+        if self.spec is None:
+            return None
+        assign = self._assignment_locked()
+        for sid in sorted(s for s, r in assign.items() if r == rank):
+            sh = self.shards[sid]
+            if sh.cursor < sh.hi:
+                lo = sh.cursor
+                n = min(self.spec.batch_size, sh.hi - lo)
+                sh.cursor = lo + n
+                return sid, lo, n
+        return None
+
+    def _rank_has_unqueued_locked(self, rank):
+        assign = self._assignment_locked()
+        return any(self.shards[s].cursor < self.shards[s].hi
+                   for s, r in assign.items() if r == rank)
+
+    def _fill_one_droplock(self, rank):
+        """Prepare one batch for ``rank`` if credit headroom allows.
+        Called with the state lock HELD; drops it around the disk read
+        and re-validates before publishing. At most ONE fill per rank
+        is ever in flight (``_filling``) — sequential fills are what
+        keep the outbox in reservation order. Returns True when a
+        batch landed in the outbox."""
+        if rank in self._filling:
+            return False  # another thread's read will publish in order
+        if self._headroom_locked(rank) <= 0:
+            if self._rank_has_unqueued_locked(rank):
+                # records are waiting but the consumer granted no room:
+                # the flow-control stall the telemetry counts
+                self.flow_control_stalls += 1
+                if _tel.ENABLED:
+                    _tel.counter("mxdata.flow_control_stalls_total").inc()
+            return False
+        plan = self._plan_batch_locked(rank)
+        if plan is None:
+            return False
+        sid, lo, n = plan
+        dpass = self.data_epoch
+        self._filling.add(rank)
+        self._lock.release()
+        read_err = None
+        try:
+            try:
+                records, skipped = self._read_records(sid, lo, n)
+            except Exception as e:  # noqa: BLE001 - disk faults heal
+                read_err = e
+        finally:
+            self._lock.acquire()
+            self._filling.discard(rank)
+        if read_err is not None:
+            # the reservation MUST roll back or records [lo, lo+n) are
+            # lost forever (the frontier could never reach hi and every
+            # consumer would park for good). Single-flight fills +
+            # sequential per-shard delivery mean an intact reservation
+            # is still the cursor tail; anything else was already
+            # rolled back by a rebalance/pass boundary.
+            sh = self.shards.get(sid)
+            if sh is not None and self.data_epoch == dpass and \
+                    sh.cursor == lo + n:
+                sh.cursor = lo
+            logging.warning(
+                "data service: batch read of shard %s [%d,%d) failed "
+                "(%s: %s) — reservation rolled back, will retry",
+                sid, lo, lo + n, type(read_err).__name__, read_err)
+            return False
+        sh = self.shards.get(sid)
+        if self.data_epoch != dpass or sh is None or \
+                self._assign.get(sid) != rank or \
+                sh.cursor < lo + n or sh.frontier > lo:
+            # the RESERVATION was invalidated while we were on disk — a
+            # pass boundary or a rebalance rolled the cursor back (the
+            # records re-plan for the current owner), or another owner
+            # already consumed past them. A membership-epoch bump ALONE
+            # (some other rank joined; this shard never moved) must NOT
+            # discard: the reservation is intact and dropping it would
+            # punch a permanent hole in the stream (cursor is already
+            # past these records) — the exact bug chaos --data caught.
+            return False
+        if skipped:
+            self.records_skipped += skipped
+        self._outbox.setdefault(rank, []).append(
+            _Batch(sid, lo, n, records, skipped, dpass))
+        if _tel.ENABLED:
+            _tel.gauge("mxdata.prefetch_queue_depth").set(
+                len(self._outbox[rank]))
+        self._cond.notify_all()
+        return True
+
+    def _prefetch_loop(self):
+        """Bounded read-ahead: keep every live rank's outbox topped up
+        to its granted credits while the workers compute."""
+        with self._lock:
+            while not self._stop.is_set():
+                progressed = False
+                for rank in sorted(self.view.live):
+                    if self._stop.is_set():
+                        break
+                    try:
+                        while self._fill_one_droplock(rank):
+                            progressed = True
+                    except Exception:  # noqa: BLE001 - loop must live
+                        # read faults already heal inside the fill;
+                        # anything else must not kill the prefetcher
+                        # for the rest of the coordinator's life
+                        logging.exception(
+                            "data service: prefetch fill failed for "
+                            "rank %s", rank)
+                if not progressed:
+                    self._cond.wait(0.2)
+
+    # -- background loops ------------------------------------------------------
+    def _sweep_loop(self):
+        interval = max(0.05, self.view.evict_after / 4.0)
+        while not self._stop.wait(interval):
+            try:
+                self.sweep()
+            except _faults.FaultInjected:
+                logging.warning("data service: eviction sweep aborted by "
+                                "injected kv.evict fault")
+            except Exception:
+                logging.exception("data service: eviction sweep failed")
+
+    def sweep(self, now=None):
+        """Evict heartbeat-lapsed ranks, return their in-flight work to
+        the shards, rebalance. Returns the evicted ranks."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            lapsed = self.view.lapsed(now)
+            evicted = []
+            for r in lapsed:
+                _faults.point("kv.evict")
+                if self.view.evict(r):
+                    self._drop_rank_work_locked(r)
+                    evicted.append(r)
+            if evicted:
+                logging.warning(
+                    "data service: evicted rank(s) %s (heartbeat lapse "
+                    "> %.1fs) -> epoch %d, live %s", evicted,
+                    self.view.evict_after, self.view.epoch,
+                    sorted(self.view.live))
+                self._assignment_locked()
+                self._cond.notify_all()
+        return evicted
+
+    def _snapshot_loop(self):
+        if not self.snapshot_prefix or self.snapshot_secs <= 0:
+            return
+        while not self._stop.wait(self.snapshot_secs):
+            try:
+                self.save_snapshot()
+            except Exception:
+                logging.exception("data service: periodic snapshot failed")
+
+    # -- snapshots -------------------------------------------------------------
+    def _counters_locked(self):
+        return {
+            "batches_streamed": self.batches_streamed,
+            "records_streamed": self.records_streamed,
+            "records_skipped": self.records_skipped,
+            "shards_rebalanced": self.shards_rebalanced,
+            "flow_control_stalls": self.flow_control_stalls,
+            "frontier_checkpoints": self.frontier_checkpoints,
+            "frontier_restores": self.frontier_restores,
+            "evictions": self.view.evictions_total,
+            "rejoins": self.view.rejoins_total,
+        }
+
+    def snapshot_state(self):
+        """Persistable state (descriptors only, no record payloads):
+        membership + spec + frontiers + per-rank sequence counters and
+        in-flight descriptors. The in-flight list is what makes a
+        restart duplicate-free: a post-restart ack still matches its
+        batch, so nothing acked is ever redelivered."""
+        with self._lock:
+            return self._snapshot_state_locked()
+
+    def _snapshot_state_locked(self):
+        inflight = {}
+        for rank, batches in self._inflight.items():
+            inflight[rank] = [(b.seq, b.sid, b.lo, b.n, b.dpass)
+                              for b in batches]
+        return {
+            "view": self.view.snapshot_state(),
+            "spec": self.spec.to_wire() if self.spec else None,
+            "data_epoch": self.data_epoch,
+            "shards": [sh.state() for sh in self.shards.values()],
+            "next_seq": dict(self._next_seq),
+            "inflight": inflight,
+            "counters": self._counters_locked(),
+        }
+
+    def restore_state(self, st, now=None):
+        """Rebuild from :meth:`snapshot_state` output. Prepared-but-
+        undelivered outbox batches are NOT restored (they were never
+        seen by a client); in-flight descriptors are, with payloads
+        re-read lazily on redelivery."""
+        now = time.monotonic() if now is None else now
+        if st.get("spec"):
+            _warm_record_indices(st["spec"].get("files", []))
+        with self._lock:
+            self.view.restore_state(st["view"], now)
+            if st.get("spec"):
+                self._install_spec_locked(
+                    DatasetSpec.from_wire(st["spec"]))
+            self.data_epoch = int(st.get("data_epoch", 0))
+            by_sid = {s["sid"]: s for s in st.get("shards", [])}
+            for sid, sh in self.shards.items():
+                rec = by_sid.get(sid)
+                if rec is not None:
+                    sh.frontier = int(rec["frontier"])
+                    sh.cursor = sh.frontier
+            self._next_seq = {int(r): int(v)
+                              for r, v in st.get("next_seq", {}).items()}
+            self._outbox = {}
+            self._inflight = {}
+            for rank, batches in st.get("inflight", {}).items():
+                rank = int(rank)
+                lst = []
+                for seq, sid, lo, n, dpass in batches:
+                    if sid not in self.shards or dpass != self.data_epoch:
+                        continue
+                    sh = self.shards[sid]
+                    sh.cursor = max(sh.cursor, lo + n)
+                    lst.append(_Batch(sid, lo, n, None, 0, dpass,
+                                      seq=seq))
+                if lst:
+                    self._inflight[rank] = sorted(
+                        lst, key=lambda b: b.seq)
+            ctr = st.get("counters", {})
+            self.batches_streamed = int(ctr.get("batches_streamed", 0))
+            self.records_streamed = int(ctr.get("records_streamed", 0))
+            self.records_skipped = int(ctr.get("records_skipped", 0))
+            self.shards_rebalanced = int(ctr.get("shards_rebalanced", 0))
+            self.flow_control_stalls = int(
+                ctr.get("flow_control_stalls", 0))
+            self.frontier_checkpoints = int(
+                ctr.get("frontier_checkpoints", 0))
+            self.frontier_restores = int(ctr.get("frontier_restores", 0))
+            self._assign_epoch = -1
+            self._assignment_locked()
+
+    def save_snapshot(self):
+        """Frontier checkpoint: the atomic tmp→fsync→rename discipline
+        of model._write_params_atomic, meta-pickle edition. The write
+        happens OUTSIDE the state lock (fsync under the lock would
+        stall every heartbeat behind the disk)."""
+        if not self.snapshot_prefix:
+            raise MXNetError("data coordinator has no snapshot prefix")
+        st = self.snapshot_state()
+        _atomic_pickle(self.snapshot_prefix + ".meta", st)
+        with self._lock:
+            self.frontier_checkpoints += 1
+        if _tel.ENABLED:
+            _tel.counter("mxdata.frontier_checkpoints_total").inc()
+
+    def _restore_snapshot(self):
+        import pickle
+
+        with open(self.snapshot_prefix + ".meta", "rb") as f:
+            st = pickle.loads(f.read())
+        self.restore_state(st)
+        # warning level: a restart-recovery event operators (and the
+        # chaos harness) must be able to see without -v
+        logging.warning(
+            "data service: restored frontier snapshot %s (epoch %d, "
+            "pass %d, %d shards)", self.snapshot_prefix, self.view.epoch,
+            self.data_epoch, len(self.shards))
+
+    # -- request dispatch ------------------------------------------------------
+    def _require_live(self, rank):
+        if rank in self.view.live:
+            return None
+        return {"status": "evicted", "epoch": self.view.epoch}
+
+    def _stats_locked(self):
+        assign = self._assignment_locked()
+        per_rank = {}
+        for sid, rank in assign.items():
+            per_rank[rank] = per_rank.get(rank, 0) + 1
+        lag = max((sh.cursor - sh.frontier
+                   for sh in self.shards.values()), default=0)
+        uptime = max(1e-9, time.monotonic() - self._t0)
+        return {"status": "ok", "epoch": self.view.epoch,
+                "live": sorted(self.view.live),
+                "world": self.view.world,
+                "data_epoch": self.data_epoch,
+                "spec": self.spec.to_wire() if self.spec else None,
+                "shards": {sh.sid: dict(sh.state(), cursor=sh.cursor,
+                                        rank=assign.get(sh.sid))
+                           for sh in self.shards.values()},
+                "shards_per_rank": per_rank,
+                "frontier_lag_max": lag,
+                "stall_rate": self.flow_control_stalls / uptime,
+                "counters": self._counters_locked()}
+
+    def _dispatch(self, req):
+        op = req.get("op")
+        rank = int(req.get("rank", -1))
+        now = time.monotonic()
+        pre_spec = None
+        if op == "configure":
+            # index building scans files — do it OUTSIDE the state lock
+            # (the set_optimizer preloaded-decode discipline). A racing
+            # duplicate configure wastes the scan, never stalls beats.
+            pre_spec = DatasetSpec.from_wire(req["spec"])
+            _warm_record_indices(pre_spec.files)
+        with self._lock:
+            if op == "register":
+                epoch, rejoined = self.view.register(rank, now)
+                self._drop_rank_work_locked(rank)
+                self._credits.setdefault(rank, 1)
+                self._assignment_locked()
+                return {"status": "ok", "epoch": epoch,
+                        "rejoined": rejoined,
+                        "world": self.view.world,
+                        "data_epoch": self.data_epoch,
+                        "spec": self.spec.to_wire() if self.spec else None,
+                        "counters": self._counters_locked()}
+            if op == "beat":
+                self.view.beat(rank, now)
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": rank in self.view.live}
+            if op == "view":
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": sorted(self.view.live),
+                        "world": self.view.world,
+                        "data_epoch": self.data_epoch,
+                        "counters": self._counters_locked()}
+            if op == "configure":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                installed = False
+                if self.spec is None:
+                    self._install_spec_locked(pre_spec)
+                    self._assignment_locked()
+                    self._cond.notify_all()
+                    installed = True
+                return {"status": "ok", "installed": installed,
+                        "spec": self.spec.to_wire(),
+                        "data_epoch": self.data_epoch}
+            if op == "next":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                if self.spec is None:
+                    return {"status": "error",
+                            "message": "data service not configured — "
+                                       "pass files= to one worker's "
+                                       "DataServiceIter"}
+                self.view.beat(rank, now)  # streaming IS liveness
+                self._ack_locked(rank, int(req.get("ack", -1)))
+                credits = int(req.get("credits", 1) or 1)
+                self._credits[rank] = max(1, credits)
+                dpass = int(req.get("data_epoch", self.data_epoch))
+                deadline = now + min(float(req.get("wait", 0.0) or 0.0),
+                                     _WAIT_CAP)
+                while True:
+                    err = self._require_live(rank)
+                    if err:
+                        return err
+                    if self.data_epoch > dpass:
+                        return {"status": "end_epoch",
+                                "data_epoch": self.data_epoch,
+                                "epoch": self.view.epoch}
+                    b = self._deliver_locked(rank)
+                    if b is not None:
+                        return {"status": "ok", "seq": b.seq,
+                                "shard": b.sid, "lo": b.lo, "n": b.n,
+                                "records": b.records,
+                                "skipped": b.skipped,
+                                "data_epoch": b.dpass,
+                                "epoch": self.view.epoch}
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return {"status": "pending",
+                                "data_epoch": self.data_epoch,
+                                "epoch": self.view.epoch}
+                    self._cond.wait(min(remaining, 0.5))
+            if op == "seek":
+                err = self._require_live(rank)
+                if err:
+                    return err
+                restored = self._seek_locked(
+                    rank, req["frontiers"],
+                    int(req.get("data_epoch", self.data_epoch)))
+                return {"status": "ok", "restored": restored,
+                        "data_epoch": self.data_epoch}
+            if op == "leave":
+                self._ack_locked(rank, int(req.get("ack", -1)))
+                if self.view.leave(rank):
+                    self._drop_rank_work_locked(rank)
+                    self._assignment_locked()
+                return {"status": "ok", "epoch": self.view.epoch}
+            if op == "evict":
+                _faults.point("kv.evict")
+                if self.view.evict(rank):
+                    self._drop_rank_work_locked(rank)
+                    self._assignment_locked()
+                return {"status": "ok", "epoch": self.view.epoch,
+                        "live": sorted(self.view.live)}
+            if op == "stats":
+                return self._stats_locked()
+        if op == "snapshot":
+            if not self.snapshot_prefix:
+                return {"status": "error",
+                        "message": "data coordinator has no snapshot "
+                                   "prefix"}
+            self.save_snapshot()  # takes the lock itself
+            return {"status": "ok"}
+        return {"status": "error", "message": "unknown op %r" % (op,)}
+
+    def _deliver_locked(self, rank):
+        """One delivery for ``rank``, as a :class:`_Batch` (the arm
+        builds the wire reply): a lost-reply retry's redelivery first
+        (lowest unacked in-flight seq), else the next prepared outbox
+        batch (filled inline when the prefetcher has not run — the
+        socketless/sim path)."""
+        inflight = self._inflight.get(rank, [])
+        if inflight:
+            b = inflight[0]
+            if b.records is None:
+                # restored from a snapshot: re-read through the index
+                self._lock.release()
+                try:
+                    records, skipped = self._read_records(b.sid, b.lo, b.n)
+                finally:
+                    self._lock.acquire()
+                b.records, b.skipped = records, skipped
+            return b
+        box = self._outbox.get(rank, [])
+        if not box:
+            self._fill_one_droplock(rank)
+            box = self._outbox.get(rank, [])
+        if not box:
+            return None
+        b = box.pop(0)
+        b.seq = self._next_seq.get(rank, 0)
+        self._next_seq[rank] = b.seq + 1
+        self._inflight.setdefault(rank, []).append(b)
+        self.batches_streamed += 1
+        self.records_streamed += len(b.records)
+        if _tel.ENABLED:
+            _tel.counter("mxdata.batches_streamed_total").inc()
+            _tel.counter("mxdata.records_streamed_total").inc(
+                len(b.records))
+            from ..telemetry import export as _export
+
+            _export.emit({"kind": "mxdata", "event": "deliver",
+                          "rank": rank, "seq": b.seq, "shard": b.sid,
+                          "lo": b.lo, "hi": b.lo + b.n, "pass": b.dpass})
+        return b
+
+    def _seek_locked(self, rank, frontiers, dpass):
+        """Exact-restore for the guardian rollback path: rewind the
+        frontiers of ``rank``'s shards to the marked positions. Only
+        shards the rank currently owns move (a rebalance between mark
+        and restore keeps other ranks' streams untouched)."""
+        if dpass != self.data_epoch:
+            return []
+        assign = self._assignment_locked()
+        # the rank's whole pipeline resets — queued prefetch for OTHER
+        # shards would otherwise deliver ahead of the restored ones and
+        # the replay would not be the original sequence. Sequence
+        # numbers stay monotonic (unlike a re-registration) so a stale
+        # pre-restore ack can never claim a post-restore delivery.
+        touched = {b.sid for b in self._outbox.get(rank, [])}
+        touched |= {b.sid for b in self._inflight.get(rank, [])}
+        self._outbox.pop(rank, None)
+        self._inflight.pop(rank, None)
+        for sid in touched:
+            self._drop_shard_work_locked(sid)
+        restored = []
+        for sid, pos in frontiers.items():
+            sid = int(sid)
+            sh = self.shards.get(sid)
+            if sh is None or assign.get(sid) != rank:
+                continue
+            pos = max(sh.lo, min(sh.hi, int(pos)))
+            self._drop_shard_work_locked(sid)
+            sh.frontier = pos
+            sh.cursor = pos
+            restored.append(sid)
+        if restored:
+            self.frontier_restores += len(restored)
+            if _tel.ENABLED:
+                _tel.counter("mxdata.frontier_restores_total").inc(
+                    len(restored))
+            self._cond.notify_all()
+        return restored
+
+
+def serve(world, bind, evict_after=None, snapshot_prefix=None,
+          snapshot_secs=None, spec=None):
+    """Foreground data coordinator (``python -m mxnet_tpu.data_service``).
+    SIGTERM lands a final frontier snapshot before exit — the
+    coordinator-restart chaos leg's zero-duplicate contract."""
+    import signal
+
+    coord = DataCoordinator(
+        world, bind=bind, evict_after=evict_after,
+        snapshot_prefix=snapshot_prefix, snapshot_secs=snapshot_secs,
+        spec=spec)
+    coord.start()
+
+    def _term(_sig, _frm):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    print("data coordinator: serving %d-worker group on %s:%d"
+          % (world, coord.addr[0], coord.addr[1]), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        coord.stop()
+        # drain window + explicit flush: a handler thread that was
+        # mid-dispatch when SIGTERM landed may emit its ack journal
+        # record AFTER the atexit flush would have run — the chaos
+        # exactness proof reads that journal, so the record must land
+        time.sleep(0.25)
+        try:
+            from .. import telemetry as _tel_mod
+
+            _tel_mod.flush(mark="exit")
+        except Exception:
+            pass

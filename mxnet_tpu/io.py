@@ -27,7 +27,21 @@ from .ndarray import NDArray, array
 __all__ = [
     "DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "CSVIter",
     "ResizeIter", "PrefetchingIter", "ImageRecordIter", "DataDesc",
+    "DataServiceIter",
 ]
+
+
+def __getattr__(name):
+    # DataServiceIter lives in the data_service package (it imports
+    # this module's DataIter protocol classes); the lazy re-export
+    # keeps the local-read path import-cycle-free AND zero-cost — with
+    # no data service in play, nothing from that package ever loads
+    if name == "DataServiceIter":
+        from .data_service.client import DataServiceIter
+
+        return DataServiceIter
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
 
 
 class DataDesc:

@@ -378,6 +378,11 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names, arg_para
         kvstore=kvstore, epoch_end_callback=epoch_end_callback, logger=logger)
     if guard is not None and eval_metric is not None:
         guard.attach_metric(eval_metric)  # loss-like metrics only
+    if guard is not None:
+        # exact-resume bridge: a data-service iterator marks its
+        # frontier at every guardian snapshot, so rollback replays the
+        # exact records instead of MXNET_GUARDIAN_FF_BATCHES skipping
+        guard.attach_data_iter(train_data)
     K = _scan_k()
     _scan_attempted = False
     if (K > 1 and len(ctx) == 1 and kvstore is None and not update_on_kvstore

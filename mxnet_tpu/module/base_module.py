@@ -280,6 +280,10 @@ class BaseModule:
             # loss z-score channel: live when the eval metric is
             # loss-like (ce/perplexity/mse/...), inert for accuracy
             guard.attach_metric(eval_metric)
+            # exact-resume bridge (docs/how_to/data_service.md): a
+            # frontier-capable iterator replaces the approximate
+            # fast-forward on rollback
+            guard.attach_data_iter(train_data)
 
         # K-step-scanned fast path (parallel/fit_trainer.py) — plain
         # single-device Module only; returns False and falls through to

@@ -19,7 +19,7 @@ from .resilience import faults as _faults
 
 __all__ = [
     "MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-    "pack_img", "unpack_img",
+    "pack_img", "unpack_img", "record_index",
 ]
 
 _kMagic = 0xCED7230A
@@ -29,6 +29,134 @@ _MAGIC_BYTES = struct.pack("<I", _kMagic)
 IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
 _IR_FORMAT = "IfQQ"
 _IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+#: sidecar suffix for the cached record-offset table (record_index)
+_IDX_CACHE_SUFFIX = ".recidx"
+_IDX_CACHE_MAGIC = b"MXRIDX1\n"
+
+
+def _index_resync(f, from_pos, size):
+    """Next 4-byte-aligned magic strictly after ``from_pos``, or None.
+    The index-builder's twin of MXRecordIO._resync: a damaged header
+    must not truncate the whole tail of the table."""
+    pos = (from_pos + 4) & ~3
+    f.seek(pos)
+    tail = b""
+    while True:
+        chunk = f.read(1 << 16)
+        if not chunk:
+            return None
+        buf = tail + chunk
+        base = pos - len(tail)
+        i = buf.find(_MAGIC_BYTES)
+        while i != -1:
+            if (base + i) % 4 == 0:
+                return base + i
+            i = buf.find(_MAGIC_BYTES, i + 1)
+        tail = buf[-3:]
+        pos += len(chunk)
+        if pos > size:
+            return None
+
+
+def _scan_record_offsets(path):
+    """Byte offset of every LOGICAL record's first header in a packed
+    file, by walking the [magic][cflag|len] framing and seeking over
+    payloads (no payload bytes are read). Multipart records (cflag
+    1..3) index at their head part. A corrupt header resyncs to the
+    next aligned magic (the corrupt="skip" discipline): the damaged
+    record simply has no table entry, so readers seeking through the
+    index silently skip it — the same records the sequential skip path
+    would lose."""
+    offsets = []
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        open_multipart = False
+        while True:
+            pos = f.tell()
+            head = f.read(8)
+            if len(head) < 8:
+                break
+            magic, lrec = struct.unpack("<II", head)
+            length = lrec & _kLenMask
+            cflag = lrec >> 29
+            bad = magic != _kMagic or pos + 8 + length > size
+            if not bad and (cflag in (2, 3)) and not open_multipart:
+                bad = True  # orphan continuation: its head is gone
+            if bad:
+                nxt = _index_resync(f, pos, size)
+                if nxt is None:
+                    break
+                f.seek(nxt)
+                open_multipart = False
+                continue
+            if cflag == 0 or cflag == 1:
+                offsets.append(pos)
+                open_multipart = cflag == 1
+            if cflag == 3:
+                open_multipart = False
+            f.seek(length + ((4 - length % 4) % 4), os.SEEK_CUR)
+    return offsets
+
+
+def _quarantine_index_cache(cache_path, why):
+    """PR 6 tuning-db discipline: an undecodable sidecar is renamed
+    aside (never deleted — it is evidence) and counted; the caller
+    rebuilds from the authoritative .rec."""
+    if _tel.ENABLED:
+        _tel.counter("io.record_index_corrupt_total").inc()
+    try:
+        os.replace(cache_path, cache_path + ".corrupt")
+    except OSError:
+        pass
+    import logging
+
+    logging.warning("recordio: quarantined corrupt record-index cache "
+                    "%s (%s) — rebuilding from the .rec", cache_path, why)
+
+
+def record_index(path, cache=True):
+    """Record-number -> byte-offset table for a packed RecordIO file.
+
+    Built once by scanning the framing headers and cached beside the
+    ``.rec`` (``<path>.recidx``) keyed by the file's mtime+size, so a
+    frontier restore (data_service) or any random access is an O(1)
+    seek instead of an O(n) re-read of the pack. A stale cache (the
+    .rec changed) silently rebuilds; an undecodable cache is
+    quarantined to ``<path>.recidx.corrupt`` and counted
+    (``io.record_index_corrupt_total``) — the tuning-db discipline:
+    corruption never crashes a run. Returns a list of byte offsets."""
+    st = os.stat(path)
+    cache_path = path + _IDX_CACHE_SUFFIX
+    if cache and os.path.exists(cache_path):
+        try:
+            with open(cache_path, "rb") as f:
+                blob = f.read()
+            if not blob.startswith(_IDX_CACHE_MAGIC):
+                raise ValueError("bad index magic")
+            mtime_ns, size, count = struct.unpack(
+                "<qqq", blob[len(_IDX_CACHE_MAGIC):len(_IDX_CACHE_MAGIC) + 24])
+            body = blob[len(_IDX_CACHE_MAGIC) + 24:]
+            if len(body) != 8 * count:
+                raise ValueError("truncated offset table")
+            if mtime_ns == st.st_mtime_ns and size == st.st_size:
+                return list(struct.unpack("<%dq" % count, body))
+            # stale, not corrupt: the .rec was rewritten — rebuild below
+        except (ValueError, struct.error) as e:
+            _quarantine_index_cache(cache_path, e)
+    offsets = _scan_record_offsets(path)
+    if cache:
+        blob = _IDX_CACHE_MAGIC + struct.pack(
+            "<qqq", st.st_mtime_ns, st.st_size, len(offsets)) + \
+            struct.pack("<%dq" % len(offsets), *offsets)
+        tmp = "%s.tmp-%d" % (cache_path, os.getpid())
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass  # a read-only dataset dir still gets the in-memory table
+    return offsets
 
 
 class MXRecordIO:
@@ -141,6 +269,31 @@ class MXRecordIO:
             self._nlib.rio_reader_seek(self._nh, pos)
         else:
             self.handle.seek(pos)
+
+    def seek_record(self, offset):
+        """Position the reader at record number ``offset`` (0-based) in
+        O(1) via the cached offset table (:func:`record_index`) — the
+        data service's frontier restore, which must not re-scan the
+        pack. Raises IndexError past the end; ``seek_record(n)`` with
+        ``n == num_records()`` is allowed and positions at EOF."""
+        assert not self.writable
+        idx = self._record_offsets()
+        n = int(offset)
+        if n < 0 or n > len(idx):
+            raise IndexError(
+                "record offset %d out of range [0, %d] in %s"
+                % (n, len(idx), self.uri))
+        self._seek(idx[n] if n < len(idx) else os.path.getsize(self.uri))
+
+    def num_records(self):
+        """Logical record count of the pack (index length)."""
+        return len(self._record_offsets())
+
+    def _record_offsets(self):
+        cached = getattr(self, "_rec_offsets", None)
+        if cached is None:
+            cached = self._rec_offsets = record_index(self.uri)
+        return cached
 
     def write(self, buf):
         assert self.writable

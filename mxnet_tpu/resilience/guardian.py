@@ -515,6 +515,7 @@ class TrainingGuardian:
         self._last_good_step = 0
         self._discard_next_chunk = False
         self._loss_feed = None
+        self._data_iter = None   # exact-resume frontier bridge (attach_data_iter)
         # elastic stores mirror the coordinator's guard skips into this
         # worker's guardian.* counters; local vote-path accounting must
         # then not ALSO count the same poisoned round (double count)
@@ -545,6 +546,34 @@ class TrainingGuardian:
         (active only for loss-like metrics — see MetricLossFeed)."""
         self._loss_feed = MetricLossFeed(eval_metric)
         return self._loss_feed.active
+
+    def attach_data_iter(self, data_iter):
+        """Register the training iterator for exact-resume rollback.
+        When the iterator speaks the data-service frontier protocol
+        (``mark()``/``restore_mark()`` — DataServiceIter,
+        docs/how_to/data_service.md), every ring snapshot also marks
+        the consumed frontier, and :meth:`rollback` seeks the stream
+        back to it instead of the approximate
+        ``MXNET_GUARDIAN_FF_BATCHES`` skip. Inert (zero-cost) for
+        local-read iterators."""
+        if hasattr(data_iter, "mark") and \
+                hasattr(data_iter, "restore_mark"):
+            self._data_iter = data_iter
+        return self._data_iter is not None
+
+    def _mark_data_iter(self):
+        """Pin the stream frontier to the snapshot just taken: the
+        rollback target's data position."""
+        it = self._data_iter
+        if it is None:
+            return
+        try:
+            it.mark()
+        except Exception as e:  # noqa: BLE001 - a mark must never kill fit
+            self.logger.warning(
+                "guardian: data-service frontier mark failed (%s: %s) — "
+                "rollback will fall back to fast-forward",
+                type(e).__name__, e)
 
     def metric_step_loss(self):
         feed = self._loss_feed
@@ -708,6 +737,7 @@ class TrainingGuardian:
                 or self._discard_next_chunk:
             return False
         self.ring.push(self.step, payload)
+        self._mark_data_iter()
         if _tel.ENABLED:
             _tel.gauge("guardian.last_good_age").set(0)
         return True
@@ -723,6 +753,7 @@ class TrainingGuardian:
                 and len(self.ring):
             return False
         self.ring.push(self.step, payload_fn())
+        self._mark_data_iter()
         if _tel.ENABLED:
             _tel.gauge("guardian.last_good_age").set(0)
         return True
@@ -776,7 +807,15 @@ class TrainingGuardian:
         self.rollbacks += 1
         if _tel.ENABLED:
             _tel.counter("guardian.rollbacks").inc()
-        if data_iter is not None and self.cfg.ff_batches:
+        if data_iter is None:
+            data_iter = self._data_iter
+        restored = self._restore_frontier(data_iter)
+        if restored:
+            self.logger.warning(
+                "guardian: data-service frontier restored for shard(s) "
+                "%s — the run replays the exact records after the "
+                "snapshot (no approximate fast-forward)", restored)
+        elif data_iter is not None and self.cfg.ff_batches:
             n = fast_forward(data_iter, self.cfg.ff_batches)
             self.logger.warning("guardian: fast-forwarded the data "
                                 "iterator %d batch(es)", n)
@@ -788,6 +827,22 @@ class TrainingGuardian:
         # re-accounted as a fresh poisoned streak
         self._discard_next_chunk = True
         return target
+
+    def _restore_frontier(self, data_iter):
+        """Exact-resume half of the rollback: seek a frontier-capable
+        iterator (DataServiceIter) back to its last mark. Returns the
+        restored shard ids ([] when unavailable — the fast-forward
+        fallback then applies)."""
+        if data_iter is None or not hasattr(data_iter, "restore_mark"):
+            return []
+        try:
+            return list(data_iter.restore_mark() or [])
+        except Exception as e:  # noqa: BLE001 - degrade, never kill fit
+            self.logger.warning(
+                "guardian: data-service frontier restore failed "
+                "(%s: %s) — falling back to fast-forward",
+                type(e).__name__, e)
+            return []
 
     # -- scanned-path bridge ---------------------------------------------------
     def drain_chunk(self, flags, losses=None):

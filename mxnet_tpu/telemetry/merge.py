@@ -211,8 +211,26 @@ def straggler_report(merged, rows=None):
         for e in per_epoch:
             votes[e["straggler"]] = votes.get(e["straggler"], 0) + 1
         overall = max(sorted(votes), key=lambda r: votes[r])
+    # boundedness labels from the mxprof step-breakdown rows
+    # (MXNET_PROF=1): an *input*-bound "straggler" is input starvation
+    # — the fix is the data plane (shards, credits, prefetch), not
+    # evict-replace — so the attribution carries the distinction
+    # instead of letting a stalled input pipeline read as a slow rank.
+    # Verdicts are weighted by each path's total seconds: a rank's few
+    # host-bound eval steps must not outvote its dominant training path.
+    votes = {}
+    for row in prof_rows(merged):
+        b = row.get("bound")
+        if b:
+            w = votes.setdefault(row["rank"], {})
+            w[b] = w.get(b, 0.0) + float(row.get("total_s") or 0.0) \
+                + 1e-12
+    bounds = {rank: max(sorted(w), key=lambda b: w[b])
+              for rank, w in votes.items()}
     return {"straggler": overall, "truncated": truncated,
-            "incomplete": incomplete, "per_epoch": per_epoch}
+            "incomplete": incomplete, "per_epoch": per_epoch,
+            "bounds": bounds,
+            "straggler_bound": bounds.get(overall)}
 
 
 def cross_rank_rows(merged):
@@ -383,10 +401,19 @@ def render_summary(merged, top_traces=5):
                         {r: round(w, 3)
                          for r, w in sorted(e["waits"].items())}))
     if rep["straggler"] is not None:
-        lines.append("straggler: rank %d%s"
-                     % (rep["straggler"],
-                        " (journal truncated — killed?)"
-                        if rep["straggler"] in rep["truncated"] else ""))
+        bound = rep.get("straggler_bound")
+        note = ""
+        if rep["straggler"] in rep["truncated"]:
+            note = " (journal truncated — killed?)"
+        elif bound == "input":
+            # input stall != straggler: the rank is starved by the data
+            # plane, not slow — evicting it would fix nothing
+            note = (" [input-bound — input starvation, not a compute "
+                    "straggler: check the data service (mxdata.* "
+                    "stalls), not the rank]")
+        elif bound is not None:
+            note = " [%s-bound]" % bound
+        lines.append("straggler: rank %d%s" % (rep["straggler"], note))
     else:
         lines.append("straggler: none identified")
     return lines

@@ -539,8 +539,15 @@ def note_step(path, phases, key=None, batches=1, samples=None,
             _tel.histogram("prof.step.%s_secs" % p).observe(v)
         global _last_gauge_t
         now = time.monotonic()
-        if now - _last_gauge_t >= _GAUGE_REFRESH_SECS:
-            _last_gauge_t = now
+        with _lock:
+            # the throttle stamp is written under the module lock
+            # everywhere (reset() holds it too); the derived()/
+            # memory_stats work below stays outside the critical
+            # section — only the claim of this refresh window is locked
+            refresh = now - _last_gauge_t >= _GAUGE_REFRESH_SECS
+            if refresh:
+                _last_gauge_t = now
+        if refresh:
             d = derived()
             if d.get("mfu") is not None:
                 _tel.gauge("prof.mfu").set(d["mfu"])

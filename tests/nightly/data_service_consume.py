@@ -1,0 +1,93 @@
+"""Data-service consumer, one worker of a multi-process job.
+
+The chaos/acceptance workload for the sharded streaming input service
+(tools/chaos.py --data; docs/how_to/data_service.md): each rank streams
+batches from the coordinator named by ``MXNET_DATA_COORD`` for
+``MXNET_DATA_TEST_PASSES`` full passes, journaling every consumed
+record id to ``MXNET_DATA_TEST_OUT/consumed-<rank>.txt``. The
+coordinator's own telemetry journal carries the authoritative acked
+frontier stream (``{"kind": "mxdata", "event": "ack"}`` records) —
+that stream, not the per-worker files, is what the harness compares
+byte-for-byte against an uninterrupted baseline (a worker SIGKILLed
+between consuming and acknowledging a batch legitimately consumes its
+tail twice; the acked stream never does).
+
+Controlled self-destruction, the dist_elastic_fit discipline:
+
+  MXNET_DATA_TEST_DIE_RANK   rank that SIGKILLs itself mid-pass
+  MXNET_DATA_TEST_DIE_AT     batch count at which it dies
+  MXNET_DATA_TEST_MARK       marker dir: die only if no marker yet
+                             (the restarted incarnation survives —
+                             the rejoin leg)
+  MXNET_DATA_TEST_SLEEP      per-batch sleep (secs): paces the stream
+                             so the coordinator-restart leg lands its
+                             SIGTERM mid-run deterministically
+
+Launch::
+
+    python tools/launch.py -n 4 --launcher local --data-service \\
+        --data-files data.rec --data-batch 8 --max-restarts 1 -- \\
+        python tests/nightly/data_service_consume.py
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def main():
+    from mxnet_tpu.data_service.client import DataServiceIter
+
+    rank = int(os.environ.get("MXNET_PROC_ID", "0"))
+    world = int(os.environ.get("MXNET_NUM_PROCS", "1"))
+    passes = int(os.environ.get("MXNET_DATA_TEST_PASSES", "1"))
+    out_dir = os.environ.get("MXNET_DATA_TEST_OUT", ".")
+    dim = int(os.environ.get("MXNET_DATA_TEST_DIM", "8"))
+    sleep_s = float(os.environ.get("MXNET_DATA_TEST_SLEEP", "0"))
+
+    die_rank = int(os.environ.get("MXNET_DATA_TEST_DIE_RANK", "-1"))
+    die_at = int(os.environ.get("MXNET_DATA_TEST_DIE_AT", "0"))
+    mark_dir = os.environ.get("MXNET_DATA_TEST_MARK", "")
+    marker = os.path.join(mark_dir, "died-rank-%d" % rank) \
+        if mark_dir else ""
+
+    # the spec (files/batch) was installed by the launcher or a peer;
+    # this worker only needs the coordinator address from the env
+    it = DataServiceIter(data_shape=(dim,), rank=rank)
+    out_path = os.path.join(out_dir, "consumed-%d.txt" % rank)
+    batches = records = 0
+    with open(out_path, "a") as out:
+        for _pass in range(passes):
+            for batch in it:
+                d = batch.data[0].asnumpy()
+                n = batch.data[0].shape[0] - batch.pad
+                # record ids ride payload slot 0 (the harness packs them)
+                out.write("".join("%d\n" % int(d[j, 0]) for j in range(n)))
+                out.flush()
+                batches += 1
+                records += n
+                if sleep_s > 0:
+                    import time
+
+                    time.sleep(sleep_s)
+                if rank == die_rank and die_at > 0 and \
+                        batches >= die_at and \
+                        not (marker and os.path.exists(marker)):
+                    if marker:
+                        with open(marker, "w") as f:
+                            f.write("died at batch %d\n" % batches)
+                    sys.stderr.write(
+                        "rank %d: SIGKILLing self mid-pass (batch %d)\n"
+                        % (rank, batches))
+                    sys.stderr.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
+            it.reset()
+    it.close()
+    print("rank %d/%d: data service OK batches=%d records=%d skipped=%d"
+          % (rank, world, batches, records, it.num_skipped), flush=True)
+
+
+if __name__ == "__main__":
+    main()

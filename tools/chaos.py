@@ -915,6 +915,7 @@ def run_proto(args):
     import time as _time
 
     from mxnet_tpu import telemetry
+    from mxnet_tpu.analysis.datasim import data_survival_suite
     from mxnet_tpu.analysis.protosim import survival_suite
 
     budget = int(os.environ.get("MXPROTO_SCHEDULES", "0") or 0) or 50
@@ -922,6 +923,9 @@ def run_proto(args):
           % (args.seed, budget))
     t0 = _time.time()
     findings, lines = survival_suite(seed=args.seed, schedules=budget)
+    dfs, dlines = data_survival_suite(seed=args.seed, schedules=budget)
+    findings.extend(dfs)
+    lines.extend(dlines)
     wall = _time.time() - t0
     telemetry.flush(mark="exit")
     counters = fold_telemetry(journal)
@@ -954,13 +958,351 @@ def run_proto(args):
         for f in findings:
             print(" - %s" % f)
         return 8
-    print("\nRESULT: SURVIVED — both seeded protocol mutants were "
-          "found and replayed from their (seed, index) pairs; the "
-          "all-reduce, barrier and shard-update workloads survived "
-          "every explored message schedule (delivery reorder, reply "
-          "loss, duplication, crash, eviction, restart, snapshot "
+    print("\nRESULT: SURVIVED — all four seeded protocol mutants "
+          "(elastic epoch-regress + unguarded completion, data-service "
+          "double-delivery + frontier-regress) were found and replayed "
+          "from their (seed, index) pairs; the all-reduce, barrier, "
+          "shard-update and data-stream workloads survived every "
+          "explored message schedule (delivery reorder, reply loss, "
+          "duplication, crash, eviction, restart, snapshot "
           "round-trip). Rerun with the same --seed to reproduce.")
     return 0
+
+
+# -- data-service survival legs ------------------------------------------------
+# The ISSUE-14 acceptance contract: with the sharded streaming input
+# service hosting the dataset (tools/launch.py --data-service,
+# docs/how_to/data_service.md), SIGKILLing 1 of 4 consumers mid-pass
+# must leave the coordinator's ACKED record stream byte-identical to an
+# uninterrupted baseline (per-shard contiguous, duplicate-free, with
+# mxdata.shards_rebalanced >= 1 proving the shards actually moved), and
+# a coordinator SIGTERM + restart must restore shard assignments from
+# the frontier snapshot and finish the run with ZERO duplicate
+# acknowledged records.
+
+_DATA_N = 4
+_DATA_RECORDS = 512
+_DATA_BATCH = 8
+_DATA_DIM = 8
+_DATA_OK_RE = re.compile(
+    r"rank (\d+)/(\d+): data service OK batches=(\d+) records=(\d+)")
+
+
+def _make_data_pack(scratch, n_records=_DATA_RECORDS, dim=_DATA_DIM):
+    """Deterministic packed .rec whose payload slot 0 is the global
+    record id — the byte-level identity the exactness assertions ride."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+
+    from mxnet_tpu import recordio
+
+    rec_path = os.path.join(scratch, "data.rec")
+    writer = recordio.MXRecordIO(rec_path, "w")
+    for i in range(n_records):
+        payload = np.full(dim, float(i), np.float32)
+        writer.write(recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), payload.tobytes()))
+    writer.close()
+    return rec_path
+
+
+def _fold_mxdata_acks(journal_paths):
+    """{(pass, shard): [(lo, hi), ...]} in journal order from the data
+    coordinator's mxdata ack records — THE authoritative acked record
+    stream (a worker killed between consuming and acking legitimately
+    re-consumes its tail; the acked stream never duplicates)."""
+    acks = {}
+    for path in journal_paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "mxdata" and \
+                            rec.get("event") == "ack":
+                        key = (int(rec.get("pass", 0)),
+                               int(rec["shard"]))
+                        acks.setdefault(key, []).append(
+                            (int(rec["lo"]), int(rec["hi"])))
+        except OSError:
+            pass
+    return acks
+
+
+def _check_ack_stream(acks, n_records, label, failures, passes=(0,)):
+    """Every asserted pass must be contiguous, duplicate-free, and
+    cover all records across shards."""
+    for p in passes:
+        covered = []
+        for (dpass, _sid), ranges in sorted(acks.items()):
+            if dpass != p:
+                continue
+            last = None
+            for lo, hi in ranges:
+                if last is not None and lo < last:
+                    failures.append(
+                        "%s: pass %d shard %d acked [%d,%d) after "
+                        "frontier %d — DUPLICATE records"
+                        % (label, p, _sid, lo, hi, last))
+                last = hi
+                covered.extend(range(lo, hi))
+        if sorted(covered) != list(range(n_records)):
+            missing = sorted(set(range(n_records)) - set(covered))
+            dups = sorted({i for i in covered
+                           if covered.count(i) > 1}) if \
+                len(covered) != len(set(covered)) else []
+            failures.append(
+                "%s: pass %d acked stream is not the exact record "
+                "sequence (missing %s..., dup %s...)"
+                % (label, p, missing[:10], dups[:10]))
+
+
+def _run_data_leg(tag, scratch, rec_path, port, timeout, n=_DATA_N,
+                  extra_env=None, launch_args=()):
+    """One tools/launch.py --data-service run of data_service_consume.py.
+    Returns (rc, {rank: records}, coordinator journal counters, acks,
+    output)."""
+    out_dir = os.path.join(scratch, tag + "-out")
+    os.makedirs(out_dir, exist_ok=True)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": os.path.join(
+            scratch, tag + "-journal-{rank}.jsonl"),
+        "MXNET_TELEMETRY_FLUSH_SECS": "1",
+        "MXNET_DATA_TEST_OUT": out_dir,
+        "MXNET_DATA_TEST_DIM": str(_DATA_DIM),
+    })
+    env.update(extra_env or {})
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", str(n), "--launcher", "local", "--data-service",
+           "--data-bind", "127.0.0.1:%d" % port,
+           "--data-files", rec_path, "--data-batch", str(_DATA_BATCH)] + \
+        list(launch_args) + \
+        ["--", sys.executable,
+         os.path.join(REPO, "tests", "nightly", "data_service_consume.py")]
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        out, _ = proc.communicate()
+        out = (out or "") + "\n<HUNG: exceeded %.0fs>" % timeout
+        rc = -1
+    done = {int(r): int(recs) for r, _w, _b, recs in
+            _DATA_OK_RE.findall(out)}
+    coord_journal = os.path.join(scratch,
+                                 tag + "-journal-datacoord.jsonl")
+    counters = fold_telemetry(coord_journal)
+    acks = _fold_mxdata_acks([coord_journal])
+    return rc, done, counters, acks, out
+
+
+def run_data(args):
+    """The data-service survival legs (ISSUE 14)."""
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-data-")
+    rec_path = _make_data_pack(scratch)
+    port = 29720 + (args.seed % 97) * 3
+    per_leg = args.timeout / 3.0
+    failures = []
+    timing_env, restart_delay = _elastic_timing()
+    # the data plane reads its own evict knob; reuse the jitter-scaled
+    # elastic window so a contended box cannot evict healthy consumers
+    data_env = {"MXNET_DATA_EVICT_AFTER":
+                timing_env["MXNET_KV_EVICT_AFTER"]}
+
+    print("chaos --data: baseline (fault-free, %d consumers, %d records)"
+          % (_DATA_N, _DATA_RECORDS))
+    rc0, done0, _c0, acks0, out0 = _run_data_leg(
+        "base", scratch, rec_path, port, per_leg, extra_env=data_env)
+    if rc0 != 0 or len(done0) != _DATA_N:
+        failures.append("baseline leg failed (rc=%d, ranks done=%s)\n%s"
+                        % (rc0, sorted(done0), out0[-2000:]))
+    _check_ack_stream(acks0, _DATA_RECORDS, "baseline", failures)
+
+    print("chaos --data: kill leg (SIGKILL rank 3 mid-pass, restart "
+          "held past the evict window, exact resume)")
+    mark = tempfile.mkdtemp(prefix="mark-", dir=scratch)
+    rc1, done1, c1, acks1, out1 = _run_data_leg(
+        "kill", scratch, rec_path, port + 1, per_leg,
+        extra_env=dict(data_env, **{
+            "MXNET_DATA_TEST_DIE_RANK": "3",
+            "MXNET_DATA_TEST_DIE_AT": "4",
+            "MXNET_DATA_TEST_MARK": mark,
+        }),
+        launch_args=["--max-restarts", "1",
+                     "--restart-delay", "%.1f" % restart_delay])
+    if rc1 != 0 or len(done1) != _DATA_N:
+        failures.append("kill leg: not every rank (incl. the restarted "
+                        "one) finished (rc=%d, done=%s)\n%s"
+                        % (rc1, sorted(done1), out1[-2000:]))
+    _check_ack_stream(acks1, _DATA_RECORDS, "kill", failures)
+    if c1.get("mxdata.shards_rebalanced_total", 0) < 1:
+        failures.append("kill leg: no shard rebalance recorded in the "
+                        "coordinator journal (counters: %s)" % c1)
+    # the whole point: the interrupted run's acked pass-0 stream is
+    # IDENTICAL to the uninterrupted baseline's — same shards, same
+    # ranges, same order
+    base_p0 = {k: v for k, v in acks0.items() if k[0] == 0}
+    kill_p0 = {k: v for k, v in acks1.items() if k[0] == 0}
+    if base_p0 and kill_p0 and base_p0 != kill_p0:
+        diff = [k for k in set(base_p0) | set(kill_p0)
+                if base_p0.get(k) != kill_p0.get(k)]
+        failures.append(
+            "kill leg: acked record sequence DIFFERS from the "
+            "uninterrupted baseline on %d shard(s): %s"
+            % (len(diff), diff[:4]))
+
+    print("chaos --data: coordinator-restart leg (SIGTERM the "
+          "coordinator mid-stream, restore from the frontier snapshot)")
+    rc2 = _run_coord_restart_leg(scratch, rec_path, port + 2, per_leg,
+                                 failures)
+
+    print("\n=== data-service survival report ===")
+    print("records         : %d (batch %d, %d consumers)"
+          % (_DATA_RECORDS, _DATA_BATCH, _DATA_N))
+    print("baseline leg    : rc=%d consumed=%s" % (rc0, done0))
+    print("kill leg        : rc=%d consumed=%s" % (rc1, done1))
+    print("kill counters   : streamed=%d rebalanced=%d checkpoints=%d "
+          "stalls=%d"
+          % (c1.get("mxdata.batches_streamed_total", 0),
+             c1.get("mxdata.shards_rebalanced_total", 0),
+             c1.get("mxdata.frontier_checkpoints_total", 0),
+             c1.get("mxdata.flow_control_stalls_total", 0)))
+    print("restart leg     : %s" % ("OK" if rc2 == 0 else "FAILED"))
+    if failures:
+        print("\nRESULT: FAIL")
+        for f in failures:
+            print(" - %s" % f)
+        return 9
+    print("\nRESULT: SURVIVED — the SIGKILLed consumer's shards "
+          "rebalanced and the rejoined rank resumed at the exact "
+          "frontier (acked record stream identical to the "
+          "uninterrupted baseline), and the restarted coordinator "
+          "restored assignments from its snapshot with zero duplicate "
+          "acknowledged records.")
+    return 0
+
+
+def _run_coord_restart_leg(scratch, rec_path, port, timeout, failures):
+    """Harness-managed coordinator: SIGTERM it mid-stream (graceful =
+    final frontier snapshot), respawn from the snapshot, assert the
+    appended journal's acked stream has zero duplicates and full
+    coverage, and that the respawn actually restored (its log says so)."""
+    import signal as _signal
+
+    addr = "127.0.0.1:%d" % port
+    prefix = os.path.join(scratch, "restart-snap")
+    journal = os.path.join(scratch, "restart-journal-datacoord.jsonl")
+    coord_log = os.path.join(scratch, "restart-coord.log")
+    coord_env = dict(os.environ)
+    coord_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + coord_env.get("PYTHONPATH", ""),
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": journal,
+        "MXNET_TELEMETRY_FLUSH_SECS": "1",
+    })
+    coord_cmd = [sys.executable, "-m", "mxnet_tpu.data_service",
+                 "--world", "2", "--bind", addr,
+                 "--files", rec_path, "--batch-size", str(_DATA_BATCH),
+                 "--snapshot-prefix", prefix]
+
+    def _spawn_coord(log_f):
+        return subprocess.Popen(coord_cmd, cwd=REPO, env=coord_env,
+                                stdout=log_f, stderr=log_f, text=True)
+
+    out_dir = os.path.join(scratch, "restart-out")
+    os.makedirs(out_dir, exist_ok=True)
+    worker_env = dict(os.environ)
+    worker_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep +
+        worker_env.get("PYTHONPATH", ""),
+        "MXNET_DATA_COORD": addr,
+        "MXNET_DATA_TEST_OUT": out_dir,
+        "MXNET_DATA_TEST_DIM": str(_DATA_DIM),
+        "MXNET_DATA_TEST_PASSES": "2",
+        "MXNET_DATA_TEST_SLEEP": "0.03",
+        # the workers must ride out the coordinator outage on retries
+        "MXNET_KV_RETRIES": "15",
+    })
+    worker_cmd = [sys.executable,
+                  os.path.join(REPO, "tools", "launch.py"),
+                  "-n", "2", "--launcher", "local", "--",
+                  sys.executable,
+                  os.path.join(REPO, "tests", "nightly",
+                               "data_service_consume.py")]
+    log_f = open(coord_log, "a")
+    coord = _spawn_coord(log_f)
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                import socket as _socket
+
+                with _socket.create_connection(
+                        ("127.0.0.1", port), timeout=1.0):
+                    break
+            except OSError:
+                time.sleep(0.1)
+        workers = subprocess.Popen(worker_cmd, cwd=REPO, env=worker_env,
+                                   text=True, stdout=subprocess.PIPE,
+                                   stderr=subprocess.STDOUT,
+                                   start_new_session=True)
+        time.sleep(3.0)  # mid-stream (paced at ~0.03s/batch x 2 ranks)
+        coord.send_signal(_signal.SIGTERM)
+        coord.wait(timeout=30)
+        coord = _spawn_coord(log_f)
+        try:
+            wout, _ = workers.communicate(timeout=timeout)
+            wrc = workers.returncode
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(workers.pid, _signal.SIGKILL)
+            except OSError:
+                pass
+            wout, _ = workers.communicate()
+            wout = (wout or "") + "\n<HUNG>"
+            wrc = -1
+    finally:
+        try:
+            coord.send_signal(_signal.SIGTERM)
+            coord.wait(timeout=30)
+        except Exception:
+            coord.kill()
+        log_f.close()
+    done = {int(r): int(recs) for r, _w, _b, recs in
+            _DATA_OK_RE.findall(wout)}
+    rc = 0
+    if wrc != 0 or len(done) != 2:
+        failures.append("restart leg: workers did not finish across the "
+                        "coordinator restart (rc=%d, done=%s)\n%s"
+                        % (wrc, sorted(done), wout[-2000:]))
+        rc = 1
+    with open(coord_log, encoding="utf-8") as f:
+        log_text = f.read()
+    if "restored frontier snapshot" not in log_text:
+        failures.append("restart leg: the respawned coordinator did not "
+                        "restore from the snapshot\n%s" % log_text[-1500:])
+        rc = 1
+    acks = _fold_mxdata_acks([journal])
+    _check_ack_stream(acks, _DATA_RECORDS, "restart", failures,
+                      passes=(0, 1))
+    return rc
 
 
 # -- mxctl closed-loop control-plane survival legs -----------------------------
@@ -1481,6 +1823,15 @@ def main(argv=None):
                          "delivery/loss/duplication/crash/restart "
                          "schedule (MXPROTO_SCHEDULES overrides the "
                          "per-leg budget)")
+    ap.add_argument("--data", action="store_true",
+                    help="run the data-service survival legs (ISSUE "
+                         "14): SIGKILL 1 of 4 streaming consumers "
+                         "mid-pass — the rejoined rank must resume at "
+                         "the exact frontier (acked record stream "
+                         "identical to an uninterrupted baseline, "
+                         "shards rebalanced), then SIGTERM + restart "
+                         "the coordinator — assignments restored from "
+                         "the frontier snapshot, zero duplicate records")
     ap.add_argument("--controller", action="store_true",
                     help="run the mxctl closed-loop survival legs "
                          "(ISSUE 12): SIGKILL a serving replica -> the "
@@ -1500,6 +1851,8 @@ def main(argv=None):
 
     if args.controller:
         return run_controller(args)
+    if args.data:
+        return run_data(args)
     if args.elastic:
         return run_elastic(args)
     if args.guardian:

@@ -68,6 +68,12 @@ def _worker_env(args, rank):
     if args.elastic:
         env["MXNET_KV_ELASTIC"] = "1"
         env["MXNET_ELASTIC_COORD"] = args.coordinator
+    # getattr: test harnesses hand _worker_env duck-typed args objects
+    # that predate the data-service flags
+    if getattr(args, "data_service", False):
+        # workers build DataServiceIter from this address
+        # (docs/how_to/data_service.md)
+        env["MXNET_DATA_COORD"] = args.data_bind
     # per-rank telemetry journals: N processes appending to one JSONL
     # file would interleave mid-line; a {rank} placeholder fans them out
     journal = env.get("MXNET_TELEMETRY_JOURNAL", "")
@@ -132,15 +138,65 @@ def _start_coordinator(args):
                        % args.coordinator)
 
 
+def _start_data_coordinator(args):
+    """Spawn the streaming data coordinator on --data-bind and wait for
+    its port (the elastic-coordinator pattern; the spec is installed by
+    the first worker's configure unless --data-files names it here)."""
+    host, port = args.data_bind.rsplit(":", 1)
+    cmd = [sys.executable, "-m", "mxnet_tpu.data_service",
+           "--world", str(args.num_workers), "--bind", args.data_bind]
+    if args.data_files:
+        cmd += ["--files"] + list(args.data_files) + \
+            ["--batch-size", str(args.data_batch)]
+    if args.data_snapshot_prefix:
+        cmd += ["--snapshot-prefix", args.data_snapshot_prefix]
+    if args.data_snapshot_secs is not None:
+        cmd += ["--snapshot-secs", str(args.data_snapshot_secs)]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # not a rank: journal templates expand as "datacoord" (the elastic
+    # coordinator's "coord" discipline), introspection port dropped
+    journal = env.get("MXNET_TELEMETRY_JOURNAL", "")
+    if "{rank}" in journal:
+        env["MXNET_TELEMETRY_JOURNAL"] = journal.format(rank="datacoord")
+    env.pop("MXNET_TELEMETRY_HTTP", None)
+    proc = subprocess.Popen(cmd, env=env)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("data coordinator exited with code %d "
+                               "during startup" % proc.returncode)
+        try:
+            with socket.create_connection((host, int(port)), timeout=1.0):
+                return proc
+        except OSError:
+            time.sleep(0.1)
+    proc.terminate()
+    raise RuntimeError("data coordinator did not open %s within 30s"
+                       % args.data_bind)
+
+
 def launch_local(args, cmd):
     coordinator = _start_coordinator(args) if args.elastic else None
+    data_coord = _start_data_coordinator(args) if args.data_service \
+        else None
     sup = _load_supervisor().Supervisor()
     for r in range(args.num_workers):
         sup.spawn(str(r), cmd, env=_worker_env(args, r))
-    # restarts only make sense in elastic mode: a respawned worker can
-    # rejoin the elastic group, but a formed jax.distributed job can
-    # never re-admit it — the restart would just wedge the collectives
-    restarts = args.max_restarts if args.elastic else 0
+    # restarts only make sense when a coordinator can re-admit the
+    # respawn — the elastic group or the data service (both run
+    # membership epochs); a formed jax.distributed job can never
+    # re-admit a worker, so the restart would just wedge the collectives
+    restarts = args.max_restarts if (args.elastic or args.data_service) \
+        else 0
+    if restarts and args.data_service and not args.elastic:
+        # the data plane re-admits the respawn, the compute plane may
+        # not: warn rather than silently wedge a job that also runs
+        # non-elastic jax.distributed collectives
+        print("launch: --max-restarts with --data-service but without "
+              "--elastic — a respawned worker rejoins the DATA plane "
+              "only; a formed jax.distributed collective job can never "
+              "re-admit it", file=sys.stderr)
 
     def _on_restart(name, rc, restarts_left, delay):
         # a deferred respawn (--restart-delay, non-blocking: other
@@ -170,6 +226,11 @@ def launch_local(args, cmd):
         if coordinator is not None:
             coordinator.terminate()
             coordinator.wait()
+        if data_coord is not None:
+            # SIGTERM: the coordinator lands a final frontier snapshot
+            # (data_service.serve's handler) before exiting
+            data_coord.terminate()
+            data_coord.wait()
     failed = {int(r): rc for r, rc in failed.items()}
     if failed and len(failed) > args.tolerate:
         print("launch: worker(s) %s failed (exit codes %s), tolerate=%d"
@@ -201,6 +262,9 @@ def launch_ssh(args, cmd):
             # --coordinator (python -m mxnet_tpu.elastic on that host)
             env_pairs += ["MXNET_KV_ELASTIC=1",
                           "MXNET_ELASTIC_COORD=%s" % args.coordinator]
+        if args.data_service:
+            # likewise: python -m mxnet_tpu.data_service on --data-bind
+            env_pairs += ["MXNET_DATA_COORD=%s" % args.data_bind]
         envs = " ".join(env_pairs)
         remote = "cd %s && %s %s" % (
             shlex.quote(args.workdir) if args.workdir else "~", envs,
@@ -240,6 +304,21 @@ def main():
                    help="coordinator crash-safe snapshot path prefix")
     p.add_argument("--snapshot-secs", type=float, default=None,
                    help="coordinator snapshot cadence in seconds")
+    p.add_argument("--data-service", action="store_true",
+                   help="host the sharded streaming data coordinator "
+                        "(local mode) and export MXNET_DATA_COORD "
+                        "(docs/how_to/data_service.md)")
+    p.add_argument("--data-bind", default="127.0.0.1:9878",
+                   help="data coordinator host:port")
+    p.add_argument("--data-files", nargs="*", default=None,
+                   help="packed .rec files the service streams (omit "
+                        "to let the first worker configure the spec)")
+    p.add_argument("--data-batch", type=int, default=32,
+                   help="records per streamed batch (with --data-files)")
+    p.add_argument("--data-snapshot-prefix", default=None,
+                   help="data coordinator frontier-snapshot prefix")
+    p.add_argument("--data-snapshot-secs", type=float, default=None,
+                   help="data coordinator snapshot cadence in seconds")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
     # drop only the single leading '--' separating launcher args from the
