@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Benchmark: continuous-batching serving vs static batching under a
-Poisson open-loop load.
+Poisson open-loop load — plus, with ``--spec``, speculative decoding
+vs the plain continuous engine.
 
 The serving companion to bench.py / bench_lm.py: drives the SAME seeded
 arrival trace (Poisson interarrivals, mixed prompt lengths, a
@@ -45,6 +46,34 @@ Methodology notes:
 
 Env knobs: BENCH_SERVE_{DMODEL,LAYERS,HEADS,DFF,VOCAB,REQUESTS,SEED,
 BLOCK_SIZE,KV_BLOCKS,MAX_BATCH,PREFILL_CHUNK,LOAD,TIMEOUT}.
+
+The ``--spec`` leg (ISSUE 15)
+-----------------------------
+
+``python bench_serve.py --spec`` replays the same seeded open-loop
+overload trace through the continuous engine twice — plain, and with
+draft-model speculative decoding — alternating repeats, median-of-3
+headline::
+
+    {"metric": "serving_spec_vs_continuous", "value": <tokens/s ratio>,
+     "vs_baseline": value / 1.25, "accept_rate": ...,
+     "accepted_tokens_per_step": ..., "repeat_ratios": [...], ...}
+
+The acceptance gate is ``value >= 1.25`` with every per-repeat ratio
+>= 1.1. Draft construction: the bench has no trained models, so the
+draft/target relationship a deployment gets from distillation is
+manufactured structurally — the draft is the target's FIRST
+``BENCH_SERVE_SPEC_DRAFT_LAYERS`` layers (embeddings shared; well
+under 1/4 of the target's parameters, ``draft_param_frac`` in the
+JSON), and the target's remaining layers carry residual weights scaled
+by ``BENCH_SERVE_SPEC_RESID`` so the truncation approximates the full
+model the way a distilled draft approximates its target. The target
+still executes every layer (its step cost is real); the accept rate
+this construction yields is MEASURED and reported, and the headline is
+only meaningful alongside it — push RESID up to see speculation turn
+into a loss (the mxctl accept-rate rule exists for exactly that,
+docs/how_to/control_plane.md). Extra spec knobs:
+BENCH_SERVE_SPEC_{K,TARGET_LAYERS,DRAFT_LAYERS,RESID}.
 """
 from __future__ import annotations
 
@@ -86,6 +115,22 @@ def make_trace(n, rate, vocab, rng):
         trace.append((t, rng.randint(0, vocab, (plen,)).astype(np.int32),
                       mnew))
     return trace
+
+
+#: mean output tokens of make_trace's bimodal mixture (0.75 * U[6,16]
+#: + 0.25 * U[80,96]) — the calibration denominator both legs share
+TRACE_MEAN_TOKENS = 0.75 * 11.0 + 0.25 * 88.0
+
+
+def median_leg(legs):
+    """The median-tokens/s leg, annotated with the min/max across
+    repeats (bench.py convention, PR 3)."""
+    mid = sorted(legs, key=lambda l: l["tokens_per_s"])[len(legs) // 2]
+    tps = [l["tokens_per_s"] for l in legs]
+    mid = dict(mid)
+    mid["tokens_per_s_min"] = min(tps)
+    mid["tokens_per_s_max"] = max(tps)
+    return mid
 
 
 def run_leg(eng, trace, timeout):
@@ -133,7 +178,7 @@ def run_leg(eng, trace, timeout):
     ttft, lat = eng.latency_samples()
     ttft, lat = ttft[len(ttft0):], lat[len(lat0):]
     tokens = st["tokens_emitted"] - st0["tokens_emitted"]
-    return {
+    leg = {
         "policy": eng.cfg.policy,
         "tokens_per_s": round(tokens / makespan, 2),
         "makespan_s": round(makespan, 3),
@@ -150,6 +195,18 @@ def run_leg(eng, trace, timeout):
         "requests_rejected": st["rejected"] - st0["rejected"],
         "steps": st["steps"] - st0["steps"],
     }
+    turns = st["spec_turns"] - st0["spec_turns"]
+    if turns:
+        drafted = st["spec_tokens_drafted"] - st0["spec_tokens_drafted"]
+        accepted = st["spec_tokens_accepted"] - st0["spec_tokens_accepted"]
+        leg["policy"] = "continuous+spec"
+        leg["spec_turns"] = turns
+        leg["spec_tokens_drafted"] = drafted
+        leg["spec_tokens_accepted"] = accepted
+        leg["spec_accept_rate"] = round(accepted / max(drafted, 1), 4)
+        leg["spec_accepted_tokens_per_turn"] = round(
+            accepted / float(turns), 3)
+    return leg
 
 
 def _pct(xs, q):
@@ -164,11 +221,21 @@ def warmup(eng, params):
         eng.model.warmup(params, eng.pool, batch_sizes=[b])
         for c in eng.model.chunk_buckets:
             bt = np.zeros((b, eng.model.max_blocks), np.int32)
-            nxt, _, kp, vp = eng.model.step(
+            nxt, kp, vp = eng.model.step(
                 params, eng.pool.k, eng.pool.v, np.zeros((b, c), np.int32),
                 np.zeros((b,), np.int32), np.ones((b,), np.int32), bt,
                 np.zeros((b,), bool))
             eng.pool.swap(kp, vp)
+    if eng.draft_model is not None:
+        # every speculative program bucket (draft prefill mirror,
+        # draft_turn, verify), then a real spec workload so the
+        # shrinking-batch tail shapes are warm too (stats are windowed
+        # deltas — warmup traffic never pollutes a leg)
+        eng.warmup_spec()
+        prompts = [np.zeros((6,), np.int32)
+                   for _ in range(eng.cfg.max_batch)]
+        eng.generate(prompts, max_new_tokens=2 * eng.cfg.spec_k + 4)
+        eng.note_idle()
 
 
 def calibrate_rate(params, model_cfg, mk_cfg, mean_tokens, load):
@@ -192,6 +259,150 @@ def calibrate_rate(params, model_cfg, mk_cfg, mean_tokens, load):
     capacity_tps = B / step_s
     eng.note_idle()  # abandoned probe engine: zero its gauges
     return load * capacity_tps / mean_tokens, capacity_tps
+
+
+def make_draft(params, model_cfg, draft_layers, resid_scale):
+    """Structurally-coupled draft for the spec leg: the target keeps
+    its full depth but its tail layers' residual contributions are
+    scaled by ``resid_scale`` (the target params are MUTATED — both
+    legs must serve the same model); the draft is the first
+    ``draft_layers`` layers with shared embeddings. Returns
+    (draft_params, draft_cfg, draft_param_frac)."""
+    import dataclasses as _dc
+
+    for lp in params["layers"][draft_layers:]:
+        lp["wo"] = lp["wo"] * resid_scale
+        lp["w2"] = lp["w2"] * resid_scale
+    draft_params = {
+        "embed": params["embed"], "pos_embed": params["pos_embed"],
+        "layers": params["layers"][:draft_layers], "ln_f": params["ln_f"],
+    }
+
+    def nparams(tree):
+        if hasattr(tree, "size"):
+            return int(tree.size)
+        if isinstance(tree, dict):
+            return sum(nparams(v) for v in tree.values())
+        return sum(nparams(v) for v in tree)
+
+    frac = nparams(draft_params) / float(nparams(params))
+    draft_cfg = _dc.replace(model_cfg, num_layers=draft_layers)
+    return draft_params, draft_cfg, frac
+
+
+def main_spec():
+    """The --spec leg: continuous vs continuous+speculative decoding,
+    same trace, alternating repeats, median headline (gate >= 1.25x,
+    every repeat pair >= 1.1x).
+
+    Model defaults differ from the classic leg: speculation's win
+    condition is a deep-enough target that one target step costs
+    visibly more than a draft step, at dims where verifying K+1
+    positions is close to the cost of verifying one (the
+    memory-/overhead-bound regime real accelerators live in) — d64 x 8
+    layers with a 1-layer shared-embedding draft (~24% of target
+    params) and a measured ~0.9 accept rate at the default RESID."""
+    d_model = _env_int("BENCH_SERVE_DMODEL", 64)
+    layers = _env_int("BENCH_SERVE_SPEC_TARGET_LAYERS", 8)
+    heads = _env_int("BENCH_SERVE_HEADS", 2)
+    d_ff = _env_int("BENCH_SERVE_DFF", 128)
+    vocab = _env_int("BENCH_SERVE_VOCAB", 512)
+    n_req = _env_int("BENCH_SERVE_REQUESTS", 40)
+    seed = _env_int("BENCH_SERVE_SEED", 0)
+    block_size = _env_int("BENCH_SERVE_BLOCK_SIZE", 16)
+    kv_blocks = _env_int("BENCH_SERVE_KV_BLOCKS", 129)
+    max_batch = _env_int("BENCH_SERVE_MAX_BATCH", 8)
+    prefill_chunk = _env_int("BENCH_SERVE_PREFILL_CHUNK", 32)
+    load = _env_float("BENCH_SERVE_LOAD", 1.5)
+    timeout = _env_float("BENCH_SERVE_TIMEOUT", 240.0)
+    spec_k = _env_int("BENCH_SERVE_SPEC_K", 8)
+    draft_layers = _env_int("BENCH_SERVE_SPEC_DRAFT_LAYERS", 1)
+    resid = _env_float("BENCH_SERVE_SPEC_RESID", 0.005)
+    repeats = _env_int("BENCH_SERVE_REPEATS", 3)
+
+    import jax
+
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+    from mxnet_tpu.serving import Engine, ServingConfig
+
+    model_cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, d_model=d_model,
+        num_heads=heads, d_ff=d_ff, max_seq_len=128, dtype="float32")
+    params = init_params(model_cfg, jax.random.PRNGKey(seed))
+    draft_params, draft_cfg, frac = make_draft(params, model_cfg,
+                                               draft_layers, resid)
+
+    def mk_cfg(spec):
+        return ServingConfig(
+            block_size=block_size, num_blocks=kv_blocks,
+            max_batch=max_batch, prefill_chunk=prefill_chunk,
+            max_queue_depth=4 * n_req, policy="continuous", spec=spec,
+            spec_k=spec_k,
+            token_budget=max_batch * (1 + spec_k) + prefill_chunk)
+
+    rng = np.random.RandomState(seed)
+    rate, capacity = calibrate_rate(params, model_cfg,
+                                    lambda p: mk_cfg(False),
+                                    TRACE_MEAN_TOKENS, load)
+    trace = make_trace(n_req, rate, vocab, rng)
+
+    engines = {
+        "continuous": Engine(params, model_cfg, mk_cfg(False)),
+        "spec": Engine(params, model_cfg, mk_cfg(True),
+                       draft_params=draft_params, draft_cfg=draft_cfg),
+    }
+    for eng in engines.values():
+        warmup(eng, params)
+        # shakeout lap: one unmeasured replay of the REAL trace — the
+        # first pass of live traffic through a fresh engine pays
+        # dispatch-fastpath/allocator warm-in that no program-level
+        # warmup covers (observed: first spec repeat ~2x slower with
+        # zero compiles in the window), and the per-repeat >= 1.1x
+        # gate must measure steady state
+        run_leg(eng, trace, timeout)
+
+    runs = {"continuous": [], "spec": []}
+    for rep in range(max(1, repeats)):
+        for leg_name in ("continuous", "spec"):
+            leg = run_leg(engines[leg_name], trace, timeout)
+            runs[leg_name].append(leg)
+            print("bench_serve[%d]: %s: %.1f tok/s, accept %.2f"
+                  % (rep, leg["policy"], leg["tokens_per_s"],
+                     leg.get("spec_accept_rate", -1)), file=sys.stderr)
+
+    c_leg = median_leg(runs["continuous"])
+    s_leg = median_leg(runs["spec"])
+    ratio = s_leg["tokens_per_s"] / max(c_leg["tokens_per_s"], 1e-9)
+    repeat_ratios = [
+        round(s["tokens_per_s"] / max(c["tokens_per_s"], 1e-9), 3)
+        for s, c in zip(runs["spec"], runs["continuous"])]
+    print(json.dumps({
+        "metric": "serving_spec_vs_continuous",
+        "value": round(ratio, 3),
+        "unit": "x tokens/s",
+        "vs_baseline": round(ratio / 1.25, 3),  # >= 1.0 meets the gate
+        "repeat_ratios": repeat_ratios,          # every one >= 1.1
+        "accept_rate": s_leg.get("spec_accept_rate"),
+        "accepted_tokens_per_step": s_leg.get(
+            "spec_accepted_tokens_per_turn"),
+        # top-level fields tools/perf_gate.py lifts from a judged
+        # BENCH record (docs/how_to/profiling.md gate workflow)
+        "tokens_per_s": s_leg["tokens_per_s"],
+        "ttft_p99_s": s_leg["ttft_p99_s"],
+        "spec_accept_rate": s_leg.get("spec_accept_rate"),
+        "draft_param_frac": round(frac, 4),
+        "offered_load_req_s": round(rate, 3),
+        "decode_capacity_tokens_s": round(capacity, 1),
+        "repeats": repeats,
+        "continuous": c_leg,
+        "spec": s_leg,
+        "config": {"d_model": d_model, "layers": layers, "heads": heads,
+                   "d_ff": d_ff, "vocab": vocab, "requests": n_req,
+                   "block_size": block_size, "kv_blocks": kv_blocks,
+                   "max_batch": max_batch, "prefill_chunk": prefill_chunk,
+                   "load": load, "seed": seed, "spec_k": spec_k,
+                   "draft_layers": draft_layers, "resid_scale": resid},
+    }))
 
 
 def main():
@@ -230,10 +441,8 @@ def main():
     repeats = _env_int("BENCH_SERVE_REPEATS", 3)
 
     rng = np.random.RandomState(seed)
-    # mean output tokens of the mixture in make_trace
-    mean_tokens = 0.75 * 11.0 + 0.25 * 88.0
     rate, capacity = calibrate_rate(params, model_cfg, mk_cfg,
-                                    mean_tokens, load)
+                                    TRACE_MEAN_TOKENS, load)
     trace = make_trace(n_req, rate, vocab, rng)
 
     from mxnet_tpu.serving import Engine
@@ -254,14 +463,6 @@ def main():
             print("bench_serve[%d]: %s: %.1f tok/s, p99 TTFT %.3fs"
                   % (rep, policy, leg["tokens_per_s"],
                      leg["ttft_p99_s"] or -1), file=sys.stderr)
-
-    def median_leg(legs):
-        mid = sorted(legs, key=lambda l: l["tokens_per_s"])[len(legs) // 2]
-        tps = [l["tokens_per_s"] for l in legs]
-        mid = dict(mid)
-        mid["tokens_per_s_min"] = min(tps)
-        mid["tokens_per_s_max"] = max(tps)
-        return mid
 
     s_leg = median_leg(runs["static"])
     c_leg = median_leg(runs["continuous"])
@@ -287,4 +488,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--spec" in sys.argv[1:]:
+        main_spec()
+    else:
+        main()

@@ -95,6 +95,30 @@ def serving_metrics(servingz, statusz=None):
         if p99s:
             out["ttft_p99"] = float(max(p99s))
         out["draining"] = float(any(e.get("draining") for e in engines))
+        # speculative-decoding health: aggregate accept rate across the
+        # process's engines (accepted / drafted over the engines' 30s
+        # sliding window, so a busy engine dominates an idle one) — the
+        # metric the documented spec_off actuator rule reads
+        # (docs/how_to/control_plane.md). A lifetime-cumulative rate
+        # would go inert with uptime; it is used ONLY for engines
+        # predating the window fields. When the window exists but is
+        # EMPTY (speculation off / traffic lull) no metric is emitted —
+        # the rule engine's missing-metric hold applies instead of a
+        # frozen stale rate breaching forever.
+        windowed = any("spec_window_drafted" in s for s in stats)
+        if windowed:
+            wd = sum(s.get("spec_window_drafted", 0) or 0 for s in stats)
+            if wd:
+                wa = sum(s.get("spec_window_accepted", 0) or 0
+                         for s in stats)
+                out["spec_accept_rate"] = float(wa) / float(wd)
+        else:
+            drafted = sum(s.get("spec_tokens_drafted", 0) or 0
+                          for s in stats)
+            if drafted:
+                accepted = sum(s.get("spec_tokens_accepted", 0) or 0
+                               for s in stats)
+                out["spec_accept_rate"] = float(accepted) / float(drafted)
     comp = (statusz or {}).get("compile", {})
     hits = comp.get("compile.jit_cache_hits", 0)
     misses = comp.get("compile.jit_cache_misses", 0)

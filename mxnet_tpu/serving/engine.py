@@ -14,14 +14,25 @@ Admission control: ``submit`` raises :class:`QueueFullError` past
 and rejects outright any request whose worst-case footprint can never
 fit the pool or the model's position table.
 
+Speculative decoding (ISSUE 15, ``MXNET_SERVE_SPEC``): a draft
+transformer proposes up to ``spec_k`` tokens per scheduled decode
+turn, the target verifies them all in one jitted ragged step with
+fused accept/reject + resampling, and the accept/reject bookkeeping
+rolls both block tables back to the first rejection. Off by default
+and structurally zero-overhead when off (no draft pool, no extra
+programs).
+
 Telemetry (docs/how_to/serving.md catalog): counters
-``serving.requests_{admitted,completed,evicted,rejected,cancelled}``,
-gauges ``serving.kv_pool_utilization`` / ``serving.tokens_per_s`` /
-``serving.queue_depth``, histograms ``serving.ttft_s`` (submit -> first
-generated token) and ``serving.token_latency_s`` (gap between
-consecutive tokens of one request). Mirrored as plain numbers in
-``Engine.stats()`` so telemetry-off processes (bench subprocesses)
-still get the record.
+``serving.requests_{admitted,completed,evicted,rejected,cancelled}``
+and ``serving.spec_turns`` / ``serving.spec_tokens_drafted`` /
+``serving.spec_tokens_accepted``, gauges
+``serving.kv_pool_utilization`` / ``serving.tokens_per_s`` /
+``serving.queue_depth`` / ``serving.spec_accept_rate``, histograms
+``serving.ttft_s`` (submit -> first generated token),
+``serving.token_latency_s`` (gap between consecutive tokens of one
+request) and ``serving.spec_accepted_tokens``. Mirrored as plain
+numbers in ``Engine.stats()`` so telemetry-off processes (bench
+subprocesses) still get the record.
 """
 from __future__ import annotations
 
@@ -35,9 +46,10 @@ import numpy as np
 
 from .. import telemetry as _tel
 from ..analysis.engine_verify import maybe_trace_lock as _maybe_trace_lock
-from ..base import MXNetError, env_int as _env_int
+from ..base import MXNetError, env_bool as _env_bool, env_int as _env_int
+from . import sampling as _samp
 from .kv_cache import PagedKVPool, blocks_for_tokens
-from .model import ServingModel, cp_prefill_kv
+from .model import ServingModel, bucket_for, cp_prefill_kv
 from .scheduler import (CANCELLED, DECODE, FINISHED, PREFILL, Request,
                         Scheduler)
 
@@ -76,6 +88,11 @@ class ServingConfig:
     policy: str = "continuous"
     eos_id: int = None
     max_seq_tokens: int = None   # per-request cap; default model max_seq_len
+    # speculative decoding (off by default — with spec False the engine
+    # allocates no draft pool and compiles no draft/verify programs):
+    spec: bool = None            # MXNET_SERVE_SPEC
+    spec_k: int = None           # draft tokens per turn, MXNET_SERVE_SPEC_K
+    events_max: int = None       # scheduler event-ring bound
     # context-parallel long-prompt prefill (model.cp_prefill_kv):
     mesh: object = None
     cp_kind: str = "ring"
@@ -95,10 +112,19 @@ class ServingConfig:
                                        2 * self.max_batch)
         if self.prefill_chunk is None:
             self.prefill_chunk = _env_int("MXNET_SERVE_PREFILL_CHUNK", 64)
+        if self.spec is None:
+            self.spec = _env_bool("MXNET_SERVE_SPEC", False)
+        if self.spec_k is None:
+            self.spec_k = _env_int("MXNET_SERVE_SPEC_K", 4)
         if self.token_budget is None:
+            # under speculation each decode slot costs its whole verify
+            # chunk (1 + spec_k); the default budget must still leave
+            # prefill_chunk headroom or a full decode batch starves
+            # admission-side prefill for the life of its requests
+            decode_cost = (1 + self.spec_k) if self.spec else 1
             self.token_budget = _env_int(
                 "MXNET_SERVE_TOKEN_BUDGET",
-                self.max_batch + self.prefill_chunk)
+                self.max_batch * decode_cost + self.prefill_chunk)
         if self.max_queue_depth is None:
             self.max_queue_depth = _env_int("MXNET_SERVE_MAX_QUEUE", 64)
         if self.cp_min_tokens is None:
@@ -149,15 +175,29 @@ class StreamHandle:
 class Engine:
     """Continuous-batching serving engine over a transformer LM.
 
+    ``SPEC_WINDOW_SECS`` bounds the sliding window behind the
+    ``spec_accept_rate_window`` stat (current draft quality for mxctl
+    rules; the cumulative rate is reported alongside).
+
     Parameters
     ----------
     params : pytree
         ``models/transformer.py`` params (what bench_lm.py trains).
     model_cfg : TransformerConfig
     cfg : ServingConfig, optional
+    draft_params, draft_cfg : pytree / TransformerConfig, optional
+        The draft model for speculative decoding (required when
+        ``cfg.spec``): a smaller ``models/transformer.py`` family model
+        whose proposals the target verifies K+1 at a time. With
+        ``cfg.spec`` off these are rejected — the zero-overhead
+        contract is structural (no draft pool, no extra programs).
     """
 
-    def __init__(self, params, model_cfg, cfg=None):
+    #: sliding-window width for the live accept-rate signal
+    SPEC_WINDOW_SECS = 30.0
+
+    def __init__(self, params, model_cfg, cfg=None, draft_params=None,
+                 draft_cfg=None):
         from ..compile import ensure_jit_cache
 
         ensure_jit_cache()  # serving cold starts ride the PR 6 cache
@@ -182,6 +222,44 @@ class Engine:
                                 self.cfg.prefill_chunk})
         chunk_buckets = [c for c in chunk_buckets
                          if c <= self.cfg.prefill_chunk]
+        # speculative decoding: draft model + mirrored paged pool.
+        # The verify program's chunk is exactly spec_k + 1 wide (no
+        # bucketing — K is static); draft buckets gain 2 (the post-
+        # full-accept catch-up ingest). Both ride the same persistent
+        # jit cache.
+        self.draft_params = None
+        self.draft_cfg = None
+        self.draft_model = None
+        self.draft_pool = None
+        spec_k = 0
+        if self.cfg.spec:
+            if draft_params is None or draft_cfg is None:
+                raise MXNetError(
+                    "ServingConfig.spec requires draft_params + "
+                    "draft_cfg (the draft transformer)")
+            if self.cfg.policy == "static":
+                # the static policy is the fixed-shape A/B baseline;
+                # spec turns dispatch at ragged buckets and would
+                # silently break its methodology — reject the combo
+                raise MXNetError(
+                    "speculative decoding requires policy="
+                    "'continuous' (static is the fixed-shape baseline)")
+            if self.cfg.spec_k < 1:
+                raise MXNetError("spec_k must be >= 1, got %d"
+                                 % self.cfg.spec_k)
+            spec_k = self.cfg.spec_k
+            self.draft_params = draft_params
+            self.draft_cfg = draft_cfg
+            self.draft_pool = self.pool.mirror(
+                draft_cfg.num_layers, draft_cfg.num_heads,
+                draft_cfg.head_dim, dtype=draft_cfg.dtype)
+            self.draft_model = ServingModel(
+                draft_cfg, bs, w, batch_buckets=batch_buckets,
+                chunk_buckets=sorted(set(chunk_buckets) | {2}))
+        elif draft_params is not None or draft_cfg is not None:
+            raise MXNetError(
+                "draft model passed but ServingConfig.spec is off — "
+                "set spec=True (or MXNET_SERVE_SPEC=1)")
         self.model = ServingModel(model_cfg, bs, w,
                                   batch_buckets=batch_buckets,
                                   chunk_buckets=chunk_buckets)
@@ -189,7 +267,8 @@ class Engine:
             self.pool, max_batch=self.cfg.max_batch,
             prefill_chunk=self.cfg.prefill_chunk,
             token_budget=self.cfg.token_budget, policy=self.cfg.policy,
-            max_active=self.cfg.max_active)
+            max_active=self.cfg.max_active, draft_pool=self.draft_pool,
+            spec_k=spec_k, events_max=self.cfg.events_max)
         # under MXNET_ENGINE_VERIFY=1 the locks are TracedLock-wrapped:
         # every acquire/release lands in the ambient lock trace
         # (analysis/engine_verify.py) for observed-order verification
@@ -207,10 +286,16 @@ class Engine:
         self._last_counts = {}
         self._stats = {"admitted": 0, "completed": 0, "evicted": 0,
                        "rejected": 0, "cancelled": 0, "tokens_emitted": 0,
-                       "steps": 0}
+                       "steps": 0, "spec_turns": 0, "spec_tokens_drafted": 0,
+                       "spec_tokens_accepted": 0}
         self._ttfts = []
         self._token_lats = []
         self._rate_window = []  # (t, cumulative tokens) ring for tokens/s
+        # (t, drafted, accepted) per spec turn over a sliding window:
+        # the accept-rate signal mxctl rules act on must track CURRENT
+        # draft quality, not the lifetime average (which goes inert
+        # with uptime)
+        self._spec_window = []
         self._thread = None
         self._stop = False
         self._last_rate = 0.0
@@ -219,15 +304,38 @@ class Engine:
         _live_engines.add(self)
 
     # -- intake --------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=16, eos_id=None):
+    def submit(self, prompt, max_new_tokens=16, eos_id=None,
+               temperature=0.0, top_k=0, top_p=1.0, seed=0):
         """Queue a generation request; returns a StreamHandle.
+
+        ``temperature`` 0 (the default) is exact greedy decode;
+        positive temperatures sample on device with top-k/top-p
+        filtering, every draw keyed by ``(seed, token position)`` so
+        the plain-decode stream is byte-reproducible across evictions
+        and re-chunking (sampling.py module docstring). Under
+        speculation a shifted turn alignment may swap which salt
+        stream a position draws from (accepted draft vs residual vs
+        bonus) — distribution-preserving by the rejection-sampling
+        construction, byte-stable at temperature 0.
 
         Raises QueueFullError past ``max_queue_depth`` and MXNetError
         for requests that could never fit the KV pool / position table
         (both counted under serving.requests_rejected).
         """
+        if temperature < 0 or top_k < 0 or not 0.0 < top_p <= 1.0:
+            # top_p <= 0 would mask EVERY token (NaN distribution,
+            # uniform-random argmax) — reject loudly, never sample
+            # garbage silently
+            with self._lock:
+                self._reject()
+            raise MXNetError(
+                "invalid sampling params: temperature >= 0, top_k >= 0 "
+                "and 0 < top_p <= 1 required (got %r, %r, %r)"
+                % (temperature, top_k, top_p))
         req = Request(prompt, max_new_tokens,
-                      eos_id=self.cfg.eos_id if eos_id is None else eos_id)
+                      eos_id=self.cfg.eos_id if eos_id is None else eos_id,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      seed=seed)
         total = req.total_len()
         limit = min(self.max_seq_tokens,
                     self.sched.max_request_tokens(),
@@ -351,6 +459,64 @@ class Engine:
         if _tel.ENABLED:
             _tel.counter("serving.requests_rejected").inc()
 
+    # -- speculative-decoding runtime toggle ---------------------------------
+    def set_spec(self, enabled):
+        """Flip speculation at runtime (takes effect at the next
+        scheduler plan). The draft pool and programs stay resident so
+        re-enabling is instant; a custom mxctl actuator flips this off
+        when the accept rate makes speculation a loss
+        (docs/how_to/control_plane.md). Raises when the engine was
+        built without a draft model."""
+        if self.draft_model is None:
+            raise MXNetError(
+                "speculation was not configured on this engine "
+                "(ServingConfig.spec + draft model)")
+        with self._lock:
+            self.sched.set_spec_k(self.cfg.spec_k if enabled else 0)
+
+    @property
+    def spec_enabled(self):
+        with self._lock:
+            return self.sched.spec_active()
+
+    def warmup_spec(self, batch_sizes=None):
+        """Pre-compile every speculative-path program — the draft-turn
+        and verify kinds at each batch bucket and both steady-state
+        ingest widths, plus the draft model's plain step buckets (the
+        prefill mirror and the toggle catch-up path dispatch those) —
+        so serving never compiles mid-traffic (and the persistent jit
+        cache serves them to the next process). Inactive rows write to
+        the scratch block, so warming against the live pools is safe."""
+        if self.draft_model is None:
+            return
+        K = self.cfg.spec_k
+        for b in (batch_sizes or self.draft_model.batch_buckets):
+            for c in self.draft_model.chunk_buckets:
+                bt = np.zeros((b, self.draft_model.max_blocks), np.int32)
+                _, dk, dv = self.draft_model.step(
+                    self.draft_params, self.draft_pool.k,
+                    self.draft_pool.v, np.zeros((b, c), np.int32),
+                    np.zeros((b,), np.int32), np.ones((b,), np.int32),
+                    bt, np.zeros((b,), bool))
+                self.draft_pool.swap(dk, dv)
+        for b in (batch_sizes or self.model.batch_buckets):
+            bt = np.zeros((b, self.model.max_blocks), np.int32)
+            ks = np.full((b,), K, np.int32)
+            act = np.zeros((b,), bool)
+            d = q = None
+            for cin in (1, 2):
+                d, q, dk, dv = self.draft_model.draft_turn(
+                    self.draft_params, self.draft_pool.k,
+                    self.draft_pool.v, np.zeros((b, cin), np.int32),
+                    np.zeros((b,), np.int32),
+                    np.full((b,), cin, np.int32), bt, act, ks, K)
+                self.draft_pool.swap(dk, dv)
+            n, t, kp, vp = self.model.verify(
+                self.params, self.pool.k, self.pool.v,
+                np.zeros((b, 1), np.int32), d, q,
+                np.zeros((b,), np.int32), 1 + ks, bt, act)
+            self.pool.swap(kp, vp)
+
     # -- synchronous batch API -----------------------------------------------
     def generate(self, prompts, max_new_tokens=16):
         """Submit all prompts, drive the loop to completion, return the
@@ -373,13 +539,17 @@ class Engine:
                 self._mirror_events()
                 decode = list(plan.decode)
                 prefill = list(plan.prefill)
+                spec_k = dict(plan.spec_k)
                 now = time.monotonic()
                 for req, _cs, _clen in prefill:
                     if req.admit_t is None:  # first admission only —
                         req.admit_t = now    # eviction re-prefills later
             worked = False
             if decode:
-                self._run_decode(decode)
+                # model dispatch (incl. the speculative turn's fences)
+                # under _step_lock is the DESIGN: the step lock exists
+                # to serialize whole steps (see its __init__ comment)
+                self._run_decode(decode, spec_k)  # mxlint: disable
                 worked = True
             if prefill:
                 # model dispatch under _step_lock is the DESIGN: the
@@ -449,12 +619,57 @@ class Engine:
             bt[i, :len(r.blocks)] = r.blocks
         return bt
 
-    def _run_decode(self, reqs):
+    def _draft_tables(self, reqs):
+        w = self.draft_model.max_blocks
+        bt = np.zeros((len(reqs), w), np.int32)
+        for i, r in enumerate(reqs):
+            bt[i, :len(r.draft_blocks)] = r.draft_blocks
+        return bt
+
+    @staticmethod
+    def _samp_arrays(reqs):
+        """Per-request fused-sampler parameter vectors."""
+        return (np.asarray([r.temperature for r in reqs], np.float32),
+                np.asarray([r.top_k for r in reqs], np.int32),
+                np.asarray([r.top_p for r in reqs], np.float32),
+                np.asarray([r.seed for r in reqs], np.uint32))
+
+    @staticmethod
+    def _stream_slice(req, a, b):
+        """Tokens at global positions [a, b) of a request's emitted
+        stream (prompt then generated — eviction's recompute fold moves
+        tokens between context and generated but never moves their
+        global positions)."""
+        lp = len(req.prompt)
+        out = []
+        for p in range(a, b):
+            out.append(int(req.prompt[p]) if p < lp
+                       else int(req.generated[p - lp]))
+        return out
+
+    def _run_decode(self, reqs, spec_k=None):
+        """Dispatch one decode batch: speculative rows (plan gave them
+        a draft budget) run the draft+verify turn, the rest (spec off,
+        or a request's final token) the plain fused-sampling step."""
+        spec_rows = [r for r in reqs if spec_k and spec_k.get(r.rid, 0) > 0]
+        if spec_rows:
+            # model dispatch under _step_lock is the DESIGN: the step
+            # lock serializes whole steps, model execution included
+            # (see its comment in __init__) — same contract as
+            # _run_prefill below
+            self._run_spec_turn(spec_rows,                # mxlint: disable
+                                [spec_k[r.rid] for r in spec_rows])
+        plain = [r for r in reqs if r not in spec_rows]
+        if plain:
+            self._run_plain_decode(plain)
+
+    def _run_plain_decode(self, reqs):
         t0 = time.monotonic()
         B = len(reqs)
         tokens = np.asarray([[r.generated[-1]] for r in reqs], np.int32)
         start = np.asarray(
             [len(r.prompt) + len(r.generated) - 1 for r in reqs], np.int32)
+        temp, tk, tp, sd = self._samp_arrays(reqs)
         # static policy = fixed-shape serving: decode dispatches at the
         # full batch width even as the batch drains (dead slots are
         # padded lanes), faithfully paying what static batching pays on
@@ -462,10 +677,11 @@ class Engine:
         # count; continuous dispatches at the ragged bucket
         min_b = self.cfg.max_batch if self.cfg.policy == "static" else None
         with _tel.span("serve.decode"):
-            nxt, _, kp, vp = self.model.step(
+            nxt, kp, vp = self.model.step(
                 self.params, self.pool.k, self.pool.v, tokens, start,
                 np.ones((B,), np.int32), self._tables(reqs),
-                np.ones((B,), bool), min_batch_bucket=min_b)
+                np.ones((B,), bool), min_batch_bucket=min_b,
+                temperature=temp, top_k=tk, top_p=tp, seed=sd)
         now = time.monotonic()
         with self._lock:
             self.pool.swap(kp, vp)
@@ -476,6 +692,142 @@ class Engine:
                 if r.state != DECODE:   # cancelled while stepping
                     continue
                 self._emit(r, int(t), now)
+
+    def _run_spec_turn(self, reqs, ks):
+        """One speculative decode turn: the draft model proposes up to
+        ``ks[i]`` tokens per request (device-chained — proposals never
+        visit the host), the target verifies every position in ONE
+        jitted ragged step with fused accept/reject + resampling, and
+        the host folds the accepted prefix + one corrected/bonus token
+        into each stream. Per-turn D2H is ints only (the accepted
+        counts, the draft tokens, the final tokens) — logits never
+        leave the device."""
+        from ..telemetry import prof as _prof
+
+        prof_on = _prof.ENABLED
+        ac0 = _prof.attribution_count() if prof_on else 0
+        t0 = time.monotonic()
+        B = len(reqs)
+        # fixed chain length: one draft_turn/verify program regardless
+        # of this turn's per-row budgets (ks masks the unused tail)
+        K = self.cfg.spec_k
+        P = np.asarray([len(r.prompt) + len(r.generated) for r in reqs],
+                       np.int32)              # next-token position per row
+        start0 = P - 1
+        temp, tk, tp, sd = self._samp_arrays(reqs)
+        dtables = self._draft_tables(reqs)
+
+        # -- draft catch-up beyond the steady-state ingest (a request
+        # that ran plain decode while speculation was toggled off can
+        # lag arbitrarily) — chunked through the draft's step program
+        for i, r in enumerate(reqs):
+            while P[i] - 1 - r.draft_pos > 1:
+                cl = min(self.cfg.prefill_chunk, int(P[i]) - 1 - r.draft_pos)
+                toks = np.asarray(
+                    [self._stream_slice(r, r.draft_pos, r.draft_pos + cl)],
+                    np.int32)
+                _, dk, dv = self.draft_model.step(
+                    self.draft_params, self.draft_pool.k, self.draft_pool.v,
+                    toks, np.asarray([r.draft_pos], np.int32),
+                    np.asarray([cl], np.int32), dtables[i:i + 1],
+                    np.ones((1,), bool))
+                self.draft_pool.swap(dk, dv)
+                r.draft_pos += cl
+
+        # -- draft phase: ingest (1-2 missing stream tokens) + K
+        # chained proposals, ONE dispatch (model._draft_turn_impl)
+        dstart = np.asarray([r.draft_pos for r in reqs], np.int32)
+        lens = P - dstart                     # 1 or 2 after catch-up
+        Cin = int(lens.max())
+        ing = np.zeros((B, Cin), np.int32)
+        for i, r in enumerate(reqs):
+            ing[i, :lens[i]] = self._stream_slice(r, r.draft_pos, int(P[i]))
+        karr = np.asarray(ks, np.int32)
+        # the spec turn IS the decode dispatch when speculation is on —
+        # it gets its own span (serve.spec_turn) so /tracez and
+        # span-based mxctl rules keep seeing decode latency
+        with _tel.span("serve.spec_turn"):
+            td0 = time.monotonic() if prof_on else 0.0
+            dmat, qmat, dk, dv = self.draft_model.draft_turn(
+                self.draft_params, self.draft_pool.k, self.draft_pool.v,
+                ing, dstart, lens, dtables, np.ones((B,), bool), karr, K,
+                temperature=temp, top_k=tk, top_p=tp, seed=sd)
+            if prof_on:
+                dmat.block_until_ready()
+                td1 = time.monotonic()
+
+            # -- verify: one ragged target step over [prev, d_0..d_k]
+            prev = np.asarray([[r.generated[-1]] for r in reqs], np.int32)
+            n_dev, fin_dev, kp, vp = self.model.verify(
+                self.params, self.pool.k, self.pool.v, prev, dmat, qmat,
+                start0, 1 + karr, self._tables(reqs), np.ones((B,), bool),
+                temperature=temp, top_k=tk, top_p=tp, seed=sd)
+            if prof_on:
+                tv1 = time.monotonic()
+                n_dev.block_until_ready()
+                tv2 = time.monotonic()
+            n = np.asarray(n_dev)
+            fin = np.asarray(fin_dev)
+            drafts = np.asarray(dmat)
+        now = time.monotonic()
+
+        drafted = accepted = emitted = 0
+        with self._lock:
+            self.pool.swap(kp, vp)
+            self.draft_pool.swap(dk, dv)
+            for i, r in enumerate(reqs):
+                if r.state != DECODE:         # cancelled while stepping
+                    continue
+                k_i = int(ks[i])
+                j = min(int(n[i]), k_i)
+                # draft KV is valid through the accepted, FED prefix
+                # (the last proposal is never fed back): positions
+                # < P + min(j, k_i - 1) — the rollback that keeps both
+                # pools position-consistent across partial accepts
+                r.draft_pos = int(P[i]) + min(j, k_i - 1)
+                r.spec_drafted += k_i
+                r.spec_accepted += j
+                drafted += k_i
+                accepted += j
+                for t in list(drafts[i, :j]) + [int(fin[i])]:
+                    emitted += 1
+                    self._emit(r, int(t), now)
+                    if r.state != DECODE:     # eos / max_new hit
+                        break
+                if r.state == DECODE:
+                    self.sched.trim_blocks(r)
+            self._stats["spec_turns"] += 1
+            self._stats["spec_tokens_drafted"] += drafted
+            self._stats["spec_tokens_accepted"] += accepted
+            self._spec_window.append((now, drafted, accepted))
+            self._spec_window = [
+                x for x in self._spec_window
+                if now - x[0] <= self.SPEC_WINDOW_SECS]
+            if _tel.ENABLED:
+                _tel.counter("serving.spec_turns").inc()
+                _tel.counter("serving.spec_tokens_drafted").inc(drafted)
+                _tel.counter("serving.spec_tokens_accepted").inc(accepted)
+                h = _tel.histogram("serving.spec_accepted_tokens")
+                for i, r in enumerate(reqs):
+                    h.observe(min(int(n[i]), int(ks[i])))
+                _tel.histogram("serving.decode_batch_size").observe(B)
+                _tel.histogram("serving.decode_step_s").observe(now - t0)
+        if prof_on and _prof.attribution_count() == ac0:
+            Bb = bucket_for(B, self.model.batch_buckets)
+            _prof.note_step(
+                "serve.spec_draft",
+                {"host": td0 - t0, "device": td1 - td0},
+                key=self.draft_model._prof_keys.get(
+                    ("draft_turn", Bb, Cin if Cin == 1 else
+                     bucket_for(Cin, self.draft_model.chunk_buckets), K)),
+                tokens=int(np.sum(lens)) + B * (K - 1))
+            _prof.note_step(
+                "serve.spec_verify",
+                {"dispatch": tv1 - td1, "device": tv2 - tv1,
+                 "d2h": now - tv2},
+                key=self.model._prof_keys.get(("verify", Bb, K)),
+                tokens=emitted,
+                d2h_bytes=int(n.nbytes + fin.nbytes + drafts.nbytes))
 
     def _run_prefill(self, chunks):
         # context-parallel long prompts take their own path, off the
@@ -492,6 +844,7 @@ class Engine:
             return
         B = len(batched)
         C = max(clen for _, _, clen in batched)
+        reqs = [r for r, _, _ in batched]
         tokens = np.zeros((B, C), np.int32)
         start = np.zeros((B,), np.int32)
         chunk_len = np.zeros((B,), np.int32)
@@ -499,18 +852,33 @@ class Engine:
             tokens[i, :clen] = req.context[cs:cs + clen]
             start[i] = cs
             chunk_len[i] = clen
+        temp, tk, tp, sd = self._samp_arrays(reqs)
         with _tel.span("serve.prefill"):
-            nxt, _, kp, vp = self.model.step(
+            nxt, kp, vp = self.model.step(
                 self.params, self.pool.k, self.pool.v, tokens, start,
-                chunk_len, self._tables([r for r, _, _ in batched]),
-                np.ones((B,), bool))
+                chunk_len, self._tables(reqs), np.ones((B,), bool),
+                temperature=temp, top_k=tk, top_p=tp, seed=sd)
+        if self.draft_model is not None:
+            # mirror the chunk into the draft pool (same tokens, same
+            # positions, the draft's own tables) so draft KV stays
+            # position-consistent with the target from admission on —
+            # the mirror runs even while speculation is toggled off, so
+            # re-enabling is instant
+            with _tel.span("serve.draft_prefill"):
+                _, dkp, dvp = self.draft_model.step(
+                    self.draft_params, self.draft_pool.k,
+                    self.draft_pool.v, tokens, start, chunk_len,
+                    self._draft_tables(reqs), np.ones((B,), bool))
         now = time.monotonic()
         with self._lock:
             self.pool.swap(kp, vp)
+            if self.draft_model is not None:
+                self.draft_pool.swap(dkp, dvp)
             for i, (req, cs, clen) in enumerate(batched):
                 if req.state != PREFILL:   # cancelled while stepping
                     continue
                 self.sched.note_prefilled(req, clen)
+                req.draft_pos = cs + clen
                 if req.state == DECODE:
                     if req.prefill_done_t is None:  # first time only —
                         req.prefill_done_t = now    # an eviction
@@ -556,15 +924,37 @@ class Engine:
         new_v = self.pool.v.at[:, blocks].set(
             jnp.asarray(v, self.pool.v.dtype))
         logits = x_last @ np.asarray(self.params["embed"], np.float32).T
+        # the first token draws from the same (seed, position) stream
+        # the fused device sampler would use — cp-prefilled requests
+        # sample identically to paged-prefilled ones
+        first = _samp.host_sample(logits, req.temperature, req.top_k,
+                                  req.top_p, req.seed, T)
+        if self.draft_model is not None:
+            # the draft pool still needs this context: ingest it
+            # through the draft's own paged prefill (the draft is small
+            # — chunked single-row steps, not worth a cp pass)
+            dpos = 0
+            while dpos < T:
+                cl = min(self.cfg.prefill_chunk, T - dpos)
+                toks = np.asarray([req.context[dpos:dpos + cl]], np.int32)
+                _, dk, dv = self.draft_model.step(
+                    self.draft_params, self.draft_pool.k,
+                    self.draft_pool.v, toks,
+                    np.asarray([dpos], np.int32),
+                    np.asarray([cl], np.int32),
+                    self._draft_tables([req]), np.ones((1,), bool))
+                self.draft_pool.swap(dk, dv)
+                dpos += cl
         now = time.monotonic()
         with self._lock:
             self.pool.swap(new_k, new_v)
             if req.state != PREFILL:
                 return
             self.sched.note_prefilled(req, T - req.prefilled)
+            req.draft_pos = T
             if req.state == DECODE and req.prefill_done_t is None:
                 req.prefill_done_t = now
-            self._emit(req, int(np.argmax(logits)), now)
+            self._emit(req, first, now)
 
     # -- per-token bookkeeping (under self._lock) ----------------------------
     def _emit(self, req, token, now):
@@ -664,6 +1054,10 @@ class Engine:
                 self.pool.high_water_mark())
             _tel.gauge("serving.tokens_per_s").set(rate)
             _tel.gauge("serving.queue_depth").set(len(self.sched.queue))
+            if self._stats["spec_tokens_drafted"]:
+                _tel.gauge("serving.spec_accept_rate").set(
+                    self._stats["spec_tokens_accepted"]
+                    / float(self._stats["spec_tokens_drafted"]))
 
     def note_idle(self):
         """Mark the engine drained: the tokens/s gauge drops to zero
@@ -693,7 +1087,23 @@ class Engine:
 
         with self._lock:
             out = dict(self._stats)
+            drafted = self._stats["spec_tokens_drafted"]
+            now = time.monotonic()
+            win = [x for x in self._spec_window
+                   if now - x[0] <= self.SPEC_WINDOW_SECS]
+            wd = sum(x[1] for x in win)
+            wa = sum(x[2] for x in win)
             out.update({
+                "spec_enabled": self.sched.spec_active(),
+                "spec_accept_rate": (
+                    self._stats["spec_tokens_accepted"] / float(drafted)
+                    if drafted else None),
+                # the actionable signal: accept rate over the last
+                # SPEC_WINDOW_SECS of turns (None when no recent turns)
+                "spec_window_drafted": wd,
+                "spec_window_accepted": wa,
+                "spec_accept_rate_window": (wa / float(wd) if wd
+                                            else None),
                 "kv_pool_utilization": self.pool.utilization(),
                 "kv_pool_hwm_blocks": self.pool.high_water_mark(),
                 "queue_depth": len(self.sched.queue),
@@ -734,6 +1144,14 @@ class Engine:
                 "policy": self.cfg.policy,
                 "draining": self._draining,
                 "drained": self._drained,
+                "spec": {
+                    "configured": self.draft_model is not None,
+                    "enabled": self.sched.spec_active(),
+                    "spec_k": self.sched.spec_k,
+                    "draft_pool_utilization": (
+                        self.draft_pool.utilization()
+                        if self.draft_pool is not None else None),
+                },
                 "requests": reqs,
                 "pool": {
                     "capacity_blocks": self.pool.capacity,
@@ -742,7 +1160,11 @@ class Engine:
                     "hwm_blocks": self.pool.high_water_mark(),
                     "block_size": self.cfg.block_size,
                 },
-                "events": [list(e) for e in self.sched.events[-event_tail:]],
+                # the event log is a bounded ring (long-lived processes)
+                # — this is the TAIL; events_total keeps the true count
+                "events": [list(e) for e in
+                           list(self.sched.events)[-event_tail:]],
+                "events_total": self.sched.events_total,
             }
         # stats() sorts the full latency sample lists for percentiles —
         # do that in its OWN lock window, not nested inside this one,

@@ -127,6 +127,16 @@ class PagedKVPool:
                 raise ValueError("double free of block %d" % b)
         self._free.extend(int(b) for b in blocks)
 
+    def mirror(self, num_layers, num_heads, head_dim, dtype="float32"):
+        """A second pool with the SAME block geometry (num_blocks,
+        block_size) but its own KV shape — the draft model's pool in
+        speculative decoding. Identical block counts mean the target
+        and draft block tables can be kept in lockstep: every paired
+        alloc/free succeeds or fails together, so one free-list check
+        covers both."""
+        return PagedKVPool(num_layers, num_heads, head_dim,
+                           self.num_blocks, self.block_size, dtype=dtype)
+
     # -- device state --------------------------------------------------------
     def swap(self, k, v):
         """Install updated pool arrays returned by a jitted step."""

@@ -11,21 +11,38 @@ covers both phases:
 - **decode**: the same function at ``C == 1`` — one new token per
   request per step.
 
+Three program kinds share the transformer body (ISSUE 15):
+
+- ``step`` — prefill/decode with the fused sampler
+  (:mod:`.sampling`): the sampled next token is computed ON DEVICE, so
+  the only per-step D2H is the ``[B]`` token vector (the old path
+  pulled the full ``[B, V]`` logits every decode step);
+- ``propose`` — the draft model's proposal step: sampled token plus the
+  filtered draft distribution ``q`` (kept on device for the verifier);
+- ``verify`` — the speculative verify: target logits at ALL ``K+1``
+  chunk positions, accept/reject against the draft proposals, and
+  rejection-resampling / bonus sampling, all inside one program. A
+  ``chunk_len == 1`` row degenerates to plain sampled decode, which is
+  how non-spec rows ride the same math.
+
 Ragged batches (every request at a different length) are assembled into
 **fixed bucketed shapes**: batch rows pad to the next configured batch
 bucket, chunk lengths pad to the next chunk bucket, and the block-table
 width is a compile-time constant — so the number of distinct XLA
-programs is ``len(batch_buckets) x len(chunk_buckets)``, bounded and
-warm across processes via the PR 6 persistent jit cache
-(``MXNET_COMPILE_CACHE_DIR``). Padded lanes redirect their K/V writes
-to the pool's scratch block 0 and are masked out of attention reads, so
-padding never corrupts real state (ragged-vs-padded equivalence is
-pinned by tests/unittest/test_serving.py).
+programs is bounded by ``len(kinds) x len(batch_buckets) x
+len(chunk_buckets)`` and warm across processes via the PR 6 persistent
+jit cache (``MXNET_COMPILE_CACHE_DIR``); the jit/prof cache keys fold
+the program KIND alongside the bucket, so a verify program can never
+alias a plain step at the same shapes. Padded lanes redirect their K/V
+writes to the pool's scratch block 0 and are masked out of attention
+reads, so padding never corrupts real state.
 
 Numerical contract: a token decoded through the paged path produces the
 same logits as ``transformer.forward`` over the whole sequence would at
 that position (same op order, same f32 softmax accumulation), which is
-what makes continuous batching a pure scheduling win.
+what makes continuous batching a pure scheduling win — and at
+``temperature == 0`` the fused sampler is exact argmax, so greedy
+parity (spec or not) is byte-for-byte.
 
 Long-context prefill on a mesh reuses the context-parallel attention in
 ``parallel/ring_attention.py`` / ``parallel/ulysses.py``: chunked
@@ -37,7 +54,10 @@ from __future__ import annotations
 import functools
 import time
 
+import numpy as np
+
 from ..models.transformer import TransformerConfig, _layer_norm
+from . import sampling as _samp
 
 __all__ = ["ServingModel", "bucket_for", "cp_prefill_kv"]
 
@@ -76,18 +96,18 @@ class ServingModel:
         self.max_blocks = int(max_blocks_per_req)
         self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
         self.chunk_buckets = tuple(sorted(set(int(c) for c in chunk_buckets)))
-        self._jitted = {}  # (B, C) -> compiled step
-        self._prof_keys = {}  # (B, C) -> mxprof program key
+        self._jitted = {}  # (kind, B, C) -> compiled program
+        self._prof_keys = {}  # (kind, B, C) -> mxprof program key
 
-    # -- the step program ----------------------------------------------------
-    def _step_impl(self, params, kpool, vpool, tokens, start, chunk_len,
-                   block_tables, active):
+    # -- the transformer body ------------------------------------------------
+    def _body(self, params, kpool, vpool, tokens, start, chunk_len,
+              block_tables, active):
         """One fused forward over ``C`` new tokens per request.
 
         tokens [B, C] int32, start [B] int32 (global position of
         tokens[:, 0]), chunk_len [B] int32 (real tokens this chunk, 0
         for padded rows), block_tables [B, W] int32, active [B] bool.
-        Returns (next_token [B] int32, logits_last [B, V] f32, kpool,
+        Returns (x [B, C, d_model] post-ln_f hidden states, kpool,
         vpool).
         """
         import jax
@@ -164,52 +184,265 @@ class ServingModel:
             ff = jax.nn.gelu(jnp.einsum("bcd,df->bcf", h, lp["w1"]))
             x = x + jnp.einsum("bcf,fd->bcd", ff, lp["w2"])
 
-        x = _layer_norm(x, params["ln_f"])
-        # logits only at each row's last real chunk position — the one
-        # spot a next token can be sampled from
+        return _layer_norm(x, params["ln_f"]), kpool, vpool
+
+    def _last_logits(self, params, x, chunk_len):
+        """Logits at each row's last real chunk position — the one spot
+        the next token can be sampled from. [B, V] f32."""
+        import jax.numpy as jnp
+
+        C = x.shape[1]
         last = jnp.clip(chunk_len - 1, 0, C - 1)                 # [B]
         x_last = jnp.take_along_axis(
-            x, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, d]
-        logits = jnp.einsum("bd,vd->bv", x_last,
-                            params["embed"]).astype(jnp.float32)
-        next_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return next_token, logits, kpool, vpool
+            x, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return jnp.einsum("bd,vd->bv", x_last,
+                          params["embed"]).astype(jnp.float32)
 
-    def _compiled(self, B, C):
-        key = (B, C)
+    # -- program kinds -------------------------------------------------------
+    def _step_impl(self, params, kpool, vpool, tokens, start, chunk_len,
+                   block_tables, active, temp, top_k, top_p, seed):
+        """Prefill/decode with the fused sampler: the sampled token for
+        global position ``start + chunk_len`` per row."""
+        x, kpool, vpool = self._body(params, kpool, vpool, tokens, start,
+                                     chunk_len, block_tables, active)
+        logits = self._last_logits(params, x, chunk_len)
+        tok, _ = _samp.sample_tokens(logits, temp, top_k, top_p, seed,
+                                     start + chunk_len, _samp.SALT_TARGET)
+        return tok, kpool, vpool
+
+    def _draft_turn_impl(self, params, kpool, vpool, tokens, start,
+                         chunk_len, block_tables, active, temp, top_k,
+                         top_p, seed, ks, K=1):
+        """The whole draft phase as ONE program: ingest the catch-up
+        chunk (the 1-2 stream tokens the draft pool is missing) and
+        chain ``K`` proposals, each feeding the previous sample back in
+        — no host round-trip, one dispatch. The K-1 follow-up proposals
+        are a ``lax.scan`` over one single-token body, so the program
+        (and its XLA compile time) stays one-body-sized at any K — the
+        unrolled form took tens of seconds PER BUCKET to compile on
+        CPU. ``ks`` [B] is the per-row draft budget: rows past theirs
+        go inactive (writes to scratch, outputs masked later by the
+        verify chunk_len). Returns (draft_toks [B, K], qdists
+        [B, K, V], kpool, vpool)."""
+        import jax
+        import jax.numpy as jnp
+
+        x, kpool, vpool = self._body(params, kpool, vpool, tokens, start,
+                                     chunk_len, block_tables, active)
+        logits = self._last_logits(params, x, chunk_len)
+        P0 = start + chunk_len          # global position of proposal d_0
+        tok0, q0 = _samp.sample_tokens(logits, temp, top_k, top_p, seed,
+                                       P0, _samp.SALT_DRAFT)
+        if K == 1:
+            return tok0[:, None], q0[:, None], kpool, vpool
+        ones = jnp.ones_like(start)
+
+        def propose(carry, j):
+            kpool, vpool, tok = carry
+            act_j = active & (ks > j)
+            x, kpool, vpool = self._body(params, kpool, vpool,
+                                         tok[:, None], P0 + j - 1, ones,
+                                         block_tables, act_j)
+            lg = self._last_logits(params, x, ones)
+            tok, q = _samp.sample_tokens(lg, temp, top_k, top_p, seed,
+                                         P0 + j, _samp.SALT_DRAFT)
+            return (kpool, vpool, tok), (tok, q)
+
+        (kpool, vpool, _), (toks, qs) = jax.lax.scan(
+            propose, (kpool, vpool, tok0), jnp.arange(1, K))
+        draft = jnp.concatenate(
+            [tok0[:, None], jnp.swapaxes(toks, 0, 1)], axis=1)
+        qd = jnp.concatenate(
+            [q0[:, None], jnp.swapaxes(qs, 0, 1)], axis=1)
+        return draft, qd, kpool, vpool
+
+    def _verify_impl(self, params, kpool, vpool, prev, draft_toks, qdists,
+                     start, chunk_len, block_tables, active, temp, top_k,
+                     top_p, seed):
+        """Speculative verify over a [B, C] chunk, C = K + 1.
+
+        Row layout: ``prev`` [B, 1] is the request's last emitted token
+        (global position ``start``), ``draft_toks[:, j]`` the draft
+        proposal ``d_j`` for position ``start + 1 + j``; a row proposes
+        ``k_i = chunk_len - 1`` drafts (``k_i == 0`` = plain sampled
+        decode). Returns (n_accept [B] int32 — leading drafts accepted,
+        tok [B] int32 — the one non-draft token to emit after them: the
+        rejection resample, or the bonus/plain sample on full
+        acceptance, kpool, vpool). Logits never leave the program.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        tokens = jnp.concatenate([prev, draft_toks], axis=1)
+        x, kpool, vpool = self._body(params, kpool, vpool, tokens, start,
+                                     chunk_len, block_tables, active)
+        B, C = tokens.shape
+        K = C - 1
+        V = self.cfg.vocab_size
+        logits = jnp.einsum("bcd,vd->bcv", x,
+                            params["embed"]).astype(jnp.float32)  # [B,C,V]
+        masked, pdist = _samp.filter_dist(
+            jnp, logits, temp[:, None], top_k[:, None], top_p[:, None])
+        argm = jnp.argmax(logits, axis=-1)                       # [B, C]
+        k_i = chunk_len - 1                                      # [B]
+        is_sampled = jnp.asarray(temp, jnp.float32) > 0          # [B]
+
+        any_sampled = jnp.any(is_sampled)
+
+        # -- accept/reject the K draft positions -----------------------------
+        pos_k = start[:, None] + 1 + jnp.arange(K)[None, :]      # [B, K]
+        d = jnp.clip(draft_toks, 0, V - 1).astype(jnp.int32)
+        p_d = jnp.take_along_axis(pdist[:, :K], d[..., None],
+                                  axis=-1)[..., 0]               # [B, K]
+        q_d = jnp.take_along_axis(qdists, d[..., None], axis=-1)[..., 0]
+
+        def accept_draw(_):
+            keys_u = _samp.fold_keys(jnp.repeat(seed, K),
+                                     pos_k.reshape(-1), _samp.SALT_ACCEPT)
+            u = jax.vmap(lambda k: jax.random.uniform(k, (), jnp.float32))(
+                keys_u).reshape(B, K)
+            return u < jnp.minimum(p_d / jnp.maximum(q_d, 1e-20), 1.0)
+
+        acc_greedy = d == argm[:, :K]
+        # all-greedy batches skip every random draw in this program
+        # (threefry is real per-step cost); the conds below mirror this
+        acc_sampled = jax.lax.cond(any_sampled, accept_draw,
+                                   lambda _: acc_greedy, 0)
+        accept = jnp.where(is_sampled[:, None], acc_sampled, acc_greedy)
+        accept = accept & (jnp.arange(K)[None, :] < k_i[:, None])
+        stop = ~accept
+        n = jnp.where(stop.any(axis=1),
+                      jnp.argmax(stop, axis=1), K).astype(jnp.int32)
+
+        # -- the one non-draft token ------------------------------------------
+        # full acceptance -> bonus sample from position start + chunk_len
+        # with the TARGET salt: exactly the draw plain decode would make
+        bon_masked = jnp.take_along_axis(
+            masked, k_i[:, None, None], axis=1)[:, 0]            # [B, V]
+        bon_greedy = jnp.take_along_axis(argm, k_i[:, None], axis=1)[:, 0]
+
+        def bonus_draw(m):
+            keys_b = _samp.fold_keys(seed, start + chunk_len,
+                                     _samp.SALT_TARGET)
+            g = jax.vmap(lambda k: jax.random.gumbel(
+                k, (V,), jnp.float32))(keys_b)
+            return jnp.argmax(m + g, axis=-1)
+
+        bon_sampled = jax.lax.cond(any_sampled, bonus_draw,
+                                   lambda m: bon_greedy, bon_masked)
+        bonus = jnp.where(is_sampled, bon_sampled, bon_greedy)
+        # rejection at draft index n -> resample from max(p - q, 0)
+        nc = jnp.clip(n, 0, C - 1)
+        p_n = jnp.take_along_axis(pdist, nc[:, None, None], axis=1)[:, 0]
+        q_n = jnp.take_along_axis(qdists,
+                                  jnp.clip(n, 0, K - 1)[:, None, None],
+                                  axis=1)[:, 0]
+        res_greedy = jnp.take_along_axis(argm, nc[:, None], axis=1)[:, 0]
+
+        def residual_draw(_):
+            r = jnp.maximum(p_n - q_n, 0.0)
+            rs = jnp.sum(r, axis=-1, keepdims=True)
+            r = jnp.where(rs > 1e-12, r / jnp.maximum(rs, 1e-12), p_n)
+            r_logits = jnp.where(r > 0, jnp.log(jnp.maximum(r, 1e-30)),
+                                 jnp.float32(-1e30))
+            keys_r = _samp.fold_keys(seed, start + 1 + n,
+                                     _samp.SALT_RESIDUAL)
+            g = jax.vmap(lambda k: jax.random.gumbel(
+                k, (V,), jnp.float32))(keys_r)
+            return jnp.argmax(r_logits + g, axis=-1)
+
+        res_sampled = jax.lax.cond(any_sampled, residual_draw,
+                                   lambda _: res_greedy, 0)
+        resample = jnp.where(is_sampled, res_sampled, res_greedy)
+
+        tok = jnp.where(n >= k_i, bonus, resample).astype(jnp.int32)
+        return n, tok, kpool, vpool
+
+    _KIND_IMPLS = {"step": "_step_impl", "draft_turn": "_draft_turn_impl",
+                   "verify": "_verify_impl"}
+
+    def _compiled(self, key):
+        """key = (kind, *static shape params) — the jit/prof cache key
+        surface: program KIND and bucket shapes together, so e.g. a
+        verify program can never alias a step program at equal
+        shapes."""
         fn = self._jitted.get(key)
         if fn is None:
             import jax
 
             from ..compile import jit_cache
 
+            impl = getattr(self, self._KIND_IMPLS[key[0]])
+            if key[0] == "draft_turn":
+                impl = functools.partial(impl, K=key[3])
             # pools are donated on TPU; jaxlib 0.4.3x CPU executables
             # deserialized from the persistent cache corrupt the heap
             # under donation (jit_cache.donation_unsafe, PR 6) — keep
             # the buffers there
             donate = () if jit_cache.donation_unsafe() else (1, 2)
-            fn = jax.jit(self._step_impl, donate_argnums=donate)
+            fn = jax.jit(impl, donate_argnums=donate)
             self._jitted[key] = fn
         return fn
 
+    def _sampling_arrays(self, B, B_real, temperature, top_k, top_p, seed):
+        """Pad per-request sampling params to the batch bucket (padded
+        rows greedy/seed-0: their draws are never read)."""
+        def pad(vals, dtype, default):
+            a = np.full((B,), default, dtype)
+            if vals is not None:
+                a[:B_real] = np.asarray(vals, dtype)
+            return a
+
+        return (pad(temperature, np.float32, 0.0),
+                pad(top_k, np.int32, 0),
+                pad(top_p, np.float32, 1.0),
+                pad(seed, np.uint32, 0))
+
+    def _attribute(self, key, fn, args, meta):
+        """mxprof: attribute this bucket's program (AOT compile = the
+        bucket's one compile); the compiled callable replaces the
+        jitted one in the bucket cache. Returns the (possibly compiled)
+        callable and whether attribution happened on this call."""
+        from ..telemetry import prof as _prof
+
+        if not _prof.ENABLED or key in self._prof_keys:
+            return fn, False
+        cfg = self.cfg
+        kind = key[0]
+        name = "serve.%s|%s" % (kind, "|".join(str(k) for k in key[1:]))
+        # graph identity: the program KIND plus the FULL model geometry
+        # (heads/d_ff/vocab included — two configs sharing L and
+        # d_model are still different programs) + the paged-pool layout
+        ghash = _prof.graph_hash("%s|%r|bs=%d|W=%d" % (
+            kind, cfg, self.block_size, self.max_blocks))
+        fn = _prof.attribute_jit(name, fn, args, site="serving.%s" % kind,
+                                 meta=meta, graph_key=ghash)
+        self._jitted[key] = fn
+        self._prof_keys[key] = _prof.program_key_for(name, graph_key=ghash)
+        return fn, True
+
     # -- host-facing API -----------------------------------------------------
     def step(self, params, kpool, vpool, tokens, start, chunk_len,
-             block_tables, active, min_batch_bucket=None):
+             block_tables, active, min_batch_bucket=None, temperature=None,
+             top_k=None, top_p=None, seed=None):
         """Run one bucketed step over host-side (numpy) batch inputs.
 
         Inputs are RAGGED: ``tokens`` is [B, C_real<=bucket] already
         padded per-row by the caller via ``chunk_len``; this method pads
         the batch and chunk dims to their buckets and slices the result
-        back down.
+        back down. Sampling params default to greedy (temperature 0).
 
         ``min_batch_bucket`` forces at least that batch bucket — the
         static-batching baseline dispatches decode at the FIXED batch
         shape even when slots have drained (dead slots are padded
         lanes), which is what "static" means on hardware where a decode
         step costs the same at any live count.
-        """
-        import numpy as np
 
+        Returns (next_token [B_real] int32 numpy, kpool, vpool) — the
+        token vector is the ONLY device->host transfer; logits stay on
+        device (the fused-sampler contract, asserted via the mxprof
+        ``d2h_bytes`` channel).
+        """
         B_real, C_real = tokens.shape
         B = bucket_for(max(B_real, min_batch_bucket or 1),
                        self.batch_buckets)
@@ -233,40 +466,25 @@ class ServingModel:
         bt[:B_real] = block_tables
         act = np.zeros((B,), bool)
         act[:B_real] = active
-        fn = self._compiled(B, C)
+        temp, tk, tp, sd = self._sampling_arrays(
+            B, B_real, temperature, top_k, top_p, seed)
+        fn = self._compiled(("step", B, C))
+        args = (params, kpool, vpool, tok, start, chunk_len, bt, act,
+                temp, tk, tp, sd)
         attributed_now = False
-        if prof_on and (B, C) not in self._prof_keys:
-            attributed_now = True
-            # mxprof: attribute this bucket's ragged-step program (AOT
-            # compile = the bucket's one compile); the compiled
-            # callable replaces the jitted one in the bucket cache
-            cfg = self.cfg
-            key = "serve.step|B=%d|C=%d" % (B, C)
-            # graph identity: the FULL model geometry (heads/d_ff/vocab
-            # included — two configs sharing L and d_model are still
-            # different programs) + the paged-pool layout
-            ghash = _prof.graph_hash("%r|bs=%d|W=%d" % (
-                cfg, self.block_size, self.max_blocks))
-            fn = _prof.attribute_jit(
-                key, fn,
-                (params, kpool, vpool, tok, start, chunk_len, bt, act),
-                site="serving.step",
-                meta={"batch_bucket": B, "chunk_bucket": C},
-                graph_key=ghash)
-            self._jitted[(B, C)] = fn
-            self._prof_keys[(B, C)] = _prof.program_key_for(
-                key, graph_key=ghash)
+        if prof_on:
+            fn, attributed_now = self._attribute(
+                ("step", B, C), fn, args,
+                meta={"batch_bucket": B, "chunk_bucket": C})
         t1 = time.monotonic() if prof_on else 0.0
-        nxt, logits, kp, vp = fn(
-            params, kpool, vpool, tok, start, chunk_len, bt, act)
+        nxt, kp, vp = fn(*args)
         if prof_on:
             t2 = time.monotonic()
             bur = getattr(nxt, "block_until_ready", None)
             if bur is not None:
                 bur()
             t3 = time.monotonic()
-        out = (np.asarray(nxt)[:B_real], np.asarray(logits)[:B_real],
-               kp, vp)
+        out_tok = np.asarray(nxt)[:B_real]
         if prof_on and not attributed_now:
             # the bucket's first step carried the attribution compile —
             # recording it would drown the steady-state phase shares
@@ -274,19 +492,102 @@ class ServingModel:
                 "serve.decode" if C == 1 else "serve.prefill",
                 {"host": t1 - t0, "dispatch": t2 - t1,
                  "device": t3 - t2, "d2h": time.monotonic() - t3},
-                key=self._prof_keys.get((B, C)),
-                tokens=int(np.sum(np.asarray(chunk_len)[:B_real])))
-        return out
+                key=self._prof_keys.get(("step", B, C)),
+                tokens=int(np.sum(np.asarray(chunk_len)[:B_real])),
+                d2h_bytes=int(out_tok.nbytes))
+        return out_tok, kp, vp
+
+    def _pad_device(self, arr, B, fill=0):
+        """Pad a device array's batch dim to the bucket."""
+        import jax.numpy as jnp
+
+        a = jnp.asarray(arr)
+        if a.shape[0] == B:
+            return a
+        pad = jnp.full((B - a.shape[0],) + a.shape[1:], fill, a.dtype)
+        return jnp.concatenate([a, pad], axis=0)
+
+    def _padb_host(self, a, B):
+        a = np.asarray(a)
+        if a.shape[0] == B:
+            return a
+        return np.concatenate(
+            [a, np.zeros((B - a.shape[0],) + a.shape[1:], a.dtype)])
+
+    def draft_turn(self, params, kpool, vpool, tokens, start, chunk_len,
+                   block_tables, active, ks, K, temperature=None,
+                   top_k=None, top_p=None, seed=None):
+        """The whole draft phase in one dispatch: ingest + K chained
+        proposals. ``tokens`` [B_real, Cin] is the per-row catch-up
+        chunk (``chunk_len`` real tokens each), ``ks`` the per-row
+        draft budgets, ``K`` the static chain length (>= max ks).
+        Returns (draft_toks [B_real, K], qdists [B_real, K, V], kpool,
+        vpool) — all still on device."""
+        B_real, C_real = np.shape(tokens)
+        B = bucket_for(B_real, self.batch_buckets)
+        C = 1 if C_real == 1 else bucket_for(C_real, self.chunk_buckets)
+        tok = np.zeros((B, C), np.int32)
+        tok[:B_real, :C_real] = tokens
+        start = self._padb_host(np.asarray(start, np.int32), B)
+        chunk_len = self._padb_host(np.asarray(chunk_len, np.int32), B)
+        ks = self._padb_host(np.asarray(ks, np.int32), B)
+        bt = np.zeros((B, self.max_blocks), np.int32)
+        bt[:B_real] = block_tables
+        act = np.zeros((B,), bool)
+        act[:B_real] = active
+        temp, tk, tp, sd = self._sampling_arrays(
+            B, B_real, temperature, top_k, top_p, seed)
+        key = ("draft_turn", B, C, int(K))
+        fn = self._compiled(key)
+        args = (params, kpool, vpool, tok, start, chunk_len, bt, act,
+                temp, tk, tp, sd, ks)
+        fn, _ = self._attribute(key, fn, args,
+                                meta={"batch_bucket": B, "chunk_bucket": C,
+                                      "spec_k": int(K)})
+        d, q, kp, vp = fn(*args)
+        return d[:B_real], q[:B_real], kp, vp
+
+    def verify(self, params, kpool, vpool, prev_tokens, draft_tokens,
+               qdists, start, chunk_len, block_tables, active,
+               temperature=None, top_k=None, top_p=None, seed=None):
+        """The speculative verify step: ``prev_tokens`` [B_real, 1]
+        host ints, ``draft_tokens`` [B_real, K] / ``qdists``
+        [B_real, K, V] device arrays from the draft turn (assembled
+        into the [B, K+1] chunk INSIDE the program — no eager glue).
+        Returns (n_accept [B_real], tok [B_real], kpool, vpool) with
+        the small int outputs still on device — the caller pulls them
+        in one fence."""
+        B_real, K = np.shape(draft_tokens)
+        B = bucket_for(B_real, self.batch_buckets)
+        prev = self._pad_device(np.asarray(prev_tokens, np.int32), B)
+        d = self._pad_device(draft_tokens, B)
+        q = self._pad_device(qdists, B, fill=1.0)
+        start = self._padb_host(np.asarray(start, np.int32), B)
+        chunk_len = self._padb_host(np.asarray(chunk_len, np.int32), B)
+        # padded rows: chunk_len 0 would make k_i negative — clamp to 1
+        chunk_len = np.maximum(chunk_len, 1)
+        bt = np.zeros((B, self.max_blocks), np.int32)
+        bt[:B_real] = block_tables
+        act = np.zeros((B,), bool)
+        act[:B_real] = active
+        temp, tk, tp, sd = self._sampling_arrays(
+            B, B_real, temperature, top_k, top_p, seed)
+        key = ("verify", B, K)
+        fn = self._compiled(key)
+        args = (params, kpool, vpool, prev, d, q, start, chunk_len, bt,
+                act, temp, tk, tp, sd)
+        fn, _ = self._attribute(key, fn, args,
+                                meta={"batch_bucket": B, "spec_k": K})
+        n, t, kp, vp = fn(*args)
+        return n[:B_real], t[:B_real], kp, vp
 
     def warmup(self, params, pool, batch_sizes=None):
         """Pre-compile the decode programs (and let the persistent jit
         cache serve them next process). Prefill buckets compile on first
         use."""
-        import numpy as np
-
         for B in (batch_sizes or self.batch_buckets):
             bt = np.zeros((B, self.max_blocks), np.int32)
-            nxt, _, kp, vp = self.step(
+            nxt, kp, vp = self.step(
                 params, pool.k, pool.v, np.zeros((B, 1), np.int32),
                 np.zeros((B,), np.int32), np.ones((B,), np.int32), bt,
                 np.zeros((B,), bool))
@@ -311,7 +612,6 @@ def cp_prefill_kv(params, cfg, tokens, mesh, kind="ring", chunk=None,
     tokens: [T] or [1, T] int32. Returns (k [L, T, H, D], v likewise,
     x_last [d_model] final-position hidden state) as host arrays.
     """
-    import numpy as np
     import jax.numpy as jnp
 
     from ..parallel.ring_attention import make_ring_attention

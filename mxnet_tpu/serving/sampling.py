@@ -1,0 +1,203 @@
+"""Fused on-device sampling for the serving engine (ISSUE 15).
+
+Before this module the decode hot path was greedy: the jitted step
+returned a full ``[B, V]`` logits array that crossed device->host every
+step just so the engine could argmax it. Sampling now happens INSIDE
+the jitted step — temperature scaling, top-k, top-p (nucleus) filtering
+and the categorical draw — so the only per-step D2H is the ``[B]``
+int32 token vector. The same sampler drives plain decode, the final
+prefill chunk's first-token emission, the draft model's proposals, and
+the speculative verify step's accept/reject + rejection-resampling.
+
+Determinism contract (the parity suite's foundation):
+
+- every random draw is keyed by ``(request seed, global token
+  position, salt)`` via ``fold_in`` chains — NOT by step count — so a
+  request replayed after eviction/recompute, or re-chunked differently,
+  draws identical samples at identical positions;
+- ``temperature == 0`` is exact greedy argmax over the RAW logits (no
+  filtering applied), byte-identical to the pre-sampling decode path;
+- the device sampler and :func:`host_sample` (the numpy reference, used
+  by tests and the context-parallel prefill path) share ONE filtering
+  implementation, parameterized by the array namespace, and both take
+  their Gumbel/uniform bits from the same jax PRNG chain.
+
+Salt layout (one stream per random purpose at each position)::
+
+    SALT_TARGET   the token draw a plain decode at this position makes
+                  (also the speculative bonus draw — full acceptance
+                  lands exactly the sample non-spec decode would);
+    SALT_ACCEPT   the accept/reject uniform judging a draft token;
+    SALT_DRAFT    the draft model's proposal draw;
+    SALT_RESIDUAL the rejection-resampling draw from max(p - q, 0).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "SALT_TARGET", "SALT_ACCEPT", "SALT_DRAFT", "SALT_RESIDUAL",
+    "filter_dist", "fold_keys", "sample_tokens", "host_key",
+    "host_sample",
+]
+
+SALT_TARGET = 0
+SALT_ACCEPT = 1
+SALT_DRAFT = 2
+SALT_RESIDUAL = 3
+
+_NEG = np.float32(-1e30)  # effective -inf that survives arithmetic
+
+
+def _filter_full(xp, scaled, top_k, top_p):
+    """The sort-based top-k/top-p masking (see filter_dist)."""
+    V = scaled.shape[-1]
+    # top-k: threshold at the kth largest (k <= 0 -> keep all V)
+    k = xp.asarray(top_k, np.int32)
+    k = xp.where(k <= 0, np.int32(V), k)
+    desc = -xp.sort(-scaled, axis=-1)
+    kth = xp.take_along_axis(
+        desc, xp.clip(k[..., None] - 1, 0, V - 1).astype(np.int32), axis=-1)
+    masked = xp.where(scaled < kth, _NEG, scaled)
+    # top-p over the top-k-filtered softmax: keep the smallest prefix of
+    # descending-prob tokens reaching top_p mass (a token is kept while
+    # the mass BEFORE it is under the cut)
+    m = xp.max(masked, axis=-1, keepdims=True)
+    e = xp.exp(masked - m) * (masked > _NEG)
+    probs = e / xp.sum(e, axis=-1, keepdims=True)
+    order = xp.argsort(-probs, axis=-1, kind="stable") \
+        if xp is np else xp.argsort(-probs, axis=-1)
+    sp = xp.take_along_axis(probs, order, axis=-1)
+    before = xp.cumsum(sp, axis=-1) - sp
+    keep_sorted = before < xp.asarray(top_p, np.float32)[..., None]
+    inv = xp.argsort(order, axis=-1, kind="stable") \
+        if xp is np else xp.argsort(order, axis=-1)
+    keep = xp.take_along_axis(keep_sorted, inv, axis=-1)
+    masked = xp.where(keep, masked, _NEG)
+    e2 = xp.exp(masked - xp.max(masked, axis=-1, keepdims=True)) \
+        * (masked > _NEG)
+    probs = e2 / xp.sum(e2, axis=-1, keepdims=True)
+    return masked, probs
+
+
+def _filter_fast(xp, scaled):
+    """The no-filtering path: plain softmax (identical arithmetic to
+    the full path when every token is kept — XLA sorts are the hot-path
+    cost this branch avoids)."""
+    m = xp.max(scaled, axis=-1, keepdims=True)
+    e = xp.exp(scaled - m) * (scaled > _NEG)
+    probs = e / xp.sum(e, axis=-1, keepdims=True)
+    return scaled, probs
+
+
+def filter_dist(xp, logits, temp, top_k, top_p):
+    """Temperature/top-k/top-p filtering, shared device/host.
+
+    ``xp`` is ``jax.numpy`` (traced) or ``numpy`` (host reference) —
+    the op sequence is identical so the two paths agree bit-for-bit up
+    to backend ulps. ``logits`` is ``[..., V]`` float32; ``temp`` /
+    ``top_k`` / ``top_p`` broadcast over the leading axes (``top_k <=
+    0`` disables top-k, ``top_p >= 1`` keeps everything).
+
+    When NO row filters (the greedy/plain-temperature hot path), a
+    ``lax.cond`` skips the sort machinery — XLA CPU sorts were the
+    dominant per-step sampler cost. The two branches are arithmetic-
+    identical for the keep-everything case, so a mixed batch sending a
+    no-filter row down the full path samples the same token the host
+    reference (which branches per request) draws.
+
+    Returns ``(masked, probs)``: filtered scaled logits (disallowed
+    entries at a large negative) and the renormalized distribution.
+    Callers handle ``temp == 0`` rows themselves (greedy argmax); the
+    scale here clamps to a tiny epsilon only so traced math stays
+    finite on those rows.
+    """
+    logits = logits.astype(np.float32)
+    t = xp.asarray(temp, np.float32)[..., None]
+    scaled = logits / xp.maximum(t, np.float32(1e-6))
+    if xp is np:
+        if np.any((np.asarray(top_k) > 0) | (np.asarray(top_p) < 1.0)):
+            return _filter_full(np, scaled, top_k, top_p)
+        return _filter_fast(np, scaled)
+    import jax
+
+    pred = xp.any((xp.asarray(top_k, np.int32) > 0)
+                  | (xp.asarray(top_p, np.float32) < 1.0))
+    return jax.lax.cond(
+        pred,
+        lambda s: _filter_full(xp, s, top_k, top_p),
+        lambda s: _filter_fast(xp, s),
+        scaled)
+
+
+def fold_keys(seed, pos, salt):
+    """Traced per-row PRNG keys: ``fold_in(fold_in(PRNGKey(seed), pos),
+    salt)`` vmapped over matching ``[N]`` seed/pos arrays."""
+    import jax
+
+    def one(s, p):
+        k = jax.random.PRNGKey(s)
+        return jax.random.fold_in(jax.random.fold_in(k, p), salt)
+
+    return jax.vmap(one)(seed.astype(np.uint32), pos.astype(np.int32))
+
+
+def sample_tokens(logits, temp, top_k, top_p, seed, pos, salt):
+    """In-jit fused sampler over ``[N, V]`` logits rows.
+
+    Returns ``(tokens [N] int32, probs [N, V] f32)`` where ``probs`` is
+    the distribution the token was drawn from (one-hot at the argmax
+    for ``temp == 0`` rows — exactly the greedy "distribution", which
+    is what speculative rejection accounting needs for ``q``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    masked, probs = filter_dist(jnp, logits, temp, top_k, top_p)
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    is_sampled = jnp.asarray(temp, jnp.float32) > 0
+    # all-greedy batches (the common serving default) skip the threefry
+    # key derivation + Gumbel draw entirely — this runs once per draft
+    # proposal inside the scanned chain, so it's hot
+    sampled = jax.lax.cond(
+        jnp.any(is_sampled),
+        lambda m: jnp.argmax(
+            m + jax.vmap(lambda k: jax.random.gumbel(
+                k, (V,), jnp.float32))(fold_keys(seed, pos, salt)),
+            axis=-1),
+        lambda m: greedy,
+        masked)
+    tok = jnp.where(is_sampled, sampled, greedy).astype(jnp.int32)
+    probs = jnp.where(is_sampled[..., None], probs,
+                      jax.nn.one_hot(greedy, V, dtype=jnp.float32))
+    return tok, probs
+
+
+# -- host reference ------------------------------------------------------------
+def host_key(seed, pos, salt):
+    """Eager-mode key for one (seed, position, salt) — the same chain
+    :func:`fold_keys` builds inside the jitted programs."""
+    import jax
+
+    k = jax.random.PRNGKey(np.uint32(seed))
+    return jax.random.fold_in(jax.random.fold_in(k, int(pos)), int(salt))
+
+
+def host_sample(logits, temperature, top_k, top_p, seed, pos,
+                salt=SALT_TARGET):
+    """Numpy reference sampler for ONE logits row — the independent
+    implementation the device sampler is pinned against (and what the
+    context-parallel prefill path, whose logits are already on host,
+    uses so cp-prefilled requests sample identically)."""
+    import jax
+
+    logits = np.asarray(logits, np.float32).reshape(-1)
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    masked, _ = filter_dist(
+        np, logits[None], np.asarray([temperature], np.float32),
+        np.asarray([top_k], np.int32), np.asarray([top_p], np.float32))
+    g = np.asarray(jax.random.gumbel(host_key(seed, pos, salt),
+                                     (logits.shape[0],), np.float32))
+    return int(np.argmax(masked[0] + g))

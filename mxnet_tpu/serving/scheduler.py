@@ -39,6 +39,7 @@ import itertools
 
 import numpy as np
 
+from ..base import env_int as _env_int
 from .kv_cache import blocks_for_tokens
 
 __all__ = ["Request", "Scheduler", "StepPlan",
@@ -57,13 +58,22 @@ class Request:
                  "blocks", "context", "prefilled", "generated",
                  "submit_t", "first_token_t", "last_token_t", "finish_t",
                  "evictions", "cancel_requested", "stream",
+                 # fused-sampling params (sampling.py): temperature 0 =
+                 # greedy; draws keyed (seed, position, salt)
+                 "temperature", "top_k", "top_p", "seed",
+                 # speculative decoding (engine + scheduler lockstep):
+                 # draft-pool block table, first position the draft
+                 # pool lacks valid KV for, cumulative drafted/accepted
+                 "draft_blocks", "draft_pos", "spec_drafted",
+                 "spec_accepted",
                  # request-scoped tracing (engine fills these in when
                  # telemetry is on; scheduling never reads them):
                  # trace id, submit wall-clock anchor, first-admission
                  # and prefill-complete monotonic stamps
                  "trace", "wall0", "admit_t", "prefill_done_t")
 
-    def __init__(self, prompt, max_new_tokens, eos_id=None, stream=None):
+    def __init__(self, prompt, max_new_tokens, eos_id=None, stream=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=0):
         self.rid = next(_rid)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -83,6 +93,14 @@ class Request:
         self.evictions = 0
         self.cancel_requested = False
         self.stream = stream
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.draft_blocks = []
+        self.draft_pos = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self.trace = None
         self.wall0 = None
         self.admit_t = None
@@ -100,11 +118,14 @@ class Request:
 class StepPlan:
     """What one engine step should run."""
 
-    __slots__ = ("decode", "prefill")
+    __slots__ = ("decode", "prefill", "spec_k")
 
-    def __init__(self, decode, prefill):
+    def __init__(self, decode, prefill, spec_k=None):
         self.decode = decode        # [Request] — one token each
         self.prefill = prefill      # [(Request, chunk_start, chunk_len)]
+        # rid -> draft tokens this turn (0 = plain decode row); empty
+        # when speculation is off
+        self.spec_k = spec_k or {}
 
     def __bool__(self):
         return bool(self.decode or self.prefill)
@@ -127,7 +148,8 @@ class Scheduler:
     """
 
     def __init__(self, pool, max_batch=8, prefill_chunk=128,
-                 token_budget=None, policy="continuous", max_active=None):
+                 token_budget=None, policy="continuous", max_active=None,
+                 draft_pool=None, spec_k=0, events_max=None):
         if policy not in ("continuous", "static"):
             raise ValueError("unknown policy %r" % (policy,))
         self.pool = pool
@@ -136,6 +158,15 @@ class Scheduler:
         self.token_budget = int(token_budget if token_budget is not None
                                 else self.max_batch + self.prefill_chunk)
         self.policy = policy
+        # speculative decoding: the draft model's paged pool (same
+        # block geometry, kv_cache.PagedKVPool.mirror) whose per-request
+        # tables stay in LOCKSTEP with the target tables — every alloc/
+        # free below pairs the two, so len(draft_blocks) == len(blocks)
+        # always. spec_k > 0 makes each decode slot cost 1 + spec_k
+        # budget tokens (its verify chunk); the engine toggles it at
+        # runtime via set_spec_k (the mxctl spec_off actuator).
+        self.draft_pool = draft_pool
+        self.spec_k = int(spec_k)
         # admission depth: more requests than one decode batch may be
         # active so freshly-prefilled requests backfill drained decode
         # slots immediately (decode occupancy is the throughput lever);
@@ -147,8 +178,53 @@ class Scheduler:
                                   else 2 * self.max_batch)
         self.queue = collections.deque()
         self.active = []          # admission-ordered PREFILL/DECODE reqs
-        self.events = []          # deterministic audit log
+        # deterministic audit log, BOUNDED: long-lived serving processes
+        # emit events forever, so the log is a ring holding the tail
+        # (introspect()/servingz render the tail anyway); events_total
+        # keeps the true count for accounting
+        self.events = collections.deque(
+            maxlen=int(events_max if events_max is not None
+                       else _env_int("MXNET_SERVE_EVENTS_MAX", 4096)))
+        self.events_total = 0
         self.counts = collections.Counter()
+
+    def spec_active(self):
+        return self.draft_pool is not None and self.spec_k > 0
+
+    def set_spec_k(self, k):
+        """Runtime speculation toggle (0 disables): takes effect at the
+        next plan()."""
+        self.spec_k = int(k)
+
+    def _event(self, ev, rid):
+        self.events.append((ev, rid))
+        self.events_total += 1
+        self.counts[ev] += 1
+
+    # -- paired target/draft block bookkeeping -------------------------------
+    def _alloc_pair(self, req, n):
+        """Allocate n blocks in the target pool (and the draft pool in
+        lockstep when speculation is configured). True on success; on
+        any failure nothing is held."""
+        blocks = self.pool.alloc(n)
+        if blocks is None:
+            return False
+        if self.draft_pool is not None:
+            dblocks = self.draft_pool.alloc(n)
+            if dblocks is None:  # lockstep makes this unreachable, but
+                self.pool.free(blocks)  # never leak on the safe side
+                return False
+            req.draft_blocks.extend(dblocks)
+        req.blocks.extend(blocks)
+        return True
+
+    def _free_all(self, req):
+        if req.blocks:
+            self.pool.free(req.blocks)
+            req.blocks = []
+        if req.draft_blocks:
+            self.draft_pool.free(req.draft_blocks)
+            req.draft_blocks = []
 
     # -- intake --------------------------------------------------------------
     def max_request_tokens(self):
@@ -164,14 +240,11 @@ class Scheduler:
 
     # -- internal helpers ----------------------------------------------------
     def _finish(self, req, state, event):
-        if req.blocks:
-            self.pool.free(req.blocks)
-            req.blocks = []
+        self._free_all(req)
         req.state = state
         if req in self.active:
             self.active.remove(req)
-        self.events.append((event, req.rid))
-        self.counts[event] += 1
+        self._event(event, req.rid)
 
     def finish(self, req):
         """Mark a running request complete (engine calls after the stop
@@ -181,8 +254,7 @@ class Scheduler:
     def note_drained(self):
         """Record the engine's drain completion in the deterministic
         event log (rid -1: a lifecycle event, not a request)."""
-        self.events.append(("drained", -1))
-        self.counts["drained"] += 1
+        self._event("drained", -1)
 
     def _sweep_cancelled(self):
         for req in [r for r in self.active if r.cancel_requested]:
@@ -191,8 +263,7 @@ class Scheduler:
         for req in self.queue:
             if req.cancel_requested:
                 req.state = CANCELLED
-                self.events.append(("cancel", req.rid))
-                self.counts["cancel"] += 1
+                self._event("cancel", req.rid)
         if len(kept) != len(self.queue):
             self.queue = collections.deque(kept)
 
@@ -202,15 +273,13 @@ class Scheduler:
             # static batches are sized once: reserve the whole worst
             # case so the batch can always run to completion
             need = blocks_for_tokens(req.total_len(), self.pool.block_size)
-        blocks = self.pool.alloc(need)
-        if blocks is None:
+        if not self._alloc_pair(req, need):
             return False
-        req.blocks = blocks
         req.state = PREFILL
         req.prefilled = 0
+        req.draft_pos = 0
         self.active.append(req)
-        self.events.append(("admit", req.rid))
-        self.counts["admit"] += 1
+        self._event("admit", req.rid)
         return True
 
     def _admit(self):
@@ -226,24 +295,23 @@ class Scheduler:
         if not self.active:
             return None
         victim = self.active.pop()
-        self.pool.free(victim.blocks)
-        victim.blocks = []
+        self._free_all(victim)
         # recompute context: everything already streamed is folded in
         victim.context = np.concatenate(
             [victim.context,
              np.asarray(victim.generated[
                  len(victim.context) - len(victim.prompt):], np.int32)])
         victim.prefilled = 0
+        victim.draft_pos = 0
         victim.state = QUEUED
         victim.evictions += 1
         self.queue.appendleft(victim)
-        self.events.append(("evict", victim.rid))
-        self.counts["evict"] += 1
+        self._event("evict", victim.rid)
         return victim
 
-    def _ensure_decode_block(self, req):
-        """Make sure the slot for this step's KV write exists;
-        evict-youngest until it does (the request itself may be the
+    def _ensure_decode_block(self, req, horizon=0):
+        """Make sure the slots for this step's KV writes exist;
+        evict-youngest until they do (the request itself may be the
         youngest, in which case it preempts itself and the step skips
         it). False = req can't decode this step.
 
@@ -251,18 +319,37 @@ class Scheduler:
         the engine feeds ``generated[-1]``, which lives at global
         position ``len(prompt) + len(generated) - 1`` (the recompute
         fold moves tokens between context and generated but never moves
-        their global positions)."""
-        pos = len(req.prompt) + len(req.generated) - 1
+        their global positions). A speculative turn writes ``horizon``
+        more positions (the draft tokens its verify chunk carries), so
+        the table must reach ``pos + horizon``; partial acceptance
+        frees the unused tail via :meth:`trim_blocks`."""
+        pos = len(req.prompt) + len(req.generated) - 1 + int(horizon)
         need = pos // self.pool.block_size + 1
         while need > len(req.blocks):
-            got = self.pool.alloc(need - len(req.blocks))
-            if got is not None:
-                req.blocks.extend(got)
+            if self._alloc_pair(req, need - len(req.blocks)):
                 return True
             victim = self._evict_youngest()
             if victim is None or victim is req:
                 return False
         return True
+
+    def trim_blocks(self, req):
+        """Roll both block tables back after a speculative turn: free
+        blocks past the next write position — the block-granular form
+        of "roll back to the first rejection" (rejected draft
+        positions' KV is dead weight; the masks already exclude it).
+        Static policy reserved the worst case at admission and keeps
+        it."""
+        if self.policy == "static":
+            return
+        pos = len(req.prompt) + len(req.generated) - 1
+        keep = pos // self.pool.block_size + 1
+        if keep < len(req.blocks):
+            self.pool.free(req.blocks[keep:])
+            del req.blocks[keep:]
+            if self.draft_pool is not None and req.draft_blocks:
+                self.draft_pool.free(req.draft_blocks[keep:])
+                del req.draft_blocks[keep:]
 
     # -- planning ------------------------------------------------------------
     def plan(self):
@@ -272,7 +359,9 @@ class Scheduler:
         self._admit()
 
         decode = []
-        cap = min(self.max_batch, self.token_budget)
+        spec_k = {}
+        spec = self.spec_active()
+        cost_used = 0
         # iterate a snapshot: _ensure_decode_block may evict the
         # youngest active request mid-loop. Eviction always moves the
         # victim's state to QUEUED, so the state check below filters
@@ -282,12 +371,27 @@ class Scheduler:
         for req in list(self.active):
             if req.state != DECODE:
                 continue
-            if len(decode) >= cap:
+            if len(decode) >= self.max_batch:
                 break
-            if self._ensure_decode_block(req):
+            left = self.token_budget - cost_used
+            if left < 1:
+                break            # even a plain token no longer fits
+            k = 0
+            if spec:
+                # a speculative slot costs its whole verify chunk
+                # (1 + k tokens) against the budget; the final token
+                # (remaining == 1) rides the plain fused-decode
+                # program, and a tight budget SHRINKS a row's chain
+                # rather than starving rows behind the first one that
+                # doesn't fit at full spec_k
+                remaining = req.max_new_tokens - len(req.generated)
+                k = max(0, min(self.spec_k, remaining - 1, left - 1))
+            if self._ensure_decode_block(req, horizon=k):
                 decode.append(req)
+                spec_k[req.rid] = k
+                cost_used += 1 + k
 
-        budget = self.token_budget - len(decode)
+        budget = self.token_budget - cost_used
         prefill = []
         for req in self.active:
             if req.state != PREFILL or budget <= 0:
@@ -298,7 +402,7 @@ class Scheduler:
                 continue
             prefill.append((req, req.prefilled, chunk))
             budget -= chunk
-        return StepPlan(decode, prefill)
+        return StepPlan(decode, prefill, spec_k if spec else None)
 
     # -- engine feedback -----------------------------------------------------
     def note_prefilled(self, req, chunk_len):

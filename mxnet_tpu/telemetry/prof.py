@@ -497,7 +497,7 @@ def _emit(record):
 
 
 def note_step(path, phases, key=None, batches=1, samples=None,
-              tokens=None):
+              tokens=None, d2h_bytes=None):
     """Record one decomposed step (or K-batch chunk).
 
     ``phases``: {phase: seconds} with phases from :data:`PHASES` —
@@ -506,8 +506,13 @@ def note_step(path, phases, key=None, batches=1, samples=None,
     record under ``key``, observes ``prof.step.<phase>_secs`` +
     ``prof.step_secs`` histograms and refreshes the derived gauges
     (``prof.mfu`` etc.) when telemetry is on, and emits one
-    ``step_breakdown`` journal record. Callers guard on
-    :data:`ENABLED`; calling this with prof off is a no-op."""
+    ``step_breakdown`` journal record. ``d2h_bytes`` (optional) is the
+    number of result bytes the step actually pulled device->host — the
+    serving decode path journals it so the "logits never leave the
+    device" contract is mechanically checkable (ISSUE 15: a decode
+    step's pull is the token vector, not a [B, V] logits array).
+    Callers guard on :data:`ENABLED`; calling this with prof off is a
+    no-op."""
     if not ENABLED:
         return None
     total = sum(phases.values())
@@ -570,6 +575,8 @@ def note_step(path, phases, key=None, batches=1, samples=None,
         rec["samples_per_s"] = samples / total
     if tokens is not None and total > 0:
         rec["tokens_per_s"] = tokens / total
+    if d2h_bytes is not None:
+        rec["d2h_bytes"] = int(d2h_bytes)
     _emit(rec)
     return rec
 

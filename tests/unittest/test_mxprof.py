@@ -290,7 +290,7 @@ class TestStepBreakdown:
         # engine's pool.swap does
         kp, vp = pool.k, pool.v
         for _ in range(2):
-            nxt, logits, kp, vp = m.step(
+            nxt, kp, vp = m.step(
                 params, kp, vp, np.asarray([[1, 2, 3]], np.int32),
                 np.zeros((1,), np.int32), np.asarray([3], np.int32), bt,
                 np.ones((1,), bool))

@@ -61,7 +61,8 @@ LOWER_IS_BETTER = frozenset((
 
 #: parsed-record fields a BENCH_r*.json baseline contributes
 _BENCH_FIELDS = ("mfu", "tokens_per_s", "step_p50_s", "samples_per_sec",
-                 "peak_hbm_bytes", "prof_step_p50_s")
+                 "peak_hbm_bytes", "prof_step_p50_s", "ttft_p99_s",
+                 "spec_accept_rate")
 
 
 def load_journal(path):
@@ -90,6 +91,16 @@ def derive_metrics(records):
             h = final.get("histograms", {}).get(hist)
             if h and h.get("p50") is not None:
                 out[name] = float(h["p50"])
+        # serving latency headline: p99 TTFT from the final snapshot's
+        # full-stream histogram (LOWER_IS_BETTER)
+        h = final.get("histograms", {}).get("serving.ttft_s")
+        if h and h.get("p99") is not None:
+            out["ttft_p99_s"] = float(h["p99"])
+        # speculative-decoding health: cumulative accept rate (a falling
+        # rate means the draft stopped paying for itself)
+        g = final.get("gauges", {}).get("serving.spec_accept_rate")
+        if g is not None:
+            out["spec_accept_rate"] = float(g)
     for gauge, name, agg in (
             ("train.samples_per_sec", "samples_per_sec", max),
             ("serving.tokens_per_s", "tokens_per_s", max),

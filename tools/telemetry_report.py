@@ -176,6 +176,16 @@ def serving_section(records):
     if reqs:
         lines.append("  requests: " + "  ".join(
             "%s=%d" % (k.split("requests_")[-1], v) for k, v in reqs))
+    drafted = counters.get("serving.spec_tokens_drafted")
+    if drafted:
+        acc = counters.get("serving.spec_tokens_accepted", 0)
+        spec_h = hists.get("serving.spec_accepted_tokens", {})
+        lines.append(
+            "  speculative: %d turns, accept rate %.3f "
+            "(%d/%d drafts), accepted/turn p50 %g"
+            % (counters.get("serving.spec_turns", 0),
+               acc / float(drafted), acc, drafted,
+               spec_h.get("p50") or 0))
     return lines
 
 
