@@ -458,11 +458,21 @@ def _cold_child():
     first_step_s = time.perf_counter() - t0
 
     from mxnet_tpu.compile import jit_cache
+    from mxnet_tpu.analysis import compile_verify
 
+    # per-boundary compile counts (the parent exports
+    # MXNET_JIT_VERIFY=record into this probe): a cache-warm leg that
+    # still *compiles* as much as the cold leg has a broken cache — the
+    # jit-cache hit then only skips XLA's backend work, not tracing
+    compiles = {b: rec["compiles"]
+                for b, rec in compile_verify.summary()["boundaries"].items()
+                if rec["compiles"]}
     print(json.dumps({
         "first_step_s": round(first_step_s, 3),
         "cache_hits": jit_cache.HITS,
         "cache_misses": jit_cache.MISSES,
+        "compiles": compiles,
+        "unexpected_recompiles": len(compile_verify.unexpected()),
     }))
 
 
@@ -499,6 +509,10 @@ def _run_cold_start():
 
     base = dict(os.environ)
     base["MXNET_COMPILE_OPT"] = base.get("MXNET_COMPILE_OPT", "1")
+    # run every probe under the mxjit verifier in record mode so each
+    # leg reports its per-boundary compile counts (and would surface an
+    # unexpected recompile inside the single measured step)
+    base["MXNET_JIT_VERIFY"] = base.get("MXNET_JIT_VERIFY") or "record"
     off_env = dict(base)
     off_env.pop("MXNET_COMPILE_CACHE_DIR", None)
     cache_dir = tempfile.mkdtemp(prefix="mxtpu-bench-jitcache-")
@@ -516,6 +530,12 @@ def _run_cold_start():
             "cache_warm_s": warm["first_step_s"],
             "warm_cache_hits": warm["cache_hits"],
             "warm_cache_misses": warm["cache_misses"],
+            "compiles": {"cache_off": off.get("compiles", {}),
+                         "cache_cold": cold.get("compiles", {}),
+                         "cache_warm": warm.get("compiles", {})},
+            "unexpected_recompiles": sum(
+                leg.get("unexpected_recompiles", 0)
+                for leg in (off, cold, warm)),
             "speedup_vs_off": round(
                 off["first_step_s"] / max(warm["first_step_s"], 1e-9), 3),
         }))

@@ -25,6 +25,13 @@ Three coordinated passes, one Finding model (findings.py):
   the real coordinator state machine under explorable delivery
   orders, losses, duplicates, crashes and restarts, with (seed, index)
   replay.
+- ``jit_lint``      — mxjit jit-boundary lint over the dispatching
+  surface (recompile hazards, donation/use-after-donate audit,
+  hot-path D2H discipline, weak jit-cache keys).
+- ``compile_verify`` — runtime compile/transfer verifier behind
+  ``MXNET_JIT_VERIFY=1``: per-callable compile budgets with
+  arg-signature diffs on unexpected recompiles, plus the hot-region
+  D2H byte ledger cross-checked against jit_lint's sanctioned sites.
 
 CLI: ``tools/mxlint.py`` / the ``mxlint`` console script (cli.py).
 
@@ -42,6 +49,9 @@ from .ast_lint import lint_file, lint_package, lint_source
 from .graph_lint import lint_json, lint_symbol
 from .lock_lint import (build_lock_graph, cross_check,
                         lint_package as lint_locks)
+from .jit_lint import (lint_targets as lint_jit,
+                       sanctioned_d2h_sites,
+                       cross_check as cross_check_d2h)
 
 __all__ = [
     "Finding", "max_severity", "summarize",
@@ -50,4 +60,5 @@ __all__ = [
     "lint_file", "lint_package", "lint_source",
     "lint_json", "lint_symbol",
     "build_lock_graph", "cross_check", "lint_locks",
+    "lint_jit", "sanctioned_d2h_sites", "cross_check_d2h",
 ]

@@ -10,6 +10,8 @@ console script (pyproject). Typical invocations::
     mxlint --engine-trace trace.json  # verify a recorded engine trace
     mxlint --locks                    # concurrency lint, whole package
     mxlint --locks some/module.py     # concurrency lint one file/dir
+    mxlint --jit                      # jit-boundary lint (recompiles,
+                                      # donation, hot-path D2H, cache keys)
     mxlint --schedules                # interleaving-explorer survival run
     mxlint --proto                    # protocol schema + timeout lattice
     mxlint --protosim                 # protocol-simulator survival run
@@ -97,6 +99,12 @@ def main(argv=None):
                         "blocking-under-lock, unguarded fields, cv "
                         "misuse) over PATH — bare --locks lints the "
                         "whole mxnet_tpu package")
+    p.add_argument("--jit", action="append", nargs="?", const="",
+                   metavar="PATH", default=[],
+                   help="mxjit jit-boundary lint (recompile hazards, "
+                        "donation/aliasing audit, hot-path D2H, weak "
+                        "cache keys) over PATH — bare --jit lints the "
+                        "package's jit-dispatching surface")
     p.add_argument("--telemetry", action="store_true",
                    help="metrics catalog gate: every counter/gauge/"
                         "histogram registered in the package must appear "
@@ -152,8 +160,9 @@ def main(argv=None):
             print(name)
         return 0
     if not (args.all or args.model or args.graph or args.ops
-            or args.engine_trace or args.locks or args.schedules
-            or args.telemetry or args.proto or args.protosim):
+            or args.engine_trace or args.locks or args.jit
+            or args.schedules or args.telemetry or args.proto
+            or args.protosim):
         p.print_usage(sys.stderr)
         print("mxlint: nothing to do (try --all)", file=sys.stderr)
         return 2
@@ -165,6 +174,7 @@ def main(argv=None):
     ops_paths = list(args.ops)
     model_names = list(args.model)
     lock_paths = list(args.locks)
+    jit_paths = list(args.jit)
     proto_paths = list(args.proto)
     run_selftest = False
     run_telemetry = args.telemetry
@@ -177,6 +187,8 @@ def main(argv=None):
         run_telemetry = True
         if not lock_paths:
             lock_paths.append("")  # whole-package concurrency lint
+        if not jit_paths:
+            jit_paths.append("")  # jit-dispatching-surface lint
         if not proto_paths:
             proto_paths.append("")  # elastic-substrate protocol lint
 
@@ -239,6 +251,14 @@ def main(argv=None):
             findings.extend(lint_locks(path or DEFAULT_PACKAGE))
         except (OSError, SyntaxError) as e:  # unreadable / unparsable .py
             return _load_error(path or DEFAULT_PACKAGE, e)
+        n_targets += 1
+    for path in jit_paths:
+        from .jit_lint import lint_targets as lint_jit
+
+        try:
+            findings.extend(lint_jit(path or None))
+        except (OSError, SyntaxError) as e:  # unreadable / unparsable .py
+            return _load_error(path or "(jit surface)", e)
         n_targets += 1
     for path in proto_paths:
         from .proto_lint import lint_protocol

@@ -33,6 +33,7 @@ import numpy as _np
 
 from . import compile as _compile
 from . import telemetry as _tel
+from .analysis import compile_verify as _cv
 from .telemetry import prof as _prof
 from .base import MXNetError
 from .context import Context, current_context
@@ -226,9 +227,19 @@ class Executor:
                 self._hybrid_run, is_train=True)
             self._fwd_bwd = None  # hybrid backward walks saved segments
         else:
-            self._fwd_infer = jax.jit(functools.partial(self._run, is_train=False))
-            self._fwd_train = jax.jit(functools.partial(self._run, is_train=True))
-            self._fwd_bwd = jax.jit(self._fwd_bwd_impl)
+            # budget 2: the rng arg dispatches as None (deterministic)
+            # or a PRNG key array — two legal traces per entry point
+            self._fwd_infer = _cv.wrap(
+                "executor.fwd_infer",
+                jax.jit(functools.partial(self._run, is_train=False)),
+                budget=2, group="executor.bind")
+            self._fwd_train = _cv.wrap(
+                "executor.fwd_train",
+                jax.jit(functools.partial(self._run, is_train=True)),
+                budget=2, group="executor.bind")
+            self._fwd_bwd = _cv.wrap(
+                "executor.fwd_bwd", jax.jit(self._fwd_bwd_impl),
+                budget=2, group="executor.bind")
             if _tel.ENABLED:
                 # each bind builds fresh programs — under bucketing /
                 # reshape this is the recompile stream worth watching
@@ -359,7 +370,10 @@ class Executor:
                 _, serials, ext_keys, out_keys, aux_ids, rng_serials = item
                 key = (idx, is_train)
                 if key not in self._seg_jit:
-                    self._seg_jit[key] = jax.jit(self._seg_fn(item, is_train))
+                    self._seg_jit[key] = _cv.wrap(
+                        "executor.seg|%s" % (key,),
+                        jax.jit(self._seg_fn(item, is_train)),
+                        budget=2, group="executor.seg")
                     if _tel.ENABLED:
                         _tel.counter("executor.jit_builds_total").inc()
                 ext_vals = [env[k] for k in ext_keys]
@@ -406,7 +420,9 @@ class Executor:
             (ext_cts,) = vjp_fn(out_cts)
             return ext_cts
 
-        self._seg_bwd_jit[idx] = jax.jit(bwd)
+        self._seg_bwd_jit[idx] = _cv.wrap(
+            "executor.seg_bwd|%d" % idx, jax.jit(bwd),
+            budget=2, group="executor.seg")
         if _tel.ENABLED:
             _tel.counter("executor.jit_builds_total").inc()
         return self._seg_bwd_jit[idx]
@@ -702,11 +718,13 @@ class Executor:
                     ",".join(self._reqs))
             except Exception:
                 self._prof_ghash = "%x" % id(self._exec_symbol)
-        out = _prof.attribute_jit(
-            "executor|%s|%s" % (tag, sig), fn, args,
+        # rebind through the verifier boundary (if one wraps this entry
+        # point) so compile counting survives the AOT swap
+        out = _cv.rebind(fn, _prof.attribute_jit(
+            "executor|%s|%s" % (tag, sig), _cv.unwrap(fn), args,
             site="executor.%s" % tag, analytic=self._prof_analytic(),
             meta={"outputs": self._output_names},
-            graph_key=self._prof_ghash)
+            graph_key=self._prof_ghash))
         setattr(self, "_" + tag, out)  # tag IS the entry-point attr name
         return out
 

@@ -218,6 +218,10 @@ def _scan_drain(pending, eval_metric, label_names, batch_end_callback,
         host_outs = [_np.asarray(jnp.argmax(outs[0], axis=-1))]
     else:
         host_outs = [_np.asarray(o) for o in outs]  # one D2H per head
+    from .analysis import compile_verify as _cv
+
+    _cv.note_d2h(sum(int(h.nbytes) for h in host_outs),
+                 "mxnet_tpu/model.py::_scan_drain")
     if prof_ctx is not None:
         key, t_host, t_dispatch = prof_ctx
         samples = None

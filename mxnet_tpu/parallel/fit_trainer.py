@@ -394,7 +394,13 @@ class FitTrainer:
             mults = _np.ones((K,), _np.float32)
 
         if K not in self._jit_cache:
-            self._jit_cache[K] = self._make_loop(K)
+            from ..analysis import compile_verify as _cv
+
+            # one compile per chunk length K (the memo key IS the
+            # bucket) — MXNET_JIT_VERIFY names any arg that breaks it
+            self._jit_cache[K] = _cv.wrap(
+                "fit_trainer.loop|K=%d" % K, self._make_loop(K),
+                budget=1, group="train.fit_loop")
             from .. import telemetry as _tel
 
             if _tel.ENABLED:
@@ -437,14 +443,20 @@ class FitTrainer:
                            if isinstance(v, (int, float, str, bool))),
                     self._cdt, self._guard_on, self._guard_max_norm,
                     self._inject))
-                self._jit_cache[K] = _prof.attribute_jit(
-                    pkey, self._jit_cache[K],
+                from ..analysis import compile_verify as _cv
+
+                # attribution replaces the program with its AOT compile
+                # — rebind through the verifier boundary so compile
+                # counting survives the swap
+                _prev = self._jit_cache[K]
+                self._jit_cache[K] = _cv.rebind(_prev, _prof.attribute_jit(
+                    pkey, _cv.unwrap(_prev),
                     (self.params, self.opt_states, self.aux, batches, lrs,
                      ts, rngs, mults),
                     site="fit_trainer.scan",
                     analytic=self._prof_analytic or None,
                     meta={"K": K, "steps_per_call": K},
-                    graph_key=ghash)
+                    graph_key=ghash))
                 self._prof_keys[K] = _prof.program_key_for(
                     pkey, graph_key=ghash)
         self.last_program_key = self._prof_keys.get(K)

@@ -128,7 +128,15 @@ def make_symbol_train_step(symbol, input_shapes, optimizer=None,
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_aux, outs
 
-    jitted = jax.jit(step_impl, donate_argnums=(0, 1, 2) if donate else ())
+    from ..analysis import compile_verify as _cv
+
+    # fixed-shape bind: the per-batch step and the scanned loop each
+    # compile exactly once (budget 1 — any second compile means a
+    # caller leaked a varying value into the traced signature)
+    jitted = _cv.wrap(
+        "symbol_trainer.step",
+        jax.jit(step_impl, donate_argnums=(0, 1, 2) if donate else ()),
+        budget=1, group="train.symbol_step")
 
     batch_sharding = None
     if mesh is not None:
@@ -168,8 +176,12 @@ def make_symbol_train_step(symbol, input_shapes, optimizer=None,
             body, (params, opt_state, aux), (batches, rngs))
         return params, opt_state, aux, stacked
 
-    jitted_loop = jax.jit(
-        loop_impl, donate_argnums=(0, 1, 2) if donate else ())
+    # the scanned loop legitimately re-traces per distinct chunk length
+    # (a tail chunk is a different K) — budget a small bucket set
+    jitted_loop = _cv.wrap(
+        "symbol_trainer.loop",
+        jax.jit(loop_impl, donate_argnums=(0, 1, 2) if donate else ()),
+        budget=4, group="train.symbol_step")
 
     def loop(state, batches, rng):
         """Run K train steps in ONE dispatch (jitted lax.scan).

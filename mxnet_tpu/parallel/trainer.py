@@ -73,7 +73,14 @@ def make_train_step(loss_fn, optimizer=None, mesh=None, param_spec=None,
             return params, opt_state, loss, aux
         return params, opt_state, loss
 
-    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    from ..analysis import compile_verify as _cv
+
+    # fixed-shape sharded step: one compile (MXNET_JIT_VERIFY names the
+    # offending arg if a varying value sneaks into the trace)
+    jitted = _cv.wrap(
+        "trainer.sharded_step",
+        jax.jit(step, donate_argnums=(0, 1) if donate else ()),
+        budget=1, group="train.sharded_step")
 
     if mesh is not None and batch_spec is None:
         from jax.sharding import NamedSharding, PartitionSpec as P

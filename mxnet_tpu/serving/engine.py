@@ -45,6 +45,7 @@ import weakref
 import numpy as np
 
 from .. import telemetry as _tel
+from ..analysis import compile_verify as _cv
 from ..analysis.engine_verify import maybe_trace_lock as _maybe_trace_lock
 from ..base import MXNetError, env_bool as _env_bool, env_int as _env_int
 from . import sampling as _samp
@@ -204,6 +205,12 @@ class Engine:
         self.params = params
         self.model_cfg = model_cfg
         self.cfg = cfg or ServingConfig()
+        # cp prefill samples its first token on the host: pull the
+        # unembedding matrix ONCE here, not per long prompt (was a
+        # vocab x d_model D2H on every cp prefill — mxjit audit)
+        self._host_unembed = (
+            np.asarray(params["embed"], np.float32).T
+            if self.cfg.mesh is not None else None)
         bs = self.cfg.block_size
         max_seq = min(self.cfg.max_seq_tokens or model_cfg.max_seq_len,
                       model_cfg.max_seq_len)
@@ -676,7 +683,12 @@ class Engine:
         # accelerators where a decode step costs the same at any live
         # count; continuous dispatches at the ragged bucket
         min_b = self.cfg.max_batch if self.cfg.policy == "static" else None
-        with _tel.span("serve.decode"):
+        # token-vector-only contract: the step's one D2H is the sampled
+        # token vector at bucket width (4 bytes/lane) — the ledger
+        # fails the turn if anything more (e.g. logits) crosses
+        Bv = bucket_for(max(B, min_b or 1), self.model.batch_buckets)
+        with _tel.span("serve.decode"), \
+                _cv.d2h_region("serve.decode_step", budget_bytes=4 * Bv):
             nxt, kp, vp = self.model.step(
                 self.params, self.pool.k, self.pool.v, tokens, start,
                 np.ones((B,), np.int32), self._tables(reqs),
@@ -745,8 +757,13 @@ class Engine:
         karr = np.asarray(ks, np.int32)
         # the spec turn IS the decode dispatch when speculation is on —
         # it gets its own span (serve.spec_turn) so /tracez and
-        # span-based mxctl rules keep seeing decode latency
-        with _tel.span("serve.spec_turn"):
+        # span-based mxctl rules keep seeing decode latency; the D2H
+        # ledger pins the ints-only transfer contract (n, fin, drafts
+        # at bucket width — never logits)
+        Bv = bucket_for(B, self.model.batch_buckets)
+        with _tel.span("serve.spec_turn"), \
+                _cv.d2h_region("serve.spec_turn",
+                               budget_bytes=4 * Bv * (K + 3)):
             td0 = time.monotonic() if prof_on else 0.0
             dmat, qmat, dk, dv = self.draft_model.draft_turn(
                 self.draft_params, self.draft_pool.k, self.draft_pool.v,
@@ -766,9 +783,15 @@ class Engine:
                 tv1 = time.monotonic()
                 n_dev.block_until_ready()
                 tv2 = time.monotonic()
-            n = np.asarray(n_dev)
-            fin = np.asarray(fin_dev)
-            drafts = np.asarray(dmat)
+            # ints-only spec-turn D2H (accepted counts, final tokens,
+            # draft tokens) — ledger-accounted below; logits never
+            # leave the device
+            n = np.asarray(n_dev)          # mxlint: disable
+            fin = np.asarray(fin_dev)      # mxlint: disable
+            drafts = np.asarray(dmat)      # mxlint: disable
+            _cv.note_d2h(
+                n.nbytes + fin.nbytes + drafts.nbytes,
+                "mxnet_tpu/serving/engine.py::Engine._run_spec_turn")
         now = time.monotonic()
 
         drafted = accepted = emitted = 0
@@ -887,7 +910,9 @@ class Engine:
                     # (evictions field records the wrinkle)
                     # the final prefill chunk's logits sample the first
                     # new token — no separate "first decode" dispatch
-                    self._emit(req, int(nxt[i]), now)
+                    # (nxt is already host: ServingModel.step pulled
+                    # the token vector once for the whole chunk batch)
+                    self._emit(req, int(nxt[i]), now)  # mxlint: disable
 
     def _cp_eligible(self, req):
         n = self.cfg.mesh.shape[self.cfg.cp_seq_axis]
@@ -923,7 +948,7 @@ class Engine:
             jnp.asarray(k, self.pool.k.dtype))
         new_v = self.pool.v.at[:, blocks].set(
             jnp.asarray(v, self.pool.v.dtype))
-        logits = x_last @ np.asarray(self.params["embed"], np.float32).T
+        logits = x_last @ self._host_unembed
         # the first token draws from the same (seed, position) stream
         # the fused device sampler would use — cp-prefilled requests
         # sample identically to paged-prefilled ones

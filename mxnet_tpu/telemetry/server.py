@@ -269,6 +269,12 @@ def _statusz(params):
         for name in ("HITS", "MISSES", "CORRUPT"):
             compile_counters["compile.jit_cache_%s" % name.lower()] = \
                 int(getattr(jc, name, 0))
+    # mxjit verifier snapshot (per-boundary compile counts vs budgets,
+    # D2H ledger) — only when the module is live and armed, never an
+    # import from here
+    cv = sys.modules.get("mxnet_tpu.analysis.compile_verify")
+    jit_verify = (cv.summary() if cv is not None
+                  and getattr(cv, "ENABLED", False) else None)
     return _json({
         "pid": os.getpid(),
         "rank": int(os.environ.get("MXNET_PROC_ID", "0") or 0),
@@ -279,6 +285,7 @@ def _statusz(params):
         "journal": _journal_path(),
         "jit_cache_dir": os.environ.get("MXNET_COMPILE_CACHE_DIR") or None,
         "compile": compile_counters,
+        "jit_verify": jit_verify,
         "env": {k: v for k, v in sorted(os.environ.items())
                 if k.startswith(("MXNET_", "MXRACE_", "JAX_PLATFORMS"))},
     })

@@ -28,6 +28,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # acquire/release the suite performs lands in the ambient lock trace.
 # pytest_sessionfinish (below) is the suite-wide gate over it.
 os.environ.setdefault("MXNET_ENGINE_VERIFY", "1")
+
+# Run the suite under the mxjit compile/transfer verifier in RECORD
+# mode: every jit boundary counts compiles against its bucket-derived
+# budget and every hot-region D2H pull lands in the byte ledger.
+# Record (not raise): an unexpected recompile anywhere in the suite is
+# gated suite-wide in pytest_sessionfinish below with the full
+# arg-signature diff, instead of crashing the one test that happened
+# to trip it. Individual tests flip to raise-mode explicitly.
+os.environ.setdefault("MXNET_JIT_VERIFY", "record")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -94,6 +103,28 @@ def pytest_sessionfinish(session, exitstatus):
             "mxproto suite-wide protocol gate: %d schema/lattice "
             "finding(s) on the elastic RPC substrate:\n%s"
             % (len(proto_bad), "\n".join(str(f) for f in proto_bad)))
+    # mxjit suite-wide compile/transfer gate: the whole session ran
+    # under MXNET_JIT_VERIFY=record (see top of file), so any compile
+    # past a boundary's bucket budget and any hot-region D2H ledger
+    # over its byte budget is ambient evidence here — with the exact
+    # arg-signature diff naming what varied. Negative-control tests
+    # divert their seeded storms via expecting_violations().
+    from mxnet_tpu.analysis import compile_verify
+
+    jit_bad = compile_verify.unexpected()
+    d2h_bad = compile_verify.d2h_violations()
+    if jit_bad or d2h_bad:
+        lines = ["%s: compile %s past budget %s — %s"
+                 % (r["name"], r["compiles"], r["budget"],
+                    "; ".join(r["diff"])) for r in jit_bad]
+        lines += ["region %s: %d bytes over budget %d (sites: %s)"
+                  % (r["region"], r["bytes"], r["budget_bytes"],
+                     sorted(r["sites"])) for r in d2h_bad]
+        raise pytest.UsageError(
+            "mxjit suite-wide compile/transfer gate: %d unexpected "
+            "recompile(s), %d D2H budget violation(s) across the "
+            "session:\n%s"
+            % (len(jit_bad), len(d2h_bad), "\n".join(lines)))
     trace = engine_verify.ambient_trace(create=False)
     if trace is None:
         return

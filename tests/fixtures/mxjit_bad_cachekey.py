@@ -1,0 +1,34 @@
+"""Seeded weak jit-cache keys for the mxjit static pass (test fixture —
+not imported by the package).
+
+``Runner._build`` memoizes per bucket only, while the traced body also
+depends on the ``causal`` flag (two configurations alias one compiled
+program — the PR 13/15 bug class) and reads ``self.scale``, which
+``set_scale`` mutates after build (the program bakes a stale value).
+``attribute`` calls attribute_jit without graph_key= — the shape-only
+attribution aliasing hole.
+"""
+import jax
+
+
+class Runner:
+    def __init__(self):
+        self._cache = {}
+        self.scale = 1.0
+
+    def _build(self, bucket, causal):
+        def impl(x):
+            if causal:
+                return x * self.scale
+            return x + self.scale
+
+        fn = jax.jit(impl)
+        self._cache[bucket] = fn  # BAD: 'causal' and self.scale not keyed
+        return fn
+
+    def set_scale(self, s):
+        self.scale = s
+
+
+def attribute(prof, fn, args):
+    return prof.attribute_jit("site", fn, args)  # BAD: no graph_key=

@@ -1,0 +1,39 @@
+"""Seeded hot-path D2H syncs for the mxjit static pass (test fixture —
+not imported by the package).
+
+``decode``'s per-request loop dispatches (``model.step``) and then
+pulls three ways — a host int() cast, an ``.item()``, an
+``np.asarray`` of the dispatch result — each a pipeline stall per
+step.  ``drain`` shows the sanctioned shape: one fence per chunk via
+the getattr(block_until_ready) idiom, then a single post-fence pull
+(both land as info, and in the sanctioned-site export).
+"""
+import numpy as np
+
+
+def decode(model, reqs):
+    toks = []
+    for r in reqs:
+        out = model.step(r)
+        toks.append(int(out[0]))   # BAD: host cast in the hot loop
+        loss = out.item()          # BAD: sync per step
+        arr = np.asarray(out)      # BAD: full pull per step
+        del loss, arr
+    return toks
+
+
+def drain(model, chunks):
+    out = None
+    for c in chunks:
+        out = model.run_chunk(c)
+    bur = getattr(out, "block_until_ready", None)
+    if bur is not None:
+        bur()                      # sanctioned: the chunk's one fence
+    return np.asarray(out)         # sanctioned: post-fence chunk pull
+
+
+def serve_forever(model, chunk_stream):
+    hosts = []
+    for chunks in chunk_stream:
+        hosts.append(drain(model, chunks))
+    return hosts

@@ -1,0 +1,45 @@
+"""Seeded donation hazards for the mxjit static pass (test fixture —
+not imported by the package).
+
+``use_after_donate`` reads a buffer it already donated to the
+executable; ``loop_without_rebind`` re-dispatches donated buffers every
+iteration without threading the returned arrays back; an un-donated
+steady-state pool loop draws the copy-per-step warning.  ``good_loop``
+follows the pool.swap discipline and must contribute nothing.
+"""
+import jax
+
+
+def _impl(params, opt_state, batch):
+    return params, opt_state, 0.0
+
+
+step = jax.jit(_impl, donate_argnums=(0, 1))
+plain = jax.jit(_impl)
+
+
+def use_after_donate(params, opt_state, batch):
+    new_p, new_o, loss = step(params, opt_state, batch)
+    norm = params["w"]  # BAD: params was donated at argnum 0
+    return new_p, new_o, norm
+
+
+def loop_without_rebind(params, opt_state, data):
+    out = None
+    for batch in data:
+        out = step(params, opt_state, batch)  # BAD: both donated args
+    return out                                # never rebound
+
+
+def undonated_pool_loop(params, opt_state, data):
+    loss = None
+    for batch in data:
+        params, opt_state, loss = plain(params, opt_state, batch)
+    return loss  # WARN: pool-ish state through a donate-less program
+
+
+def good_loop(params, opt_state, data):
+    loss = None
+    for batch in data:
+        params, opt_state, loss = step(params, opt_state, batch)
+    return params, opt_state, loss

@@ -1,0 +1,39 @@
+"""Seeded recompile hazards for the mxjit static pass (test fixture —
+not imported by the package).
+
+Two hazard classes: a jax.jit built fresh inside a steady-state loop
+(every iteration traces + compiles), and a raw ``.shape``-derived value
+reaching a jit-memo key without passing through ``bucket_for`` (every
+distinct batch shape compiles a new program instead of hitting its
+bucket).  ``good_bucketed`` launders the shape through bucket_for and
+must contribute nothing.
+"""
+import jax
+
+_memo = {}
+
+
+def build(k):
+    fn = jax.jit(lambda x: x * k)
+    _memo[k] = fn
+    return fn
+
+
+def train_loop(batches):
+    out = None
+    for batch in batches:
+        step = jax.jit(lambda x: x + 1)  # BAD: fresh trace per iteration
+        out = step(batch)
+    return out
+
+
+def bucketed(x):
+    b = x.shape[0]  # raw runtime shape ...
+    fn = _memo[b]   # BAD: ... used as the memo key unbucketed
+    return fn(x)
+
+
+def good_bucketed(x, bucket_for):
+    b = bucket_for(x.shape[0], (8, 16))
+    fn = _memo[b]   # laundered through bucket_for: clean
+    return fn(x)

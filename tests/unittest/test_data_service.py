@@ -529,13 +529,15 @@ def test_fit_accepts_data_service_iter(pack):
     try:
         it = _iter_for(coord, 0, batch_size=8)
         data = mx.symbol.Variable("data")
-        fc = mx.symbol.FullyConnected(data=data, num_hidden=7)
+        fc = mx.symbol.FullyConnected(data=data, num_hidden=7, name="fc_ds")
         net = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
         model = mx.model.FeedForward(
             symbol=net, ctx=mx.cpu(), num_epoch=2, learning_rate=0.05,
             numpy_batch_size=8)
         model.fit(X=it, eval_metric="acc")
-        assert model.arg_params["fullyconnected0_weight"] is not None
+        # explicit layer name: the auto-assigned fullyconnected<N> counter
+        # depends on how many symbols earlier tests in the process built
+        assert model.arg_params["fc_ds_weight"] is not None
         it.close()
     finally:
         coord.stop()

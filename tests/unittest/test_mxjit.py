@@ -1,0 +1,325 @@
+"""mxjit tests: jit-boundary static analysis + runtime compile/transfer
+verifier.
+
+Covers the tentpole end to end: every detector catches its seeded-bad
+fixture at the right severity, the repo's own jit-dispatching surface
+lints clean (the clean-repo gate CI relies on), the runtime verifier
+catches a seeded recompile storm naming the exact argument that varied,
+a real serving decode loop passes the token-vector-only D2H byte
+ledger, observed pulls cross-check against the statically sanctioned
+sites, and the whole machinery is zero-overhead when MXNET_JIT_VERIFY
+is off.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.analysis import compile_verify, jit_lint
+from mxnet_tpu.analysis.cli import main as mxlint_main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures")
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name + ".py")
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def by_sev(findings, sev):
+    return [f for f in findings if f.severity == sev]
+
+
+# -- static pass: seeded-bad fixtures ------------------------------------------
+
+def test_recompile_fixture_loop_and_shape_taint():
+    fs = jit_lint.lint_file(fixture("mxjit_bad_recompile"))
+    assert codes(fs) == ["recompile-hazard", "recompile-hazard"]
+    assert all(f.severity == "error" for f in fs)
+    msgs = " | ".join(f.message for f in fs)
+    assert "inside a steady-state loop" in msgs
+    assert "bucket_for" in msgs and "['b']" in msgs
+    # the memoized builder and the bucket_for-laundered lookup are clean
+    assert "good_bucketed" not in msgs and "build" not in " ".join(
+        f.where for f in fs)
+
+
+def test_donation_fixture_read_after_loop_and_pool_warning():
+    fs = jit_lint.lint_file(fixture("mxjit_bad_donation"))
+    errs, warns = by_sev(fs, "error"), by_sev(fs, "warning")
+    assert codes(errs) == ["donation-hazard"] * 3
+    assert codes(warns) == ["donation-hazard"]
+    msgs = " | ".join(f.message for f in errs)
+    assert "read after being DONATED (argnum 0)" in msgs
+    # the loop leaks BOTH donated buffers, named individually
+    assert "'params' at donated argnum 0" in msgs
+    assert "'opt_state' at donated argnum 1" in msgs
+    assert "donate_argnums" in warns[0].message
+    # good_loop threads the returned arrays through: nothing after
+    # the fixture's line 37 (the warning) may be flagged
+    assert max(int(f.where.rsplit(":", 1)[1]) for f in fs) <= 37
+
+
+def test_d2h_fixture_hot_pulls_error_fenced_drain_sanctioned():
+    sanctioned = {}
+    fs = jit_lint.lint_file(fixture("mxjit_bad_d2h"),
+                            _sanctioned=sanctioned)
+    errs, infos = by_sev(fs, "error"), by_sev(fs, "info")
+    assert codes(errs) == ["hot-d2h"] * 3
+    labels = " | ".join(f.message for f in errs)
+    for label in ("int()", ".item()", "np.asarray"):
+        assert label in labels, "missing sync class %r" % label
+    assert all("per-step loop" in f.message for f in errs)
+    # drain's post-fence pull is an info AND lands in the sanctioned
+    # export compile_verify cross-checks against
+    assert codes(infos) == ["hot-d2h"]
+    assert "post-fence" in infos[0].message
+    assert sanctioned == {"tests/fixtures/mxjit_bad_d2h.py::drain": 32}
+
+
+def test_cachekey_fixture_attribution_closure_and_mutable_self():
+    fs = jit_lint.lint_file(fixture("mxjit_bad_cachekey"))
+    assert codes(fs) == ["weak-cache-key"] * 3
+    assert all(f.severity == "error" for f in fs)
+    msgs = " | ".join(f.message for f in fs)
+    assert "without graph_key=" in msgs
+    assert "['causal']" in msgs
+    assert "mutable instance config ['scale']" in msgs
+
+
+# -- clean-repo gates ----------------------------------------------------------
+
+def test_repo_jit_surface_lints_clean():
+    fs = jit_lint.lint_targets()
+    bad = [f for f in fs if f.severity in ("error", "warning")]
+    assert not bad, "\n".join(str(f) for f in bad)
+    # the audit's surviving sanctioned pulls are infos, not silence
+    assert by_sev(fs, "info")
+
+
+def test_mxlint_jit_inprocess_exit_zero(capsys):
+    assert mxlint_main(["--jit"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s), 0 warning(s)" in out
+
+
+@pytest.mark.slow
+def test_mxlint_cli_subprocess_jit_and_all():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for flags, want in ((["--jit"], "0 error(s), 0 warning(s)"),
+                        (["--all"], "0 error(s)")):
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "mxlint.py")]
+            + flags, capture_output=True, text=True, env=env, cwd=ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert want in r.stdout
+
+
+# -- runtime verifier: compile budgets -----------------------------------------
+
+def test_recompile_storm_names_the_changed_arg():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    assert compile_verify.ENABLED  # conftest arms record mode
+    f = compile_verify.wrap("test.storm", jax.jit(lambda x: x + 1.0),
+                            budget=1, group="test.storm")
+    with compile_verify.expecting_violations() as caught:
+        f(jnp.zeros((2,), jnp.float32))
+        f(jnp.zeros((3,), jnp.float32))   # shape varies -> compile 2
+        f(jnp.zeros((3,), jnp.int32))     # dtype varies -> compile 3
+    assert [v["event"] for v in caught] == ["unexpected_recompile"] * 2
+    assert any("arg[0]: shape (2,) -> (3,)" in d
+               for d in caught[0]["diff"])
+    assert any("dtype float32 -> int32" in d for d in caught[1]["diff"])
+    # diverted storms must NOT reach the suite-wide ambient gate
+    assert not any(r["name"] == "test.storm"
+                   for r in compile_verify.unexpected())
+
+
+def test_static_value_storm_names_the_value():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    g = compile_verify.wrap(
+        "test.static_storm",
+        jax.jit(lambda x, flip: x * 2.0, static_argnums=(1,)), budget=1)
+    with compile_verify.expecting_violations() as caught:
+        g(jnp.zeros((2,), jnp.float32), True)
+        g(jnp.zeros((2,), jnp.float32), False)
+    assert len(caught) == 1
+    assert any("static value True -> False" in d
+               for d in caught[0]["diff"])
+
+
+def test_within_budget_recompiles_are_not_violations():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    f = compile_verify.wrap("test.bucketed", jax.jit(lambda x: x + 1.0),
+                            budget=2)
+    with compile_verify.expecting_violations() as caught:
+        f(jnp.zeros((2,), jnp.float32))
+        f(jnp.zeros((4,), jnp.float32))  # second bucket: within budget
+        f(jnp.zeros((2,), jnp.float32))  # cache hit: no compile
+    assert caught == []
+    assert f.compiles == 2
+
+
+def test_group_budget_declaration_and_check():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    compile_verify.declare_budget("test.budget_group", 2)
+    compile_verify.declare_budget("test.budget_group", 1)  # max-merge
+    f = compile_verify.wrap("test.budget_member",
+                            jax.jit(lambda x: x - 1.0),
+                            budget=8, group="test.budget_group")
+    for n in (2, 3, 4):
+        f(jnp.zeros((n,), jnp.float32))
+    over = dict((g, (d, o)) for g, d, o in compile_verify.check_budgets())
+    assert over.get("test.budget_group") == (2, 3)
+
+
+def test_rebind_keeps_compile_history():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    f = compile_verify.wrap("test.rebind", jax.jit(lambda x: x * 3.0),
+                            budget=4)
+    f(jnp.zeros((2,), jnp.float32))
+    # attribution replaces the program; the boundary (and its counts)
+    # survives, and unwrap exposes the raw callable attribution lowers
+    raw = compile_verify.unwrap(f)
+    assert raw is not f
+    g = compile_verify.rebind(f, jax.jit(lambda x: x * 3.0))
+    assert g is f and g.compiles == 1
+
+
+def test_zero_overhead_when_off(monkeypatch):
+    monkeypatch.setenv("MXNET_JIT_VERIFY", "0")
+    try:
+        assert compile_verify.reload() is False
+
+        def f(x):
+            return x
+
+        assert compile_verify.wrap("test.off", f) is f
+        assert compile_verify.rebind(f, f) is f
+        with compile_verify.d2h_region("test.off", budget_bytes=0):
+            compile_verify.note_d2h(1 << 20, "test.off::pull")
+        assert not compile_verify.d2h_violations()
+        assert "test.off::pull" not in compile_verify.observed_d2h_sites()
+    finally:
+        monkeypatch.undo()
+        assert compile_verify.reload() is True
+
+
+# -- runtime verifier: D2H byte ledger -----------------------------------------
+
+def _tiny_serving_model():
+    import jax
+
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+    from mxnet_tpu.serving import PagedKVPool
+    from mxnet_tpu.serving.model import ServingModel
+
+    cfg = TransformerConfig(vocab_size=31, num_layers=1, d_model=16,
+                            num_heads=2, d_ff=32, max_seq_len=64,
+                            dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    pool = PagedKVPool(cfg.num_layers, cfg.num_heads,
+                       cfg.d_model // cfg.num_heads, num_blocks=9,
+                       block_size=4)
+    m = ServingModel(cfg, block_size=4, max_blocks_per_req=4,
+                     batch_buckets=(2,), chunk_buckets=(8,))
+    return m, params, pool
+
+
+def test_serving_decode_passes_token_vector_only_ledger():
+    """The PR 15 contract, enforced at runtime: a decode step's entire
+    D2H traffic is ONE token vector of 4 bytes per bucketed row."""
+    m, params, pool = _tiny_serving_model()
+    bt = np.zeros((1, 4), np.int32)
+    bt[0] = [1, 2, 3, 4]
+    kp, vp = pool.k, pool.v
+    before = len(compile_verify.d2h_violations())
+    for i in range(3):
+        with compile_verify.d2h_region("test.decode_step",
+                                       budget_bytes=4 * 2):
+            nxt, kp, vp = m.step(
+                params, kp, vp, np.asarray([[1, 2, 3]], np.int32),
+                np.zeros((1,), np.int32), np.asarray([3], np.int32), bt,
+                np.ones((1,), bool))
+    assert len(compile_verify.d2h_violations()) == before
+    sites = compile_verify.observed_d2h_sites()
+    assert "mxnet_tpu/serving/model.py::ServingModel.step" in sites
+    assert sites["mxnet_tpu/serving/model.py::ServingModel.step"][
+        "bytes"] >= 3 * 4 * 2
+
+
+def test_over_budget_region_is_caught_with_sites():
+    m, params, pool = _tiny_serving_model()
+    bt = np.zeros((1, 4), np.int32)
+    bt[0] = [1, 2, 3, 4]
+    with compile_verify.expecting_violations() as caught:
+        with compile_verify.d2h_region("test.too_tight", budget_bytes=1):
+            m.step(params, pool.k, pool.v,
+                   np.asarray([[1, 2, 3]], np.int32),
+                   np.zeros((1,), np.int32), np.asarray([3], np.int32),
+                   bt, np.ones((1,), bool))
+    assert len(caught) == 1
+    v = caught[0]
+    assert v["event"] == "d2h_over_budget" and v["budget_bytes"] == 1
+    assert "mxnet_tpu/serving/model.py::ServingModel.step" in v["sites"]
+
+
+# -- static <-> runtime cross-check --------------------------------------------
+
+def test_cross_check_unaccounted_pull_errors_dead_sanction_infos():
+    static = {"a.py::Model.drain": 30, "a.py::Model.step": 50}
+    observed = {"a.py::Model.step": {"bytes": 8, "count": 2},
+                "b.py::rogue_pull": {"bytes": 4096, "count": 1}}
+    fs = jit_lint.cross_check(static, observed)
+    errs, infos = by_sev(fs, "error"), by_sev(fs, "info")
+    assert [f.where for f in errs] == ["b.py::rogue_pull"]
+    assert "never sanctioned" in errs[0].message
+    assert [f.where for f in infos] == ["a.py::Model.drain"]
+    assert "never observed" in infos[0].message
+
+
+def test_repo_sanctioned_sites_cover_live_serving_pulls():
+    """End to end: the static pass's sanctioned-site export must cover
+    every pull the serving decode loop actually performs, so the
+    cross-check raises no error on a real run."""
+    m, params, pool = _tiny_serving_model()
+    bt = np.zeros((1, 4), np.int32)
+    bt[0] = [1, 2, 3, 4]
+    m.step(params, pool.k, pool.v, np.asarray([[1, 2, 3]], np.int32),
+           np.zeros((1,), np.int32), np.asarray([3], np.int32), bt,
+           np.ones((1,), bool))
+    static = jit_lint.sanctioned_d2h_sites()
+    observed = {k: v for k, v in
+                compile_verify.observed_d2h_sites().items()
+                if k.startswith("mxnet_tpu/serving/model.py")}
+    assert observed, "decode loop recorded no pulls"
+    errs = by_sev(jit_lint.cross_check(static, observed), "error")
+    assert not errs, "\n".join(str(f) for f in errs)
+
+
+# -- /statusz integration ------------------------------------------------------
+
+def test_summary_shape_for_statusz():
+    s = compile_verify.summary()
+    assert s["mode"] in ("record", "raise")
+    assert isinstance(s["boundaries"], dict)
+    assert isinstance(s["groups"], dict)
+    assert set(s) >= {"unexpected_recompiles", "d2h_violations",
+                      "d2h_sites"}

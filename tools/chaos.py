@@ -20,6 +20,7 @@ Usage::
     python tools/chaos.py --guardian    # grad.nan/loss.spike survival legs
     python tools/chaos.py --schedules   # thread-schedule survival legs
     python tools/chaos.py --proto       # protocol message-schedule legs
+    python tools/chaos.py --jit         # mxjit compile/transfer legs
     python tools/chaos.py --controller  # mxctl closed-loop autonomy legs
 
 The spec is derived deterministically from --seed: per point, a fire
@@ -969,6 +970,147 @@ def run_proto(args):
     return 0
 
 
+# -- mxjit compile/transfer survival legs --------------------------------------
+# The ISSUE-16 contract: the runtime verifier must CATCH a seeded
+# recompile storm (naming the argument that varied) and a seeded
+# over-budget hot-region D2H pull — and a real serving decode loop under
+# the same verifier must produce ZERO findings (positive control). The
+# report folds the jit.* counters from the mxtel journal.
+
+def run_jit(args):
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-jit-")
+    journal = os.path.join(scratch, "jit-journal.jsonl")
+    # env set BEFORE the mxnet_tpu import: telemetry + verifier read it
+    # at load
+    os.environ["MXNET_TELEMETRY"] = "1"
+    os.environ["MXNET_TELEMETRY_JOURNAL"] = journal
+    os.environ["MXNET_JIT_VERIFY"] = "record"
+    import time as _time
+
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.analysis import compile_verify
+    from mxnet_tpu.analysis.jit_lint import lint_targets
+
+    compile_verify.reload()
+    failures = []
+    t0 = _time.time()
+
+    # leg 1: seeded recompile storm (negative control). A budget-1
+    # boundary fed five distinct shapes must be caught four times, each
+    # violation's arg-signature diff naming the shape that varied.
+    import jax
+    import jax.numpy as jnp
+
+    storm = compile_verify.wrap(
+        "chaos.jit_storm", jax.jit(lambda x: x * 2.0),
+        budget=1, group="chaos.jit_storm")
+    with compile_verify.expecting_violations() as caught:
+        for n in range(2, 7):
+            storm(jnp.zeros((n,), jnp.float32))
+    named = [v for v in caught
+             if any("shape" in d for d in v.get("diff", []))]
+    print("storm leg       : %d over-budget compiles caught, %d diffs "
+          "name the varying shape" % (len(caught), len(named)))
+    if len(caught) != 4 or len(named) != len(caught):
+        failures.append("recompile storm: expected 4 caught violations "
+                        "all naming the shape, got %d/%d"
+                        % (len(caught), len(named)))
+
+    # leg 2: seeded hot-region D2H overflow (negative control). A
+    # region budgeted for one token vector fed a fat pull must close
+    # over budget, attributing the bytes to the seeded site.
+    with compile_verify.expecting_violations() as d2h_caught:
+        with compile_verify.d2h_region("chaos.hot", budget_bytes=8):
+            compile_verify.note_d2h(4096, "tools/chaos.py::seeded_pull")
+    print("d2h leg         : %d over-budget regions caught"
+          % len(d2h_caught))
+    if len(d2h_caught) != 1 or \
+            d2h_caught[0].get("bytes") != 4096 or \
+            "tools/chaos.py::seeded_pull" not in d2h_caught[0].get(
+                "sites", {}):
+        failures.append("d2h overflow: expected 1 caught violation of "
+                        "4096 bytes at the seeded site, got %r"
+                        % (d2h_caught,))
+
+    # leg 3 (positive control): a real serving decode loop under the
+    # token-vector-only ledger — bucketed shapes, budgeted boundaries,
+    # one 4*B-byte pull per step — must produce ZERO ambient findings.
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+    from mxnet_tpu.serving import PagedKVPool
+    from mxnet_tpu.serving.model import ServingModel
+
+    cfg = TransformerConfig(vocab_size=31, num_layers=1, d_model=16,
+                            num_heads=2, d_ff=32, max_seq_len=64,
+                            dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(args.seed))
+    pool = PagedKVPool(cfg.num_layers, cfg.num_heads,
+                       cfg.d_model // cfg.num_heads, num_blocks=9,
+                       block_size=4)
+    m = ServingModel(cfg, block_size=4, max_blocks_per_req=4,
+                     batch_buckets=(2,), chunk_buckets=(8,))
+    bt = np.zeros((1, 4), np.int32)
+    bt[0] = [1, 2, 3, 4]
+    kp, vp = pool.k, pool.v
+    steps = 6
+    for i in range(steps):
+        with compile_verify.d2h_region("serve.decode_step",
+                                       budget_bytes=4 * 2):
+            nxt, kp, vp = m.step(
+                params, kp, vp, np.asarray([[1, 2, 3]], np.int32),
+                np.zeros((1,), np.int32),
+                np.asarray([3 + i], np.int32), bt,
+                np.ones((1,), bool))
+    amb_rc = compile_verify.unexpected()
+    amb_d2h = compile_verify.d2h_violations()
+    print("decode leg      : %d steps, %d unexpected recompiles, %d "
+          "D2H violations" % (steps, len(amb_rc), len(amb_d2h)))
+    if amb_rc or amb_d2h:
+        failures.append("clean decode loop tripped the verifier: %r %r"
+                        % (amb_rc, amb_d2h))
+
+    # leg 4: static clean-repo gate — mxlint --jit over the live tree
+    bad = [f for f in lint_targets()
+           if f.severity in ("error", "warning")]
+    print("static leg      : mxlint --jit -> %d error/warning finding(s)"
+          % len(bad))
+    if bad:
+        failures.append("mxlint --jit clean-repo gate: %s"
+                        % "; ".join(str(f) for f in bad))
+
+    wall = _time.time() - t0
+    telemetry.flush(mark="exit")
+    counters = fold_telemetry(journal)
+
+    print("\n=== mxjit survival report ===")
+    print("seed            : %d" % args.seed)
+    print("wall time       : %.1fs" % wall)
+    print("-- jit.* counters (mxtel journal) --")
+    jit_counters = {k: v for k, v in sorted(counters.items())
+                    if k.startswith("jit.") or
+                    k == "compile.recompiles_total"}
+    for name, v in jit_counters.items():
+        print("%-32s: %d" % (name, v))
+    if not jit_counters.get("jit.verify_compiles_total"):
+        failures.append("journal carries no jit.verify_compiles_total — "
+                        "the verifier observed nothing")
+    if failures:
+        print("\nRESULT: FAIL")
+        for f in failures:
+            print(" - %s" % f)
+        return 8
+    print("\nRESULT: SURVIVED — the verifier caught the seeded "
+          "recompile storm (naming the varying shape) and the seeded "
+          "over-budget D2H pull; a real bucketed serving decode loop "
+          "ran clean under the same budgets; and the static jit pass "
+          "reports a clean repo. Rerun with the same --seed to "
+          "reproduce.")
+    return 0
+
+
 # -- data-service survival legs ------------------------------------------------
 # The ISSUE-14 acceptance contract: with the sharded streaming input
 # service hosting the dataset (tools/launch.py --data-service,
@@ -1823,6 +1965,15 @@ def main(argv=None):
                          "delivery/loss/duplication/crash/restart "
                          "schedule (MXPROTO_SCHEDULES overrides the "
                          "per-leg budget)")
+    ap.add_argument("--jit", action="store_true",
+                    help="run the mxjit compile/transfer survival legs "
+                         "(ISSUE 16): the runtime verifier must catch a "
+                         "seeded recompile storm (naming the argument "
+                         "that varied) and a seeded over-budget hot-"
+                         "region D2H pull, a real serving decode loop "
+                         "must run clean under the same budgets, and "
+                         "mxlint --jit must report a clean repo; folds "
+                         "the jit.* counters from the mxtel journal")
     ap.add_argument("--data", action="store_true",
                     help="run the data-service survival legs (ISSUE "
                          "14): SIGKILL 1 of 4 streaming consumers "
@@ -1853,6 +2004,8 @@ def main(argv=None):
         return run_controller(args)
     if args.data:
         return run_data(args)
+    if args.jit:
+        return run_jit(args)
     if args.elastic:
         return run_elastic(args)
     if args.guardian:

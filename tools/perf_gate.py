@@ -33,6 +33,11 @@ simply not compared):
 ``tokens_per_s``    max ``serving.tokens_per_s`` over the run's snapshots
 ``mfu``             last ``prof.mfu`` (mxprof derived, prof.py)
 ``peak_hbm_bytes``  max ``prof.hbm_peak_bytes`` (lower is better)
+``recompiles_total``  ``compile.recompiles_total`` final counter — unexpected
+                    jit recompiles past a boundary's budget (mxjit, ZERO-gated:
+                    a 0 baseline still regresses on any nonzero current)
+``jit_cache_hit_rate``  ``compile.cache_hits / (hits + misses)`` of the
+                    persistent jit cache, final snapshot
 ==================  ==========================================================
 
 Baselines are either this tool's own ``--write-baseline`` output
@@ -56,13 +61,19 @@ import sys
 #: metrics where smaller is better; everything else is a throughput
 LOWER_IS_BETTER = frozenset((
     "step_p50_s", "prof_step_p50_s", "peak_hbm_bytes", "cold_start_jit_s",
-    "ttft_p99_s",
+    "ttft_p99_s", "recompiles_total",
 ))
+
+#: metrics gated even when the baseline is 0: a ratio band can't hold a
+#: zero baseline, but "zero unexpected recompiles" is exactly the line
+#: to hold — any nonzero current value regresses
+ZERO_GATED = frozenset(("recompiles_total",))
 
 #: parsed-record fields a BENCH_r*.json baseline contributes
 _BENCH_FIELDS = ("mfu", "tokens_per_s", "step_p50_s", "samples_per_sec",
                  "peak_hbm_bytes", "prof_step_p50_s", "ttft_p99_s",
-                 "spec_accept_rate")
+                 "spec_accept_rate", "recompiles_total",
+                 "jit_cache_hit_rate")
 
 
 def load_journal(path):
@@ -116,6 +127,20 @@ def derive_metrics(records):
             if s.get("gauges", {}).get("prof.mfu") is not None]
     if mfus:
         out["mfu"] = mfus[-1]
+    # compile health (mxjit): unexpected recompiles are cumulative in the
+    # final snapshot (zero-gated — see ZERO_GATED); the persistent jit
+    # cache's hit rate is a throughput-style ratio. Counters only appear
+    # once the run touched a jit boundary / the cache, so short journals
+    # simply don't contribute these.
+    if final is not None:
+        ctr = final.get("counters", {})
+        rc = ctr.get("compile.recompiles_total")
+        if rc is not None:
+            out["recompiles_total"] = float(rc)
+        hits = ctr.get("compile.cache_hits_total")
+        misses = ctr.get("compile.cache_misses_total")
+        if hits is not None and misses is not None and (hits + misses) > 0:
+            out["jit_cache_hit_rate"] = float(hits) / float(hits + misses)
     # prof step_breakdown records carry samples/tokens rates even when
     # no snapshot landed (short runs flushed only at exit)
     if "samples_per_sec" not in out:
@@ -191,7 +216,10 @@ def gate(current, baseline, tolerance):
     for name in sorted(set(current) & set(baseline)):
         base, cur = baseline[name], current[name]
         if base == 0:
-            status = "PASS"  # nothing to hold a ratio against
+            # a ratio band can't hold a zero baseline — except for the
+            # zero-gated counters, where 0 is the whole contract
+            status = ("REGRESS" if name in ZERO_GATED and cur > 0
+                      else "PASS")
         elif name in LOWER_IS_BETTER:
             if cur > base * (1.0 + tolerance):
                 status = "REGRESS"
@@ -259,10 +287,10 @@ def run_gate(journals, baseline_path, tolerance, write_baseline=None,
 
 
 # -- selftest (the chaos.py smoke leg) ----------------------------------------
-def _fake_journal(path, step_p50, samples, mfu, hbm):
+def _fake_journal(path, step_p50, samples, mfu, hbm, counters=None):
     rec = {
         "kind": "metrics", "t": 0.0, "mark": "exit",
-        "counters": {},
+        "counters": dict(counters or {}),
         "gauges": {"train.samples_per_sec": samples, "prof.mfu": mfu,
                    "prof.hbm_peak_bytes": hbm},
         "histograms": {"train.step_secs": {
@@ -290,22 +318,35 @@ def selftest(out=sys.stdout):
     bad = os.path.join(d, "bad.jsonl")
     basefile = os.path.join(d, "baseline.json")
     _fake_journal(good, step_p50=0.020, samples=5000.0, mfu=0.68,
-                  hbm=1.0e9)
+                  hbm=1.0e9,
+                  counters={"compile.recompiles_total": 0,
+                            "compile.cache_hits_total": 9,
+                            "compile.cache_misses_total": 1})
     _fake_journal(bad, step_p50=0.030, samples=3900.0, mfu=0.50,
                   hbm=1.6e9)
     rc_base = run_gate([good], None, 0.10, write_baseline=basefile,
                        out=out)
     rc_pass = run_gate([good], basefile, 0.10, out=out)
     rc_regress = run_gate([bad], basefile, 0.10, out=out)
+    # zero-gated leg: baseline holds recompiles_total at 0; a run with
+    # even one unexpected recompile must regress despite the ratio band
+    storm = os.path.join(d, "storm.jsonl")
+    _fake_journal(storm, step_p50=0.020, samples=5000.0, mfu=0.68,
+                  hbm=1.0e9,
+                  counters={"compile.recompiles_total": 1,
+                            "compile.cache_hits_total": 9,
+                            "compile.cache_misses_total": 1})
+    rc_storm = run_gate([storm], basefile, 0.10, out=out)
     empty = os.path.join(d, "empty-baseline.json")
     with open(empty, "w", encoding="utf-8") as f:
         f.write("{\"metrics\": {\"some_other_metric\": 1.0}}\n")
     rc_missing = run_gate([good], empty, 0.10, out=out)
     ok = (rc_base == 0 and rc_pass == 0 and rc_regress == 1
-          and rc_missing == 2)
-    print("perf_gate selftest: baseline=%d pass=%d regress=%d missing=%d "
-          "-> %s" % (rc_base, rc_pass, rc_regress, rc_missing,
-                     "OK" if ok else "BROKEN"), file=out)
+          and rc_storm == 1 and rc_missing == 2)
+    print("perf_gate selftest: baseline=%d pass=%d regress=%d storm=%d "
+          "missing=%d -> %s" % (rc_base, rc_pass, rc_regress, rc_storm,
+                                rc_missing, "OK" if ok else "BROKEN"),
+          file=out)
     return 0 if ok else 1
 
 
