@@ -89,6 +89,12 @@ DEFAULT_TARGETS = (
     os.path.join(_PKG, "data_service", "client.py"),
     os.path.join(_PKG, "data_service", "server.py"),
     os.path.join(_PKG, "analysis", "datasim.py"),
+    # the weight-sync speakers (docs/how_to/weight_sync.md): the
+    # wsync_* ops are prefixed because this namespace is global —
+    # their arms and call sites lint under the same discipline
+    os.path.join(_PKG, "wsync", "client.py"),
+    os.path.join(_PKG, "wsync", "publisher.py"),
+    os.path.join(_PKG, "wsync", "subscriber.py"),
 )
 
 #: constants the lattice must recover from DEFAULT_TARGETS; an explicit

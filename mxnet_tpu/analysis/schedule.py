@@ -54,7 +54,8 @@ import traceback as _tb
 
 __all__ = ["Controller", "Explorer", "ExploreResult", "FailureReport",
            "explore", "replay", "racy_counter_workload",
-           "serving_workload", "aggregator_workload"]
+           "serving_workload", "aggregator_workload",
+           "wsync_swap_workload"]
 
 _GATE_TIMEOUT = 120.0     # guard: a wedged scheduler raises, never hangs CI
 _THIS_FILE = os.path.abspath(__file__)
@@ -903,6 +904,96 @@ def serving_workload(n_requests=4, cancel=True):
     return make
 
 
+def wsync_swap_workload(n_requests=3, staged=True):
+    """Engine hot-swap safety under adversarial schedules (ISSUE 17,
+    riding PR 12's drain contract): a client thread submits/cancels, a
+    drain thread flips drain()/resume(), a driver pumps step(), and a
+    sync thread swaps the params mid-traffic. With ``staged=True`` the
+    swap goes through ``install_weights`` + ``rollback_weights`` (the
+    wsync discipline) and every schedule must survive with the
+    serving invariants intact AND the params identity equal to the
+    installed token. With ``staged=False`` — the SEEDED RACE (negative
+    control) — the sync thread rebinds ``eng.params`` directly, and
+    the explorer must catch step()'s unstaged-write guard firing."""
+
+    def make(ctl):
+        import numpy as np
+
+        eng = _stub_serving_engine()
+        eng._lock = ctl.rlock("serving.Engine._lock")
+        eng._step_lock = ctl.lock("serving.Engine._step_lock")
+        eng._work = ctl.condition(eng._lock, "serving.Engine._work")
+        old_params = eng.params
+        new_params = {"embed": np.ones((64, 8), np.float32)}
+        handles = []
+        client_done = []
+
+        def client():
+            from ..serving.engine import QueueFullError
+
+            for _ in range(n_requests):
+                try:
+                    handles.append(eng.submit([1, 2, 3],
+                                              max_new_tokens=3))
+                except QueueFullError:
+                    pass   # submit raced a drain window — by design
+                ctl.checkpoint()
+            if handles:
+                handles[0].cancel()
+            client_done.append(True)
+
+        def syncer():
+            ctl.checkpoint()
+            if staged:
+                eng.install_weights(1, new_params)
+                ctl.checkpoint()
+                eng.rollback_weights()
+            else:
+                # the unstaged direct write the step() guard must catch
+                eng.params = new_params
+            ctl.checkpoint()
+
+        def drainer():
+            ctl.checkpoint()
+            eng.drain()
+            ctl.checkpoint()
+            eng.resume()
+
+        def driver():
+            for _ in range(400):
+                ctl.checkpoint()
+                worked = eng.step()
+                if worked or not client_done:
+                    continue
+                if not (eng.sched.queue or eng.sched.active):
+                    break
+
+        def check():
+            st = eng.stats()
+            assert st["queue_depth"] == 0 and st["active"] == 0, st
+            # a drain window may have shed some submits — every stream
+            # that exists still ends exactly once
+            assert st["completed"] + st["cancelled"] == len(handles), st
+            for h in handles:
+                assert h.status in ("finished", "cancelled"), (
+                    "stream %d never terminated (status %r)"
+                    % (h.request_id, h.status))
+            assert eng.pool.utilization() == 0.0, (
+                "leaked KV blocks: %.3f" % eng.pool.utilization())
+            # the swap discipline: after install+rollback the live set
+            # is the ORIGINAL params object and the identity token
+            # matches — no torn/unblessed rebind survived the schedule
+            assert eng.params is eng._installed_params, (
+                "params rebound without install_weights")
+            assert eng.params is old_params, "rollback lost the ring set"
+            assert eng.weight_version() is None, eng.weight_version()
+
+        return [client, syncer, drainer, driver], check
+
+    make.__name__ = "wsync_swap(staged=%s)" % staged
+    return make
+
+
 def aggregator_workload(world=3, rounds=2, locked=True):
     """The elastic Aggregator round protocol driven by ``world``
     concurrent contributor threads serialized — or, with
@@ -1018,11 +1109,18 @@ def survival_suite(seed=0, schedules=None, include_serving=True):
             schedules)
     control("control/aggregator", aggregator_workload(locked=False),
             min(schedules, 20), trace_files=AGGREGATOR_TRACE_FILES())
+    if include_serving:
+        # the unstaged direct param write MUST be caught by step()'s
+        # installed-identity guard — if the explorer can't surface it,
+        # the wsync swap discipline is unenforced
+        control("control/wsync-unstaged", wsync_swap_workload(staged=False),
+                min(schedules, 10))
 
     legs = [("counter-locked", racy_counter_workload(locked=True), ()),
             ("aggregator", aggregator_workload(locked=True), ())]
     if include_serving:
         legs.append(("serving", serving_workload(), ()))
+        legs.append(("wsync-swap", wsync_swap_workload(staged=True), ()))
     for name, wl, trace_files in legs:
         r = explore(wl, schedules=schedules, seed=seed,
                     trace_files=trace_files)

@@ -28,6 +28,18 @@ executed by the controller under a per-action
     worker exits (code 43) and ``tools/launch.py --max-restarts``
     respawns a fresh incarnation that rejoins.
 
+``rollback_weights``
+    Live weight-sync remediation (docs/how_to/weight_sync.md): restore
+    every in-process serving engine's previous last-good weight
+    version from its on-engine ring (``Engine.rollback_weights``).
+    Driven by the windowed quality rules — the shipped recipe is
+    ``spec_accept_rate<0.5:for=3:action=rollback_weights:scope=serving
+    :cooldown=60``: a sync that cratered draft quality is rolled back
+    before it leaks into user traffic. In-process by design (the
+    controller rides inside the serving process, or the chaos harness
+    drives it against its own engine); raises when no engine is live
+    or no prior version exists.
+
 Custom actuators register by name via :func:`register` before the
 controller is built (plugins configure rules that name them).
 """
@@ -36,7 +48,8 @@ from __future__ import annotations
 import signal
 
 __all__ = ["Actuator", "ActionError", "RestartReplica", "DrainRestart",
-           "EvictReplace", "build_actuators", "register"]
+           "EvictReplace", "RollbackWeights", "build_actuators",
+           "register"]
 
 
 class ActionError(RuntimeError):
@@ -133,6 +146,28 @@ class EvictReplace(Actuator):
                 "live": resp.get("live")}
 
 
+class RollbackWeights(Actuator):
+    name = "rollback_weights"
+
+    def execute(self, decision, ctx):
+        from ..serving.engine import live_engines
+
+        engines = live_engines()
+        if not engines:
+            raise ActionError(
+                "rollback_weights: no live serving engines in this "
+                "process (the actuator is in-process — run the "
+                "controller inside the serving process)")
+        transitions = []
+        for eng in engines:
+            try:
+                transitions.append(eng.rollback_weights())
+            except Exception as e:  # noqa: BLE001 - empty ring etc.
+                raise ActionError("rollback_weights on engine failed: %s"
+                                  % e)
+        return {"engines": len(transitions), "transitions": transitions}
+
+
 _REGISTRY = {}
 
 
@@ -144,7 +179,8 @@ def register(actuator):
     return actuator
 
 
-for _cls in (RestartReplica, DrainRestart, EvictReplace):
+for _cls in (RestartReplica, DrainRestart, EvictReplace,
+             RollbackWeights):
     register(_cls())
 
 

@@ -184,6 +184,14 @@ class ElasticClient:
     def stats(self):
         return self.call("stats")
 
+    def snapshot(self):
+        """Ask the coordinator to write a weight snapshot NOW (the
+        ``snapshot_prefix`` it was started with): ``fit``-free
+        checkpointing for elastic jobs, and the feed a wsync
+        CheckpointWatcher publishes from (docs/how_to/weight_sync.md).
+        Errors when the coordinator has no snapshot prefix."""
+        return self.call("snapshot")
+
     def evict(self, rank):
         """Admin eviction of ``rank`` (the coordinator's force-evict
         hook): bumps the membership epoch and drops the rank's in-flight

@@ -29,14 +29,23 @@ and ``serving.spec_turns`` / ``serving.spec_tokens_drafted`` /
 ``serving.kv_pool_utilization`` / ``serving.tokens_per_s`` /
 ``serving.queue_depth`` / ``serving.spec_accept_rate``, histograms
 ``serving.ttft_s`` (submit -> first generated token),
-``serving.token_latency_s`` (gap between consecutive tokens of one
-request) and ``serving.spec_accepted_tokens``. Mirrored as plain
-numbers in ``Engine.stats()`` so telemetry-off processes (bench
-subprocesses) still get the record.
+``serving.ttft_sync_s`` (TTFTs landing inside a live weight-sync
+window — docs/how_to/weight_sync.md), ``serving.token_latency_s``
+(gap between consecutive tokens of one request) and
+``serving.spec_accepted_tokens``. Mirrored as plain numbers in
+``Engine.stats()`` so telemetry-off processes (bench subprocesses)
+still get the record.
+
+Live weight sync (ISSUE 17, ``MXNET_WSYNC``): ``install_weights``
+swaps a staged, gated param set (target + draft + host unembed)
+atomically between scheduled steps; ``rollback_weights`` restores the
+newest last-good ring entry. Off by default and structurally inert
+when off (no subscriber thread, no ring growth, no journal records).
 """
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue as _queue
 import threading
 import time
@@ -48,6 +57,7 @@ from .. import telemetry as _tel
 from ..analysis import compile_verify as _cv
 from ..analysis.engine_verify import maybe_trace_lock as _maybe_trace_lock
 from ..base import MXNetError, env_bool as _env_bool, env_int as _env_int
+from ..wsync import enabled as _wsync_enabled
 from . import sampling as _samp
 from .kv_cache import PagedKVPool, blocks_for_tokens
 from .model import ServingModel, bucket_for, cp_prefill_kv
@@ -308,6 +318,28 @@ class Engine:
         self._last_rate = 0.0
         self._draining = False
         self._drained = False
+        # -- wsync (docs/how_to/weight_sync.md): staged hot-swap state.
+        # _installed_params/_installed_draft are identity tokens —
+        # step() hard-rejects a params rebind that bypassed
+        # install_weights(), so the staged-swap gates (shape/dtype,
+        # finiteness, acceptance) are enforced, not advisory
+        self._installed_params = self.params
+        self._installed_draft = self.draft_params
+        self._weight_version = None
+        self._weight_ring = []   # (version, params, draft) last-good
+        self._weight_ring_keep = max(1, _env_int("MXNET_WSYNC_RING", 2))
+        try:
+            self._sync_ttft_window = float(
+                os.environ.get("MXNET_WSYNC_TTFT_WINDOW", "") or 2.0)
+        except ValueError:
+            self._sync_ttft_window = 2.0
+        self._sync_mark_until = 0.0   # monotonic: TTFTs before this
+        self._sync_ttfts = []         # land in the sync-window stats
+        self._wsync_sub = None
+        if _wsync_enabled():
+            from ..wsync.subscriber import maybe_autosync
+
+            self._wsync_sub = maybe_autosync(self)
         _live_engines.add(self)
 
     # -- intake --------------------------------------------------------------
@@ -524,6 +556,133 @@ class Engine:
                 np.zeros((b,), np.int32), 1 + ks, bt, act)
             self.pool.swap(kp, vp)
 
+    # -- live weight sync (docs/how_to/weight_sync.md) -----------------------
+    def install_weights(self, version, params, draft_params=None,
+                        trace=None):
+        """Atomically swap in a staged weight set between scheduled
+        steps: target params, draft params, and the host unembedding
+        in ONE transaction under ``_step_lock`` — no drain, no jit
+        recompile (params are jitted-program *arguments*; the hard
+        shape/dtype gate below guarantees compiled shapes never
+        change). The outgoing version lands on the bounded last-good
+        ring (``MXNET_WSYNC_RING``) for :meth:`rollback_weights`.
+
+        Gates (reject ⇒ ``wsync.rejected_total`` + a journaled
+        ``rejected`` record + MXNetError, live params untouched):
+
+        - shape/dtype mismatch against the live set — hard reject;
+        - non-finite tensors — the guardian's finiteness discipline
+          (``resilience/guardian.py``: a non-finite update never
+          lands) applied to weight syncs.
+
+        ``draft_params`` refresh in the same transaction so the spec
+        accept rate doesn't crater mid-swap; a version without a draft
+        half swaps the target only (and a draft half is dropped when
+        the engine was built without a draft model).
+        """
+        from ..wsync import common as _wc
+
+        version = int(version)
+        if _wc.param_manifest(params) != _wc.param_manifest(self.params):
+            self._reject_weights(
+                version, trace, "shape/dtype mismatch against live "
+                "params (jitted shapes are pinned — a resized model "
+                "needs a new engine, not a sync)")
+        bad = _wc.nonfinite_keys(_wc.flatten_params(params))
+        if bad:
+            self._reject_weights(
+                version, trace,
+                "non-finite tensors: %s" % ", ".join(sorted(bad)[:4]))
+        if draft_params is not None and self.draft_model is None:
+            draft_params = None
+        if draft_params is not None:
+            if (_wc.param_manifest(draft_params)
+                    != _wc.param_manifest(self.draft_params)):
+                self._reject_weights(
+                    version, trace,
+                    "draft shape/dtype mismatch against live draft "
+                    "params")
+            dbad = _wc.nonfinite_keys(_wc.flatten_params(draft_params))
+            if dbad:
+                self._reject_weights(
+                    version, trace, "non-finite draft tensors: %s"
+                    % ", ".join(sorted(dbad)[:4]))
+        with self._step_lock:
+            with self._lock:
+                self._weight_ring.append(
+                    (self._weight_version, self.params, self.draft_params))
+                del self._weight_ring[:-self._weight_ring_keep]
+                self.params = params
+                self._installed_params = params
+                if draft_params is not None:
+                    self.draft_params = draft_params
+                self._installed_draft = self.draft_params
+                if self.cfg.mesh is not None:
+                    self._host_unembed = np.asarray(
+                        params["embed"], np.float32).T
+                self._weight_version = version
+                self._sync_mark_until = (time.monotonic()
+                                         + self._sync_ttft_window)
+                if _tel.ENABLED:
+                    _tel.counter("wsync.versions_applied_total").inc()
+                    _tel.gauge("wsync.current_version").set(version)
+                _wc.journal("applied", version, trace=trace,
+                            draft=draft_params is not None,
+                            ring=len(self._weight_ring))
+        return version
+
+    def rollback_weights(self, trace=None):
+        """Reinstall the newest last-good ring entry (target + draft +
+        unembed in one transaction, like :meth:`install_weights`). A
+        rollback CONSUMES its entry — repeated firings walk further
+        back, never loop on one version (the guardian ring's
+        escalation discipline). The mxctl ``rollback_weights``
+        actuator's whole body. Returns ``{"from_version",
+        "to_version"}``; raises MXNetError on an empty ring."""
+        from ..wsync import common as _wc
+
+        with self._step_lock:
+            with self._lock:
+                if not self._weight_ring:
+                    raise MXNetError(
+                        "rollback_weights: last-good ring is empty "
+                        "(no prior version to restore)")
+                version, params, draft = self._weight_ring.pop()
+                from_v = self._weight_version
+                if trace is None and _tel.ENABLED:
+                    trace = _tel.mint_trace()
+                self.params = params
+                self._installed_params = params
+                if draft is not None and self.draft_model is not None:
+                    self.draft_params = draft
+                self._installed_draft = self.draft_params
+                if self.cfg.mesh is not None:
+                    self._host_unembed = np.asarray(
+                        params["embed"], np.float32).T
+                self._weight_version = version
+                if _tel.ENABLED:
+                    _tel.counter("wsync.rollbacks_total").inc()
+                    _tel.gauge("wsync.current_version").set(
+                        version if version is not None else 0)
+                _wc.journal("rolled_back", version, trace=trace,
+                            from_version=from_v,
+                            ring=len(self._weight_ring))
+        return {"from_version": from_v, "to_version": version}
+
+    def _reject_weights(self, version, trace, reason):
+        from ..wsync import common as _wc
+
+        if _tel.ENABLED:
+            _tel.counter("wsync.rejected_total").inc()
+        _wc.journal("rejected", version, trace=trace, reason=reason)
+        raise MXNetError("weight sync version %d rejected: %s"
+                         % (version, reason))
+
+    def weight_version(self):
+        """Version installed by the newest sync (None before any)."""
+        with self._lock:
+            return self._weight_version
+
     # -- synchronous batch API -----------------------------------------------
     def generate(self, prompts, max_new_tokens=16):
         """Submit all prompts, drive the loop to completion, return the
@@ -541,6 +700,14 @@ class Engine:
         batch). Returns True when any work ran. Whole-step atomic:
         concurrent drivers serialize on _step_lock."""
         with self._step_lock:
+            if (self.params is not self._installed_params
+                    or (self.draft_model is not None
+                        and self.draft_params is not self._installed_draft)):
+                raise MXNetError(
+                    "Engine params were rebound without "
+                    "install_weights(): a direct write bypasses the "
+                    "staged-swap gates (shape/dtype, finiteness, "
+                    "acceptance) — docs/how_to/weight_sync.md")
             with self._lock:
                 plan = self.sched.plan()
                 self._mirror_events()
@@ -990,6 +1157,14 @@ class Engine:
             self._ttfts.append(now - req.submit_t)
             if _tel.ENABLED:
                 _tel.histogram("serving.ttft_s").observe(now - req.submit_t)
+            if now <= self._sync_mark_until:
+                # TTFT landed inside a sync window: the degradation
+                # signal tools/perf_gate.py gates (ttft_sync_p99_s must
+                # stay within tolerance of the no-sync baseline)
+                self._sync_ttfts.append(now - req.submit_t)
+                if _tel.ENABLED:
+                    _tel.histogram("serving.ttft_sync_s").observe(
+                        now - req.submit_t)
         if req.last_token_t is not None:
             self._token_lats.append(now - req.last_token_t)
             if _tel.ENABLED:
@@ -1136,8 +1311,11 @@ class Engine:
                 "draining": self._draining,
                 "drained": self._drained,
                 "tokens_per_s_window": self._last_rate,
+                "weight_version": self._weight_version,
+                "weight_ring": len(self._weight_ring),
                 "ttft_p50_s": pct(self._ttfts, 50),
                 "ttft_p99_s": pct(self._ttfts, 99),
+                "ttft_sync_p99_s": pct(self._sync_ttfts, 99),
                 "token_latency_p50_s": pct(self._token_lats, 50),
                 "token_latency_p99_s": pct(self._token_lats, 99),
             })
@@ -1176,6 +1354,11 @@ class Engine:
                     "draft_pool_utilization": (
                         self.draft_pool.utilization()
                         if self.draft_pool is not None else None),
+                },
+                "wsync": {
+                    "version": self._weight_version,
+                    "ring": len(self._weight_ring),
+                    "syncing": self._wsync_sub is not None,
                 },
                 "requests": reqs,
                 "pool": {

@@ -1,0 +1,204 @@
+"""Shared wsync plumbing: param flattening, fingerprints, gates,
+checkpoint round-trip, and the ``{"kind": "wsync"}`` journal record.
+
+The wire unit is a *flat* param set — ``{"embed": arr,
+"layers/0/wqkv": arr, ...}`` with the draft model's tensors under a
+``draft/`` prefix — so the publisher can manifest, fingerprint, and
+serve tensors individually (per-tensor deltas) while both ends agree on
+one canonical naming for any params pytree. Weights cross the wire at
+full precision always: the byte-parity contract (a hot-swapped engine
+decodes byte-identically to a cold engine from the same checkpoint)
+forbids the lossy gradient codec here, the same scope discipline
+``quantize.py`` applies to ``put_weight``.
+"""
+from __future__ import annotations
+
+import os
+import time
+import zlib
+
+import numpy as np
+
+from .. import telemetry as _tel
+from ..base import MXNetError
+
+__all__ = ["flatten_params", "unflatten_params", "split_draft",
+           "fingerprint", "manifest_of", "param_manifest",
+           "nonfinite_keys", "save_weights_checkpoint",
+           "load_weights_checkpoint", "journal", "env_float"]
+
+#: flat-key prefix carrying the draft model's tensors inside one
+#: version (one checkpoint file, one transaction — target and draft
+#: can never tear apart)
+DRAFT_PREFIX = "draft/"
+
+
+def env_float(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        raise MXNetError("%s must be a number, got %r" % (name, raw))
+
+
+# -- flat param sets -----------------------------------------------------------
+
+def flatten_params(tree, prefix="", out=None):
+    """A params pytree (nested dict/list/tuple of arrays) as one flat
+    ``{path: array}`` dict with ``/``-joined, sorted-key paths. A dict
+    that is already flat round-trips unchanged (leaf values are kept
+    as-is — no host copy is forced here)."""
+    if out is None:
+        out = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            flatten_params(tree[k], "%s%s/" % (prefix, k), out)
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            flatten_params(v, "%s%d/" % (prefix, i), out)
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def unflatten_params(flat):
+    """Inverse of :func:`flatten_params`: rebuild the nested pytree
+    (path components that are all decimal become a dense list)."""
+    root = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+
+    def build(node):
+        if not isinstance(node, dict):
+            return node
+        keys = list(node)
+        if keys and all(k.isdigit() for k in keys):
+            idx = sorted(int(k) for k in keys)
+            if idx != list(range(len(idx))):
+                raise MXNetError("non-dense list indices in flat params: %r"
+                                 % sorted(keys))
+            return [build(node[str(i)]) for i in idx]
+        return {k: build(v) for k, v in node.items()}
+
+    return build(root)
+
+
+def split_draft(flat):
+    """``(target_flat, draft_flat_or_None)`` from one combined flat set
+    (the ``draft/`` prefix is the draft half)."""
+    target, draft = {}, {}
+    for k, v in flat.items():
+        if k.startswith(DRAFT_PREFIX):
+            draft[k[len(DRAFT_PREFIX):]] = v
+        else:
+            target[k] = v
+    return target, (draft or None)
+
+
+def combine_draft(params, draft_params=None):
+    """One flat set from a target pytree plus an optional draft pytree
+    (draft keys under ``draft/``)."""
+    flat = flatten_params(params)
+    if draft_params is not None:
+        for k, v in flatten_params(draft_params).items():
+            flat[DRAFT_PREFIX + k] = v
+    return flat
+
+
+# -- manifests and gates -------------------------------------------------------
+
+def fingerprint(arr):
+    """Content fingerprint of one tensor (crc32 over dtype/shape/bytes)
+    — what makes the version stream *delta*-transferable: a subscriber
+    skips every tensor whose fingerprint it already holds."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = zlib.crc32(("%s:%r" % (a.dtype.str, a.shape)).encode())
+    return zlib.crc32(a.tobytes(), h) & 0xFFFFFFFF
+
+
+def manifest_of(flat):
+    """Per-tensor wire manifest: ``{path: {"shape", "dtype", "fp"}}``.
+    Forces a host snapshot of each leaf (the publisher stores host
+    copies anyway — the wire is host-side by construction)."""
+    out = {}
+    for k, v in flat.items():
+        a = np.asarray(v)
+        out[k] = {"shape": tuple(int(d) for d in a.shape),
+                  "dtype": a.dtype.str, "fp": fingerprint(a)}
+    return out
+
+
+def param_manifest(tree):
+    """Shape/dtype map of a pytree WITHOUT materializing device arrays
+    on the host — the Engine-side half of the hard shape/dtype gate
+    (jitted programs keep their compiled shapes; a mismatched sync can
+    never be allowed to trigger a recompile)."""
+    out = {}
+    for k, v in flatten_params(tree).items():
+        out[k] = (tuple(int(d) for d in np.shape(v)),
+                  np.dtype(getattr(v, "dtype", np.float32)).str)
+    return out
+
+
+def nonfinite_keys(flat):
+    """Paths of tensors containing non-finite values — the guardian's
+    finiteness discipline (``resilience/guardian.py``: a non-finite
+    update never lands) applied to a staged weight set."""
+    bad = []
+    for k, v in flat.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating) and not np.all(
+                np.isfinite(a)):
+            bad.append(k)
+    return bad
+
+
+# -- checkpoint round-trip -----------------------------------------------------
+
+def save_weights_checkpoint(prefix, epoch, params, draft_params=None):
+    """Write ``<prefix>-NNNN.params`` holding the combined flat set
+    (draft under ``draft/``) via the crash-safe atomic writer — the
+    file a :class:`~.publisher.CheckpointWatcher` picks up with
+    ``model.find_latest_checkpoint``. Returns the path."""
+    from ..model import _write_params_atomic
+
+    path = "%s-%04d.params" % (prefix, int(epoch))
+    flat = combine_draft(params, draft_params)
+    _write_params_atomic(path, {k: np.asarray(v) for k, v in flat.items()})
+    return path
+
+
+def load_weights_checkpoint(prefix, epoch):
+    """``(params, draft_params_or_None)`` pytrees from one epoch's
+    weights checkpoint."""
+    from ..ndarray import load as nd_load
+
+    path = "%s-%04d.params" % (prefix, int(epoch))
+    flat = {k: v.asnumpy() for k, v in nd_load(path).items()}
+    target, draft = split_draft(flat)
+    return (unflatten_params(target),
+            unflatten_params(draft) if draft else None)
+
+
+# -- journal -------------------------------------------------------------------
+
+def journal(event, version, trace=None, **fields):
+    """One ``{"kind": "wsync"}`` journal record (no-op with telemetry
+    off — the off-by-default contract). Every record of one sync
+    transaction shares the trace id minted at transaction start, so
+    ``tools/telemetry_report.py``'s version timeline reconstructs
+    staged → applied/rejected/rolled-back per transaction."""
+    if not _tel.ENABLED:
+        return
+    from ..telemetry import export as _export
+
+    rec = {"kind": "wsync", "event": event, "version": version,
+           "t": time.time(), "trace": trace}
+    rec.update(fields)
+    _export.emit(rec)

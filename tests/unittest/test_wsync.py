@@ -1,0 +1,577 @@
+"""Gated live trainer→serving weight sync (ISSUE 17).
+
+The load-bearing contracts:
+
+- **byte parity** — an engine hot-swapped to version N over the wsync
+  RPC decodes byte-identically to a cold engine booted from the
+  version-N checkpoint, speculation on and off (weights cross the wire
+  full precision; target and draft refresh in ONE transaction);
+- **gates** — shape/dtype mismatches, non-finite tensors, and a
+  refusing acceptance probe leave the live params byte-untouched;
+- **atomicity** — a torn transaction (publisher history eviction
+  mid-fetch here; SIGKILL in tools/chaos.py --wsync) stages nothing,
+  and a direct (unstaged) param rebind is caught by the step loop;
+- **rollback** — the bounded last-good ring walks backwards one
+  consumed entry per firing, and the mxctl ``rollback_weights``
+  actuator restores the prior version when the windowed
+  ``spec_accept_rate`` rule fires;
+- **off by default** — ``MXNET_WSYNC`` unset ⇒ no thread, no socket,
+  and a serving run journals zero ``{"kind": "wsync"}`` records.
+"""
+import dataclasses
+import gc
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu.telemetry as tel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import Engine, ServingConfig
+from mxnet_tpu.wsync import common as wc
+from mxnet_tpu.wsync import enabled as wsync_enabled
+from mxnet_tpu.wsync.publisher import CheckpointWatcher, WeightPublisher
+from mxnet_tpu.wsync.subscriber import WeightSubscriber, maybe_autosync
+
+
+# -- shared tiny models (module scope: jit compiles amortized) ----------------
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, d_model=32,
+                            num_heads=2, d_ff=64, max_seq_len=96,
+                            dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _draft_of(params, cfg):
+    """The aligned draft truncated from a target param set — built from
+    the SAME set so a synced version's draft half tracks its target."""
+    dparams = {"embed": params["embed"], "pos_embed": params["pos_embed"],
+               "layers": params["layers"][:1], "ln_f": params["ln_f"]}
+    return dparams, dataclasses.replace(cfg, num_layers=1)
+
+
+def _perturb(tree, scale, seed=0):
+    """A same-shape/dtype variant of a params pytree (a 'new version')."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for k, v in wc.flatten_params(tree).items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            out[k] = (a + scale
+                      * rng.standard_normal(a.shape).astype(a.dtype))
+        else:
+            out[k] = a
+    return wc.unflatten_params(out)
+
+
+def _fp_of(params, draft=None):
+    flat = wc.combine_draft(params, draft)
+    return {k: wc.fingerprint(v) for k, v in flat.items()}
+
+
+def _mk_engine(model, draft_pair=None, **kw):
+    cfg, params = model
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("token_budget", 64)
+    if draft_pair is not None:
+        dparams, dcfg = draft_pair
+        kw.setdefault("spec", True)
+        kw.setdefault("spec_k", 3)
+        return Engine(params, cfg, ServingConfig(**kw),
+                      draft_params=dparams, draft_cfg=dcfg)
+    return Engine(params, cfg, ServingConfig(**kw))
+
+
+PROMPTS = [[7, 11, 13, 17, 19, 23], [3, 1, 4, 1, 5, 9, 2, 6]]
+
+
+@pytest.fixture()
+def pub():
+    p = WeightPublisher(bind=("127.0.0.1", 0))
+    p.start()
+    yield p
+    p.close()
+
+
+def _addr(pub):
+    host, port = pub.addr
+    return "%s:%d" % (host, port)
+
+
+# -- flat wire format ---------------------------------------------------------
+class TestFlatWire:
+    def test_flatten_unflatten_roundtrip(self, model):
+        _, params = model
+        flat = wc.flatten_params(params)
+        assert all("/" in k or k in params for k in flat)
+        back = wc.flatten_params(wc.unflatten_params(flat))
+        assert set(back) == set(flat)
+        for k in flat:
+            assert np.array_equal(np.asarray(back[k]),
+                                  np.asarray(flat[k]))
+        # layer lists come back as dense lists, not {"0": ...} dicts
+        assert isinstance(wc.unflatten_params(flat)["layers"], list)
+
+    def test_combine_split_draft_roundtrip(self, model):
+        cfg, params = model
+        dparams, _ = _draft_of(params, cfg)
+        flat = wc.combine_draft(params, dparams)
+        assert any(k.startswith(wc.DRAFT_PREFIX) for k in flat)
+        target, draft = wc.split_draft(flat)
+        assert not any(k.startswith(wc.DRAFT_PREFIX) for k in target)
+        assert draft and set(draft) == set(wc.flatten_params(dparams))
+        assert wc.split_draft(wc.combine_draft(params))[1] is None
+
+    def test_fingerprint_content_sensitivity(self):
+        a = np.arange(12, dtype=np.float32)
+        assert wc.fingerprint(a) == wc.fingerprint(a.copy())
+        b = a.copy()
+        b[3] += 1e-3
+        assert wc.fingerprint(b) != wc.fingerprint(a)
+        # shape/dtype are part of the fingerprint, not just bytes
+        assert wc.fingerprint(a.reshape(3, 4)) != wc.fingerprint(a)
+
+    def test_nonfinite_keys(self, model):
+        _, params = model
+        flat = {k: np.asarray(v).copy()
+                for k, v in wc.flatten_params(params).items()}
+        assert wc.nonfinite_keys(flat) == []
+        key = sorted(flat)[0]
+        flat[key].flat[0] = np.nan
+        assert wc.nonfinite_keys(flat) == [key]
+
+    def test_checkpoint_roundtrip(self, model, tmp_path):
+        cfg, params = model
+        dparams, _ = _draft_of(params, cfg)
+        prefix = str(tmp_path / "ck")
+        path = wc.save_weights_checkpoint(prefix, 7, params, dparams)
+        assert path.endswith("-0007.params")
+        loaded, ldraft = wc.load_weights_checkpoint(prefix, 7)
+        assert _fp_of(loaded, ldraft) == _fp_of(params, dparams)
+        wc.save_weights_checkpoint(prefix, 8, params)
+        _, nodraft = wc.load_weights_checkpoint(prefix, 8)
+        assert nodraft is None
+
+
+# -- publisher store ----------------------------------------------------------
+class TestPublisher:
+    def test_versions_monotonic(self, model):
+        _, params = model
+        p = WeightPublisher(bind=None)
+        assert p.publish(params) == 1
+        assert p.publish(params) == 2
+        assert p.publish(params, version=9) == 9
+        with pytest.raises(MXNetError):
+            p.publish(params, version=9)
+
+    def test_history_bound(self, model):
+        _, params = model
+        p = WeightPublisher(bind=None, history=2)
+        for _ in range(3):
+            p.publish(params)
+        gone = p._dispatch({"op": "wsync_manifest", "version": 1})
+        assert gone["status"] == "error"
+        assert p._dispatch({"op": "wsync_manifest",
+                            "version": 3})["status"] == "ok"
+
+    def test_poll_and_unknown_op(self, model):
+        _, params = model
+        p = WeightPublisher(bind=None)
+        assert p._dispatch({"op": "wsync_poll",
+                            "have": 0})["status"] == "pending"
+        p.publish(params)
+        resp = p._dispatch({"op": "wsync_poll", "have": 0})
+        assert (resp["status"], resp["version"]) == ("ok", 1)
+        assert p._dispatch({"op": "wsync_poll",
+                            "have": 1})["status"] == "pending"
+        assert p._dispatch({"op": "nope"})["status"] == "error"
+
+
+# -- one transaction over the wire --------------------------------------------
+class TestSyncTransaction:
+    def test_rpc_round_trip_applies(self, model, pub):
+        cfg, params = model
+        eng = _mk_engine(model, _draft_of(params, cfg))
+        sub = WeightSubscriber(eng, _addr(pub), rank=0)
+        assert sub.sync_once() is None  # nothing published yet
+        v2 = _perturb(params, 0.02, seed=1)
+        pub.publish(v2, _draft_of(v2, cfg)[0])
+        assert sub.sync_once(wait=5.0) == 1
+        assert eng.weight_version() == 1
+        assert (_fp_of(eng.params, eng.draft_params)
+                == _fp_of(v2, _draft_of(v2, cfg)[0]))
+        assert pub.acks() == [(1, 0, "applied")]
+
+    def test_delta_skip_fetches_only_changed(self, model, pub):
+        cfg, params = model
+        eng = _mk_engine(model)
+        sub = WeightSubscriber(eng, _addr(pub), rank=0)
+        pub.publish(params)
+        n_all = len(wc.flatten_params(params))
+        fetched = []
+        orig = sub._client.fetch_tensor
+        sub._client.fetch_tensor = (
+            lambda v, k: (fetched.append(k), orig(v, k))[1])
+        assert sub.sync_once(wait=5.0) == 1
+        assert len(fetched) == n_all  # cold subscriber: everything
+        # version 2 changes exactly one tensor — only it crosses again
+        nxt = {k: np.asarray(v)
+               for k, v in wc.flatten_params(params).items()}
+        nxt["ln_f/scale"] = nxt["ln_f/scale"] * 1.5
+        pub.publish(wc.unflatten_params(nxt))
+        del fetched[:]
+        assert sub.sync_once(wait=5.0) == 2
+        assert fetched == ["ln_f/scale"]
+
+    def test_acceptance_probe_refuses(self, model, pub):
+        cfg, params = model
+        eng = _mk_engine(model)
+        seen = []
+        sub = WeightSubscriber(
+            eng, _addr(pub), rank=3,
+            accept=lambda v, p, d: (seen.append(v), False)[1])
+        pub.publish(_perturb(params, 0.02, seed=2))
+        assert sub.sync_once(wait=5.0) is None
+        assert seen == [1]
+        assert eng.weight_version() is None
+        assert eng.params is not None
+        assert pub.acks() == [(1, 3, "rejected:acceptance-probe")]
+        # a refused version is not re-fetched forever: cursor advanced
+        assert sub.sync_once() is None
+
+    def test_torn_transaction_aborts_cleanly(self, model, pub):
+        cfg, params = model
+        eng = _mk_engine(model)
+        live = eng.params
+        sub = WeightSubscriber(eng, _addr(pub), rank=0)
+        pub.publish(_perturb(params, 0.02, seed=3))
+        # the slow-subscriber case: the version is evicted from the
+        # publisher's history between poll and fetch
+        with pub._lock:
+            pub._versions.clear()
+        assert sub.sync_once(wait=5.0) is None
+        assert eng.params is live  # double buffer: live set untouched
+        assert eng.weight_version() is None
+        assert pub.acks() == [(1, 0, "aborted")]
+        # the stream heals on the next complete version
+        pub.publish(_perturb(params, 0.02, seed=4))
+        assert sub.sync_once(wait=5.0) == 2
+
+
+# -- engine gates + atomic swap -----------------------------------------------
+class TestEngineGates:
+    def test_nonfinite_rejected_params_untouched(self, model):
+        cfg, params = model
+        eng = _mk_engine(model)
+        live = eng.params
+        poisoned = _perturb(params, 0.01, seed=5)
+        flat = {k: np.asarray(v).copy()
+                for k, v in wc.flatten_params(poisoned).items()}
+        flat[sorted(flat)[0]].flat[0] = np.inf
+        with pytest.raises(MXNetError, match="non-finite"):
+            eng.install_weights(1, wc.unflatten_params(flat))
+        assert eng.params is live
+        assert eng.weight_version() is None
+
+    def test_shape_dtype_mismatch_rejected(self, model):
+        cfg, params = model
+        eng = _mk_engine(model)
+        flat = {k: np.asarray(v)
+                for k, v in wc.flatten_params(params).items()}
+        flat["embed"] = flat["embed"][:-1]  # resized vocab
+        with pytest.raises(MXNetError, match="shape/dtype"):
+            eng.install_weights(1, wc.unflatten_params(flat))
+        flat = {k: np.asarray(v)
+                for k, v in wc.flatten_params(params).items()}
+        flat["embed"] = flat["embed"].astype(np.float64)
+        with pytest.raises(MXNetError, match="shape/dtype"):
+            eng.install_weights(1, wc.unflatten_params(flat))
+        assert eng.weight_version() is None
+
+    def test_draft_mismatch_rejected_target_kept(self, model):
+        cfg, params = model
+        eng = _mk_engine(model, _draft_of(params, cfg))
+        live = eng.params
+        v2 = _perturb(params, 0.02, seed=6)
+        bad_draft = {"embed": v2["embed"], "pos_embed": v2["pos_embed"],
+                     "layers": v2["layers"],  # 2 layers vs the live 1
+                     "ln_f": v2["ln_f"]}
+        with pytest.raises(MXNetError, match="draft"):
+            eng.install_weights(1, v2, bad_draft)
+        # all-or-nothing: the valid target half did NOT land alone
+        assert eng.params is live
+        assert eng.weight_version() is None
+
+    def test_draft_dropped_without_draft_model(self, model):
+        cfg, params = model
+        eng = _mk_engine(model)  # no spec, no draft model
+        v2 = _perturb(params, 0.02, seed=7)
+        assert eng.install_weights(1, v2, _draft_of(v2, cfg)[0]) == 1
+        assert eng.weight_version() == 1
+
+    def test_unstaged_direct_write_caught_by_step(self, model):
+        cfg, params = model
+        eng = _mk_engine(model)
+        eng.submit(PROMPTS[0], max_new_tokens=2)
+        eng.params = dict(eng.params)  # rebind WITHOUT install_weights
+        with pytest.raises(MXNetError, match="install_weights"):
+            eng.step()
+        eng.params = eng._installed_params
+        eng.run_until_idle()
+
+
+# -- last-good ring + rollback ------------------------------------------------
+class TestRollback:
+    def test_ring_bounded_and_rollback_walks_back(self, model):
+        cfg, params = model
+        eng = _mk_engine(model)
+        sets = {v: _perturb(params, 0.02 * v, seed=v) for v in (1, 2, 3)}
+        for v in (1, 2, 3):
+            eng.install_weights(v, sets[v])
+        # ring keeps MXNET_WSYNC_RING (2) entries: [v1, v2]
+        assert eng.rollback_weights() == {"from_version": 3,
+                                          "to_version": 2}
+        assert _fp_of(eng.params) == _fp_of(sets[2])
+        assert eng.rollback_weights() == {"from_version": 2,
+                                          "to_version": 1}
+        # entries are CONSUMED — the walk never loops on one version
+        with pytest.raises(MXNetError, match="ring is empty"):
+            eng.rollback_weights()
+        assert eng.weight_version() == 1
+
+    def test_rollback_restores_draft_in_same_transaction(self, model):
+        cfg, params = model
+        eng = _mk_engine(model, _draft_of(params, cfg))
+        d0_fp = _fp_of(eng.draft_params)
+        v1 = _perturb(params, 0.05, seed=8)
+        eng.install_weights(1, v1, _draft_of(v1, cfg)[0])
+        assert _fp_of(eng.draft_params) != d0_fp
+        eng.rollback_weights()
+        assert _fp_of(eng.draft_params) == d0_fp
+
+    def test_mxctl_rule_fires_rollback_actuator(self, model):
+        from mxnet_tpu.control import (ControlConfig, Controller,
+                                       TargetSample, parse_rules)
+        from mxnet_tpu.control.probes import serving_metrics
+
+        # the actuator rolls back EVERY live engine in the process:
+        # reap engines leaked by earlier tests so ours is the only one
+        gc.collect()
+        from mxnet_tpu.serving.engine import live_engines
+
+        cfg, params = model
+        eng = _mk_engine(model, _draft_of(params, cfg))
+        assert live_engines() == [eng]
+        eng.install_weights(1, _perturb(params, 0.02, seed=9))
+        eng.install_weights(2, _perturb(params, 0.04, seed=10))
+
+        class EngineProbe:
+            def __init__(self):
+                self.rates = [0.9, 0.2, 0.2, 0.2, 0.2]
+                self.i = 0
+
+            def sample(self, now=None):
+                m = serving_metrics({"engines": [eng.introspect()]})
+                m["spec_accept_rate"] = self.rates[
+                    min(self.i, len(self.rates) - 1)]
+                m.update(alive=1.0, ready=1.0)
+                self.i += 1
+                return TargetSample("serving0", "serving", m,
+                                    {"url": "fake://"})
+
+        ctl = Controller(
+            ControlConfig(rules=parse_rules(
+                "spec_accept_rate<0.5:for=2:action=rollback_weights"
+                ":scope=serving:cooldown=60"), interval=0.01),
+            probes=[EngineProbe()])
+        fired = []
+        for i in range(5):
+            fired.extend(ctl.step(now=100.0 + i))
+        assert [d.rule.action for d in fired] == ["rollback_weights"]
+        assert eng.weight_version() == 1  # restored the prior version
+
+
+# -- byte parity: hot-swapped == cold from the same checkpoint ----------------
+class TestByteParity:
+    @pytest.mark.parametrize("spec", [False, True],
+                             ids=["plain", "spec"])
+    def test_hot_swap_matches_cold_engine(self, model, pub, tmp_path,
+                                          spec):
+        cfg, params = model
+        vN = _perturb(params, 0.05, seed=11)
+        draftN = _draft_of(vN, cfg)[0] if spec else None
+        prefix = str(tmp_path / "ck")
+        wc.save_weights_checkpoint(prefix, 7, vN, draftN)
+
+        hot = _mk_engine(model, _draft_of(params, cfg) if spec else None)
+        sub = WeightSubscriber(hot, _addr(pub), rank=0)
+        pub.publish(vN, draftN, version=7)
+        assert sub.sync_once(wait=5.0) == 7
+
+        cold_p, cold_d = wc.load_weights_checkpoint(prefix, 7)
+        cold = _mk_engine(
+            (cfg, cold_p),
+            (cold_d, _draft_of(vN, cfg)[1]) if spec else None)
+
+        assert (_fp_of(hot.params, hot.draft_params if spec else None)
+                == _fp_of(cold.params,
+                          cold.draft_params if spec else None))
+        out_hot = hot.generate(PROMPTS, max_new_tokens=12)
+        out_cold = cold.generate(PROMPTS, max_new_tokens=12)
+        assert out_hot == out_cold
+
+
+# -- checkpoint watcher -------------------------------------------------------
+class TestCheckpointWatcher:
+    def test_epoch_is_version_exactly_once(self, model, tmp_path):
+        cfg, params = model
+        p = WeightPublisher(bind=None)
+        prefix = str(tmp_path / "train")
+        w = CheckpointWatcher(p, prefix, interval=0.05)
+        assert w.poll_once() is None  # nothing on disk
+        wc.save_weights_checkpoint(prefix, 2, params)
+        assert w.poll_once() == 2
+        assert p._latest == 2
+        assert w.poll_once() is None  # exactly-once per epoch
+        wc.save_weights_checkpoint(prefix, 3, _perturb(params, 0.01))
+        assert w.poll_once() == 3
+
+
+# -- off by default -----------------------------------------------------------
+class TestOffByDefault:
+    def test_env_unset_no_thread_no_socket(self, model, monkeypatch):
+        monkeypatch.delenv("MXNET_WSYNC", raising=False)
+        monkeypatch.delenv("MXNET_WSYNC_PUBLISHER", raising=False)
+        assert not wsync_enabled()
+        before = {t.name for t in threading.enumerate()}
+        eng = _mk_engine(model)
+        assert eng._wsync_sub is None
+        assert maybe_autosync(eng) is None
+        after = {t.name for t in threading.enumerate()} - before
+        assert not any(n.startswith("mx-wsync") for n in after)
+
+    def test_enabled_without_publisher_still_inert(self, model,
+                                                   monkeypatch):
+        monkeypatch.setenv("MXNET_WSYNC", "1")
+        monkeypatch.delenv("MXNET_WSYNC_PUBLISHER", raising=False)
+        eng = _mk_engine(model)
+        assert eng._wsync_sub is None
+
+    def test_autosync_starts_and_applies(self, model, pub, monkeypatch):
+        monkeypatch.setenv("MXNET_WSYNC", "1")
+        monkeypatch.setenv("MXNET_WSYNC_PUBLISHER", _addr(pub))
+        monkeypatch.setenv("MXNET_WSYNC_POLL_WAIT", "0.2")
+        cfg, params = model
+        eng = _mk_engine(model)
+        try:
+            assert eng._wsync_sub is not None
+            pub.publish(_perturb(params, 0.02, seed=12))
+            deadline = time.monotonic() + 20.0
+            while (eng.weight_version() != 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert eng.weight_version() == 1
+        finally:
+            eng._wsync_sub.stop()
+
+    def test_serving_run_journals_no_wsync_records(self, model,
+                                                   monkeypatch,
+                                                   tmp_path):
+        journal = tmp_path / "serve.jsonl"
+        monkeypatch.delenv("MXNET_WSYNC", raising=False)
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_JOURNAL", str(journal))
+        tel.reset()
+        tel.reload()
+        try:
+            eng = _mk_engine(model)
+            eng.generate([PROMPTS[0]], max_new_tokens=3)
+            tel.flush(mark="exit")
+            recs = [json.loads(l) for l in
+                    journal.read_text().splitlines() if l.strip()]
+            assert not [r for r in recs if r.get("kind") == "wsync"]
+            snap = tel.snapshot()
+            assert not any(k.startswith("wsync.")
+                           for k in snap["counters"])
+        finally:
+            monkeypatch.undo()
+            tel.reset()
+            tel.reload()
+
+
+# -- telemetry: counters + one trace id per transaction -----------------------
+class TestWsyncTelemetry:
+    def test_transaction_journal_and_counters(self, model, monkeypatch,
+                                              tmp_path):
+        journal = tmp_path / "wsync.jsonl"
+        monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        monkeypatch.setenv("MXNET_TELEMETRY_JOURNAL", str(journal))
+        tel.reset()
+        tel.reload()
+        try:
+            cfg, params = model
+            pub = WeightPublisher(bind=("127.0.0.1", 0))
+            pub.start()
+            try:
+                eng = _mk_engine(model)
+                sub = WeightSubscriber(eng, _addr(pub), rank=0)
+                v1 = _perturb(params, 0.02, seed=13)
+                pub.publish(v1)
+                assert sub.sync_once(wait=5.0) == 1
+                poisoned = {k: np.asarray(v).copy() for k, v in
+                            wc.flatten_params(v1).items()}
+                poisoned["embed"].flat[0] = np.nan
+                pub.publish(wc.unflatten_params(poisoned))
+                assert sub.sync_once(wait=5.0) is None
+                eng.rollback_weights()
+            finally:
+                pub.close()
+            tel.flush(mark="exit")
+            recs = [json.loads(l) for l in
+                    journal.read_text().splitlines() if l.strip()]
+            ws = [r for r in recs if r.get("kind") == "wsync"]
+            by_event = {}
+            for r in ws:
+                by_event.setdefault(r["event"], []).append(r)
+            assert [r["version"] for r in by_event["published"]] == [1, 2]
+            # one trace id per transaction: staged and applied share it
+            (applied,) = by_event["applied"]
+            assert applied["version"] == 1 and applied["trace"]
+            assert applied["trace"] in [
+                r["trace"] for r in by_event["staged"]]
+            (rejected,) = by_event["rejected"]
+            assert rejected["version"] == 2
+            assert "non-finite" in rejected["reason"]
+            (rolled,) = by_event["rolled_back"]
+            assert rolled["from_version"] == 1
+            outcomes = [r["outcome"] for r in by_event["ack"]]
+            assert outcomes[0] == "applied"
+            assert outcomes[1].startswith("rejected:")
+            snap = tel.snapshot()
+            c = snap["counters"]
+            assert c["wsync.versions_published_total"] == 2
+            assert c["wsync.versions_applied_total"] == 1
+            assert c["wsync.rejected_total"] == 1
+            assert c["wsync.rollbacks_total"] == 1
+            assert c["wsync.acks_total"] == 2
+            assert c["wsync.tensors_fetched_total"] >= 1
+            assert snap["histograms"]["wsync.apply_secs"]["count"] == 1
+            # rollback consumed the only ring entry: back on the
+            # pre-sync params (version None -> gauge 0)
+            assert eng.weight_version() is None
+            assert snap["gauges"]["wsync.current_version"] == 0
+        finally:
+            monkeypatch.undo()
+            tel.reset()
+            tel.reload()

@@ -22,6 +22,7 @@ Usage::
     python tools/chaos.py --proto       # protocol message-schedule legs
     python tools/chaos.py --jit         # mxjit compile/transfer legs
     python tools/chaos.py --controller  # mxctl closed-loop autonomy legs
+    python tools/chaos.py --wsync       # live weight-sync survival legs
 
 The spec is derived deterministically from --seed: per point, a fire
 probability in [0.02, 0.15] and a per-point RNG seed. Same seed, same
@@ -545,6 +546,48 @@ def _run_elastic_leg(tag, scratch, port, timeout, extra_env=None,
     return rc, accs, counters, out
 
 
+def _elastic_snapshot_leg(scratch):
+    """Live-coordinator snapshot RPC: an in-process coordinator started
+    with a snapshot prefix is asked to dump NOW through
+    ``ElasticClient.snapshot()`` — the feed a wsync CheckpointWatcher
+    publishes from (docs/how_to/weight_sync.md) — and the ``.params``
+    file that lands must pass the same structural scan the torn-file
+    check uses. Returns a failure string, or None."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from mxnet_tpu.elastic.client import ElasticClient
+    from mxnet_tpu.elastic.server import ElasticCoordinator
+
+    prefix = os.path.join(scratch, "coord-snap")
+    coord = ElasticCoordinator(world=1, bind=("127.0.0.1", 0),
+                               snapshot_prefix=prefix)
+    coord.start()
+    try:
+        client = ElasticClient("%s:%d" % coord.addr, rank=0)
+        client.wait_ready(20.0)
+        client.register()
+        resp = client.snapshot()
+        if resp.get("status") != "ok":
+            return "snapshot leg: coordinator answered %r" % (resp,)
+        # assert the files BEFORE stop(): the final-snapshot-on-stop
+        # path must not be what makes this leg pass
+        missing = [p for p in (prefix + ".params", prefix + ".meta")
+                   if not os.path.exists(p)]
+        if missing:
+            return ("snapshot leg: snapshot RPC answered ok but wrote "
+                    "no %s" % ", ".join(missing))
+        if not _params_ok(prefix + ".params"):
+            return ("snapshot leg: snapshot .params failed the "
+                    "structural (torn-file) scan")
+        client.leave()
+    except Exception as e:  # noqa: BLE001 - any RPC failure fails the leg
+        return "snapshot leg: %s: %s" % (type(e).__name__, e)
+    finally:
+        coord.stop()
+    return None
+
+
 def _run_trace_merge(scratch, tag):
     """tools/trace_merge.py over one leg's per-rank journals. Returns
     (output, parsed report dict or None). The Perfetto trace lands next
@@ -655,6 +698,12 @@ def run_elastic(args):
             failures.append("trace-merge leg: Perfetto trace unreadable "
                             "(%s)" % e)
 
+    print("chaos --elastic: snapshot leg (live ElasticClient.snapshot "
+          "RPC against a prefix-armed coordinator)")
+    snap_fail = _elastic_snapshot_leg(scratch)
+    if snap_fail:
+        failures.append(snap_fail)
+
     print("\n=== elastic survival report ===")
     timing_env, _rd = _elastic_timing()
     print("evict window    : %ss (jitter slack %ss)"
@@ -666,6 +715,9 @@ def run_elastic(args):
           % (rc1, sorted(survivors), {r: round(a, 3)
                                       for r, a in survivors.items()}))
     print("rejoin leg      : rc=%d finished=%s" % (rc2, sorted(accs2)))
+    print("snapshot leg    : %s" % ("FAILED" if snap_fail
+                                    else "ok (snapshot RPC wrote a "
+                                         "structurally valid .params)"))
     if merge_rep is not None:
         rep = merge_rep.get("report", {})
         print("trace merge     : straggler=rank %s truncated=%s "
@@ -1912,6 +1964,456 @@ def run_controller(args):
     return 0
 
 
+# -- live weight-sync survival legs (ISSUE 17) --------------------------------
+# The wsync acceptance contract (docs/how_to/weight_sync.md): a LOADED
+# engine hot-swaps published versions with p99 TTFT inside 1.10x its own
+# no-sync baseline and lands byte-identical to a cold engine started
+# from the same version's checkpoint; a publisher SIGKILLed mid-stream
+# leaves the engine on its last complete version with zero non-finite
+# live params; a NaN-poisoned version is refused end to end
+# (wsync.rejected_total >= 1); and a cratered spec-accept window drives
+# the mxctl rollback_weights rule back to the prior version — all
+# asserted from the {"kind": "wsync"} journal records and wsync.*
+# counters, one trace id per transaction.
+
+
+def _wsync_events(path, event=None):
+    """Every ``{"kind": "wsync"}`` journal record (optionally one
+    event type), in file order."""
+    out = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") != "wsync":
+                    continue
+                if event is not None and rec.get("event") != event:
+                    continue
+                out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def run_wsync(args):
+    """The gated live trainer->serving weight-sync survival legs."""
+    import dataclasses
+    import signal
+    import threading
+
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-wsync-")
+    port = 29920 + (args.seed % 97) * 3
+    journal = os.path.join(scratch, "wsync-journal.jsonl")
+    # env BEFORE the mxnet_tpu import: the in-process engine, publisher,
+    # subscriber and controller all journal into ONE file
+    os.environ.update({
+        "JAX_PLATFORMS": "cpu",
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": journal,
+        "MXNET_WSYNC": "1",
+    })
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import jax
+    import numpy as np
+
+    import mxnet_tpu.telemetry as tel
+    tel.reload()
+    from mxnet_tpu.control.config import ControlConfig
+    from mxnet_tpu.control.controller import Controller
+    from mxnet_tpu.control.probes import TargetSample, serving_metrics
+    from mxnet_tpu.control.rules import parse_rules
+    from mxnet_tpu.models.transformer import TransformerConfig, init_params
+    from mxnet_tpu.serving import Engine, ServingConfig
+    from mxnet_tpu.wsync import common as wc
+    from mxnet_tpu.wsync.publisher import WeightPublisher
+    from mxnet_tpu.wsync.subscriber import WeightSubscriber
+
+    failures = []
+    rng = np.random.default_rng(args.seed)
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, d_model=32,
+                            num_heads=2, d_ff=64, max_seq_len=96,
+                            dtype="float32")
+    params0 = init_params(cfg, jax.random.PRNGKey(0))
+    dcfg = dataclasses.replace(cfg, num_layers=1)
+
+    def draft_of(params):
+        # aligned draft (shared embeddings + first target layer): the
+        # spec accept rate stays HIGH on every healthy version, so the
+        # rollback leg's crater is unambiguous
+        return {"embed": params["embed"], "pos_embed": params["pos_embed"],
+                "layers": params["layers"][:1], "ln_f": params["ln_f"]}
+
+    def perturb(tree, scale):
+        flat = {}
+        for k, v in wc.flatten_params(tree).items():
+            a = np.asarray(v)
+            if np.issubdtype(a.dtype, np.floating):
+                a = a + rng.standard_normal(a.shape).astype(a.dtype) * scale
+            flat[k] = a
+        return wc.unflatten_params(flat)
+
+    def fp_diff(flat_a, flat_b):
+        keys = sorted(set(flat_a) | set(flat_b))
+        return [k for k in keys
+                if k not in flat_a or k not in flat_b
+                or wc.fingerprint(np.asarray(flat_a[k]))
+                != wc.fingerprint(np.asarray(flat_b[k]))]
+
+    scfg = ServingConfig(block_size=8, num_blocks=33, max_batch=4,
+                         prefill_chunk=16, token_budget=64,
+                         spec=True, spec_k=3)
+    eng = Engine(params0, cfg, scfg, draft_params=draft_of(params0),
+                 draft_cfg=dcfg)
+    eng.start()
+
+    def load(n, max_new=8):
+        hs = []
+        for i in range(n):
+            prompt = np.asarray([(5 * i + j) % 50 + 1 for j in range(6)],
+                                np.int32)
+            hs.append(eng.submit(prompt, max_new_tokens=max_new))
+        return [h.result(timeout=120) for h in hs]
+
+    versions = {v: perturb(params0, 0.02 * v) for v in (1, 2, 3)}
+
+    # -- leg a: loaded sync (TTFT degradation under live swaps) --------
+    print("chaos --wsync: loaded-sync leg (3 versions hot-swapped under "
+          "load; p99 TTFT vs the engine's own no-sync baseline)")
+    # warm the jit cache FIRST, at the same concurrency profile the
+    # measured windows use: compile time is not serving TTFT, and a
+    # narrower warmup leaves batch buckets compiling inside the baseline
+    load(24)
+    n_warm = len(eng.latency_samples()[0])
+    pub = WeightPublisher(bind=("127.0.0.1", port))
+    pub.start()
+    sub = WeightSubscriber(eng, "127.0.0.1:%d" % port, rank=0)
+    stop_load = threading.Event()
+
+    def pump():
+        while not stop_load.is_set():
+            try:
+                load(2)
+            except Exception:  # noqa: BLE001 - a dead pump = no sync TTFTs, asserted below
+                return
+
+    pump_t = threading.Thread(target=pump, daemon=True)
+    pump_t.start()
+    applied = []
+    try:
+        # the no-sync baseline window: the SAME pump load the sync
+        # windows see, so the two p99s differ only by the swaps
+        time.sleep(3.0)
+        base_ttfts = eng.latency_samples()[0][n_warm:]
+        base_p99 = (float(np.percentile(np.asarray(base_ttfts), 99))
+                    if base_ttfts else None)
+        for v in (1, 2, 3):
+            pub.publish(versions[v], draft_of(versions[v]))
+            applied.append(sub.sync_once(wait=10.0))
+            time.sleep(1.2)   # serve inside the post-swap TTFT window
+    finally:
+        stop_load.set()
+        pump_t.join(timeout=120)
+    if applied != [1, 2, 3]:
+        failures.append("loaded-sync leg: applied versions %s, expected "
+                        "[1, 2, 3]" % (applied,))
+    sync_p99 = eng.stats()["ttft_sync_p99_s"]
+    if sync_p99 is None or base_p99 is None:
+        failures.append("loaded-sync leg: missing TTFT samples "
+                        "(baseline %s, during-sync %s)"
+                        % (base_p99, sync_p99))
+    # 25ms absolute floor: at this tiny model's millisecond TTFTs a
+    # shared box's scheduler jitter dwarfs 10% — the ratio gate applies
+    # above it (tools/perf_gate.py holds the baseline-file line)
+    elif sync_p99 > base_p99 * 1.10 + 0.025:
+        failures.append("loaded-sync leg: p99 TTFT during sync %.4fs "
+                        "exceeds 1.10x the no-sync baseline %.4fs"
+                        % (sync_p99, base_p99))
+
+    # -- leg b: NaN-poisoned version refused ---------------------------
+    print("chaos --wsync: poisoned-version leg (NaN tensor refused by "
+          "the finiteness gate, live params untouched)")
+    pflat = wc.flatten_params(perturb(versions[3], 0.01))
+    k0 = sorted(k for k in pflat
+                if np.issubdtype(np.asarray(pflat[k]).dtype,
+                                 np.floating))[0]
+    poisoned = np.array(pflat[k0], copy=True)
+    poisoned.flat[0] = np.nan
+    pflat[k0] = poisoned
+    pub.publish(wc.unflatten_params(pflat), draft_of(versions[3]),
+                version=4)
+    got4 = sub.sync_once(wait=5.0)
+    if got4 is not None:
+        failures.append("poisoned leg: version 4 applied (%s) despite "
+                        "the NaN in %s" % (got4, k0))
+    if eng.weight_version() != 3:
+        failures.append("poisoned leg: engine moved to version %s, "
+                        "expected to stay on 3" % (eng.weight_version(),))
+    bad = wc.nonfinite_keys(wc.combine_draft(eng.params, eng.draft_params))
+    if bad:
+        failures.append("poisoned leg: non-finite LIVE params after the "
+                        "refusal: %s" % sorted(bad))
+
+    # -- leg c: cratered spec accept -> mxctl rollback_weights ---------
+    print("chaos --wsync: rollback leg (garbage weights crater the "
+          "spec-accept window; the mxctl rule must fire "
+          "rollback_weights)")
+    garbage = wc.unflatten_params({
+        k: (rng.standard_normal(np.shape(np.asarray(v)))
+            .astype(np.asarray(v).dtype)
+            if np.issubdtype(np.asarray(v).dtype, np.floating)
+            else np.asarray(v))
+        for k, v in wc.flatten_params(versions[3]).items()})
+    # the OLD draft rides along: target garbage vs a draft aligned to
+    # the previous target = near-zero accept rate, the signal the
+    # shipped rule recipe (docs/how_to/control_plane.md) reads
+    pub.publish(garbage, draft_of(versions[3]), version=5)
+    got5 = sub.sync_once(wait=5.0)
+    if got5 != 5:
+        failures.append("rollback leg: garbage version 5 did not apply "
+                        "(%s) — the crater needs it live" % (got5,))
+    load(8)  # populate the spec accept window on the garbage weights
+
+    class _EngineProbe:
+        def sample(self, now=None):
+            m = serving_metrics({"engines": [eng.introspect()]})
+            m.update({"alive": 1.0, "ready": 1.0})
+            return TargetSample("serving0", "serving", m,
+                                {"url": "chaos://in-process"})
+
+    ctl = Controller(
+        ControlConfig(
+            targets={},
+            rules=parse_rules("spec_accept_rate<0.5:for=3:"
+                              "action=rollback_weights:scope=serving:"
+                              "cooldown=60"),
+            interval=0.2,
+            state_path=os.path.join(scratch, "mxctl-state.json")),
+        probes=[_EngineProbe()])
+    fired = False
+    for _ in range(8):
+        load(4)
+        if any(d.rule.action == "rollback_weights" for d in ctl.step()):
+            fired = True
+            break
+        time.sleep(0.2)
+    if not fired:
+        failures.append("rollback leg: the spec_accept_rate rule never "
+                        "fired (window rate %s)"
+                        % (eng.stats()["spec_accept_rate_window"],))
+    if eng.weight_version() != 3:
+        failures.append("rollback leg: engine on version %s after the "
+                        "rollback, expected the prior good version 3"
+                        % (eng.weight_version(),))
+    else:
+        diff = fp_diff(wc.flatten_params(eng.params),
+                       wc.flatten_params(versions[3]))
+        if diff:
+            failures.append("rollback leg: restored params differ from "
+                            "version 3 on %d tensors (e.g. %s)"
+                            % (len(diff), diff[:3]))
+
+    # -- leg d: byte parity vs a cold engine from the checkpoint -------
+    print("chaos --wsync: byte-parity leg (hot-swapped+rolled-back "
+          "engine vs a cold engine from the version-3 checkpoint)")
+    ck = os.path.join(scratch, "parity-ck")
+    wc.save_weights_checkpoint(ck, 3, versions[3], draft_of(versions[3]))
+    cold_params, cold_draft = wc.load_weights_checkpoint(ck, 3)
+    cold = Engine(cold_params, cfg, scfg, draft_params=cold_draft,
+                  draft_cfg=dcfg)
+    diff = fp_diff(wc.combine_draft(eng.params, eng.draft_params),
+                   wc.combine_draft(cold.params, cold.draft_params))
+    if diff:
+        failures.append("byte-parity leg: %d tensors differ between the "
+                        "hot and cold engines (e.g. %s)"
+                        % (len(diff), diff[:3]))
+    parity_prompt = np.asarray([7, 11, 13, 17, 19, 23], np.int32)
+    hot_toks = eng.submit(parity_prompt,
+                          max_new_tokens=12).result(timeout=120)
+    cold_toks = cold.generate([parity_prompt], max_new_tokens=12)[0]
+    if list(hot_toks) != list(cold_toks):
+        failures.append("byte-parity leg: greedy streams diverge — hot "
+                        "%s vs cold %s" % (hot_toks, cold_toks))
+
+    # -- leg e: publisher SIGKILL mid-stream ---------------------------
+    print("chaos --wsync: publisher-SIGKILL leg (throttled stream "
+          "killed mid-fetch; the engine must stay on the last "
+          "complete version)")
+    ck2 = os.path.join(scratch, "stream-ck")
+    v1p, v2p = perturb(params0, 0.015), perturb(params0, 0.025)
+    wc.save_weights_checkpoint(ck2, 1, v1p, draft_of(v1p))
+    eng2 = Engine(params0, cfg, scfg, draft_params=draft_of(params0),
+                  draft_cfg=dcfg)
+    n_keys = len(wc.combine_draft(v1p, draft_of(v1p)))
+    throttle = 0.08
+    penv = dict(os.environ)
+    penv.update({
+        "PYTHONPATH": REPO + os.pathsep + penv.get("PYTHONPATH", ""),
+        "MXNET_TELEMETRY_JOURNAL": os.path.join(
+            scratch, "wsync-pub-journal.jsonl"),
+        "MXNET_TELEMETRY_FLUSH_SECS": "1",
+    })
+    plog = os.path.join(scratch, "wsync-pub.log")
+    pproc = _spawn_logged(
+        [sys.executable, "-m", "mxnet_tpu.wsync.publisher",
+         "--bind", "127.0.0.1:%d" % (port + 1),
+         "--watch", ck2, "--interval", "0.2",
+         "--throttle", "%g" % throttle], penv, plog)
+    sub2 = WeightSubscriber(eng2, "127.0.0.1:%d" % (port + 1), rank=1)
+    got1 = None
+    deadline = time.time() + max(60.0, 4 * throttle * n_keys)
+    while got1 is None and time.time() < deadline:
+        try:
+            got1 = sub2.sync_once(wait=2.0)
+        except Exception:  # noqa: BLE001 - publisher still importing
+            time.sleep(0.3)
+    if got1 != 1:
+        failures.append("publisher-SIGKILL leg: version 1 never applied "
+                        "(got %s) — publisher log tail:\n%s"
+                        % (got1, _stop_proc(pproc, plog,
+                                            grace=5.0)[1][-1500:]))
+    else:
+        wc.save_weights_checkpoint(ck2, 2, v2p, draft_of(v2p))
+        holder = {}
+
+        def fetch_v2():
+            try:
+                holder["v"] = sub2.sync_once(wait=15.0)
+            except Exception as e:  # noqa: BLE001 - asserted below
+                holder["err"] = e
+
+        t2 = threading.Thread(target=fetch_v2, daemon=True)
+        t2.start()
+        # ~40% through the throttled transfer: mid-stream by
+        # construction (watch poll 0.2s + the manifest fetch land well
+        # inside the first second; the transfer takes throttle*n_keys)
+        time.sleep(1.0 + 0.4 * throttle * n_keys)
+        try:
+            os.killpg(pproc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        t2.join(timeout=120)
+        pproc.wait()
+        if t2.is_alive():
+            failures.append("publisher-SIGKILL leg: subscriber hung "
+                            "after the kill (no abort)")
+        elif holder.get("v") is not None:
+            failures.append("publisher-SIGKILL leg: torn version 2 "
+                            "reported applied (%s)" % (holder["v"],))
+        if eng2.weight_version() != 1:
+            failures.append("publisher-SIGKILL leg: engine on version "
+                            "%s, not the last complete version 1"
+                            % (eng2.weight_version(),))
+        bad = wc.nonfinite_keys(wc.combine_draft(eng2.params,
+                                                 eng2.draft_params))
+        if bad:
+            failures.append("publisher-SIGKILL leg: non-finite live "
+                            "params after the torn fetch: %s"
+                            % sorted(bad))
+        want1 = wc.combine_draft(*wc.load_weights_checkpoint(ck2, 1))
+        diff = fp_diff(wc.combine_draft(eng2.params, eng2.draft_params),
+                       want1)
+        if diff:
+            failures.append("publisher-SIGKILL leg: live params differ "
+                            "from the complete version-1 checkpoint on "
+                            "%d tensors" % len(diff))
+
+    # -- journal assertions (the chaos contract: prove it from disk) ---
+    eng.stop()
+    pub.close()
+    tel.flush(mark="exit")
+    counters = fold_telemetry(journal)
+    events = _wsync_events(journal)
+    # one trace id per transaction: every applied record must pair with
+    # a staged record carrying the SAME (version, trace) — version alone
+    # is not enough (two engines each stage their own version 1)
+    staged_pairs = {(e.get("version"), e.get("trace"))
+                    for e in events if e.get("event") == "staged"}
+    for e in events:
+        if e.get("event") != "applied":
+            continue
+        if (e.get("trace") is None
+                or (e.get("version"), e.get("trace")) not in staged_pairs):
+            failures.append("journal: applied version %s does not carry "
+                            "its staged transaction's trace id"
+                            % e.get("version"))
+    rejected4 = [e for e in events if e.get("event") == "rejected"
+                 and e.get("version") == 4]
+    if not rejected4 or "non-finite" not in str(
+            rejected4[0].get("reason", "")):
+        failures.append("journal: no non-finite 'rejected' record for "
+                        "version 4 (%s)" % rejected4)
+    aborted2 = [e for e in events if e.get("event") == "aborted"
+                and e.get("version") == 2]
+    if not aborted2:
+        failures.append("journal: no 'aborted' record for the torn "
+                        "version-2 transaction")
+    elif not any(e.get("fetched", 0) >= 1 for e in aborted2):
+        failures.append("journal: the version-2 abort shows 0 fetched "
+                        "tensors — the kill missed the stream window")
+    rolled = [e for e in events if e.get("event") == "rolled_back"]
+    if not any(e.get("from_version") == 5 for e in rolled):
+        failures.append("journal: no 'rolled_back' record from version "
+                        "5 (%s)" % rolled)
+    for name, floor in (("wsync.versions_published_total", 5),
+                        ("wsync.versions_applied_total", 4),
+                        ("wsync.rejected_total", 1),
+                        ("wsync.aborted_total", 1),
+                        ("wsync.rollbacks_total", 1),
+                        ("wsync.acks_total", 4),
+                        ("wsync.tensors_fetched_total", n_keys)):
+        if counters.get(name, 0) < floor:
+            failures.append("journal: counter %s=%s below the expected "
+                            "floor %d" % (name, counters.get(name, 0),
+                                          floor))
+    # the SIGKILLed publisher flushed periodically (1s cadence): its own
+    # journal must still show the version-1 publish it completed
+    pub_published = _wsync_events(penv["MXNET_TELEMETRY_JOURNAL"],
+                                  event="published")
+    if not pub_published:
+        failures.append("journal: the SIGKILLed publisher's own journal "
+                        "recorded no 'published' transitions")
+
+    print("\n=== wsync survival report ===")
+    print("loaded sync     : applied=%s p99 TTFT %.4fs during sync vs "
+          "%.4fs baseline (bound 1.10x + 25ms jitter floor)"
+          % (applied, sync_p99 or -1, base_p99 or -1))
+    print("poisoned v4     : %s"
+          % ("refused" if got4 is None else "APPLIED (%s)" % got4))
+    print("rollback        : rule fired=%s, engine back on version %s"
+          % (fired, eng.weight_version()))
+    print("publisher kill  : engine2 on version %s after the torn fetch"
+          % (eng2.weight_version(),))
+    print("counters        : published=%d applied=%d rejected=%d "
+          "aborted=%d rollbacks=%d acks=%d tensors=%d bytes=%d"
+          % (counters.get("wsync.versions_published_total", 0),
+             counters.get("wsync.versions_applied_total", 0),
+             counters.get("wsync.rejected_total", 0),
+             counters.get("wsync.aborted_total", 0),
+             counters.get("wsync.rollbacks_total", 0),
+             counters.get("wsync.acks_total", 0),
+             counters.get("wsync.tensors_fetched_total", 0),
+             counters.get("wsync.bytes_fetched_total", 0)))
+    if failures:
+        print("\nRESULT: FAIL")
+        for f in failures:
+            print(" - %s" % f)
+        return 10
+    print("\nRESULT: SURVIVED — live weight sync swapped versions under "
+          "load inside the TTFT bound, refused the poisoned version, "
+          "stayed on the last complete version through a mid-stream "
+          "publisher SIGKILL, rolled back a quality crater via the "
+          "mxctl rule, and byte-matched a cold engine — all proven "
+          "from the journal.")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run the test suite under a seeded fault spec")
@@ -1992,6 +2494,18 @@ def main(argv=None):
                          "healthy replica draws ZERO actions (hysteresis "
                          "negative control) — all asserted from the "
                          "mxctl.* decision journal")
+    ap.add_argument("--wsync", action="store_true",
+                    help="run the live weight-sync survival legs "
+                         "(ISSUE 17): a loaded engine hot-swaps "
+                         "published versions inside 1.10x its no-sync "
+                         "p99 TTFT and byte-matches a cold engine from "
+                         "the same version's checkpoint; a publisher "
+                         "SIGKILLed mid-stream leaves the last complete "
+                         "version live; a NaN-poisoned version is "
+                         "refused (wsync.rejected_total >= 1); a "
+                         "cratered spec-accept window fires the mxctl "
+                         "rollback_weights rule — all asserted from "
+                         "the wsync journal records and counters")
     ap.add_argument("--controller-legs", default="all",
                     metavar="LEGS",
                     help="comma subset of the --controller legs: "
@@ -2000,6 +2514,8 @@ def main(argv=None):
                     help="explicit test paths (default: smoke set)")
     args = ap.parse_args(argv)
 
+    if args.wsync:
+        return run_wsync(args)
     if args.controller:
         return run_controller(args)
     if args.data:

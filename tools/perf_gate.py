@@ -31,6 +31,10 @@ simply not compared):
 ``prof_step_p50_s`` ``prof.step_secs`` p50 — chunk/step decomposition total
 ``samples_per_sec`` max ``train.samples_per_sec`` over the run's snapshots
 ``tokens_per_s``    max ``serving.tokens_per_s`` over the run's snapshots
+``ttft_sync_p99_s``  ``serving.ttft_sync_s`` p99 — TTFT of requests served
+                    inside a wsync hot-swap window (lower is better; held
+                    within 1.10x of a no-sync ``ttft_p99_s`` baseline by
+                    tools/baselines/wsync_perf.json)
 ``mfu``             last ``prof.mfu`` (mxprof derived, prof.py)
 ``peak_hbm_bytes``  max ``prof.hbm_peak_bytes`` (lower is better)
 ``recompiles_total``  ``compile.recompiles_total`` final counter — unexpected
@@ -61,7 +65,7 @@ import sys
 #: metrics where smaller is better; everything else is a throughput
 LOWER_IS_BETTER = frozenset((
     "step_p50_s", "prof_step_p50_s", "peak_hbm_bytes", "cold_start_jit_s",
-    "ttft_p99_s", "recompiles_total",
+    "ttft_p99_s", "ttft_sync_p99_s", "recompiles_total",
 ))
 
 #: metrics gated even when the baseline is 0: a ratio band can't hold a
@@ -72,8 +76,8 @@ ZERO_GATED = frozenset(("recompiles_total",))
 #: parsed-record fields a BENCH_r*.json baseline contributes
 _BENCH_FIELDS = ("mfu", "tokens_per_s", "step_p50_s", "samples_per_sec",
                  "peak_hbm_bytes", "prof_step_p50_s", "ttft_p99_s",
-                 "spec_accept_rate", "recompiles_total",
-                 "jit_cache_hit_rate")
+                 "ttft_sync_p99_s", "spec_accept_rate",
+                 "recompiles_total", "jit_cache_hit_rate")
 
 
 def load_journal(path):
@@ -107,6 +111,15 @@ def derive_metrics(records):
         h = final.get("histograms", {}).get("serving.ttft_s")
         if h and h.get("p99") is not None:
             out["ttft_p99_s"] = float(h["p99"])
+        # weight-sync degradation: p99 TTFT of requests whose first
+        # token landed inside a hot-swap window (wsync install +
+        # MXNET_WSYNC_TTFT_WINDOW). The line held against a no-sync
+        # baseline's ttft_p99_s under the default 10% tolerance IS the
+        # "<1.10x degradation during sync" acceptance bound
+        # (docs/how_to/weight_sync.md)
+        h = final.get("histograms", {}).get("serving.ttft_sync_s")
+        if h and h.get("p99") is not None:
+            out["ttft_sync_p99_s"] = float(h["p99"])
         # speculative-decoding health: cumulative accept rate (a falling
         # rate means the draft stopped paying for itself)
         g = final.get("gauges", {}).get("serving.spec_accept_rate")
@@ -287,16 +300,23 @@ def run_gate(journals, baseline_path, tolerance, write_baseline=None,
 
 
 # -- selftest (the chaos.py smoke leg) ----------------------------------------
-def _fake_journal(path, step_p50, samples, mfu, hbm, counters=None):
+def _fake_journal(path, step_p50, samples, mfu, hbm, counters=None,
+                  ttft_sync=None):
+    hists = {"train.step_secs": {
+        "count": 100, "sum": step_p50 * 100, "min": step_p50,
+        "max": step_p50, "p50": step_p50, "p95": step_p50,
+        "p99": step_p50}}
+    if ttft_sync is not None:
+        hists["serving.ttft_sync_s"] = {
+            "count": 40, "sum": ttft_sync * 40, "min": ttft_sync,
+            "max": ttft_sync, "p50": ttft_sync, "p95": ttft_sync,
+            "p99": ttft_sync}
     rec = {
         "kind": "metrics", "t": 0.0, "mark": "exit",
         "counters": dict(counters or {}),
         "gauges": {"train.samples_per_sec": samples, "prof.mfu": mfu,
                    "prof.hbm_peak_bytes": hbm},
-        "histograms": {"train.step_secs": {
-            "count": 100, "sum": step_p50 * 100, "min": step_p50,
-            "max": step_p50, "p50": step_p50, "p95": step_p50,
-            "p99": step_p50}},
+        "histograms": hists,
     }
     with open(path, "w", encoding="utf-8") as f:
         f.write(json.dumps({"kind": "meta", "t": 0.0, "pid": 0, "rank": 0,
@@ -337,16 +357,35 @@ def selftest(out=sys.stdout):
                             "compile.cache_hits_total": 9,
                             "compile.cache_misses_total": 1})
     rc_storm = run_gate([storm], basefile, 0.10, out=out)
+    # sync-degradation leg: the shipped wsync baseline's contract is
+    # "p99 TTFT during a weight hot-swap within 1.10x of baseline" —
+    # the 10% tolerance band IS the bound, so a run 8% over passes and
+    # one 50% over regresses
+    syncbase = os.path.join(d, "sync-baseline.json")
+    syncgood = os.path.join(d, "sync-good.jsonl")
+    syncbad = os.path.join(d, "sync-bad.jsonl")
+    _fake_journal(os.path.join(d, "sync-ref.jsonl"), step_p50=0.020,
+                  samples=5000.0, mfu=0.68, hbm=1.0e9, ttft_sync=0.010)
+    rc_syncbase = run_gate([os.path.join(d, "sync-ref.jsonl")], None,
+                           0.10, write_baseline=syncbase, out=out)
+    _fake_journal(syncgood, step_p50=0.020, samples=5000.0, mfu=0.68,
+                  hbm=1.0e9, ttft_sync=0.0108)
+    _fake_journal(syncbad, step_p50=0.020, samples=5000.0, mfu=0.68,
+                  hbm=1.0e9, ttft_sync=0.015)
+    rc_sync_pass = run_gate([syncgood], syncbase, 0.10, out=out)
+    rc_sync_regress = run_gate([syncbad], syncbase, 0.10, out=out)
     empty = os.path.join(d, "empty-baseline.json")
     with open(empty, "w", encoding="utf-8") as f:
         f.write("{\"metrics\": {\"some_other_metric\": 1.0}}\n")
     rc_missing = run_gate([good], empty, 0.10, out=out)
     ok = (rc_base == 0 and rc_pass == 0 and rc_regress == 1
-          and rc_storm == 1 and rc_missing == 2)
+          and rc_storm == 1 and rc_syncbase == 0 and rc_sync_pass == 0
+          and rc_sync_regress == 1 and rc_missing == 2)
     print("perf_gate selftest: baseline=%d pass=%d regress=%d storm=%d "
-          "missing=%d -> %s" % (rc_base, rc_pass, rc_regress, rc_storm,
-                                rc_missing, "OK" if ok else "BROKEN"),
-          file=out)
+          "sync=%d/%d/%d missing=%d -> %s"
+          % (rc_base, rc_pass, rc_regress, rc_storm, rc_syncbase,
+             rc_sync_pass, rc_sync_regress, rc_missing,
+             "OK" if ok else "BROKEN"), file=out)
     return 0 if ok else 1
 
 

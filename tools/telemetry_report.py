@@ -20,7 +20,10 @@ Journals carrying serving or control-plane activity additionally get a
 serving section (tokens/s timeline, TTFT percentiles) and an mxctl
 section: the controller's decision journal rendered as a timeline —
 rule fired -> action taken -> outcome -> recovery, trace ids linking
-each firing to the affected replica's spans.
+each firing to the affected replica's spans. Journals with live
+weight-sync records get a wsync section: the version timeline
+(published -> staged -> applied / rejected / aborted / rolled back,
+one trace id per transaction) plus the final wsync.* counters.
 
 Given SEVERAL journals (one per rank of an elastic job), a cross-rank
 section is prepended: per-rank step-time / barrier-wait table plus the
@@ -326,6 +329,75 @@ def controller_section(records):
     return lines
 
 
+def wsync_section(records):
+    """Rendered lines for the live weight-sync layer, or [] when the
+    journal has no ``{"kind": "wsync"}`` records: the version timeline
+    (published -> staged -> applied / rejected / aborted, plus
+    rollbacks), one line per transition with the transaction's trace id
+    — the same id every record of one sync transaction shares
+    (docs/how_to/weight_sync.md) — and the final wsync.* counters."""
+    events = [r for r in records if r.get("kind") == "wsync"]
+    final = final_metrics(records)
+    counters = {k: v
+                for k, v in sorted(((final or {}).get("counters",
+                                                      {})).items())
+                if k.startswith("wsync.")}
+    if not events and not counters:
+        return []
+    lines = ["", "-- weight sync (wsync) --"]
+    events.sort(key=lambda r: r.get("t", 0.0))
+    t0 = events[0].get("t", 0.0) if events else 0.0
+    lines.append("  version timeline:")
+    for e in events:
+        dt = e.get("t", 0.0) - t0
+        ev = e.get("event", "?")
+        v = e.get("version")
+        if ev == "published":
+            detail = "%d tensors, %s%s" % (
+                e.get("tensors", 0), _human_bytes(e.get("bytes", 0)),
+                ", +draft" if e.get("draft") else "")
+        elif ev == "staged":
+            detail = "%d/%d tensors fetched (%s; rest delta-skipped)" % (
+                e.get("fetched", 0), e.get("tensors", 0),
+                _human_bytes(e.get("bytes", 0)))
+        elif ev == "applied":
+            detail = "ring depth %d%s" % (
+                e.get("ring", 0), ", +draft" if e.get("draft") else "")
+        elif ev in ("rejected", "aborted"):
+            detail = e.get("reason", "?")
+            if ev == "aborted":
+                detail += " (after %d tensors)" % e.get("fetched", 0)
+        elif ev == "rolled_back":
+            detail = "from version %s" % (e.get("from_version"),)
+        elif ev == "ack":
+            detail = "rank %s -> %s" % (e.get("rank"), e.get("outcome"))
+        else:
+            detail = ""
+        trace = e.get("trace")
+        lines.append("  t+%7.1fs %-11s v%-5s %s%s" % (
+            dt, ev.upper(), v if v is not None else "-", detail,
+            ("  [trace %s]" % trace) if trace else ""))
+    gauges = (final or {}).get("gauges", {})
+    cur = gauges.get("wsync.current_version")
+    pub = gauges.get("wsync.published_version")
+    if cur is not None or pub is not None:
+        lines.append("  final: engine on v%s, publisher at v%s" % (
+            int(cur) if cur is not None else "?",
+            int(pub) if pub is not None else "?"))
+    if counters:
+        lines.append("  counters: " + "  ".join(
+            "%s=%d" % (k.split("wsync.")[-1], v)
+            for k, v in counters.items()))
+    hists = (final or {}).get("histograms", {})
+    s = hists.get("serving.ttft_sync_s")
+    if s:
+        lines.append("  TTFT inside sync windows: count %d p50 %.6g "
+                     "p99 %.6g max %.6g (perf_gate ttft_sync_p99_s)"
+                     % (s.get("count", 0), s.get("p50") or 0,
+                        s.get("p99") or 0, s.get("max") or 0))
+    return lines
+
+
 def _human_bytes(n):
     for unit in ("B", "KB", "MB", "GB"):
         if n < 1024.0 or unit == "GB":
@@ -369,6 +441,7 @@ def render_report(records, top=10):
 
     lines.extend(profiling_section(records))
     lines.extend(serving_section(records))
+    lines.extend(wsync_section(records))
     lines.extend(controller_section(records))
 
     lines.append("")
