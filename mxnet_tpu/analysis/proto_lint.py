@@ -95,6 +95,11 @@ DEFAULT_TARGETS = (
     os.path.join(_PKG, "wsync", "client.py"),
     os.path.join(_PKG, "wsync", "publisher.py"),
     os.path.join(_PKG, "wsync", "subscriber.py"),
+    # the serving-fleet speakers (docs/how_to/serving.md, mxfleet):
+    # fleet_* ops — router.py carries FleetClient (the only client)
+    # plus the router's register/leave arms; replica.py the data arms
+    os.path.join(_PKG, "serving", "fleet", "router.py"),
+    os.path.join(_PKG, "serving", "fleet", "replica.py"),
 )
 
 #: constants the lattice must recover from DEFAULT_TARGETS; an explicit

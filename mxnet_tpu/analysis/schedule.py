@@ -55,7 +55,7 @@ import traceback as _tb
 __all__ = ["Controller", "Explorer", "ExploreResult", "FailureReport",
            "explore", "replay", "racy_counter_workload",
            "serving_workload", "aggregator_workload",
-           "wsync_swap_workload"]
+           "wsync_swap_workload", "fleet_router_workload"]
 
 _GATE_TIMEOUT = 120.0     # guard: a wedged scheduler raises, never hangs CI
 _THIS_FILE = os.path.abspath(__file__)
@@ -1059,6 +1059,209 @@ def AGGREGATOR_TRACE_FILES():
     return (_srv.__file__,)
 
 
+class _NullLock:
+    """A reentrant no-op lock — the seeded-race stand-in for a routing
+    table lock someone forgot (fleet negative control)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def acquire(self, *a, **k):
+        return True
+
+    def release(self):
+        pass
+
+
+class _StubFleetReplica:
+    """A socketless fleet replica answering the ``fleet_*`` arms with a
+    deterministic token function of the prompt (one token per stream
+    poll, so every request spans many router steps). ``dead=True``
+    makes every dispatch raise — the SIGKILL stand-in the router's
+    transport-error path turns into an eviction."""
+
+    def __init__(self, name):
+        import itertools as _it
+
+        self.name = name
+        self.dead = False
+        self._rids = _it.count()
+        self._reqs = {}
+
+    @staticmethod
+    def expected(prompt, max_new):
+        base = int(sum(prompt))
+        return [(base + i) % 50 for i in range(int(max_new))]
+
+    def _dispatch(self, req):
+        if self.dead:
+            raise ConnectionError("replica %s is dead" % self.name)
+        op = req.get("op")
+        if op == "fleet_submit":
+            rid = next(self._rids)
+            toks = self.expected(req["prompt"], req["max_new"])
+            # a redelivery prefix = tokens the client already saw on a
+            # dead replica: resume past them (PR 8 recompute semantics)
+            self._reqs[rid] = {"toks": toks,
+                               "sent": len(req.get("prefix") or [])}
+            return {"status": "ok", "rid": rid, "name": self.name}
+        if op == "fleet_stream":
+            rec = self._reqs[req["rid"]]
+            out = []
+            if rec["sent"] < len(rec["toks"]):
+                out = [rec["toks"][rec["sent"]]]
+                rec["sent"] += 1
+            done = rec["sent"] >= len(rec["toks"])
+            return {"status": "ok", "tokens": out, "done": done,
+                    "final_status": "finished"}
+        if op == "fleet_cancel":
+            return {"status": "ok", "known": req["rid"] in self._reqs}
+        if op == "fleet_stats":
+            return {"status": "ok", "name": self.name, "accepting": True,
+                    "stats": {"queue_depth": 0}}
+        return {"status": "error", "message": "unknown op %r" % (op,)}
+
+
+def fleet_router_workload(locked=True, failover=True, n_requests=3,
+                          max_new=4):
+    """The fleet router's submit/place/poll bookkeeping under
+    adversarial schedules (ISSUE 20).
+
+    ``locked=True`` (the shipped discipline): two submitter threads
+    race a driver pumping ``Router.step()`` over two stub replicas,
+    with — when ``failover`` — a killer thread blowing one replica away
+    mid-stream. Invariants: every stream terminates with EXACTLY its
+    expected token sequence (redelivery is invisible), the journal
+    drains, and no replica ever exceeds its in-flight cap.
+
+    ``locked=False`` is the SEEDED RACE (negative control): the
+    router's lock is replaced with a no-op, and two submitters race
+    the admission check-then-append window against a tiny
+    ``pending_max``. Paired with line-granularity preemption over
+    router.py (:func:`FLEET_TRACE_FILES`) the explorer must FIND the
+    cap violation and REPLAY it — proving the lock is load-bearing,
+    not decorative."""
+
+    def make(ctl):
+        from ..serving.engine import QueueFullError
+        from ..serving.fleet.router import Router
+
+        if not locked:
+            router = Router(bind=None, pending_max=2, inflight_cap=2,
+                            health_interval=0.0)
+            router._lock = _NullLock()
+            accepted = []
+
+            def submitter():
+                for i in range(2):
+                    try:
+                        router.submit([1, 2, 3], max_new_tokens=2)
+                    except QueueFullError:
+                        continue
+                    accepted.append(1)
+
+            def check():
+                assert len(router._pending) <= router.pending_max, (
+                    "admission cap breached: %d pending > pending_max %d "
+                    "(check-then-append raced)"
+                    % (len(router._pending), router.pending_max))
+
+            return [submitter, submitter], check
+
+        router = Router(bind=None, pending_max=16, inflight_cap=2,
+                        health_interval=0.0)
+        router._lock = ctl.rlock("fleet.Router._lock")
+        reps = [_StubFleetReplica("rep0"), _StubFleetReplica("rep1")]
+        for r in reps:
+            router.register_local(r.name, r)
+        prompts = [[1 + i, 2, 3] for i in range(n_requests)]
+        streams = []
+        submitters_done = []
+
+        def submitter(lo, hi):
+            def body():
+                for i in range(lo, hi):
+                    streams.append((i, router.submit(
+                        prompts[i], max_new_tokens=max_new)))
+                    ctl.checkpoint()
+                submitters_done.append(True)
+            return body
+
+        killer_done = []
+
+        def killer():
+            ctl.checkpoint()
+            reps[0].dead = True
+            killer_done.append(True)
+
+        def driver():
+            for _ in range(400):
+                ctl.checkpoint()
+                worked = router.step()
+                if worked or len(submitters_done) < 2:
+                    continue
+                if failover and not killer_done:
+                    continue
+                if not router._requests:
+                    break
+
+        def check():
+            assert not router._requests, (
+                "journal leaked %d entries" % len(router._requests))
+            assert not router._pending, "pending leaked"
+            got = sorted((i, _drain_stream(s)) for i, s in streams)
+            assert len(got) == n_requests, got
+            for i, toks in got:
+                want = _StubFleetReplica.expected(prompts[i], max_new)
+                assert toks == want, (
+                    "stream %d not byte-identical after %s: %r != %r"
+                    % (i, "failover" if failover else "routing",
+                       toks, want))
+            for rep in router._replicas.values():
+                assert not rep.inflight, (
+                    "replica %s leaked inflight %r"
+                    % (rep.name, rep.inflight))
+            if failover:
+                assert not router._replicas["rep0"].alive, (
+                    "dead replica was never evicted")
+
+        threads = [submitter(0, n_requests // 2),
+                   submitter(n_requests // 2, n_requests), driver]
+        if failover:
+            threads.append(killer)
+        return threads, check
+
+    make.__name__ = "fleet_router(locked=%s)" % locked
+    return make
+
+
+def _drain_stream(stream):
+    """Collect a FleetStream's delivered tokens without blocking (the
+    coop scheduler owns the threads — a real Queue.get wait would
+    wedge it)."""
+    import queue as _q
+
+    out = []
+    while True:
+        try:
+            item = stream._q.get_nowait()
+        except _q.Empty:
+            return out
+        if item is None or item.__class__ is not int:
+            return out
+        out.append(item)
+
+
+def FLEET_TRACE_FILES():
+    """Line-granularity preemption targets for the fleet race leg."""
+    from ..serving.fleet import router as _rt
+
+    return (_rt.__file__,)
+
+
 def survival_suite(seed=0, schedules=None, include_serving=True):
     """The ``mxlint --schedules`` / ``chaos --schedules`` legs.
 
@@ -1115,12 +1318,20 @@ def survival_suite(seed=0, schedules=None, include_serving=True):
         # the wsync swap discipline is unenforced
         control("control/wsync-unstaged", wsync_swap_workload(staged=False),
                 min(schedules, 10))
+        # the unlocked routing table is the fleet's seeded race: the
+        # admission check-then-append window must be findable under
+        # line preemption, or the router lock is unproven
+        control("control/fleet-unlocked",
+                fleet_router_workload(locked=False),
+                min(schedules, 20), trace_files=FLEET_TRACE_FILES())
 
     legs = [("counter-locked", racy_counter_workload(locked=True), ()),
             ("aggregator", aggregator_workload(locked=True), ())]
     if include_serving:
         legs.append(("serving", serving_workload(), ()))
         legs.append(("wsync-swap", wsync_swap_workload(staged=True), ()))
+        legs.append(("fleet-router", fleet_router_workload(locked=True),
+                     ()))
     for name, wl, trace_files in legs:
         r = explore(wl, schedules=schedules, seed=seed,
                     trace_files=trace_files)

@@ -40,16 +40,36 @@ executed by the controller under a per-action
     drives it against its own engine); raises when no engine is live
     or no prior version exists.
 
+``scale_up`` / ``scale_down``
+    Fleet elasticity (docs/how_to/serving.md, the mxfleet section):
+    driven by the router's aggregate view through
+    :class:`~.probes.FleetProbe` (queue depth / tokens-per-s / p99
+    TTFT on the ``fleet`` target). ``scale_up`` spawns one more
+    supervised replica from ``MXCTL_REPLICA_TEMPLATE`` (a
+    ``{name}``-templated command; the replica self-registers with the
+    router via ``MXNET_FLEET_ROUTER``, so no port bookkeeping here),
+    refusing past ``MXCTL_FLEET_MAX``. ``scale_down`` picks the
+    highest-indexed live replica, SIGTERMs it (the drain contract:
+    admissions close, in-flight streams finish, ``fleet_leave``, exit
+    0), waits up to ``drain_grace`` for the exit, then RETIRES the
+    record through :meth:`~.supervisor.Supervisor.retire` so nothing
+    respawns it — refusing below ``MXCTL_FLEET_MIN``, and raising
+    (never SIGKILLing) when the drain doesn't finish in time: a slow
+    drain must not become dropped streams.
+
 Custom actuators register by name via :func:`register` before the
 controller is built (plugins configure rules that name them).
 """
 from __future__ import annotations
 
+import re
+import shlex
 import signal
+import subprocess
 
 __all__ = ["Actuator", "ActionError", "RestartReplica", "DrainRestart",
-           "EvictReplace", "RollbackWeights", "build_actuators",
-           "register"]
+           "EvictReplace", "RollbackWeights", "ScaleUp", "ScaleDown",
+           "build_actuators", "register"]
 
 
 class ActionError(RuntimeError):
@@ -168,6 +188,83 @@ class RollbackWeights(Actuator):
         return {"engines": len(transitions), "transitions": transitions}
 
 
+_IDX_RE = re.compile(r"^(?P<prefix>.*?)(?P<idx>\d+)$")
+
+
+def _fleet_index(name):
+    m = _IDX_RE.match(name)
+    return int(m.group("idx")) if m else -1
+
+
+class ScaleUp(Actuator):
+    name = "scale_up"
+
+    def execute(self, decision, ctx):
+        sup = ctx.supervisor
+        if sup is None:
+            raise ActionError("scale_up needs a supervising controller")
+        tmpl = getattr(ctx.cfg, "replica_template", None)
+        if not tmpl:
+            raise ActionError("scale_up needs MXCTL_REPLICA_TEMPLATE")
+        alive = [n for n in sup.names() if sup.alive(n)]
+        fleet_max = int(getattr(ctx.cfg, "fleet_max", 8))
+        if len(alive) >= fleet_max:
+            raise ActionError(
+                "scale_up refused: %d live replicas >= MXCTL_FLEET_MAX %d"
+                % (len(alive), fleet_max))
+        # deterministic next name: one past the highest index ever
+        # supervised (retired names are NOT reused — their journals and
+        # logs must stay unambiguous)
+        taken = sup.names()
+        idx = max((_fleet_index(n) for n in taken), default=-1) + 1
+        prefix = "replica"
+        for n in taken:
+            m = _IDX_RE.match(n)
+            if m:
+                prefix = m.group("prefix")
+                break
+        name = "%s%d" % (prefix, idx)
+        cmd = [a.format(name=name) for a in shlex.split(tmpl)]
+        from . import __main__ as _cli  # lazy: avoids an import cycle
+
+        env = _cli._replica_env(name, ctx.cfg)
+        log = (ctx.cfg.replica_log.format(name=name)
+               if getattr(ctx.cfg, "replica_log", None) else None)
+        pid = sup.spawn(name, cmd, env=env, log_path=log,
+                        start_new_session=True)
+        return {"replica": name, "pid": pid, "fleet": len(alive) + 1}
+
+
+class ScaleDown(Actuator):
+    name = "scale_down"
+
+    def execute(self, decision, ctx):
+        sup = ctx.supervisor
+        if sup is None:
+            raise ActionError("scale_down needs a supervising controller")
+        alive = [n for n in sup.names() if sup.alive(n)]
+        fleet_min = int(getattr(ctx.cfg, "fleet_min", 1))
+        if len(alive) <= fleet_min:
+            raise ActionError(
+                "scale_down refused: %d live replicas <= MXCTL_FLEET_MIN %d"
+                % (len(alive), fleet_min))
+        victim = max(alive, key=lambda n: (_fleet_index(n), n))
+        rep = sup.get(victim)
+        sup.send_signal(victim, signal.SIGTERM)
+        try:
+            rep.proc.wait(timeout=ctx.cfg.drain_grace)
+        except subprocess.TimeoutExpired:
+            # still draining — raise (the action policy retries; the
+            # SIGTERM re-send is idempotent) rather than SIGKILL a
+            # replica mid-stream
+            raise ActionError(
+                "scale_down: %r did not drain within %.1fs"
+                % (victim, ctx.cfg.drain_grace))
+        rc = rep.proc.returncode
+        sup.retire(victim)
+        return {"victim": victim, "rc": rc, "fleet": len(alive) - 1}
+
+
 _REGISTRY = {}
 
 
@@ -180,7 +277,7 @@ def register(actuator):
 
 
 for _cls in (RestartReplica, DrainRestart, EvictReplace,
-             RollbackWeights):
+             RollbackWeights, ScaleUp, ScaleDown):
     register(_cls())
 
 

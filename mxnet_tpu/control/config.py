@@ -65,7 +65,8 @@ class ControlConfig:
                  action_retries=2, coord=None, journals_glob=None,
                  straggler_min_wait=2.0, state_path=None,
                  replica_journal=None, replica_log=None, drain_grace=15.0,
-                 startup_grace=10.0):
+                 startup_grace=10.0, replica_template=None, fleet_min=1,
+                 fleet_max=8):
         self.targets = dict(targets or {})      # name -> mxdash base url
         self.rules = list(rules if rules is not None
                           else parse_rules(DEFAULT_RULES))
@@ -85,6 +86,12 @@ class ControlConfig:
         # mxdash socket before alive=0 counts against it — without it
         # the liveness rule re-kills every cold start mid-import
         self.startup_grace = float(startup_grace)
+        # fleet autoscaling (scale_up/scale_down actuators): the
+        # {name}-templated command a scale_up spawns, and the bounds
+        # the actuators refuse to cross
+        self.replica_template = replica_template
+        self.fleet_min = int(fleet_min)
+        self.fleet_max = int(fleet_max)
 
     @classmethod
     def from_env(cls):
@@ -109,6 +116,9 @@ class ControlConfig:
             replica_log=_env("MXCTL_REPLICA_LOG") or None,
             drain_grace=_env_float("MXCTL_DRAIN_GRACE", 15.0),
             startup_grace=_env_float("MXCTL_STARTUP_GRACE", 10.0),
+            replica_template=_env("MXCTL_REPLICA_TEMPLATE") or None,
+            fleet_min=_env_int("MXCTL_FLEET_MIN", 1),
+            fleet_max=_env_int("MXCTL_FLEET_MAX", 8),
         )
 
     def describe(self):

@@ -36,8 +36,9 @@ import urllib.error
 import urllib.request
 
 __all__ = ["TargetSample", "HttpProbe", "CoordinatorProbe",
-           "DataServiceProbe", "serving_metrics", "tracez_metrics",
-           "data_metrics", "ProbeError"]
+           "DataServiceProbe", "FleetProbe", "serving_metrics",
+           "tracez_metrics", "data_metrics", "fleet_metrics",
+           "ProbeError"]
 
 
 class ProbeError(Exception):
@@ -282,6 +283,67 @@ class DataServiceProbe:
         for rank, metrics in sorted(per_rank.items()):
             out.append(TargetSample("data-rank%d" % rank, "training",
                                     metrics, {"coord": self.coord}))
+        return out
+
+
+def fleet_metrics(stats):
+    """Pure mapping from a fleet ``Router.stats()`` snapshot to rule
+    metrics (the unit-testable half of :class:`FleetProbe`). Returns
+    ``(aggregate metrics, {replica name: per-replica metrics})``."""
+    agg = {}
+    per = {}
+    if not stats:
+        return agg, per
+    reps = stats.get("replicas") or {}
+    agg["replicas"] = float(len(reps))
+    agg["replicas_alive"] = float(stats.get("replicas_alive", 0))
+    agg["queue_depth"] = float(stats.get("queue_depth", 0))
+    agg["pending"] = float(stats.get("pending", 0))
+    agg["inflight"] = float(stats.get("inflight", 0))
+    agg["tokens_per_s"] = float(stats.get("tokens_per_s", 0.0) or 0.0)
+    if stats.get("ttft_p99_s") is not None:
+        agg["ttft_p99"] = float(stats["ttft_p99_s"])
+    agg["redelivered"] = float(stats.get("redelivered", 0))
+    agg["evictions"] = float(stats.get("evictions", 0))
+    for name, r in sorted(reps.items()):
+        per[name] = {
+            "alive": 1.0 if r.get("alive") else 0.0,
+            "ready": (1.0 if (r.get("alive") and r.get("accepting"))
+                      else 0.0),
+            "inflight": float(r.get("inflight", 0)),
+            "queue_depth": float(r.get("queue_depth", 0)),
+            "tokens_per_s": float(r.get("tokens_per_s", 0.0) or 0.0),
+        }
+    return agg, per
+
+
+class FleetProbe:
+    """Turn a fleet router's aggregate view into mxctl targets: one
+    ``fleet`` aggregate sample (queue depth / tokens-per-s / p99 TTFT —
+    what ``scale_up``/``scale_down`` rules key on) plus one sample per
+    replica, NAMED to match its supervisor entry, so the liveness rule
+    (``alive<1:for=K:action=restart_replica``) fires on a crash the
+    router evicted — the router keeps a dead replica's entry with
+    ``alive=0`` for exactly this hand-off. ``router`` is the in-process
+    :class:`~..serving.fleet.Router` (the chaos-harness shape) or a
+    zero-arg callable returning its ``stats()`` dict (tests)."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def sample(self, now=None):
+        try:
+            stats = (self.router() if callable(self.router)
+                     else self.router.stats())
+        except Exception as e:  # noqa: BLE001 - router down = the finding
+            return [TargetSample(
+                "fleet", "serving", {"alive": 0.0},
+                {"error": "%s: %s" % (type(e).__name__, e)})]
+        agg, per = fleet_metrics(stats)
+        agg["alive"] = 1.0
+        out = [TargetSample("fleet", "serving", agg, {})]
+        for name, metrics in sorted(per.items()):
+            out.append(TargetSample(name, "serving", metrics, {}))
         return out
 
 

@@ -179,6 +179,21 @@ class Supervisor:
             rep.last_rc = rep.proc.returncode
             rep.done = True
 
+    def retire(self, name):
+        """Drop a replica from supervision entirely: cancel any held
+        respawn and forget the record, so nothing ever respawns it —
+        the scale_down contract (retirement, not death). The process
+        must already have exited; retiring a live replica raises (the
+        caller owns the drain)."""
+        rep = self._replicas.get(name)
+        if rep is None:
+            return False
+        if rep.alive():
+            raise RuntimeError("retire(%r): process still running — "
+                               "drain it first" % name)
+        del self._replicas[name]
+        return True
+
     # -- introspection -------------------------------------------------------
     def names(self):
         return sorted(self._replicas)

@@ -81,7 +81,18 @@ def live_engines():
 
 
 class QueueFullError(MXNetError):
-    """submit() past max_queue_depth — shed load upstream."""
+    """submit() past max_queue_depth — shed load upstream.
+
+    Carries the observed ``queue_depth`` and a computed
+    ``retry_after_s`` hint (one admission slot's expected time to free
+    at the current service rate) so an upstream router backs off for a
+    meaningful interval instead of blind-retrying into the same full
+    queue (mxnet_tpu/serving/fleet/router.py reads both)."""
+
+    def __init__(self, message, queue_depth=0, retry_after_s=1.0):
+        super().__init__(message)
+        self.queue_depth = int(queue_depth)
+        self.retry_after_s = float(retry_after_s)
 
 
 @dataclasses.dataclass
@@ -110,6 +121,9 @@ class ServingConfig:
     cp_seq_axis: str = "seq"
     cp_min_tokens: int = None
     cp_chunk: int = None
+    # idle-stream reaper: a StreamHandle nobody consumes for this many
+    # seconds is cancelled and its KV blocks freed (0 = off)
+    stream_idle_s: float = None
 
     def __post_init__(self):
         if self.block_size is None:
@@ -140,6 +154,12 @@ class ServingConfig:
             self.max_queue_depth = _env_int("MXNET_SERVE_MAX_QUEUE", 64)
         if self.cp_min_tokens is None:
             self.cp_min_tokens = _env_int("MXNET_SERVE_CP_MIN_TOKENS", 2048)
+        if self.stream_idle_s is None:
+            try:
+                self.stream_idle_s = float(
+                    os.environ.get("MXNET_SERVE_STREAM_IDLE_S", "") or 0.0)
+            except ValueError:
+                self.stream_idle_s = 0.0
 
 
 class StreamHandle:
@@ -150,6 +170,10 @@ class StreamHandle:
         self._req = req
         self._q = _queue.Queue()
         self.status = "running"
+        # last time a consumer pulled a token (monotonic) — the idle
+        # reaper's signal. Consuming resets it; an abandoned handle
+        # with tokens piling up in _q goes stale and gets cancelled.
+        self._touched_t = time.monotonic()
         req.stream = self
 
     @property
@@ -158,6 +182,13 @@ class StreamHandle:
 
     def _emit(self, token):
         self._q.put(int(token))
+
+    def _idle_abandoned(self, now, idle_s):
+        """True when nobody has consumed for ``idle_s`` seconds WHILE
+        tokens sat ready (an empty queue means the consumer is merely
+        blocked waiting on us — never reap those)."""
+        return (self.status == "running" and self._q.qsize() > 0
+                and now - self._touched_t > idle_s)
 
     def _end(self, status):
         self.status = status
@@ -174,6 +205,7 @@ class StreamHandle:
         finishes, is cancelled, or errors."""
         while True:
             item = self._q.get(timeout=timeout)
+            self._touched_t = time.monotonic()
             if item is _END:
                 return
             yield item
@@ -303,8 +335,8 @@ class Engine:
         self._last_counts = {}
         self._stats = {"admitted": 0, "completed": 0, "evicted": 0,
                        "rejected": 0, "cancelled": 0, "tokens_emitted": 0,
-                       "steps": 0, "spec_turns": 0, "spec_tokens_drafted": 0,
-                       "spec_tokens_accepted": 0}
+                       "steps": 0, "streams_reaped": 0, "spec_turns": 0,
+                       "spec_tokens_drafted": 0, "spec_tokens_accepted": 0}
         self._ttfts = []
         self._token_lats = []
         self._rate_window = []  # (t, cumulative tokens) ring for tokens/s
@@ -344,8 +376,19 @@ class Engine:
 
     # -- intake --------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None,
-               temperature=0.0, top_k=0, top_p=1.0, seed=0):
+               temperature=0.0, top_k=0, top_p=1.0, seed=0,
+               prefix_tokens=None):
         """Queue a generation request; returns a StreamHandle.
+
+        ``prefix_tokens`` is the fleet redelivery hook
+        (serving/fleet/router.py): tokens this request ALREADY streamed
+        on a replica that died are folded into the recompute context —
+        exactly the eviction-recompute fold one tier up. The request
+        prefills ``prompt + prefix`` and decodes onward; the pre-seeded
+        tokens count against ``max_new_tokens`` but are never
+        re-emitted, and because sampling is keyed by (seed, global
+        position) the continuation is byte-identical to the
+        uninterrupted stream (exact at temperature 0).
 
         ``temperature`` 0 (the default) is exact greedy decode;
         positive temperatures sample on device with top-k/top-p
@@ -375,25 +418,45 @@ class Engine:
                       eos_id=self.cfg.eos_id if eos_id is None else eos_id,
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       seed=seed)
+        if prefix_tokens is not None and len(prefix_tokens):
+            pre = np.asarray(prefix_tokens, np.int32).reshape(-1)
+            if pre.shape[0] >= max_new_tokens:
+                with self._lock:
+                    self._reject("prefix")
+                raise MXNetError(
+                    "prefix_tokens (%d) already meets max_new_tokens "
+                    "(%d) — nothing left to generate" % (pre.shape[0],
+                                                         max_new_tokens))
+            # the redelivery fold: already-streamed tokens become
+            # recompute context (KV re-prefilled on this engine) AND
+            # pre-seeded generated tokens (positions stay global; _emit
+            # only ever sees tokens decoded here, so nothing replays)
+            req.context = np.concatenate([req.context, pre])
+            req.generated = [int(t) for t in pre]
         total = req.total_len()
         limit = min(self.max_seq_tokens,
                     self.sched.max_request_tokens(),
                     self.model.max_blocks * self.cfg.block_size)
         with self._lock:
             if self._draining:
-                self._reject()
+                depth = len(self.sched.queue)
+                self._reject("draining", depth)
                 raise QueueFullError(
                     "engine draining — admissions closed (resume() "
-                    "reopens)")
+                    "reopens)", queue_depth=depth,
+                    retry_after_s=self._retry_after_locked(depth))
             if total > limit:
-                self._reject()
+                self._reject("geometry")
                 raise MXNetError(
                     "request needs %d tokens; engine limit is %d "
                     "(pool/max_seq geometry)" % (total, limit))
             if len(self.sched.queue) >= self.cfg.max_queue_depth:
-                self._reject()
+                depth = len(self.sched.queue)
+                self._reject("queue_full", depth)
                 raise QueueFullError(
-                    "admission queue full (%d)" % self.cfg.max_queue_depth)
+                    "admission queue full (%d)" % self.cfg.max_queue_depth,
+                    queue_depth=depth,
+                    retry_after_s=self._retry_after_locked(depth))
             req.submit_t = time.monotonic()
             if _tel.ENABLED:
                 # request-scoped trace: every lifecycle span of this
@@ -405,6 +468,7 @@ class Engine:
                 _tel.event("serve.request.submit", t=req.wall0,
                            trace=req.trace, rid=req.rid,
                            prompt_len=int(req.prompt.shape[0]),
+                           prefix_len=len(req.generated),
                            max_new_tokens=req.max_new_tokens)
             handle = StreamHandle(self, req)
             self._by_rid[req.rid] = req
@@ -416,6 +480,27 @@ class Engine:
         with self._lock:
             self.sched.cancel(req)
             self._work.notify_all()
+
+    def _reap_idle_locked(self, now):
+        """Cancel streams nobody is consuming (satellite of the fleet
+        PR): an abandoned ``StreamHandle`` otherwise pins its KV blocks
+        for the request's whole lifetime. Caller holds ``_lock``; the
+        cancel is the ordinary scheduler sweep, so blocks free on the
+        next plan()."""
+        idle = self.cfg.stream_idle_s
+        if not idle or idle <= 0:
+            return
+        for req in list(self._by_rid.values()):
+            s = req.stream
+            if s is not None and s._idle_abandoned(now, idle):
+                self._stats["streams_reaped"] += 1
+                if _tel.ENABLED:
+                    _tel.counter("serving.streams_reaped").inc()
+                    _tel.event("serve.stream.reaped", rid=req.rid,
+                               trace=req.trace,
+                               idle_s=now - s._touched_t,
+                               tokens=len(req.generated))
+                self.sched.cancel(req)
 
     # -- graceful drain ------------------------------------------------------
     def drain(self, wait=False, timeout=None):
@@ -493,10 +578,34 @@ class Engine:
             # _work is Condition(self._lock), so this notify is locked
             self._work.notify_all()  # mxlint: disable
 
-    def _reject(self):
+    def _reject(self, reason="params", queue_depth=None):
         self._stats["rejected"] += 1
         if _tel.ENABLED:
             _tel.counter("serving.requests_rejected").inc()
+            # the rejection DETAIL rides a journal event (reason +
+            # depth + the backoff hint handed to the caller), so a
+            # fleet router's shed decisions are reconstructable from
+            # the journal alone
+            _tel.event("serve.request.reject", reason=reason,
+                       queue_depth=queue_depth,
+                       retry_after_s=(
+                           self._retry_after_locked(queue_depth)
+                           if queue_depth is not None else None))
+
+    def _retry_after_locked(self, queue_depth=None):
+        """Backoff hint for a rejected submit: expected seconds until
+        one admission slot frees. At the current windowed token rate,
+        the soonest-finishing active request needs ``min remaining
+        tokens / (rate / active)`` seconds; idle or cold engines fall
+        back to a 1s hint. Clamped to [0.05, 30]."""
+        rate = self._last_rate
+        active = len(self.sched.active)
+        if rate <= 0.0 or not active:
+            return 1.0
+        remaining = min(
+            max(1, r.max_new_tokens - len(r.generated))
+            for r in self.sched.active)
+        return float(min(30.0, max(0.05, remaining * active / rate)))
 
     # -- speculative-decoding runtime toggle ---------------------------------
     def set_spec(self, enabled):
@@ -709,6 +818,7 @@ class Engine:
                     "staged-swap gates (shape/dtype, finiteness, "
                     "acceptance) — docs/how_to/weight_sync.md")
             with self._lock:
+                self._reap_idle_locked(time.monotonic())
                 plan = self.sched.plan()
                 self._mirror_events()
                 decode = list(plan.decode)

@@ -1,0 +1,17 @@
+"""mxfleet: a fault-isolated serving fleet (ISSUE 20).
+
+N replicas — each one :class:`~..engine.Engine` in its own supervised
+process (``replica.py``) — behind a health-routed front-end
+(``router.py``). Any replica is a disposable fault domain: a SIGKILL
+costs redelivered requests (already-streamed tokens folded into a
+recompute prefill on a survivor), never lost streams. The router's
+aggregate view feeds mxctl's ``scale_up``/``scale_down`` actuators
+(control/actuators.py); ``tools/chaos.py --fleet`` proves the whole
+loop. Architecture notes: docs/how_to/serving.md (fleet section).
+"""
+from __future__ import annotations
+
+from .replica import ReplicaServer
+from .router import FleetClient, FleetStream, Router
+
+__all__ = ["ReplicaServer", "Router", "FleetClient", "FleetStream"]

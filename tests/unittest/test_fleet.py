@@ -1,0 +1,457 @@
+"""mxfleet tests (ISSUE 20): the fault-isolated serving fleet.
+
+Engine-side satellites first (QueueFullError retry-after payload, the
+idle-stream reaper, redelivery-prefix byte parity), then the router
+itself — placement, affinity, backpressure, crash eviction with
+lossless redelivery, graceful leave — over deterministic stub replicas
+(no sockets, no model), then the control-plane hand-off (FleetProbe,
+scale actuators, Supervisor.retire) and the mxrace legs (the unlocked
+routing table must be FOUND + REPLAYED; the locked router must
+survive).
+"""
+import itertools
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import Engine, QueueFullError, ServingConfig
+from mxnet_tpu.serving.fleet import FleetClient, ReplicaServer, Router
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from mxnet_tpu.models.transformer import (TransformerConfig, forward,
+                                              init_params)
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, d_model=32,
+                            num_heads=2, d_ff=64, max_seq_len=96,
+                            dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def greedy_ref(prompt, n):
+        seq = [int(t) for t in prompt]
+        out = []
+        for _ in range(n):
+            logits = forward(params, np.asarray([seq], np.int32), cfg)
+            t = int(np.argmax(np.asarray(logits)[0, -1]))
+            out.append(t)
+            seq.append(t)
+        return out
+
+    return cfg, params, greedy_ref
+
+
+def _mk_engine(model, **kw):
+    cfg, params, _ = model
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 33)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("prefill_chunk", 16)
+    return Engine(params, cfg, ServingConfig(**kw))
+
+
+def _pump(engines, until, max_steps=2000):
+    for _ in range(max_steps):
+        any(e.step() for e in engines)
+        if until():
+            return True
+    return False
+
+
+# -- satellite: QueueFullError payload ---------------------------------------
+def test_queue_full_carries_depth_and_retry_after(model):
+    eng = _mk_engine(model, max_queue_depth=1, max_batch=1, max_active=1)
+    eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=4)
+    with pytest.raises(QueueFullError) as ei:
+        eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=4)
+    assert ei.value.queue_depth == 1
+    assert ei.value.retry_after_s > 0
+    assert eng.stats()["rejected"] == 1
+    # draining also answers with the payload
+    eng2 = _mk_engine(model)
+    eng2.drain()
+    with pytest.raises(QueueFullError) as ei2:
+        eng2.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=2)
+    assert ei2.value.retry_after_s > 0
+
+
+# -- satellite: idle-stream reaper -------------------------------------------
+def test_idle_stream_reaper_frees_blocks(model, monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_STREAM_IDLE_S", "0.05")
+    eng = _mk_engine(model)
+    assert eng.cfg.stream_idle_s == 0.05
+    h = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=12)
+    # produce a few tokens nobody consumes, then let the handle idle out
+    _pump([eng], lambda: len(eng.sched.active) > 0 and any(
+        r.generated for r in eng.sched.active), 200)
+    time.sleep(0.08)
+    assert _pump([eng], lambda: eng.stats()["streams_reaped"] >= 1, 200)
+    _pump([eng], lambda: not (eng.sched.queue or eng.sched.active), 200)
+    assert h.status == "cancelled"
+    assert eng.pool.utilization() == 0.0
+    assert eng.stats()["streams_reaped"] == 1
+
+
+def test_consumed_stream_is_not_reaped(model, monkeypatch):
+    # generous threshold: the consumer thread can be GIL-starved for
+    # hundreds of ms while the step loop jit-compiles, and a prompt
+    # consumer must NEVER be reaped however slow the box
+    monkeypatch.setenv("MXNET_SERVE_STREAM_IDLE_S", "2.5")
+    eng = _mk_engine(model)
+    h = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=6)
+    got = []
+    import threading
+
+    t = threading.Thread(target=lambda: got.extend(h.tokens()))
+    t.start()
+    _pump([eng], lambda: not (eng.sched.queue or eng.sched.active), 500)
+    t.join(timeout=10)
+    assert h.status == "finished"
+    assert len(got) == 6
+    assert eng.stats()["streams_reaped"] == 0
+
+
+# -- satellite: redelivery prefix --------------------------------------------
+def test_submit_prefix_tokens_byte_parity(model):
+    _, _, greedy_ref = model
+    prompt = np.arange(2, 11, dtype=np.int32)
+    full = greedy_ref(prompt, 10)
+    eng = _mk_engine(model)
+    # a survivor resuming after 4 streamed tokens must produce exactly
+    # the remaining 6 — the prefix folds into the recompute prefill
+    h = eng.submit(prompt, max_new_tokens=10, prefix_tokens=full[:4])
+    _pump([eng], lambda: not (eng.sched.queue or eng.sched.active), 500)
+    assert h.result(timeout=5) == full[4:]
+
+
+def test_submit_prefix_rejects_exhausted_budget(model):
+    eng = _mk_engine(model)
+    with pytest.raises(MXNetError):
+        eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=2,
+                   prefix_tokens=[1, 2])
+
+
+# -- router over deterministic stub replicas ---------------------------------
+class StubReplica:
+    """fleet_* arms answered by a pure token function of the prompt;
+    ``dead=True`` raises on every dispatch (the crash stand-in)."""
+
+    def __init__(self, name, per_poll=2):
+        self.name = name
+        self.dead = False
+        self.accepting = True
+        self.full = None           # (queue_depth, retry_after_s) or None
+        self.per_poll = per_poll
+        self._rids = itertools.count()
+        self._reqs = {}
+        self.submits = 0
+
+    @staticmethod
+    def expected(prompt, max_new):
+        base = int(sum(prompt))
+        return [(base + i) % 50 for i in range(int(max_new))]
+
+    def _dispatch(self, req):
+        if self.dead:
+            raise ConnectionError("dead")
+        op = req.get("op")
+        if op == "fleet_submit":
+            if self.full is not None:
+                return {"status": "full", "queue_depth": self.full[0],
+                        "retry_after_s": self.full[1]}
+            self.submits += 1
+            rid = next(self._rids)
+            toks = self.expected(req["prompt"], req["max_new"])
+            self._reqs[rid] = {"toks": toks,
+                               "sent": len(req.get("prefix") or [])}
+            return {"status": "ok", "rid": rid, "name": self.name}
+        if op == "fleet_stream":
+            rec = self._reqs[req["rid"]]
+            hi = min(len(rec["toks"]), rec["sent"] + self.per_poll)
+            out = rec["toks"][rec["sent"]:hi]
+            rec["sent"] = hi
+            return {"status": "ok", "tokens": out,
+                    "done": hi >= len(rec["toks"]),
+                    "final_status": "finished"}
+        if op == "fleet_cancel":
+            return {"status": "ok", "known": req["rid"] in self._reqs}
+        if op == "fleet_stats":
+            return {"status": "ok", "name": self.name,
+                    "accepting": self.accepting,
+                    "stats": {"queue_depth": len(self._reqs)}}
+        return {"status": "error", "message": "unknown op %r" % (op,)}
+
+
+def _mk_router(n=2, **kw):
+    kw.setdefault("health_interval", 0.0)
+    router = Router(bind=None, **kw)
+    reps = [StubReplica("rep%d" % i) for i in range(n)]
+    for r in reps:
+        router.register_local(r.name, r)
+    return router, reps
+
+
+def _run(router, until, max_steps=500):
+    for _ in range(max_steps):
+        router.step()
+        if until():
+            return True
+    return False
+
+
+def test_router_least_loaded_placement():
+    router, reps = _mk_router(n=3)
+    streams = [router.submit([1, 2, i], max_new_tokens=2)
+               for i in range(6)]
+    router.step()   # one step places everything round-robin-ish
+    assert [r.submits for r in reps] == [2, 2, 2]
+    assert _run(router, lambda: not router._requests)
+    for i, s in enumerate(streams):
+        assert s.result(timeout=5) == StubReplica.expected([1, 2, i], 2)
+
+
+def test_router_session_affinity():
+    router, reps = _mk_router(n=3)
+    for i in range(4):
+        router.submit([3, i], max_new_tokens=2, session="user-A")
+        assert _run(router, lambda: not router._requests)
+    placed = [r.submits for r in reps]
+    assert sorted(placed) == [0, 0, 4], placed
+
+
+def test_router_backpressure_and_full_backoff():
+    router, reps = _mk_router(n=1, pending_max=2)
+    reps[0].full = (5, 0.25)
+    router.submit([1], max_new_tokens=2)
+    router.submit([2], max_new_tokens=2)
+    with pytest.raises(QueueFullError) as ei:
+        router.submit([3], max_new_tokens=2)
+    assert ei.value.queue_depth == 2
+    now = time.monotonic()
+    router.step(now)
+    # the replica answered "full": backed off for ITS hint, not hammered
+    assert reps[0].submits == 0
+    assert router._replicas["rep0"].full_until == pytest.approx(
+        now + 0.25)
+    reps[0].full = None
+    # stepping with a clock past the backoff window places both
+    for _ in range(500):
+        router.step(time.monotonic() + 0.3)
+        if not router._requests:
+            break
+    assert not router._requests
+    assert reps[0].submits == 2
+
+
+def test_router_failover_redelivers_losslessly():
+    router, reps = _mk_router(n=2, inflight_cap=8)
+    reps[0].per_poll = 1
+    reps[1].per_poll = 1
+    prompts = [[1, 2, i] for i in range(4)]
+    streams = [router.submit(p, max_new_tokens=6) for p in prompts]
+    # a few polls in, SIGKILL stand-in on rep0
+    for _ in range(3):
+        router.step()
+    victims = len(router._replicas["rep0"].inflight)
+    assert victims > 0
+    reps[0].dead = True
+    assert _run(router, lambda: not router._requests)
+    for p, s in zip(prompts, streams):
+        assert s.result(timeout=5) == StubReplica.expected(p, 6)
+    st = router.stats()
+    assert st["evictions"] == 1
+    assert st["redelivered"] == victims
+    assert st["completed"] == 4
+    assert not router._replicas["rep0"].alive
+    # the dead entry still reports (alive=0) — the FleetProbe hand-off
+    assert "rep0" in router._replicas
+
+
+def test_router_reregistration_revives():
+    router, reps = _mk_router(n=2)
+    reps[0].dead = True
+    router.submit([5], max_new_tokens=2)
+    assert _run(router, lambda: not router._requests)
+    assert not router._replicas["rep0"].alive
+    fresh = StubReplica("rep0")
+    router.register_local("rep0", fresh)
+    assert router._replicas["rep0"].alive
+    router.submit([5], max_new_tokens=2, session="s")
+    assert _run(router, lambda: not router._requests)
+
+
+def test_router_graceful_leave_removes_entry():
+    router, _ = _mk_router(n=2)
+    assert router.leave("rep1")
+    assert "rep1" not in router._replicas
+    assert not router.leave("rep1")
+    st = router.stats()
+    assert st["left"] == 1 and st["evictions"] == 0
+
+
+def test_router_cancel_pending_and_inflight():
+    router, reps = _mk_router(n=1)
+    reps[0].per_poll = 0          # never finishes
+    s1 = router.submit([1], max_new_tokens=4)
+    router.step()
+    s2 = router.submit([2], max_new_tokens=4)   # still pending
+    assert router.cancel(s2.rid) and router.cancel(s1.rid)
+    assert s1.status == "cancelled" and s2.status == "cancelled"
+    assert not router._requests and not router._pending
+    assert not router._replicas["rep0"].inflight
+
+
+# -- real engines end to end (socketless) ------------------------------------
+def test_fleet_matches_single_engine_and_survives_kill(model):
+    _, _, greedy_ref = model
+    e1 = _mk_engine(model, num_blocks=97)
+    e2 = _mk_engine(model, num_blocks=97)
+    r1 = ReplicaServer(e1, name="rep0", bind=None)
+    r2 = ReplicaServer(e2, name="rep1", bind=None)
+    router = Router(bind=None, health_interval=0.05)
+    router.register_local("rep0", r1)
+    router.register_local("rep1", r2)
+    prompts = [np.arange(1, 8, dtype=np.int32),
+               np.arange(3, 12, dtype=np.int32)]
+    refs = [greedy_ref(p, 8) for p in prompts]
+    streams = [router.submit(p, max_new_tokens=8) for p in prompts]
+
+    def drive():
+        router.step()
+        e1.step()
+        e2.step()
+
+    for _ in range(50):
+        drive()
+        if any(len(router._requests[s.rid].tokens) >= 2 for s in streams
+               if s.rid in router._requests):
+            break
+    # kill rep0 mid-stream
+    class Dead:
+        def __getattr__(self, _):
+            def boom(*a, **k):
+                raise ConnectionError("killed")
+            return boom
+    victim = router._replicas["rep0"]
+    victim.client = Dead()
+    victim.last_scrape_t = 0.0
+    for _ in range(2000):
+        router.step()
+        e2.step()
+        if not router._requests:
+            break
+    for s, ref in zip(streams, refs):
+        assert s.result(timeout=5) == ref
+    assert router.stats()["completed"] == 2
+
+
+def test_fleet_client_direct_error_check():
+    rep = StubReplica("r")
+    client = FleetClient(direct=rep)
+    resp = client.stats()
+    assert resp["status"] == "ok"
+    with pytest.raises(MXNetError):
+        client.call("no_such_op")
+    assert client.call("no_such_op", check=False)["status"] == "error"
+
+
+# -- control plane ------------------------------------------------------------
+def test_fleet_probe_targets_match_supervisor_names():
+    from mxnet_tpu.control.probes import FleetProbe, fleet_metrics
+
+    router, reps = _mk_router(n=2)
+    reps[1].dead = True
+    router.submit([1], max_new_tokens=2)
+    _run(router, lambda: not router._requests)
+    samples = FleetProbe(router).sample()
+    by_name = {s.target: s for s in samples}
+    assert set(by_name) == {"fleet", "rep0", "rep1"}
+    assert by_name["fleet"].scope == "serving"
+    assert by_name["fleet"].metrics["alive"] == 1.0
+    assert by_name["rep0"].metrics["alive"] == 1.0
+    assert by_name["rep1"].metrics["alive"] == 0.0   # evicted -> respawnable
+    agg, per = fleet_metrics(router.stats())
+    assert agg["evictions"] == 1.0
+    assert per["rep1"]["ready"] == 0.0
+
+    down = FleetProbe(lambda: (_ for _ in ()).throw(OSError("gone")))
+    s = down.sample()
+    assert s[0].metrics == {"alive": 0.0}
+
+
+def test_scale_actuators_bounds_and_retire():
+    from mxnet_tpu.control.actuators import build_actuators
+    from mxnet_tpu.control.config import ControlConfig
+    from mxnet_tpu.control.supervisor import Supervisor
+
+    cat = build_actuators()
+    assert "scale_up" in cat and "scale_down" in cat
+
+    class Ctx:
+        pass
+
+    class D:
+        target = "fleet"
+
+    ctx = Ctx()
+    ctx.supervisor = Supervisor()
+    ctx.cfg = ControlConfig(
+        replica_template=sys.executable + " -c "
+        "\"import signal,time; signal.signal(signal.SIGTERM, "
+        "lambda *a: exit(0)); time.sleep(30)\"",
+        fleet_min=1, fleet_max=2, drain_grace=10.0)
+    try:
+        d1 = cat["scale_up"].execute(D(), ctx)
+        d2 = cat["scale_up"].execute(D(), ctx)
+        assert d1["replica"] == "replica0" and d2["replica"] == "replica1"
+        with pytest.raises(Exception, match="refused"):
+            cat["scale_up"].execute(D(), ctx)     # fleet_max
+        time.sleep(1.0)   # let the children install their SIGTERM traps
+        d3 = cat["scale_down"].execute(D(), ctx)
+        assert d3["victim"] == "replica1" and d3["rc"] == 0
+        assert ctx.supervisor.names() == ["replica0"]   # retired, gone
+        with pytest.raises(Exception, match="refused"):
+            cat["scale_down"].execute(D(), ctx)   # fleet_min
+    finally:
+        ctx.supervisor.stop_all(wait=5.0)
+
+
+def test_supervisor_retire_refuses_live():
+    from mxnet_tpu.control.supervisor import Supervisor
+
+    sup = Supervisor()
+    sup.spawn("r0", [sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        with pytest.raises(RuntimeError):
+            sup.retire("r0")
+    finally:
+        sup.stop_all(wait=5.0)
+    assert sup.retire("r0")
+    assert not sup.retire("r0")
+
+
+# -- mxrace: placement/failover determinism ----------------------------------
+def test_mxrace_unlocked_routing_found_and_replayed():
+    from mxnet_tpu.analysis.schedule import (FLEET_TRACE_FILES, explore,
+                                             fleet_router_workload, replay)
+
+    wl = fleet_router_workload(locked=False)
+    r = explore(wl, schedules=20, seed=0, trace_files=FLEET_TRACE_FILES())
+    assert not r.ok, "explorer missed the seeded routing race"
+    f = r.first_failure()
+    assert "cap breached" in f.message
+    rep = replay(wl, seed=0, index=f.index,
+                 trace_files=FLEET_TRACE_FILES())
+    assert rep is not None, "failing schedule did not replay"
+
+
+def test_mxrace_locked_router_survives():
+    from mxnet_tpu.analysis.schedule import explore, fleet_router_workload
+
+    r = explore(fleet_router_workload(locked=True), schedules=15, seed=0)
+    assert r.ok, r.first_failure().message

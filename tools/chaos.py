@@ -2414,6 +2414,339 @@ def run_wsync(args):
     return 0
 
 
+def run_fleet(args):
+    """The mxfleet fault-isolated serving fleet survival legs (ISSUE 20)."""
+    scratch = tempfile.mkdtemp(prefix="mxtpu-chaos-fleet-")
+    base_port = 30100 + (args.seed % 97) * 2
+    journal = os.path.join(scratch, "fleet-journal.jsonl")
+    # env BEFORE the mxnet_tpu import: the in-process router + controller
+    # journal into ONE file; replica subprocesses get their own journals
+    # via MXCTL_REPLICA_JOURNAL templating and share the jit cache so a
+    # respawned replica comes back warm
+    os.environ.update({
+        "JAX_PLATFORMS": "cpu",
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_JOURNAL": journal,
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "MXNET_COMPILE_CACHE_DIR": os.path.join(scratch, "jit-cache"),
+    })
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import numpy as np
+
+    import mxnet_tpu.telemetry as tel
+    tel.reload()
+    from mxnet_tpu.control.config import ControlConfig
+    from mxnet_tpu.control.controller import Controller
+    from mxnet_tpu.control.probes import FleetProbe
+    from mxnet_tpu.control.rules import parse_rules
+    from mxnet_tpu.control.supervisor import Supervisor
+    from mxnet_tpu.serving.fleet import Router
+
+    failures = []
+    rng = np.random.RandomState(args.seed)
+    router = Router(bind=("127.0.0.1", base_port), inflight_cap=4,
+                    pending_max=256, health_interval=0.5)
+    router.serve()
+    router.start(interval=0.01)
+    template = ("%s -m mxnet_tpu.serving.fleet.replica "
+                "--router 127.0.0.1:%d --name {name} --bind 127.0.0.1:0 "
+                "--seed %d" % (sys.executable, base_port, args.seed))
+    sup = Supervisor()
+
+    def mk_ctl(rules):
+        return Controller(
+            ControlConfig(
+                targets={}, rules=parse_rules(rules), interval=0.3,
+                state_path=os.path.join(scratch, "mxctl-state.json"),
+                replica_journal=os.path.join(
+                    scratch, "fleet-{name}-journal.jsonl"),
+                replica_log=os.path.join(scratch, "fleet-{name}.log"),
+                drain_grace=120.0, startup_grace=120.0,
+                replica_template=template, fleet_min=4, fleet_max=5),
+            probes=[FleetProbe(router)], supervisor=sup)
+
+    def accepting():
+        return router.stats()["replicas_accepting"]
+
+    def submit_batch(prompts, max_new):
+        return [router.submit(p, max_new_tokens=max_new) for p in prompts]
+
+    def collect(streams, timeout=300.0):
+        deadline = time.time() + timeout
+        out = []
+        for s in streams:
+            try:
+                out.append(s.result(timeout=max(1.0,
+                                                deadline - time.time())))
+            except Exception:  # noqa: BLE001 - a lost stream = the finding
+                out.append(None)
+        return out
+
+    def mk_prompts(n):
+        return [rng.randint(1, 50,
+                            size=int(rng.randint(4, 9))).tolist()
+                for _ in range(n)]
+
+    # -- bring-up: 4 supervised replicas via the scale_up actuator ------
+    print("chaos --fleet: bring-up (4 supervised replicas via scale_up, "
+          "readyz-gated registration)")
+    boot = mk_ctl("alive<1:for=3:action=restart_replica:cooldown=20")
+    for _ in range(4):
+        boot.actuators.get("scale_up").execute(None, boot)
+    if not _wait_until(lambda: accepting() >= 4, 420):
+        tail = ""
+        log0 = os.path.join(scratch, "fleet-replica0.log")
+        try:
+            with open(log0, "r", encoding="utf-8", errors="replace") as f:
+                tail = f.read()[-1500:]
+        except OSError:
+            pass
+        print("RESULT: FAIL\n - fleet never reached 4 accepting replicas "
+              "(stats: %s)\nreplica0 log tail:\n%s"
+              % (router.stats(), tail))
+        sup.stop_all()
+        router.close()
+        return 10
+    report = {}
+
+    # -- leg a: SIGKILL 1 of 4 mid-decode, zero lost requests ----------
+    print("chaos --fleet: kill leg (SIGKILL 1 of 4 mid-decode; streams "
+          "must be byte-identical to an uninterrupted run, and the "
+          "liveness rule must respawn the replica)")
+    boot.start()
+    prompts = mk_prompts(12)
+    ref = collect(submit_batch(prompts, 48))
+    if any(r is None or len(r) != 48 for r in ref):
+        failures.append("kill leg: the uninterrupted reference run lost "
+                        "requests (%s)"
+                        % [None if r is None else len(r) for r in ref])
+    st0 = router.stats()
+    streams = submit_batch(prompts, 48)
+
+    def pick_victim():
+        # a replica with a request actively mid-stream (< half done):
+        # killing it forces a redelivery whose recompute prefill folds
+        # the already-streamed tokens
+        with router._lock:
+            for _rid, e in sorted(router._requests.items()):
+                if (e.replica is not None and e.placed_tokens == 0
+                        and 1 <= len(e.tokens) < e.max_new // 2):
+                    return e.replica
+        return None
+
+    victim, deadline = None, time.time() + 120
+    while victim is None and time.time() < deadline:
+        victim = pick_victim()
+        if victim is None:
+            time.sleep(0.005)
+    if victim is None:
+        failures.append("kill leg: no replica was ever mid-stream — the "
+                        "kill window never opened")
+        collect(streams)
+    else:
+        vic_pid = sup.pid(victim)
+        os.kill(int(vic_pid), 9)  # the chaos injection
+        t_kill = time.time()
+        got = collect(streams)
+        lost = sum(1 for g in got if g is None)
+        if lost:
+            failures.append("kill leg: %d of %d requests lost after the "
+                            "SIGKILL" % (lost, len(got)))
+        mism = [i for i, (a, b) in enumerate(zip(ref, got))
+                if b is not None and a != b]
+        if mism:
+            failures.append("kill leg: %d stream(s) diverged from the "
+                            "uninterrupted run (e.g. request %d: %s vs "
+                            "%s)" % (len(mism), mism[0], ref[mism[0]][:8],
+                                     got[mism[0]][:8]))
+        st1 = router.stats()
+        if st1["evictions"] - st0["evictions"] < 1:
+            failures.append("kill leg: no eviction recorded (counts %s "
+                            "-> %s)" % (st0["evictions"], st1["evictions"]))
+        if st1["redelivered"] - st0["redelivered"] < 1:
+            failures.append("kill leg: no redelivery recorded — the kill "
+                            "missed every in-flight request")
+        if st1["completed"] - st0["completed"] != len(prompts):
+            failures.append("kill leg: completed %d of %d"
+                            % (st1["completed"] - st0["completed"],
+                               len(prompts)))
+        # the controller must respawn the SIGKILLed replica and the new
+        # incarnation must re-register (alive AND accepting again)
+        if not _wait_until(
+                lambda: (router.stats()["replicas"].get(victim, {})
+                         .get("alive")
+                         and router.stats()["replicas"][victim]
+                         ["accepting"]), 300):
+            failures.append("kill leg: %s never came back after the "
+                            "restart_replica respawn" % victim)
+        recovery_wall = time.time() - t_kill
+        report["kill"] = {
+            "victim": victim, "lost": lost,
+            "redelivered": st1["redelivered"] - st0["redelivered"],
+            "evictions": st1["evictions"] - st0["evictions"],
+            "respawn_wall_s": round(recovery_wall, 1),
+        }
+    boot.stop()
+
+    # -- leg b: load ramp fires scale_up and the SLO recovers ----------
+    print("chaos --fleet: ramp leg (admission backlog sustains "
+          "pending>4; the scale_up rule must add replica4 and the "
+          "backlog must drain — SLO recovery journaled)")
+    ramp = mk_ctl("pending>4:for=2:action=scale_up:scope=serving:"
+                  "cooldown=120")
+    ramp.start()
+    st0 = router.stats()
+    burst = collect(submit_batch(mk_prompts(64), 32), timeout=420.0)
+    if not _wait_until(lambda: accepting() >= 5, 300):
+        failures.append("ramp leg: replica4 never became accepting "
+                        "(stats: %s)" % router.stats())
+    lost = sum(1 for g in burst if g is None)
+    if lost:
+        failures.append("ramp leg: %d of %d burst requests lost"
+                        % (lost, len(burst)))
+    time.sleep(1.5)  # >= 2 probe cycles AFTER the backlog drained: the
+    ramp.stop()      # recovery record lands on a healthy probe
+    report["ramp"] = {"burst": len(burst), "lost": lost,
+                      "replicas_accepting": accepting()}
+
+    # -- leg c: scale_down drains losslessly (retire, not death) -------
+    print("chaos --fleet: drain leg (replicas>4 fires scale_down under "
+          "live streams; the victim drains, leaves, retires — zero "
+          "dropped streams, zero evictions)")
+    st0 = router.stats()
+    drain = mk_ctl("replicas>4:for=2:action=scale_down:scope=serving:"
+                   "cooldown=120")
+    d_prompts = mk_prompts(10)
+    d_streams = submit_batch(d_prompts, 48)
+    drain.start()
+    d_got = collect(d_streams)
+    if not _wait_until(lambda: "replica4" not in sup.names(), 240):
+        failures.append("drain leg: replica4 was never retired from "
+                        "supervision (names: %s)" % sup.names())
+    drain.stop()
+    lost = sum(1 for g in d_got if g is None)
+    if lost:
+        failures.append("drain leg: %d of %d in-flight streams dropped "
+                        "by the drain" % (lost, len(d_got)))
+    # byte-check: replay the same prompts on the settled 4-replica
+    # fleet — identically seeded replicas must reproduce every stream
+    d_ref = collect(submit_batch(d_prompts, 48))
+    mism = [i for i, (a, b) in enumerate(zip(d_ref, d_got))
+            if a is not None and b is not None and a != b]
+    if mism:
+        failures.append("drain leg: %d stream(s) served across the "
+                        "drain diverge from the settled-fleet replay"
+                        % len(mism))
+    st1 = router.stats()
+    if st1["left"] - st0["left"] < 1:
+        failures.append("drain leg: no graceful leave recorded")
+    if st1["evictions"] - st0["evictions"] != 0:
+        failures.append("drain leg: the drain EVICTED instead of "
+                        "draining (%d evictions)"
+                        % (st1["evictions"] - st0["evictions"]))
+    if router.stats()["replicas_accepting"] != 4:
+        failures.append("drain leg: fleet settled at %d accepting "
+                        "replicas, expected 4"
+                        % router.stats()["replicas_accepting"])
+    report["drain"] = {"streams": len(d_got), "lost": lost,
+                       "left": st1["left"] - st0["left"]}
+
+    # -- teardown + journal assertions (prove it from disk) ------------
+    final = router.stats()
+    sup.stop_all(wait=60.0)
+    router.close()
+    tel.flush(mark="exit")
+    counters = fold_telemetry(journal)
+    events = _journal_events(journal, prefix="fleet.")
+    # one trace id per redelivery transaction: every fleet.redeliver
+    # must share its trace with the re-placement's fleet.request.place
+    place_traces = {e.get("trace") for e in events
+                    if e["name"] == "fleet.request.place"}
+    redelivers = [e for e in events if e["name"] == "fleet.redeliver"]
+    if not redelivers:
+        failures.append("journal: no fleet.redeliver events — the kill "
+                        "leg left no redelivery evidence")
+    for e in redelivers:
+        if e.get("trace") is None or e["trace"] not in place_traces:
+            failures.append("journal: redelivery of rid %s does not "
+                            "share a trace with its re-placement"
+                            % e.get("rid"))
+    mxctl_events = _journal_events(journal)
+    restarts = [e for e in mxctl_events if e["name"] == "mxctl.action"
+                and e.get("action") == "restart_replica"
+                and e.get("outcome") == "ok"]
+    if report.get("kill") and not any(
+            e.get("target") == report["kill"]["victim"]
+            for e in restarts):
+        failures.append("journal: no successful restart_replica on the "
+                        "SIGKILLed %s" % report["kill"]["victim"])
+    ups = [e for e in mxctl_events if e["name"] == "mxctl.action"
+           and e.get("action") == "scale_up"
+           and e.get("outcome") == "ok" and e.get("replica") == "replica4"]
+    if not ups:
+        failures.append("journal: no successful scale_up action spawning "
+                        "replica4")
+    downs = [e for e in mxctl_events if e["name"] == "mxctl.action"
+             and e.get("action") == "scale_down"
+             and e.get("outcome") == "ok"]
+    if not any(e.get("victim") == "replica4" and e.get("rc") == 0
+               for e in downs):
+        failures.append("journal: no successful scale_down retiring "
+                        "replica4 with rc=0 (%s)"
+                        % [(e.get("victim"), e.get("rc")) for e in downs])
+    # the ramp SLO proof: a recovery record for the pending rule on the
+    # fleet target, with its restore duration
+    recoveries = [e for e in mxctl_events if e["name"] == "mxctl.recovery"
+                  and e.get("target") == "fleet"]
+    if not any(e.get("action") == "scale_up" for e in recoveries):
+        failures.append("journal: no mxctl.recovery for the scale_up "
+                        "rule — the backlog SLO never provably recovered")
+    for name, floor in (("fleet.requests_total", 98),
+                        ("fleet.requests_completed", 98),
+                        ("fleet.redeliveries_total", 1),
+                        ("fleet.replica_evictions_total", 1),
+                        ("fleet.replicas_registered_total", 6),
+                        ("fleet.replicas_left_total", 1),
+                        ("mxctl.actions_total", 3)):
+        if counters.get(name, 0) < floor:
+            failures.append("journal: counter %s=%s below the expected "
+                            "floor %d"
+                            % (name, counters.get(name, 0), floor))
+
+    print("\n=== fleet survival report ===")
+    if report.get("kill"):
+        k = report["kill"]
+        print("kill 1-of-4   : victim=%s lost=%d redelivered=%d "
+              "evictions=%d respawn %.1fs"
+              % (k["victim"], k["lost"], k["redelivered"],
+                 k["evictions"], k["respawn_wall_s"]))
+    print("load ramp     : %d requests, %d lost, fleet grew to %d "
+          "accepting" % (report["ramp"]["burst"], report["ramp"]["lost"],
+                         report["ramp"]["replicas_accepting"]))
+    print("drain         : %d live streams across scale_down, %d lost, "
+          "%d graceful leave(s)"
+          % (report["drain"]["streams"], report["drain"]["lost"],
+             report["drain"]["left"]))
+    print("router counts : submitted=%d completed=%d redelivered=%d "
+          "evictions=%d registered=%d left=%d rejected=%d"
+          % (final["submitted"], final["completed"], final["redelivered"],
+             final["evictions"], final["registered"], final["left"],
+             final["rejected"]))
+    if failures:
+        print("\nRESULT: FAIL")
+        for f in failures:
+            print(" - %s" % f)
+        return 10
+    print("\nRESULT: SURVIVED — a SIGKILLed replica lost zero requests "
+          "and zero tokens (byte-identical greedy streams vs the "
+          "uninterrupted run) while the liveness rule respawned it; the "
+          "admission backlog fired scale_up and provably recovered; "
+          "scale_down drained a live replica losslessly into "
+          "retirement — all asserted from the fleet.* / mxctl.* journal.")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="run the test suite under a seeded fault spec")
@@ -2506,6 +2839,17 @@ def main(argv=None):
                          "cratered spec-accept window fires the mxctl "
                          "rollback_weights rule — all asserted from "
                          "the wsync journal records and counters")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the mxfleet serving-fleet survival legs "
+                         "(ISSUE 20): SIGKILL 1 of 4 replicas mid-decode "
+                         "— zero lost requests, byte-identical greedy "
+                         "streams vs an uninterrupted run, redeliveries "
+                         "trace-paired with their re-placements, and the "
+                         "liveness rule respawns the replica; a load "
+                         "ramp fires the scale_up rule and the backlog "
+                         "SLO provably recovers; scale_down drains a "
+                         "replica losslessly into retirement — all "
+                         "asserted from the fleet.*/mxctl.* journal")
     ap.add_argument("--controller-legs", default="all",
                     metavar="LEGS",
                     help="comma subset of the --controller legs: "
@@ -2514,6 +2858,8 @@ def main(argv=None):
                     help="explicit test paths (default: smoke set)")
     args = ap.parse_args(argv)
 
+    if args.fleet:
+        return run_fleet(args)
     if args.wsync:
         return run_wsync(args)
     if args.controller:

@@ -35,6 +35,12 @@ simply not compared):
                     inside a wsync hot-swap window (lower is better; held
                     within 1.10x of a no-sync ``ttft_p99_s`` baseline by
                     tools/baselines/wsync_perf.json)
+``fleet_tokens_per_s``  max ``fleet.tokens_per_s`` — the router's
+                    aggregate delivered rate across the replica set
+                    (bench_serve --fleet)
+``fleet_ttft_p99_s``  ``fleet.ttft_s`` p99 — router-side submit to
+                    first token, queueing + placement included (lower
+                    is better)
 ``mfu``             last ``prof.mfu`` (mxprof derived, prof.py)
 ``peak_hbm_bytes``  max ``prof.hbm_peak_bytes`` (lower is better)
 ``recompiles_total``  ``compile.recompiles_total`` final counter — unexpected
@@ -66,6 +72,7 @@ import sys
 LOWER_IS_BETTER = frozenset((
     "step_p50_s", "prof_step_p50_s", "peak_hbm_bytes", "cold_start_jit_s",
     "ttft_p99_s", "ttft_sync_p99_s", "recompiles_total",
+    "fleet_ttft_p99_s",
 ))
 
 #: metrics gated even when the baseline is 0: a ratio band can't hold a
@@ -77,7 +84,8 @@ ZERO_GATED = frozenset(("recompiles_total",))
 _BENCH_FIELDS = ("mfu", "tokens_per_s", "step_p50_s", "samples_per_sec",
                  "peak_hbm_bytes", "prof_step_p50_s", "ttft_p99_s",
                  "ttft_sync_p99_s", "spec_accept_rate",
-                 "recompiles_total", "jit_cache_hit_rate")
+                 "recompiles_total", "jit_cache_hit_rate",
+                 "fleet_tokens_per_s", "fleet_ttft_p99_s")
 
 
 def load_journal(path):
@@ -125,9 +133,15 @@ def derive_metrics(records):
         g = final.get("gauges", {}).get("serving.spec_accept_rate")
         if g is not None:
             out["spec_accept_rate"] = float(g)
+        # fleet latency headline: router-side submit->first-token p99
+        # across the replica set (mxfleet, bench_serve --fleet)
+        h = final.get("histograms", {}).get("fleet.ttft_s")
+        if h and h.get("p99") is not None:
+            out["fleet_ttft_p99_s"] = float(h["p99"])
     for gauge, name, agg in (
             ("train.samples_per_sec", "samples_per_sec", max),
             ("serving.tokens_per_s", "tokens_per_s", max),
+            ("fleet.tokens_per_s", "fleet_tokens_per_s", max),
             ("prof.hbm_peak_bytes", "peak_hbm_bytes", max)):
         vals = [float(s.get("gauges", {}).get(gauge))
                 for s in snapshots
